@@ -1,0 +1,57 @@
+"""nnstreamer_tpu — a TPU-native streaming AI pipeline framework.
+
+A ground-up re-design of the NNStreamer capability surface
+(reference: suehdn/nnstreamer; see SURVEY.md) for TPU hardware:
+
+* gst-launch-style declarative pipelines of tensor elements
+  (``tensor_converter``, ``tensor_transform``, ``tensor_filter``,
+  ``tensor_decoder``, mux/demux/if/crop/aggregator, query/edge distribution,
+  on-device training),
+* executed by an async stage executor whose device stages are **fused into
+  single jitted XLA programs** with buffers resident in HBM between stages,
+* models dispatched through JAX/PJRT instead of per-vendor NPU SDKs,
+* multi-chip scale via ``jax.sharding`` meshes + XLA collectives over ICI,
+  multi-host feed over DCN/gRPC instead of TCP/MQTT.
+
+Quick start::
+
+    import nnstreamer_tpu as nt
+
+    pipe = nt.parse_launch(
+        "appsrc name=src ! tensor_converter ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax model=mobilenet_v1 ! "
+        "tensor_decoder mode=image_labeling labels=imagenet ! tensor_sink name=out"
+    )
+    with nt.Pipeline(pipe) as p:
+        p.push("src", frame)            # numpy HWC uint8 frame
+        label = p.pull("out")
+"""
+
+from .core.types import (  # noqa: F401
+    TENSOR_COUNT_LIMIT,
+    TENSOR_RANK_LIMIT,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+    dtype_from_name,
+    dtype_name,
+    parse_dims,
+)
+from .core.buffer import Buffer, Event  # noqa: F401
+from .core.caps import Caps, MediaType  # noqa: F401
+from .core import registry  # noqa: F401
+from .core.registry import (  # noqa: F401
+    register_converter,
+    register_decoder,
+    register_element,
+    register_filter,
+    register_trainer,
+)
+from .pipeline.parser import parse as parse_launch  # noqa: F401
+from .pipeline.parser import ParseError  # noqa: F401
+from .pipeline.graph import PipelineGraph  # noqa: F401
+from .pipeline.runtime import Pipeline  # noqa: F401
+from .elements.filter import SingleShot  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,131 @@
+"""Stream buffers: the unit of data flowing between pipeline stages.
+
+Reference analog: ``GstBuffer`` carrying N ``GstMemory`` chunks, one per
+tensor, plus pts/duration metadata (``gst/nnstreamer/tensor_common.c``,
+upstream-reconstructed — see SURVEY.md).
+
+TPU-first difference: a chunk's payload may be **either** a host numpy array
+**or** a ``jax.Array`` already resident in HBM.  Fused device stages pass
+device arrays straight through (the zero-copy requirement of the north star —
+the reference's CUDA ``cudaMallocManaged`` path in tensor_filter_tensorrt.cc
+becomes "stay in HBM between compiled stages").  Host boundaries
+(ingest/overlay out) are the only places `device_put`/`device_get` happen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .types import TensorFormat, TensorSpec, TensorsSpec
+
+_seq = itertools.count()
+
+
+def _is_device_array(x) -> bool:
+    # jax.Array without importing jax at module import time (keeps core light).
+    return type(x).__module__.startswith("jax") or hasattr(x, "addressable_shards")
+
+
+@dataclasses.dataclass
+class Buffer:
+    """One pipeline buffer: a tuple of tensors + timing + metadata.
+
+    ``tensors`` entries are numpy arrays or jax Arrays.  ``spec`` describes
+    them; for FLEXIBLE streams it is derived per-buffer.  ``pts`` is the
+    presentation timestamp in nanoseconds (reference: GST_BUFFER_PTS);
+    ``meta`` carries cross-element metadata (e.g. the query client id, the
+    crop-region info — reference: GstMeta).
+    """
+
+    tensors: List[Any]
+    spec: Optional[TensorsSpec] = None
+    pts: Optional[int] = None
+    duration: Optional[int] = None
+    seqno: int = dataclasses.field(default_factory=lambda: next(_seq))
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.spec is None:
+            self.spec = TensorsSpec.of(self.tensors)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def of(cls, *arrays, pts: Optional[int] = None, **meta) -> "Buffer":
+        return cls(list(arrays), pts=pts, meta=dict(meta))
+
+    # -- views -------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.tensors)
+
+    def __getitem__(self, i: int):
+        return self.tensors[i]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(t.nbytes) for t in self.tensors)
+
+    @property
+    def on_device(self) -> bool:
+        return all(_is_device_array(t) for t in self.tensors)
+
+    # -- transforms --------------------------------------------------------
+    def with_tensors(self, tensors: Sequence[Any], spec: Optional[TensorsSpec] = None) -> "Buffer":
+        """New buffer with same timing/meta but different payload."""
+        return Buffer(
+            list(tensors),
+            spec=spec,
+            pts=self.pts,
+            duration=self.duration,
+            seqno=self.seqno,
+            meta=dict(self.meta),
+        )
+
+    def to_host(self) -> "Buffer":
+        arrs = [np.asarray(t) for t in self.tensors]
+        return self.with_tensors(arrs)
+
+    def to_device(self, device=None, sharding=None) -> "Buffer":
+        import jax
+
+        if sharding is not None:
+            arrs = [jax.device_put(t, sharding) for t in self.tensors]
+        elif device is not None:
+            arrs = [jax.device_put(t, device) for t in self.tensors]
+        else:
+            arrs = [jax.device_put(t) for t in self.tensors]
+        return self.with_tensors(arrs)
+
+    def block_until_ready(self) -> "Buffer":
+        for t in self.tensors:
+            if hasattr(t, "block_until_ready"):
+                t.block_until_ready()
+        return self
+
+
+@dataclasses.dataclass
+class Event:
+    """In-band stream event (reference: GstEvent — EOS, segment, caps)."""
+
+    kind: str  # "eos" | "caps" | "segment" | "flush" | "error"
+    payload: Any = None
+
+    @classmethod
+    def eos(cls) -> "Event":
+        return cls("eos")
+
+    @classmethod
+    def caps(cls, spec: TensorsSpec) -> "Event":
+        return cls("caps", spec)
+
+    @classmethod
+    def error(cls, exc: BaseException) -> "Event":
+        return cls("error", exc)
+
+
+def now_ns() -> int:
+    return time.monotonic_ns()

@@ -1,0 +1,98 @@
+"""Global configuration registry.
+
+Reference analog: ``gst/nnstreamer/nnstreamer_conf.c`` + ``nnstreamer.ini``
+(sub-plugin search paths, per-framework priority for ``framework=auto``,
+env-var overrides NNSTREAMER_CONF/FILTERS/DECODERS/CONVERTERS) —
+upstream-reconstructed, SURVEY.md §5.6.
+
+TPU build: one dataclass, populated from (in priority order) explicit set() >
+environment > ini file (``NNS_TPU_CONF`` path, default ``~/.nnstreamer_tpu.ini``)
+> defaults.  Sub-plugin discovery is module-import based (see registry.py), so
+"paths" become module lists.
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_ENV_CONF = "NNS_TPU_CONF"
+_ENV_PLUGINS = "NNS_TPU_PLUGINS"
+_ENV_FW_PRIORITY = "NNS_TPU_FILTER_PRIORITY"
+
+
+@dataclasses.dataclass
+class Config:
+    #: extra plugin modules to import at registry init (comma/colon separated env)
+    plugin_modules: List[str] = dataclasses.field(default_factory=list)
+    #: framework priority for tensor_filter framework=auto
+    filter_priority: List[str] = dataclasses.field(
+        default_factory=lambda: ["jax", "custom-easy", "python3"]
+    )
+    #: default queue capacity between pipeline stages (buffers)
+    queue_capacity: int = 4
+    #: pad flexible shapes up to the next bucket to bound XLA recompiles
+    shape_bucketing: bool = True
+    #: emit per-stage latency measurements
+    enable_latency: bool = True
+    #: free-form per-framework options ([filter-jax] section of the ini)
+    framework_options: Dict[str, Dict[str, str]] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def load(cls) -> "Config":
+        cfg = cls()
+        path = os.environ.get(_ENV_CONF, os.path.expanduser("~/.nnstreamer_tpu.ini"))
+        if path and os.path.exists(path):
+            ini = configparser.ConfigParser()
+            ini.read(path)
+            if ini.has_option("common", "plugin_modules"):
+                cfg.plugin_modules = _split(ini.get("common", "plugin_modules"))
+            if ini.has_option("filter", "priority"):
+                cfg.filter_priority = _split(ini.get("filter", "priority"))
+            if ini.has_option("common", "queue_capacity"):
+                cfg.queue_capacity = ini.getint("common", "queue_capacity")
+            for sec in ini.sections():
+                if sec.startswith("filter-"):
+                    cfg.framework_options[sec[len("filter-"):]] = dict(ini.items(sec))
+        if os.environ.get(_ENV_PLUGINS):
+            cfg.plugin_modules = _split(os.environ[_ENV_PLUGINS])
+        if os.environ.get(_ENV_FW_PRIORITY):
+            cfg.filter_priority = _split(os.environ[_ENV_FW_PRIORITY])
+        return cfg
+
+
+def _split(s: str) -> List[str]:
+    out = []
+    for part in s.replace(":", ",").split(","):
+        part = part.strip()
+        if part:
+            out.append(part)
+    return out
+
+
+_config: Optional[Config] = None
+_lock = threading.Lock()
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        with _lock:
+            if _config is None:
+                _config = Config.load()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    with _lock:
+        _config = cfg
+
+
+def reset_config() -> None:
+    global _config
+    with _lock:
+        _config = None

@@ -1,0 +1,95 @@
+"""Structured logging (reference analog: nnstreamer_log.c nns_logi/logw/loge).
+
+Also hosts the lightweight metrics counter set promised by SURVEY.md §5.5:
+frames in/out, queue depths, bytes moved, per-stage latency percentiles are
+recorded in-process and dumped on demand — the reference had only GST debug
+categories plus tensor_filter's latency property.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_FMT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+_configured = False
+
+
+def logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("NNS_TPU_LOG", "WARNING").upper()
+        logging.basicConfig(level=getattr(logging, level, logging.WARNING), format=_FMT)
+        _configured = True
+    return logging.getLogger(name)
+
+
+class Metrics:
+    """Process-wide counters + latency reservoirs, thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = collections.defaultdict(float)
+        self._lat: Dict[str, List[float]] = collections.defaultdict(list)
+        self._lat_cap = 4096
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def observe_latency(self, name: str, seconds: float) -> None:
+        with self._lock:
+            r = self._lat[name]
+            if len(r) >= self._lat_cap:
+                # reservoir decimation: keep every other sample
+                del r[::2]
+            r.append(seconds)
+
+    def percentile(self, name: str, q: float) -> Optional[float]:
+        with self._lock:
+            r = sorted(self._lat.get(name, ()))
+        if not r:
+            return None
+        idx = min(len(r) - 1, max(0, math.ceil(q / 100.0 * len(r)) - 1))
+        return r[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            for name, r in self._lat.items():
+                if r:
+                    s = sorted(r)
+                    out[f"{name}.p50"] = s[len(s) // 2]
+                    out[f"{name}.p99"] = s[min(len(s) - 1, int(len(s) * 0.99))]
+                    out[f"{name}.mean"] = sum(s) / len(s)
+                    out[f"{name}.n"] = float(len(s))
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._lat.clear()
+
+
+metrics = Metrics()
+
+
+class Timer:
+    """Context manager feeding a Metrics latency series."""
+
+    def __init__(self, name: str, m: Metrics = metrics):
+        self.name = name
+        self.m = m
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.m.observe_latency(self.name, time.perf_counter() - self.t0)
+        return False

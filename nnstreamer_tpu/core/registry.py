@@ -1,0 +1,161 @@
+"""Sub-plugin and element registries.
+
+Reference analog: ``gst/nnstreamer/nnstreamer_subplugin.c`` (name->vtable hash
+per sub-plugin class, lazy dlopen from configured paths) plus GStreamer's
+element factory registry (upstream-reconstructed; SURVEY.md §2.1).
+
+TPU-first translation: sub-plugins are Python classes registered under a
+(kind, name) key via decorators; "lazy dlopen" becomes lazy import of the
+built-in plugin modules on first lookup, plus user modules listed in
+config/env (``NNS_TPU_PLUGINS=pkg.mod:pkg2.mod2``).  Entry-point discovery
+keeps the reference's "drop a .so in a directory" extensibility without
+dynamic linking.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
+
+from .config import get_config
+from .log import logger
+
+log = logger(__name__)
+
+# Sub-plugin kinds (reference: NNS_SUBPLUGIN_FILTER / _DECODER / _CONVERTER / _TRAINER).
+KIND_ELEMENT = "element"
+KIND_FILTER = "filter"
+KIND_DECODER = "decoder"
+KIND_CONVERTER = "converter"
+KIND_TRAINER = "trainer"
+
+_registry: Dict[Tuple[str, str], type] = {}
+_aliases: Dict[Tuple[str, str], str] = {}
+_lock = threading.RLock()
+_builtins_loaded = False
+
+#: Modules imported lazily on first lookup; each registers its plugins at
+#: import time (the analog of .so constructors calling nnstreamer_filter_probe).
+_BUILTIN_MODULES = [
+    "nnstreamer_tpu.elements.source",
+    "nnstreamer_tpu.elements.converter",
+    "nnstreamer_tpu.elements.transform",
+    "nnstreamer_tpu.elements.filter",
+    "nnstreamer_tpu.elements.decoder",
+    "nnstreamer_tpu.elements.routing",
+    "nnstreamer_tpu.elements.aggregator",
+    "nnstreamer_tpu.elements.sink",
+    "nnstreamer_tpu.elements.repo",
+    "nnstreamer_tpu.elements.sparse",
+    "nnstreamer_tpu.elements.rate",
+    "nnstreamer_tpu.elements.crop",
+    "nnstreamer_tpu.elements.cond",
+    "nnstreamer_tpu.elements.debug",
+    "nnstreamer_tpu.elements.query",
+    "nnstreamer_tpu.elements.edge",
+    "nnstreamer_tpu.elements.datarepo",
+    "nnstreamer_tpu.elements.trainer",
+    "nnstreamer_tpu.filters.custom_easy",
+    "nnstreamer_tpu.filters.jax_fw",
+    "nnstreamer_tpu.filters.python3",
+    "nnstreamer_tpu.filters.llm",
+    "nnstreamer_tpu.decoders.image_labeling",
+    "nnstreamer_tpu.decoders.bounding_boxes",
+    "nnstreamer_tpu.decoders.pose",
+    "nnstreamer_tpu.decoders.image_segment",
+    "nnstreamer_tpu.decoders.direct_video",
+    "nnstreamer_tpu.decoders.serialize",
+    "nnstreamer_tpu.converters.serialize",
+    "nnstreamer_tpu.trainer.subplugin",
+]
+
+
+def register(kind: str, name: str, cls=None, *, aliases: Iterable[str] = ()):
+    """Register ``cls`` under (kind, name).  Usable as a decorator:
+
+    >>> @register(KIND_FILTER, "custom-easy")
+    ... class CustomEasy: ...
+    """
+
+    def do(c):
+        with _lock:
+            key = (kind, name)
+            if key in _registry and _registry[key] is not c:
+                log.debug("re-registering %s/%s", kind, name)
+            _registry[key] = c
+            for a in aliases:
+                _aliases[(kind, a)] = name
+        return c
+
+    return do(cls) if cls is not None else do
+
+
+def register_element(name: str, cls=None, **kw):
+    return register(KIND_ELEMENT, name, cls, **kw)
+
+
+def register_filter(name: str, cls=None, **kw):
+    return register(KIND_FILTER, name, cls, **kw)
+
+
+def register_decoder(name: str, cls=None, **kw):
+    return register(KIND_DECODER, name, cls, **kw)
+
+
+def register_converter(name: str, cls=None, **kw):
+    return register(KIND_CONVERTER, name, cls, **kw)
+
+
+def register_trainer(name: str, cls=None, **kw):
+    return register(KIND_TRAINER, name, cls, **kw)
+
+
+def _ensure_builtins():
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    with _lock:
+        if _builtins_loaded:
+            return
+        _builtins_loaded = True  # set first: modules may look things up
+        for mod in _BUILTIN_MODULES + get_config().plugin_modules:
+            try:
+                importlib.import_module(mod)
+            except ImportError as e:
+                # Module file simply absent (not yet built / optional): fine.
+                # Module EXISTS but failed to import: that's a real bug whose
+                # elements would silently vanish — surface it loudly.
+                if e.name == mod:
+                    log.debug("plugin module %s absent: %s", mod, e)
+                else:
+                    raise
+
+
+def lookup(kind: str, name: str) -> Optional[type]:
+    _ensure_builtins()
+    with _lock:
+        key = (kind, name)
+        if key in _aliases:
+            key = (kind, _aliases[key])
+        return _registry.get(key)
+
+
+def get(kind: str, name: str) -> type:
+    cls = lookup(kind, name)
+    if cls is None:
+        raise KeyError(
+            f"no {kind} sub-plugin named {name!r}; known: {sorted(names(kind))}"
+        )
+    return cls
+
+
+def names(kind: str) -> List[str]:
+    _ensure_builtins()
+    with _lock:
+        return sorted(n for k, n in _registry if k == kind)
+
+
+def unregister(kind: str, name: str) -> bool:
+    with _lock:
+        return _registry.pop((kind, name), None) is not None

@@ -1,0 +1,135 @@
+"""Element base classes.
+
+Reference analog: GstElement/GstBaseTransform and the per-element chain
+functions (``gst/nnstreamer/elements/gsttensor_*.c``, upstream-reconstructed —
+SURVEY §2.2).  The TPU redesign splits an element into:
+
+* **negotiation** — :meth:`Element.configure` maps input :class:`Caps` to
+  output Caps once, before streaming starts (GStreamer caps negotiation);
+* **streaming** — :meth:`Element.process` handles one buffer push
+  (the 🔥 chain function);
+* **device stage** — optionally, :meth:`Element.device_fn` exposes the
+  element's math as a pure ``arrays -> arrays`` JAX function so the planner
+  can fuse adjacent elements into ONE jitted XLA program (the capability the
+  reference cannot have; north-star "fused XLA preprocess stages").
+
+Elements that expose ``device_fn`` still implement ``process`` (used in
+unfused/host mode and by unit tests); ``process`` must produce bit-identical
+results to the fused path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+from ..core.buffer import Buffer, Event
+from ..core.caps import Caps
+from ..core.types import TensorsSpec
+
+#: (out_pad, payload) pairs returned from process/finalize.
+Out = List[Tuple[str, Union[Buffer, Event]]]
+
+SRC = "src"
+SINK = "sink"
+
+
+class ElementError(RuntimeError):
+    pass
+
+
+class Element:
+    """Base streaming element."""
+
+    #: registered kind name, set by subclass
+    kind: str = "element"
+    #: multi-input collation policy: "all" waits for a buffer on every
+    #: connected sink pad (mux/merge slowest-sync), "any" processes buffers
+    #: as they arrive (join / single-input elements).
+    sync_policy: str = "any"
+
+    def __init__(self, props: Optional[Dict[str, object]] = None, name: Optional[str] = None):
+        self.props: Dict[str, object] = dict(props or {})
+        self.name = name or self.kind
+        self.in_caps: Dict[str, Caps] = {}
+        self.out_caps: Dict[str, Caps] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """NULL->READY: open resources (reference: element start vmethod)."""
+
+    def stop(self) -> None:
+        """READY->NULL: release resources."""
+
+    # -- negotiation -------------------------------------------------------
+    def configure(self, in_caps: Dict[str, Caps], out_pads: List[str]) -> Dict[str, Caps]:
+        """Map input caps to output caps for each connected out pad.
+
+        Default: passthrough of the (single) input caps to every out pad.
+        """
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        caps = {p: src for p in out_pads}
+        self.out_caps = caps
+        return caps
+
+    # -- streaming ---------------------------------------------------------
+    def process(self, pad: str, buf: Buffer) -> Out:
+        """Handle one input buffer; return downstream pushes."""
+        raise NotImplementedError
+
+    def process_group(self, bufs: Dict[str, Buffer]) -> Out:
+        """Handle one collated buffer-per-pad group (sync_policy == "all")."""
+        raise NotImplementedError
+
+    def on_event(self, pad: str, event: Event) -> Out:
+        """Non-EOS in-band events; default forwards to all out pads."""
+        return [(SRC, event)]
+
+    def finalize(self) -> Out:
+        """All input pads reached EOS: flush buffered state (before EOS is
+        forwarded downstream)."""
+        return []
+
+    # -- fusion ------------------------------------------------------------
+    def device_fn(
+        self, in_spec: TensorsSpec
+    ) -> Optional[Tuple[Callable, TensorsSpec]]:
+        """Return (pure_fn, out_spec) when this element's streaming math can
+        run inside a jitted XLA program.  ``pure_fn`` takes and returns a
+        tuple of jax arrays (one per tensor).  None => host-only element."""
+        return None
+
+    def get_property(self, key: str, default=None):
+        return self.props.get(key, default)
+
+    def __repr__(self):  # pragma: no cover
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SourceElement(Element):
+    """Element with no input pads; drives the pipeline.
+
+    Reference analog: GstBaseSrc (v4l2src/appsrc/videotestsrc...).
+    """
+
+    is_source = True
+
+    def generate(self) -> Iterator[Union[Buffer, Event]]:
+        """Yield buffers; return to signal EOS."""
+        raise NotImplementedError
+
+
+class SinkElement(Element):
+    """Terminal element (reference: GstBaseSink / tensor_sink)."""
+
+    is_sink = True
+
+
+class TransformElement(Element):
+    """1-in/1-out convenience base (reference: GstBaseTransform)."""
+
+    def transform(self, buf: Buffer) -> Buffer:
+        raise NotImplementedError
+
+    def process(self, pad: str, buf: Buffer) -> Out:
+        return [(SRC, self.transform(buf))]

@@ -1,0 +1,186 @@
+"""tensor_converter: media streams -> other/tensors.
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_converter.c``
+(upstream-reconstructed, SURVEY §2.2): video/x-raw, audio/x-raw, text,
+octet-stream (and serialized formats via converter sub-plugins, see
+converters/serialize.py) become tensor buffers.  Replicated behaviors:
+
+* video dims ``C:W:H:N`` (innermost-first) => numpy/JAX shape ``(N,H,W,C)``
+  — NHWC, the TPU-friendly layout, falls straight out of nnstreamer's own
+  dim order;
+* row-stride removal: raw video rows padded to 4-byte boundaries are
+  repacked densely (reference does the same memcpy dance);
+* ``frames-per-tensor``: batch N media frames into one tensor buffer;
+* text/octet reshaped per ``input-dim``/``input-type`` props;
+* ``other/tensors`` passthrough, flexible -> static when spec is known.
+
+Custom converter sub-plugins (flatbuf/protobuf analogs) are looked up in the
+converter registry by ``mode=<name>`` (reference: converter sub-plugins).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType, audio_dtype, video_bpp
+from ..core.registry import KIND_CONVERTER, lookup, register_element
+from ..core.types import TensorFormat, TensorSpec, TensorsSpec, dtype_from_name, parse_fraction
+from .base import Element, ElementError, SRC
+
+
+@register_element("tensor_converter")
+class TensorConverter(Element):
+    kind = "tensor_converter"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.frames_per_tensor = int(self.props.get("frames_per_tensor", 1))
+        self.input_dim = self.props.get("input_dim")
+        self.input_type = str(self.props.get("input_type", "uint8"))
+        self.mode = self.props.get("mode")  # custom converter sub-plugin
+        self._sub = None
+        self._media: Optional[MediaType] = None
+        self._spec: Optional[TensorsSpec] = None
+        self._pending: List[np.ndarray] = []
+
+    # -- negotiation -------------------------------------------------------
+    def configure(self, in_caps: Dict[str, Caps], out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        self._media = src.media if not src.is_any() else None
+        spec: Optional[TensorsSpec] = None
+
+        if self.mode:
+            cls = lookup(KIND_CONVERTER, str(self.mode))
+            if cls is None:
+                raise ElementError(f"unknown converter sub-plugin {self.mode!r}")
+            self._sub = cls(self.props)
+            spec = getattr(self._sub, "out_spec", None)
+        elif self._media == MediaType.VIDEO:
+            fmt = src.get("format", "RGB")
+            w, h = src.get("width"), src.get("height")
+            if isinstance(w, int) and isinstance(h, int) and isinstance(fmt, str):
+                c = video_bpp(fmt)
+                spec = TensorsSpec.single(
+                    TensorSpec((c, w, h, self.frames_per_tensor), np.uint8),
+                    rate=parse_fraction(src.get("framerate", (0, 1))),
+                )
+        elif self._media == MediaType.AUDIO:
+            ch = src.get("channels")
+            if isinstance(ch, int) and self.frames_per_tensor > 1:
+                dt = dtype_from_name(audio_dtype(src.get("format", "S16LE")))
+                spec = TensorsSpec.single(
+                    TensorSpec((ch, self.frames_per_tensor), dt)
+                )
+        elif self._media in (MediaType.OCTET, MediaType.TEXT) or self._media is None:
+            if self.input_dim:
+                spec = TensorsSpec.from_string(str(self.input_dim), self.input_type)
+        elif self._media in (MediaType.TENSORS, MediaType.FLEX_TENSORS):
+            spec = src.spec
+
+        self._spec = spec
+        caps = Caps.tensors(spec)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    # -- streaming ---------------------------------------------------------
+    def process(self, pad, buf: Buffer):
+        if self._sub is not None:
+            return [(SRC, self._sub.convert(buf))]
+        media = self._media
+        if media in (MediaType.TENSORS, MediaType.FLEX_TENSORS, None):
+            return [(SRC, buf)]
+        if media == MediaType.VIDEO:
+            return self._video(buf)
+        if media == MediaType.AUDIO:
+            return self._audio(buf)
+        if media == MediaType.TEXT:
+            return self._text(buf)
+        if media == MediaType.OCTET:
+            return self._octet(buf)
+        raise ElementError(f"unsupported media {media}")
+
+    def _video(self, buf: Buffer):
+        src = next(iter(self.in_caps.values()))
+        fmt = src.get("format", "RGB")
+        c = video_bpp(fmt)
+        w = src.get("width")
+        h = src.get("height")
+        frame = np.asarray(buf.tensors[0])
+        if frame.ndim == 1:  # raw bytes: undo 4-byte row stride padding
+            if w is None or h is None:
+                raise ElementError("raw video bytes need width/height caps")
+            stride = ((w * c + 3) // 4) * 4
+            if frame.size == h * stride:
+                frame = frame.reshape(h, stride)[:, : w * c].reshape(h, w, c)
+            elif frame.size == h * w * c:
+                frame = frame.reshape(h, w, c)
+            else:
+                raise ElementError(
+                    f"video buffer size {frame.size} matches neither dense "
+                    f"{h*w*c} nor strided {h*stride}"
+                )
+        if frame.ndim == 2:  # GRAY
+            frame = frame[:, :, None]
+        if self.frames_per_tensor == 1:
+            return [(SRC, buf.with_tensors([frame[None]], spec=self._spec))]
+        self._pending.append(frame)
+        if len(self._pending) < self.frames_per_tensor:
+            return []
+        batch = np.stack(self._pending)
+        self._pending = []
+        return [(SRC, buf.with_tensors([batch], spec=self._spec))]
+
+    def _audio(self, buf: Buffer):
+        samples = np.asarray(buf.tensors[0])  # (S, C) interleaved
+        if samples.ndim == 1:
+            samples = samples[:, None]
+        if self.frames_per_tensor <= 1:
+            return [(SRC, buf.with_tensors([samples]))]
+        self._pending.append(samples)
+        total = sum(len(p) for p in self._pending)
+        outs = []
+        if total >= self.frames_per_tensor:
+            cat = np.concatenate(self._pending)
+            n = self.frames_per_tensor
+            while len(cat) >= n:
+                outs.append((SRC, buf.with_tensors([cat[:n]], spec=self._spec)))
+                cat = cat[n:]
+            self._pending = [cat] if len(cat) else []
+        return outs
+
+    def _text(self, buf: Buffer):
+        raw = buf.tensors[0]
+        if isinstance(raw, str):
+            data = np.frombuffer(raw.encode("utf-8"), np.uint8)
+        elif isinstance(raw, np.ndarray) and raw.dtype.kind in "US":
+            data = np.frombuffer(str(raw).encode("utf-8"), np.uint8)
+        else:
+            data = np.asarray(raw, np.uint8).ravel()
+        if self._spec is not None:
+            size = self._spec[0].count
+            out = np.zeros(size, np.uint8)
+            out[: min(size, data.size)] = data[:size]
+            data = out.reshape(self._spec[0].shape)
+        return [(SRC, buf.with_tensors([data], spec=self._spec))]
+
+    def _octet(self, buf: Buffer):
+        data = np.asarray(buf.tensors[0])
+        if self._spec is None:
+            raise ElementError("octet-stream conversion needs input-dim/input-type")
+        spec = self._spec[0]
+        arr = data.ravel().view(spec.dtype)
+        n = spec.count
+        outs = []
+        for off in range(0, arr.size - n + 1, n):
+            chunk = arr[off : off + n].reshape(spec.shape)
+            outs.append((SRC, buf.with_tensors([chunk], spec=self._spec)))
+        return outs
+
+    def finalize(self):
+        if self._pending and self._media == MediaType.VIDEO:
+            pass  # incomplete batch dropped, as the reference drops partials
+        return []

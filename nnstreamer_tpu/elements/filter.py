@@ -1,0 +1,197 @@
+"""tensor_filter: run a model as a stream element.
+
+Reference analog: ``gst/nnstreamer/tensor_filter/gsttensor_filter.c`` +
+``tensor_filter_common.c`` (SURVEY §2.3): framework selection (``auto`` walks
+the configured priority list), model load at READY, input/output dims from
+props or queried from the framework, per-invoke latency/throughput
+measurement, ``invoke-dynamic`` flexible output, input/output combination
+remapping.  The single-shot no-pipeline path (gsttensor_filter_single.c) is
+:class:`SingleShot` below.
+
+TPU-first: when the chosen framework exposes a pure JAX function, the
+planner fuses this element with its preprocess/postprocess neighbors into
+one jitted XLA program, and buffers stay in HBM across the whole fused span
+(the north star's PJRT zero-copy requirement).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.config import get_config
+from ..core.log import Timer, logger, metrics
+from ..core.registry import KIND_FILTER, get as registry_get, lookup, names, register_element
+from ..core.types import TensorFormat, TensorsSpec
+from ..filters.base import Framework, FrameworkError, parse_accelerator
+from .base import Element, ElementError, SRC
+
+log = logger(__name__)
+
+
+def _load_framework(props: Dict[str, object]) -> Framework:
+    """framework= name or 'auto' (priority list from config)."""
+    fw_name = str(props.get("framework", "auto")).lower()
+    candidates = (
+        get_config().filter_priority if fw_name in ("auto", "") else [fw_name]
+    )
+    last_err: Optional[Exception] = None
+    for cand in candidates:
+        cls = lookup(KIND_FILTER, cand)
+        if cls is None:
+            last_err = KeyError(f"framework {cand!r} not registered")
+            continue
+        fw: Framework = cls()
+        try:
+            fw.open(props)
+            return fw
+        except FrameworkError as e:
+            last_err = e
+            continue
+    raise ElementError(
+        f"no framework could open model {props.get('model')!r} "
+        f"(tried {candidates}): {last_err}"
+    )
+
+
+@register_element("tensor_filter")
+class TensorFilter(Element):
+    kind = "tensor_filter"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.fw: Optional[Framework] = None
+        self.accelerators = parse_accelerator(str(self.props.get("accelerator", "")))
+        self.invoke_dynamic = bool(self.props.get("invoke_dynamic", False))
+        self.latency_report = bool(self.props.get("latency", get_config().enable_latency))
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
+        self._lat_ema: Optional[float] = None
+        self._n_invoked = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._ensure_fw()
+
+    def _ensure_fw(self) -> Framework:
+        if self.fw is None:
+            self.fw = _load_framework(self.props)
+        return self.fw
+
+    def stop(self) -> None:
+        if self.fw is not None:
+            self.fw.close()
+            self.fw = None
+
+    # -- negotiation -------------------------------------------------------
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        fw = self._ensure_fw()
+        fw_in, fw_out = fw.get_model_info()
+
+        # explicit props override / fill in what the fw doesn't know
+        if self.props.get("input"):
+            fw_in = TensorsSpec.from_string(
+                str(self.props["input"]), str(self.props.get("inputtype", "float32"))
+            )
+        if self.props.get("output"):
+            fw_out = TensorsSpec.from_string(
+                str(self.props["output"]), str(self.props.get("outputtype", "float32"))
+            )
+        src = next(iter(in_caps.values()), Caps.any())
+        up_spec = src.spec
+        if fw_in is None:
+            fw_in = up_spec
+        elif up_spec is not None and not up_spec.is_flexible:
+            if len(up_spec) != len(fw_in) or not all(
+                a.is_compatible(b) for a, b in zip(up_spec, fw_in)
+            ):
+                raise ElementError(
+                    f"{self.name}: upstream spec {up_spec} does not match model "
+                    f"input {fw_in}"
+                )
+        self._in_spec = fw_in
+        if fw_in is not None:
+            fw.set_input_spec(fw_in)
+            if fw_out is None:
+                fw_in2, fw_out = fw.get_model_info()
+        self._out_spec = fw_out
+        fmt = TensorFormat.FLEXIBLE if self.invoke_dynamic else TensorFormat.STATIC
+        if fw_out is not None:
+            fw_out = fw_out.replace(format=fmt)
+        caps = Caps.tensors(fw_out)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    # -- streaming ---------------------------------------------------------
+    def process(self, pad, buf: Buffer):
+        fw = self._ensure_fw()
+        t0 = time.perf_counter()
+        outs = fw.invoke(buf.tensors)
+        dt = time.perf_counter() - t0
+        self._n_invoked += 1
+        if self.latency_report:
+            metrics.observe_latency(f"{self.name}.invoke", dt)
+            self._lat_ema = dt if self._lat_ema is None else 0.9 * self._lat_ema + 0.1 * dt
+        spec = self._out_spec if not self.invoke_dynamic else None
+        return [(SRC, buf.with_tensors(list(outs), spec=spec))]
+
+    # -- fusion ------------------------------------------------------------
+    def device_fn(self, in_spec: TensorsSpec):
+        fw = self._ensure_fw()
+        fn = fw.pure_fn()
+        if fn is None or self.invoke_dynamic:
+            return None
+        out_spec = self._out_spec
+        if out_spec is None:
+            _, out_spec = fw.get_model_info()
+        if out_spec is None:
+            return None
+        return fn, out_spec
+
+    # -- introspection (reference: latency/throughput read-only props) -----
+    @property
+    def latency(self) -> Optional[float]:
+        """Moving-average seconds per invoke."""
+        return self._lat_ema
+
+    @property
+    def throughput(self) -> Optional[float]:
+        return (1.0 / self._lat_ema) if self._lat_ema else None
+
+
+class SingleShot:
+    """Invoke a filter without a pipeline.
+
+    Reference analog: ``gsttensor_filter_single.c`` — the basis of the
+    external ML C-API's ``ml_single_open``/``ml_single_invoke`` (SURVEY §3.5).
+
+    >>> s = SingleShot(framework="jax", model="mobilenet_v1")
+    >>> out = s.invoke(np.zeros((1, 224, 224, 3), np.float32))
+    """
+
+    def __init__(self, framework: str = "auto", model: object = "", **props):
+        p = dict(props)
+        p["framework"] = framework
+        p["model"] = model
+        self.fw = _load_framework(p)
+        self.in_spec, self.out_spec = self.fw.get_model_info()
+
+    def invoke(self, *arrays) -> List[np.ndarray]:
+        if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+            arrays = tuple(arrays[0])
+        outs = self.fw.invoke(list(arrays))
+        return [np.asarray(o) for o in outs]
+
+    def close(self) -> None:
+        self.fw.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,140 @@
+"""Sink elements.
+
+Reference analogs: ``tensor_sink`` (gsttensor_sink.c — appsink-like terminal
+emitting new-data signals), ``fakesink``, ``filesink`` (SURVEY §2.2, §4:
+"tensor_sink + checksum/golden compare as deterministic sink").
+
+``tensor_sink`` is where device buffers come home: ``pop()`` returns host
+numpy arrays by default (one `device_get` at the pipeline edge), or the raw
+jax Arrays with ``to_host=False`` for zero-copy handoff into app JAX code.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.log import metrics
+from ..core.registry import register_element
+from .base import SinkElement
+
+
+@register_element("tensor_sink")
+class TensorSink(SinkElement):
+    """Terminal sink with app-facing pull queue + callbacks.
+
+    Props: ``max-buffers`` (queue bound; oldest dropped when exceeded and
+    ``drop=true``), ``emit-signals`` kept for reference familiarity.
+    """
+
+    kind = "tensor_sink"
+    sync_policy = "any"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        cap = int(self.props.get("max_buffers", 1024))
+        self.drop = bool(self.props.get("drop", False))
+        self._q: _queue.Queue = _queue.Queue(maxsize=cap)
+        self._callbacks: List[Callable[[Buffer], None]] = []
+        self.to_host = bool(self.props.get("to_host", True))
+
+    def connect_new_data(self, cb: Callable[[Buffer], None]) -> None:
+        """Reference: g_signal_connect(sink, "new-data", ...)."""
+        self._callbacks.append(cb)
+
+    def process(self, pad, buf: Buffer):
+        metrics.count(f"{self.name}.frames")
+        for cb in self._callbacks:
+            cb(buf)
+        stop = getattr(self, "_stop_event", None)
+        while True:
+            try:
+                self._q.put(buf, timeout=0.1)
+                return []
+            except _queue.Full:
+                if self.drop:
+                    try:
+                        self._q.get_nowait()
+                    except _queue.Empty:
+                        pass
+                elif stop is not None and stop.is_set():
+                    return []  # pipeline stopping: shed instead of deadlocking
+                # else: keep blocking — backpressure to the pipeline
+
+    # -- app API -----------------------------------------------------------
+    def pop(self, timeout: float = 30.0, check: Optional[Callable] = None) -> Buffer:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                buf = self._q.get(timeout=0.1)
+                break
+            except _queue.Empty:
+                if check:
+                    check()
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(f"no buffer at sink {self.name!r} in {timeout}s")
+        return buf.to_host() if self.to_host else buf
+
+    def try_pop(self) -> Optional[Buffer]:
+        try:
+            buf = self._q.get_nowait()
+        except _queue.Empty:
+            return None
+        return buf.to_host() if self.to_host else buf
+
+    @property
+    def depth(self) -> int:
+        return self._q.qsize()
+
+
+@register_element("fakesink")
+class FakeSink(SinkElement):
+    """Discard everything (but count it)."""
+
+    kind = "fakesink"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.count = 0
+        self.sync = bool(self.props.get("sync", False))
+        self.last: Optional[Buffer] = None
+
+    def process(self, pad, buf):
+        # Block until device work for this buffer really finished — without
+        # this, "throughput" would measure XLA's async dispatch queue.
+        buf.block_until_ready()
+        self.count += 1
+        self.last = buf
+        metrics.count(f"{self.name}.frames")
+        return []
+
+
+@register_element("filesink")
+class FileSink(SinkElement):
+    """Append raw tensor bytes to a file (reference: filesink in SSAT golden
+    tests)."""
+
+    kind = "filesink"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.location = str(self.props.get("location", "out.bin"))
+        self._f = None
+
+    def start(self):
+        self._f = open(self.location, "wb")
+
+    def stop(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def process(self, pad, buf):
+        for t in buf.tensors:
+            self._f.write(np.asarray(t).tobytes())
+        return []

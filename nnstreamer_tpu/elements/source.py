@@ -1,0 +1,255 @@
+"""Source elements: app feed + deterministic test sources.
+
+Reference analogs: ``appsrc``, ``videotestsrc``, ``audiotestsrc``,
+``filesrc`` (GStreamer base plugins used throughout the reference's SSAT
+suites as deterministic inputs — SURVEY §4), and ``datareposrc`` lives in
+elements/datarepo.py.
+
+TPU-first note: sources are host elements by definition (camera/file/app
+ingest).  They produce host numpy buffers; the first fused device stage
+downstream does one `device_put` per buffer and everything after stays in
+HBM.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Iterator, Optional, Union
+
+import numpy as np
+
+from ..core.buffer import Buffer, Event
+from ..core.caps import Caps, MediaType, parse_caps_string, video_bpp
+from ..core.registry import register_element
+from ..core.types import TensorsSpec, parse_fraction
+from .base import SourceElement, SRC
+
+
+@register_element("appsrc")
+class AppSrc(SourceElement):
+    """Application-driven source: ``pipeline.push(name, array)`` feeds it.
+
+    Props: ``caps`` (caps string describing what the app will push),
+    ``max-buffers`` (feed queue bound), ``block`` (push blocks when full).
+    """
+
+    kind = "appsrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        cap = self.props.get("caps")
+        self._caps = parse_caps_string(str(cap)) if cap else Caps.any()
+        self._q: _queue.Queue = _queue.Queue(maxsize=int(self.props.get("max_buffers", 64)))
+        self._eos = threading.Event()
+
+    def configure(self, in_caps, out_pads):
+        self.out_caps = {p: self._caps for p in out_pads}
+        return self.out_caps
+
+    # -- app API -----------------------------------------------------------
+    def push(self, data, pts: Optional[int] = None) -> None:
+        if self._eos.is_set():
+            raise RuntimeError("appsrc already EOS")
+        if isinstance(data, Buffer):
+            buf = data
+        elif isinstance(data, (list, tuple)):
+            buf = Buffer(list(data), pts=pts)
+        elif isinstance(data, str):
+            buf = Buffer([np.frombuffer(data.encode("utf-8"), np.uint8)], pts=pts)
+        elif isinstance(data, (bytes, bytearray)):
+            buf = Buffer([np.frombuffer(bytes(data), np.uint8)], pts=pts)
+        else:
+            buf = Buffer([np.asarray(data)], pts=pts)
+        self._q.put(buf)
+
+    def signal_eos(self) -> None:
+        self._eos.set()
+
+    def generate(self) -> Iterator[Union[Buffer, Event]]:
+        while True:
+            try:
+                yield self._q.get(timeout=0.05)
+            except _queue.Empty:
+                if self._eos.is_set() and self._q.empty():
+                    return
+
+
+@register_element("videotestsrc")
+class VideoTestSrc(SourceElement):
+    """Deterministic video frames (reference test pipelines' workhorse).
+
+    Props: ``width``, ``height``, ``format`` (RGB/BGR/RGBA/GRAY8),
+    ``num-buffers``, ``pattern`` (``smpte`` gradient, ``ball``, ``black``,
+    ``white``, ``random`` with fixed seed), ``framerate``.
+    """
+
+    kind = "videotestsrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.width = int(self.props.get("width", 320))
+        self.height = int(self.props.get("height", 240))
+        self.format = str(self.props.get("format", "RGB"))
+        self.num_buffers = int(self.props.get("num_buffers", -1))
+        self.pattern = str(self.props.get("pattern", "smpte"))
+        self.rate = parse_fraction(self.props.get("framerate", (30, 1)))
+
+    def configure(self, in_caps, out_pads):
+        caps = Caps.new(
+            MediaType.VIDEO,
+            format=self.format,
+            width=self.width,
+            height=self.height,
+            framerate=self.rate,
+        )
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def _frame(self, i: int) -> np.ndarray:
+        c = video_bpp(self.format)
+        h, w = self.height, self.width
+        if self.pattern == "black":
+            f = np.zeros((h, w, c), np.uint8)
+        elif self.pattern == "white":
+            f = np.full((h, w, c), 255, np.uint8)
+        elif self.pattern == "random":
+            rng = np.random.default_rng(i)
+            f = rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+        elif self.pattern == "ball":
+            f = np.zeros((h, w, c), np.uint8)
+            cy = (i * 7) % h
+            cx = (i * 11) % w
+            yy, xx = np.ogrid[:h, :w]
+            mask = (yy - cy) ** 2 + (xx - cx) ** 2 <= (min(h, w) // 8) ** 2
+            f[mask] = 255
+        else:  # smpte-ish deterministic gradient
+            yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+            base = (xx * 255 // max(1, w - 1) + yy + i) % 256
+            f = np.stack([(base + 85 * k) % 256 for k in range(c)], axis=-1).astype(np.uint8)
+        return f
+
+    def generate(self):
+        num = self.num_buffers if self.num_buffers >= 0 else 1 << 62
+        frame_ns = int(1e9 * self.rate[1] / max(1, self.rate[0]))
+        for i in range(num):
+            yield Buffer([self._frame(i)], pts=i * frame_ns)
+
+
+@register_element("audiotestsrc")
+class AudioTestSrc(SourceElement):
+    """Deterministic audio: sine wave.  Props: ``freq``, ``samplesperbuffer``,
+    ``num-buffers``, ``rate``, ``channels``, ``format`` (S16LE/F32LE/U8)."""
+
+    kind = "audiotestsrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.freq = float(self.props.get("freq", 440.0))
+        self.spb = int(self.props.get("samplesperbuffer", 1024))
+        self.num_buffers = int(self.props.get("num_buffers", -1))
+        self.sample_rate = int(self.props.get("rate", 44100))
+        self.channels = int(self.props.get("channels", 1))
+        self.format = str(self.props.get("format", "S16LE"))
+
+    def configure(self, in_caps, out_pads):
+        caps = Caps.new(
+            MediaType.AUDIO,
+            format=self.format,
+            rate=self.sample_rate,
+            channels=self.channels,
+        )
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def generate(self):
+        num = self.num_buffers if self.num_buffers >= 0 else 1 << 62
+        t0 = 0
+        for i in range(num):
+            n = np.arange(t0, t0 + self.spb, dtype=np.float64)
+            wave = np.sin(2 * np.pi * self.freq * n / self.sample_rate)
+            if self.format == "S16LE":
+                samples = (wave * 32767).astype(np.int16)
+            elif self.format == "U8":
+                samples = ((wave * 0.5 + 0.5) * 255).astype(np.uint8)
+            else:
+                samples = wave.astype(np.float32)
+            frame = np.repeat(samples[:, None], self.channels, axis=1)
+            pts = int(1e9 * t0 / self.sample_rate)
+            t0 += self.spb
+            yield Buffer([frame], pts=pts)
+
+
+@register_element("filesrc")
+class FileSrc(SourceElement):
+    """Whole-file byte source (``application/octet-stream``).
+
+    Props: ``location``, ``blocksize`` (0 = whole file in one buffer).
+    """
+
+    kind = "filesrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.location = str(self.props.get("location", ""))
+        self.blocksize = int(self.props.get("blocksize", 0))
+
+    def configure(self, in_caps, out_pads):
+        caps = Caps.new(MediaType.OCTET)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def generate(self):
+        with open(self.location, "rb") as f:
+            data = f.read()
+        if self.blocksize <= 0:
+            yield Buffer([np.frombuffer(data, np.uint8)])
+            return
+        for off in range(0, len(data), self.blocksize):
+            yield Buffer([np.frombuffer(data[off : off + self.blocksize], np.uint8)])
+
+
+@register_element("tensor_src_iio")
+class TensorSrcIIO(SourceElement):
+    """Industrial-I/O sensor source (reference: gsttensor_srciio.c).
+
+    No IIO bus exists in this environment; the element reads from a
+    pluggable sampler callable (``sampler`` prop or a registered synthetic
+    default) at ``frequency`` Hz, preserving the reference's buffered-scan
+    semantics (``buffer-capacity`` samples per emitted tensor).
+    """
+
+    kind = "tensor_src_iio"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.frequency = float(self.props.get("frequency", 100.0))
+        self.capacity = int(self.props.get("buffer_capacity", 16))
+        self.channels = int(self.props.get("channels", 3))
+        self.num_buffers = int(self.props.get("num_buffers", 16))
+        self.sampler = self.props.get("sampler")  # callable i -> np[channels]
+
+    def configure(self, in_caps, out_pads):
+        spec = TensorsSpec.from_string(
+            f"{self.channels}:{self.capacity}", "float32"
+        )
+        caps = Caps.tensors(spec)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def generate(self):
+        i = 0
+        for _ in range(self.num_buffers):
+            rows = []
+            for _ in range(self.capacity):
+                if callable(self.sampler):
+                    rows.append(np.asarray(self.sampler(i), np.float32))
+                else:
+                    # synthetic: deterministic pseudo-sensor
+                    rows.append(
+                        np.sin(np.arange(self.channels) + i / self.frequency).astype(
+                            np.float32
+                        )
+                    )
+                i += 1
+            yield Buffer([np.stack(rows)])

@@ -1,0 +1,288 @@
+"""tensor_transform: elementwise/layout preprocessing.
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_transform.c``
+(upstream-reconstructed, SURVEY §2.2).  Modes replicated: ``typecast``,
+``arithmetic`` (op chain, e.g. ``typecast:float32,add:-127.5,div:127.5``),
+``transpose``, ``dimchg``, ``clamp``, ``stand`` (standardization),
+``padding``.
+
+TPU-first: every mode is implemented once over a pluggable array namespace
+(numpy for the host path and unit tests, jax.numpy inside fused XLA stages).
+The reference accelerates these loops with ORC SIMD; here the same math is
+traced into the surrounding jitted program, so XLA fuses the normalize chain
+into the model's first conv (the north star's "fused XLA preprocess
+stages") — zero extra HBM round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_element
+from ..core.types import TensorSpec, TensorsSpec, dtype_from_name, dtype_name
+from .base import ElementError, TransformElement, SRC
+
+
+def _np_axis(rank: int, dim_index: int) -> int:
+    """nnstreamer dim index (innermost-first) -> numpy axis (outermost-first)."""
+    return rank - 1 - dim_index
+
+
+@dataclasses.dataclass
+class _ArithOp:
+    name: str  # add|sub|mul|div|pow|typecast
+    value: object = None
+    per_channel_dim: Optional[int] = None  # dim index for vector consts
+
+
+def _promotes_to_float(op: "_ArithOp") -> bool:
+    """Whether applying ``op`` to an integer tensor must lift it to float32.
+
+    Single source of truth for BOTH the spec-derivation path
+    (:meth:`TensorTransform._out_spec_one`) and the data path
+    (:meth:`Ops.arithmetic`) — they must agree or negotiated caps diverge
+    from actual buffer dtypes inside fused stages.
+    """
+    if op.name == "div":
+        return True
+    v = op.value
+    if isinstance(v, float) and not float(v).is_integer():
+        return True
+    if isinstance(v, (list, tuple)) and any(not float(e).is_integer() for e in v):
+        return True
+    return False
+
+
+class Ops:
+    """Mode implementations, parameterized by array namespace ``xp``."""
+
+    @staticmethod
+    def typecast(xp, x, dtype: np.dtype):
+        return x.astype(dtype)
+
+    @staticmethod
+    def arithmetic(xp, x, ops: Sequence[_ArithOp]):
+        for op in ops:
+            if op.name == "typecast":
+                x = x.astype(op.value)
+                continue
+            v = op.value
+            # Deterministic promotion shared by host/device paths: float
+            # constants lift integer tensors to float32 (numpy would pick
+            # float64, jnp float32 — pin one behavior for bit-parity).
+            if np.dtype(x.dtype).kind in "iu":
+                if _promotes_to_float(op):
+                    x = x.astype(np.float32)
+                elif isinstance(v, float):
+                    v = int(v)
+            if op.per_channel_dim is not None and isinstance(v, (list, tuple)):
+                vec = xp.asarray(list(v), dtype=x.dtype if x.dtype.kind == "f" else np.float32)
+                shape = [1] * x.ndim
+                shape[_np_axis(x.ndim, op.per_channel_dim)] = len(v)
+                v = vec.reshape(shape)
+            if op.name == "add":
+                x = x + v
+            elif op.name == "sub":
+                x = x - v
+            elif op.name == "mul":
+                x = x * v
+            elif op.name == "div":
+                x = x / v
+            elif op.name == "pow":
+                x = x**v
+            else:
+                raise ElementError(f"unknown arithmetic op {op.name!r}")
+        return x
+
+    @staticmethod
+    def transpose(xp, x, order: Sequence[int]):
+        r = x.ndim
+        axes = [_np_axis(r, order[_np_axis(r, a)]) for a in range(r)]
+        return xp.transpose(x, axes)
+
+    @staticmethod
+    def dimchg(xp, x, frm: int, to: int):
+        r = x.ndim
+        return xp.moveaxis(x, _np_axis(r, frm), _np_axis(r, to))
+
+    @staticmethod
+    def clamp(xp, x, lo: float, hi: float):
+        return xp.clip(x, lo, hi)
+
+    @staticmethod
+    def stand(xp, x, variant: str, per_channel: bool):
+        xf = x.astype(np.float32)
+        if per_channel:
+            axes = tuple(range(xf.ndim - 1))  # all but channel (innermost dim)
+            mean = xf.mean(axis=axes, keepdims=True)
+            std = xf.std(axis=axes, keepdims=True)
+        else:
+            mean = xf.mean()
+            std = xf.std()
+        if variant == "dc-average":
+            return xf - mean
+        return (xf - mean) / (std + 1e-10)
+
+    @staticmethod
+    def padding(xp, x, pads: Dict[int, Tuple[int, int]]):
+        width = [(0, 0)] * x.ndim
+        for dim, (before, after) in pads.items():
+            if not 0 <= dim < x.ndim:
+                raise ElementError(
+                    f"padding dim {dim} out of range for rank-{x.ndim} tensor"
+                )
+            width[_np_axis(x.ndim, dim)] = (before, after)
+        return xp.pad(x, width)
+
+
+def _parse_arith(option: str) -> List[_ArithOp]:
+    ops: List[_ArithOp] = []
+    for part in option.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ElementError(f"bad arithmetic op {part!r}")
+        name, val = part.split(":", 1)
+        name = name.strip().lower()
+        if name == "typecast":
+            ops.append(_ArithOp("typecast", dtype_from_name(val)))
+            continue
+        ch_dim = None
+        if "@" in val:
+            val, ch = val.rsplit("@", 1)
+            ch_dim = int(ch)
+        vals = [float(v) for v in val.split("|")]
+        value: object = vals if len(vals) > 1 else vals[0]
+        ops.append(_ArithOp(name, value, ch_dim))
+    return ops
+
+
+@register_element("tensor_transform")
+class TensorTransform(TransformElement):
+    kind = "tensor_transform"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.mode = str(self.props.get("mode", "typecast")).lower()
+        self.option = str(self.props.get("option", ""))
+        self._compiled: Optional[Callable] = None
+        self._parse()
+
+    # -- option parsing ----------------------------------------------------
+    def _parse(self) -> None:
+        m, o = self.mode, self.option
+        if m == "typecast":
+            self._dtype = dtype_from_name(o or "float32")
+        elif m == "arithmetic":
+            self._ops = _parse_arith(o)
+        elif m == "transpose":
+            self._order = [int(v) for v in o.split(":") if v != ""]
+        elif m == "dimchg":
+            frm, to = o.split(":")
+            self._frm, self._to = int(frm), int(to)
+        elif m == "clamp":
+            lo, hi = o.split(":")
+            self._lo, self._hi = float(lo), float(hi)
+        elif m == "stand":
+            parts = o.split(":") if o else ["default"]
+            self._variant = parts[0] or "default"
+            self._per_channel = "per-channel" in parts
+        elif m == "padding":
+            self._pads: Dict[int, Tuple[int, int]] = {}
+            for item in o.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                d, b, a = item.split(":")
+                self._pads[int(d)] = (int(b), int(a))
+        else:
+            raise ElementError(f"unknown transform mode {self.mode!r}")
+
+    # -- spec propagation --------------------------------------------------
+    def _out_spec_one(self, spec: TensorSpec) -> TensorSpec:
+        m = self.mode
+        dims, dtype = spec.dims, spec.dtype
+        if m == "typecast":
+            dtype = self._dtype
+        elif m == "arithmetic":
+            for op in self._ops:
+                if op.name == "typecast":
+                    dtype = op.value
+                    continue
+                if dtype.kind in "iu" and _promotes_to_float(op):
+                    dtype = np.dtype(np.float32)
+        elif m == "transpose":
+            order = self._order + list(range(len(self._order), len(dims)))
+            dims = tuple(dims[order[i]] for i in range(len(dims)))
+        elif m == "dimchg":
+            d = list(dims)
+            v = d.pop(self._frm)
+            d.insert(self._to, v)
+            dims = tuple(d)
+        elif m == "stand":
+            dtype = np.dtype(np.float32)
+        elif m == "padding":
+            d = list(dims)
+            for dim, (b, a) in self._pads.items():
+                if not 0 <= dim < len(d):
+                    raise ElementError(
+                        f"padding dim {dim} out of range for rank-{len(d)} tensor"
+                    )
+                d[dim] += b + a
+            dims = tuple(d)
+        return TensorSpec(dims, dtype, spec.name)
+
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        return in_spec.replace(specs=tuple(self._out_spec_one(s) for s in in_spec))
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        spec = src.spec
+        caps = Caps.tensors(self.out_spec(spec) if spec is not None else None)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    # -- math (shared by host + device paths) ------------------------------
+    def _apply(self, xp, x):
+        m = self.mode
+        if m == "typecast":
+            return Ops.typecast(xp, x, self._dtype)
+        if m == "arithmetic":
+            return Ops.arithmetic(xp, x, self._ops)
+        if m == "transpose":
+            order = self._order + list(range(len(self._order), x.ndim))
+            return Ops.transpose(xp, x, order)
+        if m == "dimchg":
+            return Ops.dimchg(xp, x, self._frm, self._to)
+        if m == "clamp":
+            return Ops.clamp(xp, x, self._lo, self._hi)
+        if m == "stand":
+            return Ops.stand(xp, x, self._variant, self._per_channel)
+        if m == "padding":
+            return Ops.padding(xp, x, self._pads)
+        raise ElementError(self.mode)
+
+    def transform(self, buf: Buffer) -> Buffer:
+        outs = [np.asarray(self._apply(np, np.asarray(t))) for t in buf.tensors]
+        spec = None
+        if buf.spec is not None:
+            try:
+                spec = self.out_spec(buf.spec)
+            except Exception:  # pragma: no cover - spec stays derived
+                spec = None
+        return buf.with_tensors(outs, spec=spec)
+
+    def device_fn(self, in_spec: TensorsSpec):
+        import jax.numpy as jnp
+
+        def fn(arrays: Tuple) -> Tuple:
+            return tuple(self._apply(jnp, a) for a in arrays)
+
+        return fn, self.out_spec(in_spec)

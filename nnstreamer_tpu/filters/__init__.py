@@ -1,0 +1,1 @@
+"""nnstreamer_tpu.filters"""

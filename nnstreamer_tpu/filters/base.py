@@ -1,0 +1,101 @@
+"""Framework sub-plugin API for tensor_filter.
+
+Reference analog: ``GstTensorFilterFramework`` vtable in
+``nnstreamer_plugin_api_filter.h`` — open/close/invoke_NN/getInputDimension/
+getOutputDimension/setInputDimension/getModelInfo/eventHandler (SURVEY §2.3).
+Each reference framework (.so per vendor SDK, §2.4) becomes a Python class
+registered under KIND_FILTER; the CUDA/NPU zero-copy paths collapse into the
+single JAX/PJRT framework (filters/jax_fw.py).
+
+Contract:
+
+* :meth:`open` loads the model named by ``props['model']``.
+* :meth:`invoke` maps input arrays -> output arrays (host path; must work on
+  numpy inputs).
+* :meth:`pure_fn` — TPU-first extension — returns a *pure, traceable* JAX
+  function so the planner can fuse the model with surrounding preprocess/
+  postprocess stages into one XLA program.  Frameworks that wrap host-only
+  code (custom callbacks, external runtimes) return None.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.types import TensorsSpec
+
+
+class FrameworkError(RuntimeError):
+    pass
+
+
+class Framework:
+    """Base class for tensor_filter framework sub-plugins."""
+
+    #: registered name, e.g. "jax", "custom-easy"
+    name: str = "base"
+    #: whether invoke() accepts batched leading dim natively
+    handles_batch: bool = True
+
+    def __init__(self):
+        self.props: Dict[str, object] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self, props: Dict[str, object]) -> None:
+        """Load the model; raise FrameworkError when the model prop is
+        unusable (framework=auto uses this to fall through the priority
+        list)."""
+        self.props = dict(props)
+
+    def close(self) -> None:
+        pass
+
+    # -- model metadata ----------------------------------------------------
+    def get_model_info(self) -> Tuple[Optional[TensorsSpec], Optional[TensorsSpec]]:
+        """(input spec, output spec); either may be None when the framework
+        cannot know (then the element's input/output props must say)."""
+        return None, None
+
+    def set_input_spec(self, spec: TensorsSpec) -> None:
+        """Reference setInputDimension: reconfigure for a new input shape."""
+
+    # -- execution ---------------------------------------------------------
+    def invoke(self, inputs: Sequence) -> List:
+        raise NotImplementedError
+
+    def pure_fn(self) -> Optional[Callable]:
+        """Optional pure JAX function ``tuple(arrays) -> tuple(arrays)``."""
+        return None
+
+    # -- events ------------------------------------------------------------
+    def handle_event(self, kind: str, payload=None) -> None:
+        """Reference eventHandler (model reload etc.)."""
+
+
+def parse_custom_options(custom: str) -> Dict[str, str]:
+    """Parse the tensor_filter ``custom=key:val,key2:val2`` option string."""
+    out: Dict[str, str] = {}
+    for part in str(custom or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            k, v = part.split(":", 1)
+            out[k.strip()] = v.strip()
+        else:
+            out[part] = "true"
+    return out
+
+
+def parse_accelerator(acc: str) -> List[str]:
+    """Parse ``accelerator=true:tpu,cpu`` into an ordered device preference
+    list (reference: hw accel string in tensor_filter_common.c)."""
+    s = str(acc or "").strip()
+    if not s or s.lower() in ("false", "none"):
+        return []
+    if ":" in s:
+        flag, devs = s.split(":", 1)
+        if flag.lower() == "false":
+            return []
+        return [d.strip() for d in devs.split(",") if d.strip()]
+    return []

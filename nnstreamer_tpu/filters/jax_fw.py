@@ -1,0 +1,112 @@
+"""The JAX/PJRT framework: TPU-native model execution for tensor_filter.
+
+This is the component the north star names: the replacement for the
+reference's TensorRT/SNPE/EdgeTPU CUDA/NPU sub-plugins
+(``ext/nnstreamer/tensor_filter/tensor_filter_tensorrt.cc`` with its
+``cudaMallocManaged`` zero-copy path — SURVEY §2.4).  Differences by design:
+
+* models are pure JAX programs (from the zoo, an import string, or a bundle
+  object) compiled once by XLA; no per-vendor runtime;
+* zero-copy: invoke keeps outputs as jax Arrays in HBM; when the element is
+  fused (pure_fn), inputs never materialize on host at all;
+* ``accelerator=true:tpu`` etc. maps to jax device selection; bfloat16
+  execution via ``custom=dtype:bfloat16``;
+* batching: the model's leading dim is the batch dim (NHWC video batches map
+  straight onto the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..core.log import logger
+from ..core.registry import register_filter
+from ..core.types import TensorsSpec
+from ..models.zoo import ModelBundle, build as build_model
+from .base import Framework, FrameworkError, parse_custom_options
+
+log = logger(__name__)
+
+
+@register_filter("jax", aliases=("tpu-xla", "xla", "pjrt"))
+class JaxFramework(Framework):
+    name = "jax"
+
+    def __init__(self):
+        super().__init__()
+        self.bundle: Optional[ModelBundle] = None
+        self._jitted: Optional[Callable] = None
+        self._device = None
+
+    def open(self, props):
+        super().open(props)
+        model = props.get("model")
+        if model in (None, ""):
+            raise FrameworkError("jax framework needs model=<zoo name|module:attr>")
+        opts = parse_custom_options(str(props.get("custom", "")))
+        try:
+            self.bundle = build_model(model, opts)
+        except KeyError as e:
+            raise FrameworkError(str(e)) from e
+        except ImportError as e:
+            raise FrameworkError(f"cannot import model {model!r}: {e}") from e
+
+        import jax
+
+        accel = [a.lower() for a in _accel_list(props)]
+        if accel:
+            for kind in accel:
+                devs = [d for d in jax.devices() if kind in d.platform.lower()]
+                if devs:
+                    self._device = devs[0]
+                    break
+
+        apply_fn = self.bundle.apply_fn
+        params = self.bundle.params
+        if self._device is not None:
+            params = jax.device_put(params, self._device)
+            self.bundle.params = params
+
+        def run(*inputs):
+            out = apply_fn(params, *inputs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        self._jitted = jax.jit(run)
+
+    def close(self):
+        self.bundle = None
+        self._jitted = None
+
+    def get_model_info(self):
+        if self.bundle is None:
+            return None, None
+        return self.bundle.in_spec, self.bundle.out_spec
+
+    def invoke(self, inputs) -> List:
+        import jax.numpy as jnp
+
+        arrays = [jnp.asarray(x) for x in inputs]
+        outs = self._jitted(*arrays)
+        return list(outs)
+
+    def pure_fn(self):
+        if self.bundle is None:
+            return None
+        apply_fn = self.bundle.apply_fn
+        params = self.bundle.params
+
+        def fn(arrays):
+            out = apply_fn(params, *arrays)
+            return out if isinstance(out, tuple) else (
+                tuple(out) if isinstance(out, list) else (out,)
+            )
+
+        return fn
+
+
+def _accel_list(props) -> List[str]:
+    from .base import parse_accelerator
+
+    return parse_accelerator(str(props.get("accelerator", "")))

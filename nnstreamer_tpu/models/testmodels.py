@@ -1,0 +1,65 @@
+"""Tiny deterministic zoo models for tests and examples.
+
+Reference analog: the custom example models used by the reference's test
+suites (``custom_example_passthrough/scaler/average`` — SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .zoo import ModelBundle, register_model
+
+
+@register_model("passthrough")
+def _passthrough(opts: Dict[str, str]) -> ModelBundle:
+    dims = opts.get("dims", "3:4:4:1")
+    dtype = opts.get("dtype", "float32")
+    spec = TensorsSpec.from_string(dims, dtype)
+    return ModelBundle(
+        apply_fn=lambda params, *xs: tuple(xs),
+        params=(),
+        in_spec=spec,
+        out_spec=spec,
+        name="passthrough",
+    )
+
+
+@register_model("scaler")
+def _scaler(opts: Dict[str, str]) -> ModelBundle:
+    scale = float(opts.get("scale", 2.0))
+    dims = opts.get("dims", "3:4:4:1")
+    spec = TensorsSpec.from_string(dims, "float32")
+    return ModelBundle(
+        apply_fn=lambda params, x: x * params["scale"],
+        params={"scale": np.float32(scale)},
+        in_spec=spec,
+        out_spec=spec,
+        name="scaler",
+    )
+
+
+@register_model("average")
+def _average(opts: Dict[str, str]) -> ModelBundle:
+    """Mean over all non-batch axes -> one scalar per batch item."""
+    dims = opts.get("dims", "3:4:4:1")
+    in_spec = TensorsSpec.from_string(dims, "float32")
+    n = in_spec[0].dims[-1]
+
+    def apply_fn(params, x):
+        import jax.numpy as jnp
+
+        return jnp.mean(
+            x.astype(jnp.float32), axis=tuple(range(1, x.ndim))
+        ).reshape(n, 1)
+
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=(),
+        in_spec=in_spec,
+        out_spec=TensorsSpec.from_string(f"1:{n}", "float32"),
+        name="average",
+    )

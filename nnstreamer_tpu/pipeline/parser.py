@@ -1,0 +1,226 @@
+"""gst-launch-style pipeline string parser.
+
+Parses the reference's declarative pipeline DSL (the grammar of
+``gst_parse_launch`` / ``tools/development/parser`` upstream — reconstructed,
+SURVEY §2.8) into a :class:`~nnstreamer_tpu.pipeline.graph.PipelineGraph`.
+
+Supported grammar subset (everything the reference's own test pipelines use):
+
+* chains:            ``a ! b ! c``
+* properties:        ``elem key=value key2="quoted value"``
+* caps filters:      ``video/x-raw,format=RGB,width=640,framerate=30/1``
+* named elements:    ``tee name=t``  then branch refs ``t. ! queue ! ...``
+* named pads:        ``mux.sink_0`` / ``demux.src_1``
+* multiple chains separated by starting a new element without ``!``
+
+The parser is deliberately strict: unknown syntax raises ParseError with the
+offending token, because a silently-misparsed pipeline is how streaming bugs
+are born.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core.caps import Caps, parse_caps_string
+from .graph import GraphError, Node, PipelineGraph
+
+
+class ParseError(ValueError):
+    pass
+
+
+_NAME_RE = re.compile(r"^[A-Za-z_][\w\-]*$")
+_PROP_RE = re.compile(r"^([A-Za-z_][\w\-]*)=(.*)$", re.S)
+_REF_RE = re.compile(r"^([A-Za-z_][\w\-]*)\.([\w\-]*)$")
+_CAPS_RE = re.compile(r"^[a-z]+/[\w\-\.\+]+")
+
+
+def _tokenize(text: str) -> List[str]:
+    """Split on whitespace and '!' outside quotes; quoted spans (single or
+    double) keep their content verbatim — including '!' and spaces."""
+    toks: List[str] = []
+    cur: List[str] = []
+    quote: Optional[str] = None
+    for ch in text:
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            else:
+                cur.append(ch)
+            continue
+        if ch in "\"'":
+            quote = ch
+            continue
+        if ch.isspace() or ch == "!":
+            if cur:
+                toks.append("".join(cur))
+                cur = []
+            if ch == "!":
+                toks.append("!")
+            continue
+        cur.append(ch)
+    if quote is not None:
+        raise ParseError(f"unterminated quote in pipeline string: {text!r}")
+    if cur:
+        toks.append("".join(cur))
+    return toks
+
+
+def _coerce(v: str):
+    if len(v) >= 2 and v[0] in "\"'" and v[-1] == v[0]:
+        return v[1:-1]
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    return v
+
+
+def parse(text: str) -> PipelineGraph:
+    """Parse a pipeline description string into a validated PipelineGraph."""
+    toks = _tokenize(text)
+    if not toks:
+        raise ParseError("empty pipeline description")
+
+    g = PipelineGraph()
+    # pending link state
+    prev: Optional[Node] = None
+    prev_pad = "src"
+    want_link = False  # saw '!' and waiting for the next element
+    # deferred name refs: ("name", "pad") we couldn't resolve yet
+    deferred: List[Tuple[str, str, Node, str]] = []  # (name, pad, src_node, src_pad)
+
+    i = 0
+    n = len(toks)
+    while i < n:
+        t = toks[i]
+
+        if t == "!":
+            if prev is None:
+                raise ParseError("'!' with no element before it")
+            if want_link:
+                raise ParseError("two '!' in a row")
+            want_link = True
+            i += 1
+            continue
+
+        ref = _REF_RE.match(t)
+        if ref and not _PROP_RE.match(t):
+            name, pad = ref.group(1), ref.group(2)
+            if want_link:
+                # prev ! name.pad  => link INTO named element's sink pad
+                pad = pad or "sink"
+                target = g.by_name.get(name)
+                if target is None:
+                    deferred.append((name, pad, prev, prev_pad))
+                else:
+                    g.link(prev, target, prev_pad, pad)
+                want_link = False
+                prev, prev_pad = None, "src"
+            else:
+                # chain start: name.pad ! ...  => link FROM named element's src pad
+                target = g.by_name.get(name)
+                if target is None:
+                    raise ParseError(f"reference to unknown element {name!r}")
+                prev = target
+                prev_pad = pad or _next_src_pad(g, target)
+            i += 1
+            continue
+
+        if _CAPS_RE.match(t) and "=" not in t.split(",", 1)[0]:
+            caps = parse_caps_string(t)
+            node = g.add("capsfilter", {}, caps=caps)
+            if want_link:
+                g.link(prev, node, prev_pad, "sink")
+                want_link = False
+            prev, prev_pad = node, "src"
+            i += 1
+            continue
+
+        if _NAME_RE.match(t):
+            kind = t
+            props: Dict[str, object] = {}
+            i += 1
+            while i < n:
+                m = _PROP_RE.match(toks[i])
+                if not m or toks[i] == "!":
+                    break
+                props[m.group(1).replace("-", "_")] = _coerce(m.group(2))
+                i += 1
+            node = g.add(kind, props)
+            if want_link:
+                g.link(prev, node, prev_pad, "sink")
+                want_link = False
+            elif prev is not None:
+                pass  # new chain begins
+            prev, prev_pad = node, "src"
+            continue
+
+        raise ParseError(f"unexpected token {t!r}")
+
+    if want_link:
+        raise ParseError("pipeline ends with '!'")
+
+    for name, pad, src_node, src_pad in deferred:
+        target = g.by_name.get(name)
+        if target is None:
+            raise ParseError(f"reference to unknown element {name!r}")
+        g.link(src_node, target, src_pad, pad)
+
+    _assign_request_pads(g)
+    g.validate()
+    return g
+
+
+_MULTI_SRC = ("tee", "tensor_demux", "tensor_split", "tensor_if")
+
+
+def _next_src_pad(g: PipelineGraph, node: Node) -> str:
+    """Auto-number source pads for tee/demux-style elements referenced as 'name.'."""
+    used = {e.src_pad for e in g.out_edges(node.id)}
+    if node.kind not in _MULTI_SRC:
+        if "src" in used:
+            raise ParseError(
+                f"element {node.name or node.kind!r} has a single src pad already "
+                "linked; insert a tee to branch"
+            )
+        return "src"
+    i = 0
+    while f"src_{i}" in used:
+        i += 1
+    return f"src_{i}"
+
+
+def _assign_request_pads(g: PipelineGraph) -> None:
+    """Give multi-input elements (mux/merge/join) numbered sink pads and
+    multi-output elements numbered src pads when linked via default pads."""
+    multi_sink = {"tensor_mux", "tensor_merge", "join", "tensor_trainer"}
+    multi_src = {"tee"}
+    for node in g.nodes.values():
+        if node.kind in multi_sink:
+            counter = 0
+            used = {e.dst_pad for e in g.in_edges(node.id) if e.dst_pad != "sink"}
+            for idx, e in enumerate(g.edges):
+                if e.dst == node.id and e.dst_pad == "sink":
+                    while f"sink_{counter}" in used:
+                        counter += 1
+                    g.edges[idx] = type(e)(e.src, e.src_pad, e.dst, f"sink_{counter}")
+                    used.add(f"sink_{counter}")
+        if node.kind in multi_src:
+            counter = 0
+            used = {e.src_pad for e in g.out_edges(node.id) if e.src_pad != "src"}
+            for idx, e in enumerate(g.edges):
+                if e.src == node.id and e.src_pad == "src":
+                    while f"src_{counter}" in used:
+                        counter += 1
+                    g.edges[idx] = type(e)(e.src, f"src_{counter}", e.dst, e.dst_pad)
+                    used.add(f"src_{counter}")

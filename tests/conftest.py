@@ -1,0 +1,23 @@
+"""Test environment: hermetic CPU backend with 8 virtual devices.
+
+SURVEY §4 translation: multi-chip tests run on a simulated local mesh
+(``--xla_force_host_platform_device_count=8``) instead of the reference's
+localhost-socket multi-process rigs.  Must be set before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,110 @@
+"""Pipeline-string parser tests (reference analog: tools/development/parser
+pipeline-grammar validation)."""
+
+import pytest
+
+from nnstreamer_tpu.core.caps import MediaType
+from nnstreamer_tpu.pipeline.parser import ParseError, parse
+
+
+def kinds(g):
+    return [n.kind for n in g.topo_order()]
+
+
+class TestChains:
+    def test_linear(self):
+        g = parse("videotestsrc ! tensor_converter ! tensor_sink name=out")
+        assert kinds(g) == ["videotestsrc", "tensor_converter", "tensor_sink"]
+        assert len(g.edges) == 2
+
+    def test_properties(self):
+        g = parse('videotestsrc num-buffers=5 pattern=ball ! tensor_sink name=x')
+        src = g.topo_order()[0]
+        assert src.props["num_buffers"] == 5
+        assert src.props["pattern"] == "ball"
+        assert g.by_name["x"].kind == "tensor_sink"
+
+    def test_quoted_property(self):
+        g = parse('appsrc caps="video/x-raw,format=RGB,width=4,height=4" ! tensor_sink')
+        src = g.topo_order()[0]
+        assert "width=4" in src.props["caps"]
+
+    def test_capsfilter(self):
+        g = parse("videotestsrc ! video/x-raw,format=RGB,width=64,height=32 ! tensor_converter")
+        caps_node = [n for n in g.nodes.values() if n.kind == "capsfilter"][0]
+        assert caps_node.caps.media == MediaType.VIDEO
+        assert caps_node.caps.get("width") == 64
+
+    def test_framerate_fraction(self):
+        g = parse("videotestsrc ! video/x-raw,framerate=30/1 ! tensor_sink")
+        caps_node = [n for n in g.nodes.values() if n.kind == "capsfilter"][0]
+        assert caps_node.caps.get("framerate") == (30, 1)
+
+
+class TestBranches:
+    def test_tee(self):
+        g = parse(
+            "videotestsrc ! tee name=t "
+            "t. ! tensor_converter ! tensor_sink name=a "
+            "t. ! tensor_converter ! tensor_sink name=b"
+        )
+        tee = g.by_name["t"]
+        assert len(g.out_edges(tee.id)) == 2
+        pads = {e.src_pad for e in g.out_edges(tee.id)}
+        assert pads == {"src_0", "src_1"}
+
+    def test_mux_named_pads(self):
+        g = parse(
+            "tensor_mux name=m ! tensor_sink name=out "
+            "videotestsrc ! tensor_converter ! m.sink_0 "
+            "videotestsrc ! tensor_converter ! m.sink_1"
+        )
+        m = g.by_name["m"]
+        assert {e.dst_pad for e in g.in_edges(m.id)} == {"sink_0", "sink_1"}
+
+    def test_mux_auto_pads(self):
+        g = parse(
+            "tensor_mux name=m ! tensor_sink "
+            "videotestsrc ! tensor_converter ! m. "
+            "videotestsrc ! tensor_converter ! m."
+        )
+        m = g.by_name["m"]
+        assert {e.dst_pad for e in g.in_edges(m.id)} == {"sink_0", "sink_1"}
+
+
+class TestErrors:
+    def test_empty(self):
+        with pytest.raises(ParseError):
+            parse("")
+
+    def test_trailing_bang(self):
+        with pytest.raises(ParseError):
+            parse("videotestsrc !")
+
+    def test_double_bang(self):
+        with pytest.raises(ParseError):
+            parse("videotestsrc ! ! tensor_sink")
+
+    def test_unknown_ref(self):
+        with pytest.raises(ParseError):
+            parse("nosuch. ! tensor_sink")
+
+    def test_duplicate_name(self):
+        with pytest.raises(Exception):
+            parse("videotestsrc name=a ! tensor_sink name=a")
+
+    def test_same_src_pad_twice_needs_tee(self):
+        with pytest.raises(Exception):
+            parse(
+                "videotestsrc name=v ! tensor_sink name=s1 v. ! tensor_sink name=s2"
+            )
+
+
+def test_branch_then_continue_linear():
+    g = parse(
+        "videotestsrc ! tensor_converter ! tensor_transform mode=typecast "
+        "option=float32 ! tensor_sink name=out"
+    )
+    t = [n for n in g.nodes.values() if n.kind == "tensor_transform"][0]
+    assert t.props["mode"] == "typecast"
+    assert t.props["option"] == "float32"

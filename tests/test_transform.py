@@ -1,0 +1,131 @@
+"""tensor_transform golden tests vs numpy (reference analog: SSAT suites
+tests/transform_typecast, transform_arithmetic, transform_transpose, ...)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.elements.transform import TensorTransform
+
+
+def run(mode, option, arr):
+    t = TensorTransform({"mode": mode, "option": option})
+    out = t.transform(Buffer([arr]))
+    return out.tensors[0]
+
+
+def run_device(mode, option, arr):
+    import jax.numpy as jnp
+
+    t = TensorTransform({"mode": mode, "option": option})
+    spec = TensorsSpec.of([arr])
+    fn, out_spec = t.device_fn(spec)
+    out = fn((jnp.asarray(arr),))
+    host = np.asarray(out[0])
+    assert out_spec[0].shape == host.shape, (out_spec, host.shape)
+    assert out_spec[0].dtype == host.dtype
+    return host
+
+
+MODES = [
+    ("typecast", "float32", np.arange(12, dtype=np.uint8).reshape(3, 4)),
+    ("typecast", "int16", (np.arange(12, dtype=np.float32) * 1.7).reshape(3, 4)),
+    ("arithmetic", "typecast:float32,add:-127.5,div:127.5",
+     np.arange(24, dtype=np.uint8).reshape(2, 3, 4)),
+    ("arithmetic", "add:10,mul:2", np.arange(6, dtype=np.int32)),
+    ("clamp", "0:1", np.linspace(-2, 2, 9, dtype=np.float32)),
+    ("stand", "default", np.arange(20, dtype=np.float32).reshape(4, 5)),
+    ("stand", "dc-average", np.arange(20, dtype=np.float32).reshape(4, 5)),
+    ("transpose", "1:0:2:3", np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)),
+    ("dimchg", "0:2", np.arange(24, dtype=np.uint8).reshape(2, 3, 4)),
+    ("padding", "0:1:1,1:2:0", np.ones((2, 3, 4), np.float32)),
+]
+
+
+@pytest.mark.parametrize("mode,option,arr", MODES)
+def test_host_device_parity(mode, option, arr):
+    """The fused device path must match the host path bit-for-bit."""
+    h = run(mode, option, arr)
+    d = run_device(mode, option, arr)
+    assert h.dtype == d.dtype, (h.dtype, d.dtype)
+    assert h.shape == d.shape
+    np.testing.assert_allclose(h, d, rtol=1e-6, atol=1e-6)
+
+
+class TestGolden:
+    def test_typecast(self):
+        a = np.array([250, 251, 252], np.uint8)
+        out = run("typecast", "float32", a)
+        np.testing.assert_array_equal(out, a.astype(np.float32))
+
+    def test_normalize_chain(self):
+        a = np.array([[0, 255], [127, 128]], np.uint8)
+        out = run("arithmetic", "typecast:float32,add:-127.5,div:127.5", a)
+        expected = (a.astype(np.float32) - 127.5) / 127.5
+        np.testing.assert_allclose(out, expected)
+        assert out.dtype == np.float32
+
+    def test_arithmetic_int_stays_int(self):
+        a = np.array([1, 2, 3], np.int32)
+        out = run("arithmetic", "add:10,mul:2", a)
+        np.testing.assert_array_equal(out, (a + 10) * 2)
+        assert out.dtype == np.int32
+
+    def test_arithmetic_float_const_promotes(self):
+        a = np.array([1, 2, 3], np.uint8)
+        out = run("arithmetic", "mul:0.5", a)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, a * 0.5)
+
+    def test_div_promotes(self):
+        a = np.array([4, 8], np.uint8)
+        out = run("arithmetic", "div:2", a)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, [2.0, 4.0])
+
+    def test_per_channel_add(self):
+        a = np.zeros((2, 3), np.float32)  # dims (3, 2): dim0=3 channels
+        out = run("arithmetic", "add:1|2|3@0", a)
+        np.testing.assert_allclose(out, np.tile([1, 2, 3], (2, 1)))
+
+    def test_transpose_hwc_to_chw(self):
+        # dims order: in dims (C,W,H,N); option 1:0:2:3 swaps C and W
+        a = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)  # N,H,W,C
+        out = run("transpose", "1:0:2:3", a)
+        assert out.shape == (1, 2, 4, 3)
+        np.testing.assert_array_equal(out, np.swapaxes(a, 2, 3))
+
+    def test_dimchg(self):
+        # dims (C,W,H) -> move dim0 (C) to position 2: (W,H,C)
+        a = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)  # H,W,C numpy
+        out = run("dimchg", "0:2", a)
+        assert out.shape == (4, 2, 3)
+        np.testing.assert_array_equal(out, np.moveaxis(a, 2, 0))
+
+    def test_clamp(self):
+        a = np.array([-5.0, 0.5, 9.0], np.float32)
+        out = run("clamp", "0:1", a)
+        np.testing.assert_allclose(out, [0.0, 0.5, 1.0])
+
+    def test_stand_default(self):
+        a = np.arange(10, dtype=np.float32)
+        out = run("stand", "default", a)
+        np.testing.assert_allclose(out.mean(), 0.0, atol=1e-6)
+        np.testing.assert_allclose(out.std(), 1.0, atol=1e-4)
+
+    def test_padding(self):
+        a = np.ones((2, 3), np.float32)  # dims (3, 2)
+        out = run("padding", "0:1:1", a)  # pad innermost dim by 1 each side
+        assert out.shape == (2, 5)
+        np.testing.assert_array_equal(out[:, 0], 0)
+
+    def test_spec_propagation(self):
+        t = TensorTransform({"mode": "transpose", "option": "1:0:2:3"})
+        spec = TensorsSpec.from_string("3:4:5:1", "uint8")
+        out = t.out_spec(spec)
+        assert out[0].dims == (4, 3, 5, 1)
+
+    def test_unknown_mode(self):
+        with pytest.raises(Exception):
+            TensorTransform({"mode": "nope"})

@@ -1,0 +1,135 @@
+"""Unit tests for the core tensor type system (reference analog:
+tests/common/unittest_common.cc — tensor type/caps/dim parsing)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.types import (
+    TENSOR_RANK_LIMIT,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+    dims_equal,
+    dtype_from_name,
+    dtype_name,
+    parse_dims,
+    parse_fraction,
+)
+
+
+class TestDims:
+    def test_parse_basic(self):
+        assert parse_dims("3:224:224:1") == (3, 224, 224, 1)
+
+    def test_parse_single(self):
+        assert parse_dims("10") == (10,)
+
+    def test_parse_trailing_zero_dropped(self):
+        assert parse_dims("3:224:224:0") == (3, 224, 224)
+
+    def test_parse_inner_zero_rejected(self):
+        with pytest.raises(ValueError):
+            parse_dims("3:0:224")
+
+    def test_parse_empty_rejected(self):
+        with pytest.raises(ValueError):
+            parse_dims("")
+
+    def test_rank_limit(self):
+        ok = ":".join(["2"] * TENSOR_RANK_LIMIT)
+        assert len(parse_dims(ok)) == TENSOR_RANK_LIMIT
+        with pytest.raises(ValueError):
+            parse_dims(ok + ":2")
+
+    def test_dims_equal_ignores_trailing_ones(self):
+        assert dims_equal((3, 224, 224), (3, 224, 224, 1, 1))
+        assert not dims_equal((3, 224), (3, 224, 2))
+
+
+class TestDtypes:
+    @pytest.mark.parametrize(
+        "name,np_dtype",
+        [
+            ("uint8", np.uint8),
+            ("int8", np.int8),
+            ("uint16", np.uint16),
+            ("int16", np.int16),
+            ("uint32", np.uint32),
+            ("int32", np.int32),
+            ("uint64", np.uint64),
+            ("int64", np.int64),
+            ("float16", np.float16),
+            ("float32", np.float32),
+            ("float64", np.float64),
+        ],
+    )
+    def test_roundtrip(self, name, np_dtype):
+        dt = dtype_from_name(name)
+        assert dt == np.dtype(np_dtype)
+        assert dtype_name(dt) == name
+
+    def test_bfloat16(self):
+        dt = dtype_from_name("bfloat16")
+        assert dt.itemsize == 2
+        assert dtype_name(dt) == "bfloat16"
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            dtype_from_name("no-such-type")
+
+
+class TestTensorSpec:
+    def test_shape_reversal(self):
+        s = TensorSpec.from_string("3:224:224:1", "uint8")
+        assert s.shape == (1, 224, 224, 3)  # NHWC
+        assert s.rank == 4
+        assert s.count == 3 * 224 * 224
+        assert s.nbytes == 3 * 224 * 224
+
+    def test_from_shape(self):
+        s = TensorSpec.from_shape((1, 224, 224, 3), np.float32)
+        assert s.dims == (3, 224, 224, 1)
+        assert s.nbytes == 3 * 224 * 224 * 4
+
+    def test_of_array(self):
+        a = np.zeros((2, 5, 7), np.int16)
+        s = TensorSpec.of(a)
+        assert s.shape == a.shape
+        assert s.dtype == a.dtype
+
+    def test_compat(self):
+        a = TensorSpec.from_string("3:4:5", "float32")
+        b = TensorSpec.from_string("3:4:5:1:1", "float32")
+        c = TensorSpec.from_string("3:4:5", "int32")
+        assert a.is_compatible(b)
+        assert not a.is_compatible(c)
+
+
+class TestTensorsSpec:
+    def test_from_string_multi(self):
+        ts = TensorsSpec.from_string("3:224:224:1,1001:1", "uint8,float32")
+        assert len(ts) == 2
+        assert ts[0].dtype == np.uint8
+        assert ts[1].dtype == np.float32
+        assert ts[1].shape == (1, 1001)
+
+    def test_default_type_uint8(self):
+        ts = TensorsSpec.from_string("4:4")
+        assert ts[0].dtype == np.uint8
+
+    def test_formats(self):
+        ts = TensorsSpec.from_string("2:2", format="flexible")
+        assert ts.is_flexible and not ts.is_sparse
+        assert TensorsSpec.from_string("2:2", format="sparse").is_sparse
+
+    def test_compat_static(self):
+        a = TensorsSpec.from_string("3:4", "float32")
+        b = TensorsSpec.from_string("3:4:1", "float32")
+        assert a.is_compatible(b)
+        assert not a.is_compatible(TensorsSpec.from_string("3:5", "float32"))
+
+
+def test_parse_fraction():
+    assert parse_fraction("30/1") == (30, 1)
+    assert parse_fraction("15") == (15, 1)
+    assert parse_fraction((24, 2)) == (24, 2)
