@@ -1,0 +1,70 @@
+"""Converter sub-plugins: serialized bytes -> tensors.
+
+Reference analog: ``ext/nnstreamer/tensor_converter/tensor_converter_flatbuf
+/_protobuf/_flexbuf/_python3`` (SURVEY §2.6).  Counterparts of
+decoders/serialize.py over the same wire format; ``python3`` runs a user
+callable (module:function) on the raw buffer.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.registry import register_converter
+from ..core.types import TensorsSpec
+from ..utils.wire import decode_buffer
+
+
+class _WireConverter:
+    out_spec: Optional[TensorsSpec] = None
+
+    def __init__(self, props):
+        self.props = dict(props or {})
+
+    def convert(self, buf: Buffer) -> Buffer:
+        raw = bytes(np.asarray(buf.tensors[0], np.uint8).tobytes())
+        out, _ = decode_buffer(raw)
+        out.pts = buf.pts if out.pts is None else out.pts
+        return out
+
+
+@register_converter("flexbuf")
+class FlexbufConverter(_WireConverter):
+    pass
+
+
+@register_converter("flatbuf")
+class FlatbufConverter(_WireConverter):
+    pass
+
+
+@register_converter("protobuf")
+class ProtobufConverter(_WireConverter):
+    pass
+
+
+@register_converter("python3")
+class Python3Converter:
+    """User-scripted converter: ``mode=python3 script=module:function`` where
+    the callable maps a Buffer to a Buffer (reference:
+    tensor_converter_python3.cc running a user script class)."""
+
+    out_spec: Optional[TensorsSpec] = None
+
+    def __init__(self, props):
+        self.props = dict(props or {})
+        target = str(self.props.get("script", ""))
+        if ":" not in target:
+            raise ValueError("python3 converter needs script=module:function")
+        mod, attr = target.split(":", 1)
+        self.fn = getattr(importlib.import_module(mod), attr)
+
+    def convert(self, buf: Buffer) -> Buffer:
+        out = self.fn(buf)
+        if not isinstance(out, Buffer):
+            out = Buffer(list(out) if isinstance(out, (list, tuple)) else [np.asarray(out)])
+        return out

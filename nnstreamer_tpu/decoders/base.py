@@ -1,0 +1,60 @@
+"""Decoder sub-plugin API.
+
+Reference analog: ``NNStreamerExternalDecoder`` vtable from
+``nnstreamer_plugin_api_decoder.h`` (SURVEY §2.5) — the ``tensor_decoder``
+shell element dispatches to a sub-plugin chosen by ``mode=``.
+
+Option properties follow the reference convention: ``option1..option9``
+carry mode-specific config (labels path, output size, thresholds, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps
+from ..core.types import TensorsSpec
+
+
+class Decoder:
+    """Base decoder sub-plugin: tensors -> media/overlay/meta."""
+
+    mode: str = "base"
+
+    def __init__(self, props: Dict[str, object]):
+        self.props = dict(props)
+
+    def option(self, n: int, default: str = "") -> str:
+        v = self.props.get(f"option{n}", default)
+        return str(v) if v is not None else default
+
+    # -- negotiation -------------------------------------------------------
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.any()
+
+    # -- decode ------------------------------------------------------------
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        raise NotImplementedError
+
+    # -- fusion (optional) -------------------------------------------------
+    def device_fn(self, in_spec: TensorsSpec):
+        """Pure-JAX decode for fusion; None => host decode."""
+        return None
+
+
+def load_labels(path_or_name: str) -> List[str]:
+    """Load a labels file (one label per line, reference format).  A few
+    builtin names avoid needing data files in tests: ``imagenet-mini``,
+    ``coco-mini``, ``digits``."""
+    builtin = {
+        "digits": [str(i) for i in range(10)],
+        "imagenet-mini": [f"class_{i}" for i in range(1001)],
+        "coco-mini": [f"obj_{i}" for i in range(91)],
+    }
+    if path_or_name in builtin:
+        return builtin[path_or_name]
+    with open(path_or_name, "r", encoding="utf-8") as f:
+        return [line.strip() for line in f if line.strip()]

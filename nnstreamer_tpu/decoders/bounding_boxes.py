@@ -1,0 +1,131 @@
+"""bounding_boxes decoder: detections -> overlay video + meta.
+
+Reference analog: ``tensordec-boundingbox.c`` + per-format modules
+(mobilenetssd.cc, yolo.cc — SURVEY §2.5, BASELINE config #2): model output
+-> threshold -> NMS -> ``video/x-raw`` RGBA overlay with box rectangles;
+label file via option properties.
+
+Input contracts (option1 selects, mirroring the reference's format modes):
+
+* ``ssd`` (default): two tensors — boxes (N,4) corner-format, normalized
+  [0,1]; scores (N,C) per-class (class 0 may be background when option
+  ``bg`` set).  Our models/ssd.py emits exactly this (decoded anchors are a
+  model concern, matching how tflite SSD graphs embed their postprocess).
+* ``yolov5``: one tensor (N, 5+C): cx,cy,w,h (normalized), objectness,
+  class scores.
+
+Options (reference numbering): option1=format, option2=labels,
+option3=score threshold (default 0.5), option4=WIDTH:HEIGHT of output
+overlay (default 640:480), option5=iou threshold (default 0.5).
+
+Output: RGBA overlay frame (H,W,4) uint8 + ``buf.meta["detections"]`` =
+list of dicts {box, score, class_index, label}.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_decoder
+from ..core.types import TensorsSpec
+from ..ops.nms import center_to_corner, nms_numpy
+from .base import Decoder, load_labels
+
+_PALETTE = np.array(
+    [
+        [230, 25, 75, 255], [60, 180, 75, 255], [255, 225, 25, 255],
+        [0, 130, 200, 255], [245, 130, 48, 255], [145, 30, 180, 255],
+        [70, 240, 240, 255], [240, 50, 230, 255], [210, 245, 60, 255],
+        [250, 190, 190, 255],
+    ],
+    np.uint8,
+)
+
+
+@register_decoder("bounding_boxes")
+class BoundingBoxes(Decoder):
+    mode = "bounding_boxes"
+
+    def __init__(self, props):
+        super().__init__(props)
+        self.format = (self.option(1) or "ssd").lower()
+        labels = self.option(2) or "coco-mini"
+        self.labels = load_labels(labels)
+        self.threshold = float(self.option(3) or 0.5)
+        size = self.option(4) or "640:480"
+        w, h = size.split(":")
+        self.out_w, self.out_h = int(w), int(h)
+        self.iou_threshold = float(self.option(5) or 0.5)
+        self.max_detections = int(self.option(6) or 100)
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.new(
+            MediaType.VIDEO, format="RGBA", width=self.out_w, height=self.out_h
+        )
+
+    # -- decode ------------------------------------------------------------
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        if self.format in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd"):
+            boxes, scores, classes = self._decode_ssd(tensors)
+        elif self.format in ("yolov5", "yolov8", "yolo"):
+            boxes, scores, classes = self._decode_yolo(tensors)
+        else:
+            raise ValueError(f"unknown bounding-box format {self.format!r}")
+
+        keep = nms_numpy(boxes, scores, self.iou_threshold, self.max_detections)
+        detections = []
+        for i in keep:
+            x1, y1, x2, y2 = boxes[i]
+            ci = int(classes[i])
+            detections.append(
+                {
+                    "box": [float(x1), float(y1), float(x2), float(y2)],
+                    "score": float(scores[i]),
+                    "class_index": ci,
+                    "label": self.labels[ci] if ci < len(self.labels) else str(ci),
+                }
+            )
+        overlay = self._draw(detections)
+        out = buf.with_tensors([overlay], spec=None)
+        out.meta["detections"] = detections
+        return out
+
+    def _decode_ssd(self, tensors):
+        boxes = np.asarray(tensors[0], np.float32).reshape(-1, 4)
+        scores_all = np.asarray(tensors[1], np.float32)
+        scores_all = scores_all.reshape(boxes.shape[0], -1)
+        classes = scores_all.argmax(axis=1)
+        scores = scores_all.max(axis=1)
+        m = scores >= self.threshold
+        return boxes[m], scores[m], classes[m]
+
+    def _decode_yolo(self, tensors):
+        pred = np.asarray(tensors[0], np.float32)
+        pred = pred.reshape(-1, pred.shape[-1])
+        xywh, obj, cls = pred[:, :4], pred[:, 4], pred[:, 5:]
+        scores_all = obj[:, None] * cls if cls.size else obj[:, None]
+        classes = scores_all.argmax(axis=1)
+        scores = scores_all.max(axis=1)
+        boxes = center_to_corner(xywh)
+        m = scores >= self.threshold
+        return boxes[m], scores[m], classes[m]
+
+    def _draw(self, detections) -> np.ndarray:
+        overlay = np.zeros((self.out_h, self.out_w, 4), np.uint8)
+        t = 2  # line thickness (reference draws 1px rectangles + label text)
+        for d in detections:
+            x1, y1, x2, y2 = d["box"]
+            color = _PALETTE[d["class_index"] % len(_PALETTE)]
+            px1 = int(np.clip(x1 * self.out_w, 0, self.out_w - 1))
+            px2 = int(np.clip(x2 * self.out_w, 0, self.out_w - 1))
+            py1 = int(np.clip(y1 * self.out_h, 0, self.out_h - 1))
+            py2 = int(np.clip(y2 * self.out_h, 0, self.out_h - 1))
+            overlay[py1 : py1 + t, px1:px2] = color
+            overlay[max(0, py2 - t) : py2, px1:px2] = color
+            overlay[py1:py2, px1 : px1 + t] = color
+            overlay[py1:py2, max(0, px2 - t) : px2] = color
+        return overlay

@@ -1,0 +1,76 @@
+"""direct_video decoder: reinterpret a tensor as raw video.
+
+Reference analog: ``tensordec-directvideo.c`` (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_decoder
+from ..core.types import TensorsSpec
+from .base import Decoder
+
+
+@register_decoder("direct_video")
+class DirectVideo(Decoder):
+    mode = "direct_video"
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        fields = {}
+        if in_spec is not None and len(in_spec) == 1:
+            c, w, h = (list(in_spec[0].dims) + [1, 1, 1])[:3]
+            fmt = {1: "GRAY8", 3: "RGB", 4: "RGBA"}.get(c)
+            if fmt:
+                fields = dict(format=fmt, width=w, height=h)
+        return Caps.new(MediaType.VIDEO, **fields)
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        frame = np.asarray(tensors[0], np.uint8)
+        if frame.ndim == 4:
+            frame = frame[0]
+        return buf.with_tensors([frame], spec=None)
+
+
+@register_decoder("tensor_region")
+class TensorRegion(Decoder):
+    """Crop-region decoder pairing with tensor_crop (reference:
+    tensordec-tensor_region.c): top detection -> [x, y, w, h] info tensor in
+    pixel units of option1=WIDTH:HEIGHT (default 640:480)."""
+
+    mode = "tensor_region"
+
+    def __init__(self, props):
+        super().__init__(props)
+        size = self.option(1) or "640:480"
+        w, h = size.split(":")
+        self.out_w, self.out_h = int(w), int(h)
+        self.num = int(self.option(2) or 1)
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.tensors(TensorsSpec.from_string(f"4:{self.num}", "uint32"))
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        boxes = np.asarray(tensors[0], np.float32).reshape(-1, 4)
+        scores = np.asarray(tensors[1], np.float32) if len(tensors) > 1 else None
+        if scores is not None:
+            order = np.argsort(-scores.reshape(boxes.shape[0], -1).max(axis=1))
+            boxes = boxes[order]
+        regions = []
+        for x1, y1, x2, y2 in boxes[: self.num]:
+            regions.append(
+                [
+                    int(np.clip(x1, 0, 1) * self.out_w),
+                    int(np.clip(y1, 0, 1) * self.out_h),
+                    int(np.clip(x2 - x1, 0, 1) * self.out_w),
+                    int(np.clip(y2 - y1, 0, 1) * self.out_h),
+                ]
+            )
+        while len(regions) < self.num:
+            regions.append([0, 0, 0, 0])
+        out = np.asarray(regions, np.uint32)
+        return buf.with_tensors([out], spec=None)

@@ -1,0 +1,46 @@
+"""image_labeling decoder: scores -> text label.
+
+Reference analog: ``tensordec-imagelabel.c`` (SURVEY §2.5, BASELINE config #1):
+argmax over the class-scores tensor, mapped through a labels file, emitted as
+``text/x-raw`` (uint8 bytes here) with index/label/score in buffer meta.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_decoder
+from ..core.types import TensorsSpec
+from .base import Decoder, load_labels
+
+
+@register_decoder("image_labeling")
+class ImageLabeling(Decoder):
+    mode = "image_labeling"
+
+    def __init__(self, props):
+        super().__init__(props)
+        labels = self.option(1) or str(props.get("labels", "")) or "imagenet-mini"
+        self.labels = load_labels(labels)
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.new(MediaType.TEXT)
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        scores = tensors[0].reshape(-1)
+        idx = int(np.argmax(scores))
+        label = self.labels[idx] if idx < len(self.labels) else str(idx)
+        out = np.frombuffer(label.encode("utf-8"), np.uint8)
+        new = buf.with_tensors([out], spec=None)
+        new.meta.update(
+            label=label, label_index=idx, score=float(scores[idx])
+        )
+        return new
+
+    # No device_fn: the host path emits text, which an XLA program cannot —
+    # fused and unfused paths must stay bit-identical (argmax over ~1k floats
+    # on host is negligible; the model stays fused upstream).

@@ -1,0 +1,53 @@
+"""image_segment decoder: class map -> colored overlay.
+
+Reference analog: ``tensordec-imagesegment.c`` (SURVEY §2.5): per-pixel class
+scores (H,W,C) or class ids (H,W) -> RGBA color overlay.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_decoder
+from ..core.types import TensorsSpec
+from .base import Decoder
+
+_COLORS = np.array(
+    [
+        [0, 0, 0, 0],  # class 0 = background, transparent
+        [230, 25, 75, 160], [60, 180, 75, 160], [255, 225, 25, 160],
+        [0, 130, 200, 160], [245, 130, 48, 160], [145, 30, 180, 160],
+        [70, 240, 240, 160], [240, 50, 230, 160], [210, 245, 60, 160],
+        [250, 190, 190, 160], [0, 128, 128, 160], [230, 190, 255, 160],
+        [170, 110, 40, 160], [255, 250, 200, 160], [128, 0, 0, 160],
+        [170, 255, 195, 160], [128, 128, 0, 160], [255, 215, 180, 160],
+        [0, 0, 128, 160], [128, 128, 128, 160],
+    ],
+    np.uint8,
+)
+
+
+@register_decoder("image_segment")
+class ImageSegment(Decoder):
+    mode = "image_segment"
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.new(MediaType.VIDEO, format="RGBA")
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        x = np.asarray(tensors[0])
+        x = np.squeeze(x)
+        if x.ndim == 3:  # (H,W,C) scores -> argmax
+            classes = x.argmax(axis=-1)
+        elif x.ndim == 2:
+            classes = x.astype(np.int64)
+        else:
+            raise ValueError(f"image_segment expects rank 2/3, got {x.shape}")
+        overlay = _COLORS[classes % len(_COLORS)]
+        out = buf.with_tensors([overlay], spec=None)
+        out.meta["class_map"] = classes
+        return out

@@ -1,0 +1,96 @@
+"""pose_estimation decoder: heatmaps -> keypoints + skeleton overlay.
+
+Reference analog: ``tensordec-pose.c`` (SURVEY §2.5, BASELINE config #3):
+per-keypoint heatmaps -> argmax locations (scaled to output size) -> keypoint
+dots + bone lines on an RGBA overlay; keypoints in meta.
+
+Input contract: heatmaps tensor shaped (H', W', K) (numpy order; nnstreamer
+dims K:W':H') — PoseNet-style.  Optional second tensor (K, 2) of short-range
+offsets is added when present.
+
+Options: option1=labels (keypoint names file), option2=WIDTH:HEIGHT of the
+overlay (default 640:480), option3=score threshold.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_decoder
+from ..core.types import TensorsSpec
+from .base import Decoder, load_labels
+
+# COCO-17 skeleton bones (keypoint index pairs)
+_BONES = [
+    (0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8), (8, 10),
+    (5, 11), (6, 12), (11, 12), (11, 13), (13, 15), (12, 14), (14, 16),
+]
+
+
+@register_decoder("pose_estimation")
+class PoseEstimation(Decoder):
+    mode = "pose_estimation"
+
+    def __init__(self, props):
+        super().__init__(props)
+        size = self.option(2) or "640:480"
+        w, h = size.split(":")
+        self.out_w, self.out_h = int(w), int(h)
+        self.threshold = float(self.option(3) or 0.3)
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.new(
+            MediaType.VIDEO, format="RGBA", width=self.out_w, height=self.out_h
+        )
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        hm = np.asarray(tensors[0], np.float32)
+        hm = hm.reshape(hm.shape[-3], hm.shape[-2], hm.shape[-1]) if hm.ndim > 3 else hm
+        hh, hw, k = hm.shape
+        flat = hm.reshape(-1, k)
+        idx = flat.argmax(axis=0)
+        scores = flat[idx, np.arange(k)]
+        ys, xs = np.unravel_index(idx, (hh, hw))
+        # scale heatmap coords to overlay pixels
+        px = (xs + 0.5) / hw * self.out_w
+        py = (ys + 0.5) / hh * self.out_h
+        if len(tensors) > 1:  # short-range offsets (K,2) in heatmap cells
+            off = np.asarray(tensors[1], np.float32).reshape(-1, 2)[:k]
+            px = px + off[:, 0] / hw * self.out_w
+            py = py + off[:, 1] / hh * self.out_h
+
+        keypoints = [
+            {"x": float(px[i]), "y": float(py[i]), "score": float(scores[i])}
+            for i in range(k)
+        ]
+        overlay = self._draw(keypoints)
+        out = buf.with_tensors([overlay], spec=None)
+        out.meta["keypoints"] = keypoints
+        return out
+
+    def _draw(self, kps) -> np.ndarray:
+        overlay = np.zeros((self.out_h, self.out_w, 4), np.uint8)
+        green = np.array([60, 220, 60, 255], np.uint8)
+        white = np.array([255, 255, 255, 255], np.uint8)
+        for a, b in _BONES:
+            if a < len(kps) and b < len(kps):
+                ka, kb = kps[a], kps[b]
+                if ka["score"] >= self.threshold and kb["score"] >= self.threshold:
+                    self._line(overlay, ka, kb, white)
+        for kp in kps:
+            if kp["score"] >= self.threshold:
+                x, y = int(kp["x"]), int(kp["y"])
+                overlay[
+                    max(0, y - 3) : y + 3, max(0, x - 3) : x + 3
+                ] = green
+        return overlay
+
+    def _line(self, img, ka, kb, color, n: int = 64):
+        xs = np.linspace(ka["x"], kb["x"], n).astype(int)
+        ys = np.linspace(ka["y"], kb["y"], n).astype(int)
+        m = (xs >= 0) & (xs < img.shape[1]) & (ys >= 0) & (ys < img.shape[0])
+        img[ys[m], xs[m]] = color

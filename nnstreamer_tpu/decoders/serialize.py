@@ -1,0 +1,57 @@
+"""Serialization decoders: tensors -> self-describing bytes.
+
+Reference analog: ``tensordec-flatbuf.cc`` / ``tensordec-flexbuf.cc`` /
+``tensordec-protobuf.cc`` / ``tensordec-octetstream.c`` (SURVEY §2.5).  All
+reference codecs collapse onto the one wire format in utils/wire.py (the
+vendored flatbuffers/protobuf libs are an implementation detail of the
+reference, not a capability); ``octet_stream`` emits raw bytes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_decoder
+from ..core.types import TensorsSpec
+from ..utils.wire import encode_buffer
+from .base import Decoder
+
+
+class _WireDecoder(Decoder):
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.new(MediaType.OCTET)
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        blob = np.frombuffer(encode_buffer(buf), np.uint8)
+        return buf.with_tensors([blob], spec=None)
+
+
+@register_decoder("flexbuf")
+class FlexbufDecoder(_WireDecoder):
+    mode = "flexbuf"
+
+
+@register_decoder("flatbuf")
+class FlatbufDecoder(_WireDecoder):
+    mode = "flatbuf"
+
+
+@register_decoder("protobuf")
+class ProtobufDecoder(_WireDecoder):
+    mode = "protobuf"
+
+
+@register_decoder("octet_stream")
+class OctetStream(Decoder):
+    mode = "octet_stream"
+
+    def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        return Caps.new(MediaType.OCTET)
+
+    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        raw = b"".join(np.ascontiguousarray(t).tobytes() for t in tensors)
+        return buf.with_tensors([np.frombuffer(raw, np.uint8)], spec=None)

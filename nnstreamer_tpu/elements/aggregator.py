@@ -1,0 +1,86 @@
+"""tensor_aggregator: window/stride accumulation along a dim.
+
+Reference analog: ``gsttensor_aggregator.c`` (SURVEY §2.2) — concatenate N
+frames along an axis with flush control; the time-series/audio windowing
+primitive (and the closest thing the reference has to sequence-dimension
+machinery, §5.7).
+
+Props (reference names):
+* ``frames-in``    — frames contained in one incoming buffer (along the dim)
+* ``frames-out``   — frames per outgoing buffer (window size)
+* ``frames-flush`` — frames to drop after each output (stride; 0 => frames-out,
+                     i.e. non-overlapping windows)
+* ``frames-dim``   — nnstreamer dim index to count frames along
+* ``concat``       — whether to concat (true) or emit latest window
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps
+from ..core.registry import register_element
+from ..core.types import TensorSpec, TensorsSpec
+from .base import Element, ElementError, SRC
+
+
+@register_element("tensor_aggregator")
+class TensorAggregator(Element):
+    kind = "tensor_aggregator"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.frames_in = int(self.props.get("frames_in", 1))
+        self.frames_out = int(self.props.get("frames_out", 1))
+        self.frames_flush = int(self.props.get("frames_flush", 0)) or self.frames_out
+        self.frames_dim = int(self.props.get("frames_dim", 3))
+        self._window: Optional[np.ndarray] = None
+        self._axis: Optional[int] = None
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        spec = src.spec
+        out_spec = None
+        if spec is not None and len(spec) == 1:
+            dims = list(spec[0].dims)
+            if self.frames_dim >= len(dims):
+                raise ElementError(
+                    f"frames-dim {self.frames_dim} out of range for rank {len(dims)}"
+                )
+            dims[self.frames_dim] = dims[self.frames_dim] // self.frames_in * self.frames_out
+            out_spec = TensorsSpec(
+                (TensorSpec(tuple(dims), spec[0].dtype),), rate=spec.rate
+            )
+        caps = Caps.tensors(out_spec)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf: Buffer):
+        x = np.asarray(buf.tensors[0])
+        axis = x.ndim - 1 - self.frames_dim
+        if self._window is None:
+            self._window = x
+            self._axis = axis
+        else:
+            self._window = np.concatenate([self._window, x], axis=axis)
+        outs: List = []
+        # one incoming buffer carries frames_in frames; window counts frames
+        frame_len = x.shape[axis] // self.frames_in  # samples per frame
+        need = self.frames_out * frame_len
+        stride = self.frames_flush * frame_len
+        while self._window.shape[axis] >= need:
+            sl = [slice(None)] * self._window.ndim
+            sl[axis] = slice(0, need)
+            outs.append((SRC, buf.with_tensors([self._window[tuple(sl)]], spec=None)))
+            keep = [slice(None)] * self._window.ndim
+            keep[axis] = slice(stride, None)
+            self._window = self._window[tuple(keep)]
+        return outs
+
+    def finalize(self):
+        self._window = None
+        return []

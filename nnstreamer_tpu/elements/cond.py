@@ -1,0 +1,125 @@
+"""tensor_if: conditional stream branching on tensor values.
+
+Reference analog: ``gsttensor_if.c`` (SURVEY §2.2): compared-value
+(A_VALUE / TENSOR_AVERAGE_VALUE), compared-value-option (tensor:element
+indices), supplied-value, operator (EQ/NE/GT/GE/LT/LE/RANGE_*), then/else
+actions (PASSTHROUGH / SKIP / TENSORPICK), plus registerable custom
+condition callbacks (reference: nnstreamer_if_custom API).
+
+Pads: ``src_0`` receives the THEN result, ``src_1`` (optional) the ELSE
+result; with only one src pad linked, else falls back to SKIP semantics on
+that pad (matching the common upstream usage of tensor_if as a gate).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps
+from ..core.registry import register_element
+from ..core.types import TensorsSpec
+from .base import Element, ElementError, SRC
+
+_custom_conditions: Dict[str, Callable[[List[np.ndarray]], bool]] = {}
+_lock = threading.Lock()
+
+
+def register_if_condition(name: str, fn: Callable[[List[np.ndarray]], bool]) -> None:
+    """Register a custom condition callable (reference: custom condition cb)."""
+    with _lock:
+        _custom_conditions[name] = fn
+
+
+_OPERATORS = {
+    "EQ": lambda a, b: a == b,
+    "NE": lambda a, b: a != b,
+    "GT": lambda a, b: a > b,
+    "GE": lambda a, b: a >= b,
+    "LT": lambda a, b: a < b,
+    "LE": lambda a, b: a <= b,
+    "RANGE_INCLUSIVE": lambda a, b: b[0] <= a <= b[1],
+    "RANGE_EXCLUSIVE": lambda a, b: b[0] < a < b[1],
+    "NOT_IN_RANGE_INCLUSIVE": lambda a, b: not (b[0] <= a <= b[1]),
+    "NOT_IN_RANGE_EXCLUSIVE": lambda a, b: not (b[0] < a < b[1]),
+}
+
+
+@register_element("tensor_if")
+class TensorIf(Element):
+    kind = "tensor_if"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.compared_value = str(self.props.get("compared_value", "A_VALUE")).upper()
+        self.cv_option = str(self.props.get("compared_value_option", "0"))
+        self.operator = str(self.props.get("operator", "GT")).upper()
+        sv = str(self.props.get("supplied_value", "0"))
+        self.supplied = [float(v) for v in sv.split(":") if v != ""]
+        self.then_action = str(self.props.get("then", "PASSTHROUGH")).upper()
+        self.else_action = str(self.props.get("else", "SKIP")).upper()
+        self.then_pick = _parse_pick(self.props.get("then_option"))
+        self.else_pick = _parse_pick(self.props.get("else_option"))
+        self.custom = self.props.get("custom")
+        if self.operator not in _OPERATORS:
+            raise ElementError(f"unknown tensor_if operator {self.operator!r}")
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        self.out_caps = {p: src for p in out_pads}
+        self._pads = sorted(out_pads)
+        return self.out_caps
+
+    # -- condition ---------------------------------------------------------
+    def _evaluate(self, buf: Buffer) -> bool:
+        arrays = [np.asarray(t) for t in buf.tensors]
+        if self.custom:
+            with _lock:
+                fn = _custom_conditions.get(str(self.custom))
+            if fn is None:
+                raise ElementError(f"no custom tensor_if condition {self.custom!r}")
+            return bool(fn(arrays))
+        if self.compared_value == "A_VALUE":
+            # option "tensor_idx:flat_element_idx" (reference uses dim coords;
+            # flat index covers the same selections deterministically)
+            parts = [int(v) for v in self.cv_option.split(":") if v != ""]
+            t_idx = parts[0] if parts else 0
+            e_idx = parts[1] if len(parts) > 1 else 0
+            value = float(arrays[t_idx].ravel()[e_idx])
+        elif self.compared_value == "TENSOR_AVERAGE_VALUE":
+            t_idx = int(self.cv_option or 0)
+            value = float(arrays[t_idx].astype(np.float64).mean())
+        else:
+            raise ElementError(f"unknown compared_value {self.compared_value!r}")
+        op = _OPERATORS[self.operator]
+        if "RANGE" in self.operator:
+            if len(self.supplied) < 2:
+                raise ElementError("range operators need supplied-value v1:v2")
+            return bool(op(value, (self.supplied[0], self.supplied[1])))
+        return bool(op(value, self.supplied[0]))
+
+    # -- streaming ---------------------------------------------------------
+    def process(self, pad, buf: Buffer):
+        cond = self._evaluate(buf)
+        action = self.then_action if cond else self.else_action
+        pick = self.then_pick if cond else self.else_pick
+        pads = getattr(self, "_pads", [SRC])
+        target = pads[0] if cond or len(pads) == 1 else pads[-1]
+        if action == "SKIP":
+            return []
+        if action == "PASSTHROUGH":
+            return [(target, buf)]
+        if action == "TENSORPICK":
+            tensors = [buf.tensors[i] for i in (pick or [0])]
+            return [(target, buf.with_tensors(tensors, spec=None))]
+        raise ElementError(f"unknown tensor_if action {action!r}")
+
+
+def _parse_pick(opt) -> Optional[List[int]]:
+    if opt in (None, ""):
+        return None
+    return [int(v) for v in str(opt).replace(":", ",").split(",") if v != ""]

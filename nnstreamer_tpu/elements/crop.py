@@ -1,0 +1,59 @@
+"""tensor_crop: dynamic region cropping driven by a second info stream.
+
+Reference analog: ``gsttensor_crop.c`` (SURVEY §2.2) — two sink pads:
+``sink_0`` ("raw") carries data tensors, ``sink_1`` ("info") carries crop
+regions [x, y, w, h] produced e.g. by the tensor_region decoder.  Output is
+FLEXIBLE (per-buffer shapes: one cropped tensor per region).
+
+The raw tensor is interpreted video-style: dims (C, W, H, N) => numpy
+(N, H, W, C); x/y index W/H.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_element
+from ..core.types import TensorFormat, TensorsSpec
+from .base import Element, ElementError, SRC
+
+
+@register_element("tensor_crop")
+class TensorCrop(Element):
+    kind = "tensor_crop"
+    sync_policy = "all"
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        caps = Caps.new(MediaType.FLEX_TENSORS)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def process_group(self, bufs: Dict[str, Buffer]):
+        pads = sorted(bufs)
+        if len(pads) < 2:
+            raise ElementError("tensor_crop needs raw (sink_0) and info (sink_1) pads")
+        raw = np.asarray(bufs[pads[0]].tensors[0])
+        info = np.asarray(bufs[pads[1]].tensors[0]).reshape(-1, 4)
+        if raw.ndim < 2:
+            raise ElementError("tensor_crop raw tensor must be at least rank 2")
+        frame = raw
+        if frame.ndim == 4:  # (N,H,W,C): crop the first frame of the batch
+            frame = frame[0]
+        if frame.ndim == 2:
+            frame = frame[:, :, None]
+        h, w = frame.shape[0], frame.shape[1]
+        crops = []
+        for x, y, cw, ch in info.astype(np.int64):
+            x0 = int(np.clip(x, 0, w))
+            y0 = int(np.clip(y, 0, h))
+            x1 = int(np.clip(x + cw, 0, w))
+            y1 = int(np.clip(y + ch, 0, h))
+            crops.append(frame[y0:y1, x0:x1, :])
+        base = bufs[pads[0]]
+        out = base.with_tensors(crops, spec=TensorsSpec.of(crops, format=TensorFormat.FLEXIBLE))
+        return [(SRC, out)]

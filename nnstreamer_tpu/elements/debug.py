@@ -1,0 +1,36 @@
+"""tensor_debug: passthrough that logs caps/shape/timing metadata.
+
+Reference analog: ``gsttensor_debug.c`` (SURVEY §2.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.log import logger
+from ..core.registry import register_element
+from .base import Element, SRC
+
+log = logger(__name__)
+
+
+@register_element("tensor_debug")
+class TensorDebug(Element):
+    kind = "tensor_debug"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.console = bool(self.props.get("console", False))
+        self.count = 0
+
+    def process(self, pad, buf):
+        self.count += 1
+        desc = ", ".join(
+            f"{tuple(np.asarray(t).shape)}:{np.asarray(t).dtype}" for t in buf.tensors
+        )
+        msg = f"[{self.name}] #{self.count} pts={buf.pts} tensors=[{desc}] meta={list(buf.meta)}"
+        if self.console:
+            print(msg)
+        else:
+            log.info("%s", msg)
+        return [(SRC, buf)]

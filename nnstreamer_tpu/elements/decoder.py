@@ -1,0 +1,41 @@
+"""tensor_decoder shell element.
+
+Reference analog: ``gsttensor_decoder.c`` (SURVEY §2.2): ``other/tensors``
+-> media via the decoder sub-plugin named by ``mode=``.
+"""
+
+from __future__ import annotations
+
+from ..core.caps import Caps
+from ..core.registry import KIND_DECODER, get as registry_get, register_element
+from .base import Element, ElementError, SRC
+
+
+@register_element("tensor_decoder")
+class TensorDecoder(Element):
+    kind = "tensor_decoder"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        mode = self.props.get("mode")
+        if not mode:
+            raise ElementError("tensor_decoder needs mode=<subplugin>")
+        cls = registry_get(KIND_DECODER, str(mode))
+        self.decoder = cls(self.props)
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        caps = self.decoder.out_caps(src.spec)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf):
+        import numpy as np
+
+        tensors = [np.asarray(t) for t in buf.tensors]
+        out = self.decoder.decode(tensors, buf)
+        return [(SRC, out)]
+
+    def device_fn(self, in_spec):
+        return self.decoder.device_fn(in_spec)

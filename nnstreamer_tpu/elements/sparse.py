@@ -1,0 +1,103 @@
+"""Sparse tensor wire format: tensor_sparse_enc / tensor_sparse_dec.
+
+Reference analog: ``gsttensor_sparseenc.c`` / ``gsttensor_sparsedec.c`` /
+``gsttensor_sparseutil.c`` (SURVEY §2.2): COO (index, value) pairs to cut
+bandwidth for sparse data before IPC/network hops.
+
+Wire layout per tensor (little-endian), mirroring the reference's
+self-describing header idea:
+
+    uint32 magic 0x53505253 ("SPRS") | uint32 rank | uint32 dims[rank]
+    | uint32 dtype_name_len | dtype_name utf-8 | uint64 nnz
+    | uint32 indices[nnz] (flat, C-order of the numpy shape) | values[nnz]
+
+Encoded output is a single uint8 tensor per input tensor (FLEXIBLE stream).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_element
+from ..core.types import TensorFormat, TensorSpec, TensorsSpec, dtype_from_name, dtype_name
+from .base import Element, ElementError, SRC
+
+_MAGIC = 0x53505253
+
+
+def sparse_encode_array(x: np.ndarray) -> np.ndarray:
+    flat = x.ravel()
+    nz = np.flatnonzero(flat)
+    values = flat[nz]
+    name = dtype_name(x.dtype).encode()
+    header = struct.pack(
+        f"<II{x.ndim}II",
+        _MAGIC,
+        x.ndim,
+        *[int(d) for d in x.shape],
+        len(name),
+    )
+    body = (
+        name
+        + struct.pack("<Q", len(nz))
+        + nz.astype(np.uint32).tobytes()
+        + values.tobytes()
+    )
+    return np.frombuffer(header + body, np.uint8)
+
+
+def sparse_decode_array(blob: np.ndarray) -> np.ndarray:
+    raw = bytes(np.asarray(blob, np.uint8).tobytes())
+    magic, rank = struct.unpack_from("<II", raw, 0)
+    if magic != _MAGIC:
+        raise ElementError("not a sparse-encoded tensor (bad magic)")
+    off = 8
+    shape = struct.unpack_from(f"<{rank}I", raw, off)
+    off += 4 * rank
+    (name_len,) = struct.unpack_from("<I", raw, off)
+    off += 4
+    dtype = dtype_from_name(raw[off : off + name_len].decode())
+    off += name_len
+    (nnz,) = struct.unpack_from("<Q", raw, off)
+    off += 8
+    idx = np.frombuffer(raw, np.uint32, count=nnz, offset=off)
+    off += 4 * nnz
+    values = np.frombuffer(raw, dtype, count=nnz, offset=off)
+    out = np.zeros(int(np.prod(shape)) if shape else 1, dtype)
+    out[idx] = values
+    return out.reshape(shape)
+
+
+@register_element("tensor_sparse_enc", aliases=("tensor_sparseenc",))
+class TensorSparseEnc(Element):
+    kind = "tensor_sparse_enc"
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        caps = Caps.new(MediaType.FLEX_TENSORS)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf: Buffer):
+        blobs = [sparse_encode_array(np.asarray(t)) for t in buf.tensors]
+        spec = TensorsSpec.of(blobs, format=TensorFormat.SPARSE)
+        return [(SRC, buf.with_tensors(blobs, spec=spec))]
+
+
+@register_element("tensor_sparse_dec", aliases=("tensor_sparsedec",))
+class TensorSparseDec(Element):
+    kind = "tensor_sparse_dec"
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        self.out_caps = {p: Caps.new(MediaType.TENSORS) for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf: Buffer):
+        outs = [sparse_decode_array(t) for t in buf.tensors]
+        return [(SRC, buf.with_tensors(outs, spec=TensorsSpec.of(outs)))]

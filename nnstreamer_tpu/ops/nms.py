@@ -1,0 +1,106 @@
+"""Non-maximum suppression + box utilities.
+
+Reference analog: the NMS inside ``tensordec-boundingbox.c`` (SURVEY §2.5).
+Two implementations with identical semantics:
+
+* :func:`nms_numpy` — greedy IoU NMS on host (the decoder's default path);
+* :func:`nms_jax` — fixed-size, branch-free variant usable inside jitted
+  programs (SURVEY §7 "hard parts": data-dependent control flow -> use a
+  masked O(K·N) sweep with static shapes instead of dynamic early-exit).
+
+Boxes are corner-format [x1, y1, x2, y2].
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def iou_matrix(boxes: np.ndarray) -> np.ndarray:
+    """Pairwise IoU for corner-format boxes (N,4) -> (N,N)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(0.0, x2 - x1) * np.maximum(0.0, y2 - y1)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(0.0, ix2 - ix1) * np.maximum(0.0, iy2 - iy1)
+    union = area[:, None] + area[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
+
+
+def nms_numpy(
+    boxes: np.ndarray,
+    scores: np.ndarray,
+    iou_threshold: float = 0.5,
+    max_out: int = 100,
+) -> np.ndarray:
+    """Greedy NMS; returns indices of kept boxes, best-first."""
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    iou = iou_matrix(boxes.astype(np.float64))
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        if len(keep) >= max_out:
+            break
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True
+    return np.asarray(keep, np.int64)
+
+
+def nms_jax(boxes, scores, iou_threshold: float = 0.5, max_out: int = 100):
+    """Branch-free NMS for jit: returns (indices[max_out], valid[max_out]).
+
+    Iterates max_out times: pick current best unsuppressed score, suppress
+    its overlaps.  Static shapes throughout — MXU/VPU friendly, no host sync.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    boxes = boxes.astype(jnp.float32)
+    n = boxes.shape[0]
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(0.0, x2 - x1) * jnp.maximum(0.0, y2 - y1)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(0.0, ix2 - ix1) * jnp.maximum(0.0, iy2 - iy1)
+    union = area[:, None] + area[None, :] - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+
+    def body(carry, _):
+        live_scores = carry
+        best = jnp.argmax(live_scores)
+        best_score = live_scores[best]
+        valid = best_score > -jnp.inf
+        # suppress overlaps of best (including itself)
+        kill = (iou[best] > iou_threshold) | (jnp.arange(n) == best)
+        live_scores = jnp.where(valid & kill, -jnp.inf, live_scores)
+        return live_scores, (best.astype(jnp.int32), valid)
+
+    init = jnp.where(jnp.isfinite(scores), scores.astype(jnp.float32), -jnp.inf)
+    _, (idx, valid) = jax.lax.scan(body, init, None, length=max_out)
+    return idx, valid
+
+
+def center_to_corner(boxes_cxcywh: np.ndarray) -> np.ndarray:
+    """[cx, cy, w, h] -> [x1, y1, x2, y2] (works for numpy and jax arrays)."""
+    cx, cy, w, h = (
+        boxes_cxcywh[..., 0],
+        boxes_cxcywh[..., 1],
+        boxes_cxcywh[..., 2],
+        boxes_cxcywh[..., 3],
+    )
+    if isinstance(boxes_cxcywh, np.ndarray):
+        stack = np.stack
+    else:  # jax
+        import jax.numpy as jnp
+
+        stack = jnp.stack
+    return stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)

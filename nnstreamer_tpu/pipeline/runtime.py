@@ -138,12 +138,12 @@ class _Runner:
             if isinstance(item, Event):
                 if item.kind == "eos":
                     self._eos_pads.add(pad)
+                    if all_policy:
+                        self._try_groups()
                     if self._eos_pads >= set(self.in_pads):
                         self._emit(el.finalize())
                         self._broadcast(Event.eos())
                         return
-                    if all_policy:
-                        self._try_groups()
                     continue
                 if item.kind == "error":
                     self._broadcast(item)
@@ -161,14 +161,20 @@ class _Runner:
                 metrics.count(f"{el.name}.out")
 
     def _try_groups(self) -> None:
-        """Collate one buffer per live pad (slowest-pad sync; reference:
-        tensor_mux sync-mode=slowest)."""
+        """Collate one buffer per active pad (slowest-pad sync; reference:
+        tensor_mux sync-mode=slowest).  A pad stays active while it has
+        pending buffers even after EOS — data queued before EOS must still
+        pair up; the pad only drops out once EOS'd AND drained."""
         el = self.element
-        live = [p for p in self.in_pads if p not in self._eos_pads]
-        if not live:
-            return
-        while all(self._pending.get(p) for p in live):
-            group = {p: self._pending[p].pop(0) for p in live}
+        while True:
+            active = [
+                p
+                for p in self.in_pads
+                if self._pending.get(p) or p not in self._eos_pads
+            ]
+            if not active or not all(self._pending.get(p) for p in active):
+                return
+            group = {p: self._pending[p].pop(0) for p in active}
             with Timer(f"{el.name}.proc"):
                 outs = el.process_group(group)
             self._emit(outs)

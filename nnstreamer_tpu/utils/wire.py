@@ -1,0 +1,136 @@
+"""Tensor wire format: self-describing serialization of a Buffer.
+
+Reference analog: the flatbuf/protobuf/flexbuf codecs
+(``ext/nnstreamer/tensor_decoder/tensordec-flatbuf.cc`` etc., SURVEY
+§2.5/2.6) that serialize ``other/tensors`` for IPC — and the framing
+nnstreamer-edge puts on the wire (§2.7).  One codec serves all of:
+``tensor_decoder mode=flexbuf``, ``tensor_converter mode=flexbuf``, the
+tensor_query TCP protocol, and edge pub/sub.
+
+Layout (little-endian):
+
+    u32 magic "NNST" | u32 version | u32 flags | u32 num_tensors
+    | i64 pts (-1 = none) | u64 seqno | u32 meta_len | meta (utf-8 JSON)
+    per tensor:
+      u32 rank | u32 dims[rank] (innermost-first) | u32 name_len
+      | dtype_name utf-8 | u64 nbytes | raw bytes (C-order)
+
+JSON meta keeps only JSON-representable entries; numpy scalars/arrays in
+meta are converted (arrays to nested lists) — sufficient for detection/query
+metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.types import TensorSpec, TensorsSpec, dtype_from_name, dtype_name
+
+MAGIC = 0x4E4E5354  # "NNST"
+VERSION = 1
+
+
+def _meta_safe(meta: dict) -> dict:
+    out = {}
+    for k, v in meta.items():
+        if isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        elif isinstance(v, (np.integer, np.floating)):
+            out[k] = v.item()
+        else:
+            try:
+                json.dumps(v)
+                out[k] = v
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def encode_buffer(buf: Buffer, flags: int = 0) -> bytes:
+    meta = json.dumps(_meta_safe(buf.meta)).encode("utf-8")
+    parts = [
+        struct.pack(
+            "<IIIIqQI",
+            MAGIC,
+            VERSION,
+            flags,
+            len(buf.tensors),
+            buf.pts if buf.pts is not None else -1,
+            buf.seqno,
+            len(meta),
+        ),
+        meta,
+    ]
+    for t in buf.tensors:
+        a = np.ascontiguousarray(np.asarray(t))
+        spec = TensorSpec.of(a)
+        dname = dtype_name(a.dtype).encode()
+        parts.append(
+            struct.pack(f"<I{a.ndim}II", a.ndim, *[int(d) for d in spec.dims], len(dname))
+        )
+        parts.append(dname)
+        raw = a.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_buffer(raw: bytes) -> Tuple[Buffer, int]:
+    """Decode one buffer; returns (buffer, flags)."""
+    magic, version, flags, n, pts, seqno, meta_len = struct.unpack_from("<IIIIqQI", raw, 0)
+    if magic != MAGIC:
+        raise ValueError("bad wire magic")
+    if version != VERSION:
+        raise ValueError(f"unsupported wire version {version}")
+    off = struct.calcsize("<IIIIqQI")
+    meta = json.loads(raw[off : off + meta_len].decode("utf-8")) if meta_len else {}
+    off += meta_len
+    tensors: List[np.ndarray] = []
+    for _ in range(n):
+        (rank,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        dims = struct.unpack_from(f"<{rank}I", raw, off)
+        off += 4 * rank
+        (name_len,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        dtype = dtype_from_name(raw[off : off + name_len].decode())
+        off += name_len
+        (nbytes,) = struct.unpack_from("<Q", raw, off)
+        off += 8
+        shape = tuple(reversed(dims))
+        arr = np.frombuffer(raw, dtype, count=nbytes // dtype.itemsize, offset=off)
+        tensors.append(arr.reshape(shape))
+        off += nbytes
+    buf = Buffer(tensors, pts=None if pts < 0 else pts, meta=meta)
+    buf.seqno = seqno
+    return buf, flags
+
+
+def read_frame(sock) -> Optional[bytes]:
+    """Read one length-prefixed frame from a socket-like object."""
+    hdr = _read_exact(sock, 8)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack("<Q", hdr)
+    return _read_exact(sock, length)
+
+
+def write_frame(sock, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _read_exact(sock, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

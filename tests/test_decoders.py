@@ -1,0 +1,172 @@
+"""Decoder sub-plugin tests (reference analogs: tests/nnstreamer_decoder_*
+SSAT suites)."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+from nnstreamer_tpu.decoders.image_labeling import ImageLabeling
+from nnstreamer_tpu.decoders.image_segment import ImageSegment
+from nnstreamer_tpu.decoders.pose import PoseEstimation
+from nnstreamer_tpu.ops.nms import center_to_corner, iou_matrix, nms_numpy
+from nnstreamer_tpu.utils.wire import decode_buffer, encode_buffer
+
+
+class TestImageLabeling:
+    def test_argmax_label(self):
+        d = ImageLabeling({"option1": "digits"})
+        scores = np.zeros(10, np.float32)
+        scores[7] = 0.9
+        out = d.decode([scores], Buffer([scores]))
+        assert out.meta["label"] == "7"
+        assert out.meta["label_index"] == 7
+        assert bytes(out.tensors[0].tobytes()).decode() == "7"
+
+
+class TestNMS:
+    def test_iou(self):
+        boxes = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [10, 10, 12, 12]], np.float64)
+        iou = iou_matrix(boxes)
+        assert iou[0, 0] == pytest.approx(1.0)
+        assert iou[0, 1] == pytest.approx(1 / 7)
+        assert iou[0, 2] == 0.0
+
+    def test_greedy(self):
+        boxes = np.array(
+            [[0, 0, 2, 2], [0.1, 0.1, 2.1, 2.1], [5, 5, 7, 7]], np.float64
+        )
+        scores = np.array([0.9, 0.8, 0.7])
+        keep = nms_numpy(boxes, scores, iou_threshold=0.5)
+        assert list(keep) == [0, 2]
+
+    def test_jax_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        centers = rng.uniform(0.2, 0.8, size=(20, 2))
+        wh = rng.uniform(0.05, 0.3, size=(20, 2))
+        boxes = center_to_corner(np.concatenate([centers, wh], axis=1))
+        scores = rng.uniform(0.1, 1.0, size=20)
+        keep_np = nms_numpy(boxes, scores, 0.5, max_out=10)
+
+        from nnstreamer_tpu.ops.nms import nms_jax
+
+        idx, valid = nms_jax(boxes, scores, 0.5, max_out=10)
+        keep_jx = np.asarray(idx)[np.asarray(valid)]
+        np.testing.assert_array_equal(keep_np, keep_jx)
+
+
+class TestBoundingBoxes:
+    def _dets(self):
+        boxes = np.array(
+            [[0.1, 0.1, 0.3, 0.3], [0.11, 0.11, 0.31, 0.31], [0.6, 0.6, 0.9, 0.9]],
+            np.float32,
+        )
+        scores = np.zeros((3, 5), np.float32)
+        scores[0, 1] = 0.9
+        scores[1, 1] = 0.85  # overlaps det 0 -> suppressed
+        scores[2, 3] = 0.7
+        return boxes, scores
+
+    def test_ssd_decode_nms_overlay(self):
+        d = BoundingBoxes({"option1": "ssd", "option4": "100:100"})
+        boxes, scores = self._dets()
+        out = d.decode([boxes, scores], Buffer([boxes, scores]))
+        dets = out.meta["detections"]
+        assert len(dets) == 2
+        assert dets[0]["class_index"] == 1
+        assert dets[1]["class_index"] == 3
+        overlay = out.tensors[0]
+        assert overlay.shape == (100, 100, 4)
+        assert overlay[10, 10:30].any()  # top edge of box 0 drawn
+
+    def test_threshold(self):
+        d = BoundingBoxes({"option1": "ssd", "option3": "0.95"})
+        boxes, scores = self._dets()
+        out = d.decode([boxes, scores], Buffer([boxes, scores]))
+        assert out.meta["detections"] == []
+
+    def test_yolo_decode(self):
+        d = BoundingBoxes({"option1": "yolov5", "option4": "64:64"})
+        pred = np.zeros((4, 9), np.float32)
+        pred[0] = [0.5, 0.5, 0.2, 0.2, 0.9, 0, 0.8, 0, 0]
+        pred[1] = [0.2, 0.2, 0.1, 0.1, 0.1, 0, 0, 0, 0.3]  # below threshold
+        out = d.decode([pred], Buffer([pred]))
+        dets = out.meta["detections"]
+        assert len(dets) == 1
+        assert dets[0]["class_index"] == 1
+        np.testing.assert_allclose(dets[0]["box"], [0.4, 0.4, 0.6, 0.6], atol=1e-6)
+
+
+class TestPose:
+    def test_keypoints(self):
+        k = 17
+        hm = np.zeros((8, 8, k), np.float32)
+        for i in range(k):
+            hm[i % 8, (i * 3) % 8, i] = 1.0
+        d = PoseEstimation({"option2": "80:80"})
+        out = d.decode([hm], Buffer([hm]))
+        kps = out.meta["keypoints"]
+        assert len(kps) == k
+        # keypoint 2 sits at heatmap (2, 6) -> pixel (65, 25)
+        assert kps[2]["x"] == pytest.approx((6 + 0.5) / 8 * 80)
+        assert kps[2]["y"] == pytest.approx((2 + 0.5) / 8 * 80)
+        assert out.tensors[0].shape == (80, 80, 4)
+
+
+class TestSegment:
+    def test_argmax_overlay(self):
+        scores = np.zeros((4, 4, 3), np.float32)
+        scores[:2, :, 1] = 1.0
+        scores[2:, :, 2] = 1.0
+        d = ImageSegment({})
+        out = d.decode([scores], Buffer([scores]))
+        overlay = out.tensors[0]
+        assert overlay.shape == (4, 4, 4)
+        assert (out.meta["class_map"][:2] == 1).all()
+
+
+class TestWire:
+    def test_roundtrip(self):
+        buf = Buffer(
+            [np.arange(6, dtype=np.float32).reshape(2, 3), np.array([7], np.uint8)],
+            pts=123,
+        )
+        buf.meta["detections"] = [{"box": [0, 0, 1, 1], "score": 0.5}]
+        raw = encode_buffer(buf)
+        out, flags = decode_buffer(raw)
+        assert out.pts == 123
+        assert len(out.tensors) == 2
+        np.testing.assert_array_equal(out.tensors[0], buf.tensors[0])
+        assert out.meta["detections"][0]["score"] == 0.5
+
+    def test_decoder_converter_pipeline_roundtrip(self):
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_decoder mode=flexbuf ! "
+            "tensor_converter mode=flexbuf ! tensor_sink name=out"
+        )
+        with p:
+            x = np.arange(12, dtype=np.int16).reshape(3, 4)
+            p.push("src", x)
+            out = p.pull("out", timeout=10)
+        np.testing.assert_array_equal(out.tensors[0], x)
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            decode_buffer(b"\x00" * 64)
+
+
+def test_detection_pipeline_e2e():
+    """appsrc(dets) -> bounding_boxes decoder -> sink with overlay + meta."""
+    p = nt.Pipeline(
+        "appsrc name=src ! "
+        "tensor_decoder mode=bounding_boxes option1=ssd option4=64:64 ! "
+        "tensor_sink name=out"
+    )
+    boxes = np.array([[0.2, 0.2, 0.5, 0.5]], np.float32)
+    scores = np.array([[0.0, 0.99]], np.float32)
+    with p:
+        p.push("src", [boxes, scores])
+        out = p.pull("out", timeout=10)
+    assert out.tensors[0].shape == (64, 64, 4)
+    assert len(out.meta["detections"]) == 1

@@ -1,0 +1,230 @@
+"""Routing element tests (reference analogs: tests/nnstreamer_mux, _demux,
+_merge, _split, _if, _aggregator, _repo SSAT suites)."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.elements.aggregator import TensorAggregator
+from nnstreamer_tpu.elements.cond import TensorIf, register_if_condition
+from nnstreamer_tpu.elements.crop import TensorCrop
+from nnstreamer_tpu.elements.repo import reset_slots
+from nnstreamer_tpu.elements.routing import TensorDemux, TensorMerge, TensorMux, TensorSplit
+from nnstreamer_tpu.elements.sparse import sparse_decode_array, sparse_encode_array
+
+
+class TestMuxDemux:
+    def test_mux_groups(self):
+        m = TensorMux()
+        m.configure({}, ["src"])
+        a = Buffer([np.ones((2, 2), np.float32)], pts=10)
+        b = Buffer([np.zeros((3,), np.int32)], pts=20)
+        outs = m.process_group({"sink_0": a, "sink_1": b})
+        assert len(outs) == 1
+        buf = outs[0][1]
+        assert len(buf.tensors) == 2
+        assert buf.pts == 20  # slowest
+
+    def test_demux_pick(self):
+        d = TensorDemux({"tensorpick": "1"})
+        d.configure({}, ["src_0"])
+        buf = Buffer([np.zeros(2), np.ones(3), np.full(4, 2.0)])
+        outs = d.process("sink", buf)
+        assert len(outs) == 1
+        np.testing.assert_array_equal(outs[0][1].tensors[0], np.ones(3))
+
+    def test_demux_all(self):
+        d = TensorDemux()
+        d.configure({}, ["src_0", "src_1"])
+        buf = Buffer([np.zeros(2), np.ones(3)])
+        outs = d.process("sink", buf)
+        assert [o[0] for o in outs] == ["src_0", "src_1"]
+
+    def test_mux_pipeline_e2e(self):
+        p = nt.Pipeline(
+            "tensor_mux name=m ! tensor_sink name=out "
+            "videotestsrc num-buffers=2 width=4 height=4 ! tensor_converter ! m.sink_0 "
+            "videotestsrc num-buffers=2 width=2 height=2 ! tensor_converter ! m.sink_1"
+        )
+        with p:
+            b = p.pull("out", timeout=10)
+            p.wait(timeout=10)
+        assert len(b.tensors) == 2
+        assert b.tensors[0].shape == (1, 4, 4, 3)
+        assert b.tensors[1].shape == (1, 2, 2, 3)
+
+
+class TestMergeSplit:
+    def test_merge_linear(self):
+        m = TensorMerge({"option": 0})
+        m.configure({}, ["src"])
+        a = Buffer([np.ones((2, 3), np.float32)])
+        b = Buffer([np.zeros((2, 2), np.float32)])
+        outs = m.process_group({"sink_0": a, "sink_1": b})
+        out = outs[0][1].tensors[0]
+        assert out.shape == (2, 5)  # concat along innermost dim (numpy last axis)
+
+    def test_split(self):
+        s = TensorSplit({"tensorseg": "2,3", "dim": 0})
+        s.configure({}, ["src_0", "src_1"])
+        buf = Buffer([np.arange(10, dtype=np.float32).reshape(2, 5)])
+        outs = s.process("sink", buf)
+        assert outs[0][1].tensors[0].shape == (2, 2)
+        assert outs[1][1].tensors[0].shape == (2, 3)
+        np.testing.assert_array_equal(outs[0][1].tensors[0], [[0, 1], [5, 6]])
+
+    def test_split_size_mismatch(self):
+        s = TensorSplit({"tensorseg": "2,2", "dim": 0})
+        s.configure({}, ["src_0", "src_1"])
+        with pytest.raises(Exception):
+            s.process("sink", Buffer([np.zeros((2, 5), np.float32)]))
+
+    def test_merge_split_roundtrip_pipeline(self):
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_split tensorseg=2,2 dim=1 name=sp "
+            "sp.src_0 ! tensor_sink name=a "
+            "sp.src_1 ! tensor_sink name=b"
+        )
+        with p:
+            x = np.arange(16, dtype=np.float32).reshape(4, 4)
+            p.push("src", x)
+            ta = p.pull("a", timeout=10).tensors[0]
+            tb = p.pull("b", timeout=10).tensors[0]
+        np.testing.assert_array_equal(np.concatenate([ta, tb], axis=0), x)
+
+
+class TestTee:
+    def test_tee_pipeline(self):
+        p = nt.Pipeline(
+            "videotestsrc num-buffers=2 width=4 height=4 ! tensor_converter ! "
+            "tee name=t t. ! tensor_sink name=a t. ! tensor_sink name=b"
+        )
+        with p:
+            a = p.pull("a", timeout=10)
+            b = p.pull("b", timeout=10)
+            p.wait(timeout=10)
+        np.testing.assert_array_equal(a.tensors[0], b.tensors[0])
+
+
+class TestIf:
+    def test_average_gate(self):
+        f = TensorIf(
+            {
+                "compared_value": "TENSOR_AVERAGE_VALUE",
+                "compared_value_option": "0",
+                "operator": "GT",
+                "supplied_value": "10",
+                "then": "PASSTHROUGH",
+                "else": "SKIP",
+            }
+        )
+        f.configure({}, ["src"])
+        hi = Buffer([np.full((4,), 20.0, np.float32)])
+        lo = Buffer([np.full((4,), 5.0, np.float32)])
+        assert len(f.process("sink", hi)) == 1
+        assert len(f.process("sink", lo)) == 0
+
+    def test_range_and_pick(self):
+        f = TensorIf(
+            {
+                "compared_value": "A_VALUE",
+                "compared_value_option": "0:0",
+                "operator": "RANGE_INCLUSIVE",
+                "supplied_value": "2:8",
+                "then": "TENSORPICK",
+                "then_option": "1",
+            }
+        )
+        f.configure({}, ["src"])
+        buf = Buffer([np.array([5.0]), np.array([42.0])])
+        outs = f.process("sink", buf)
+        assert len(outs) == 1
+        np.testing.assert_array_equal(outs[0][1].tensors[0], [42.0])
+
+    def test_custom_condition(self):
+        register_if_condition("always-no", lambda arrays: False)
+        f = TensorIf({"custom": "always-no", "then": "PASSTHROUGH", "else": "SKIP"})
+        f.configure({}, ["src"])
+        assert f.process("sink", Buffer([np.ones(3)])) == []
+
+
+class TestAggregator:
+    def test_window(self):
+        agg = TensorAggregator({"frames_in": 1, "frames_out": 3, "frames_dim": 1})
+        agg.configure({}, ["src"])
+        outs = []
+        for i in range(5):
+            outs += agg.process("sink", Buffer([np.full((1, 2), i, np.float32)]))
+        # windows: [0,1,2] then [3,4,...] incomplete -> 1 output
+        assert len(outs) == 1
+        assert outs[0][1].tensors[0].shape == (3, 2)
+
+    def test_sliding(self):
+        agg = TensorAggregator(
+            {"frames_in": 1, "frames_out": 2, "frames_flush": 1, "frames_dim": 1}
+        )
+        agg.configure({}, ["src"])
+        outs = []
+        for i in range(4):
+            outs += agg.process("sink", Buffer([np.full((1, 1), i, np.float32)]))
+        # sliding windows: [0,1],[1,2],[2,3]
+        assert len(outs) == 3
+        np.testing.assert_array_equal(
+            outs[1][1].tensors[0].ravel(), [1, 2]
+        )
+
+
+class TestCrop:
+    def test_crop_regions(self):
+        c = TensorCrop()
+        c.configure({}, ["src"])
+        raw = Buffer([np.arange(16 * 16 * 3, dtype=np.uint8).reshape(1, 16, 16, 3)])
+        info = Buffer([np.array([[2, 3, 4, 5]], np.uint32)])
+        outs = c.process_group({"sink_0": raw, "sink_1": info})
+        crop = outs[0][1].tensors[0]
+        assert crop.shape == (5, 4, 3)
+
+
+class TestSparse:
+    def test_roundtrip(self, rng):
+        x = np.zeros((8, 8), np.float32)
+        x[2, 3] = 1.5
+        x[7, 0] = -2.0
+        blob = sparse_encode_array(x)
+        assert blob.nbytes < x.nbytes  # actually compresses sparse data
+        y = sparse_decode_array(blob)
+        np.testing.assert_array_equal(x, y)
+
+    def test_pipeline_roundtrip(self):
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_sparse_enc ! tensor_sparse_dec ! "
+            "tensor_sink name=out"
+        )
+        with p:
+            x = np.zeros((4, 4), np.int32)
+            x[1, 1] = 7
+            p.push("src", x)
+            out = p.pull("out", timeout=10)
+        np.testing.assert_array_equal(out.tensors[0], x)
+
+
+class TestRepoLoop:
+    def test_recurrence(self):
+        reset_slots()
+        # loop: reposrc emits zeros then feeds back filter output (x+1)
+        from nnstreamer_tpu.core.types import TensorsSpec
+        from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+        spec = TensorsSpec.from_string("4", "float32")
+        register_custom_easy("inc", lambda ins: [ins[0] + 1], spec, spec)
+        p = nt.Pipeline(
+            "tensor_reposrc slot-name=loop init-dims=4 init-type=float32 num-buffers=5 ! "
+            "tensor_filter framework=custom-easy model=inc ! tee name=t "
+            "t. ! tensor_reposink slot-name=loop "
+            "t. ! tensor_sink name=out",
+            fuse=False,
+        )
+        with p:
+            vals = [p.pull("out", timeout=10).tensors[0][0] for _ in range(5)]
+        assert vals == [1.0, 2.0, 3.0, 4.0, 5.0]
