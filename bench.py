@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Headline benchmark: MobileNet-v1 classification pipeline, frames/sec/chip.
+
+BASELINE.json KPI: "frames/sec/chip on tensor_filter pipeline; p50 per-frame
+latency".  North star: >=2000 fps aggregate on a v5e-8 => 250 fps/chip is
+parity (vs_baseline = fps_per_chip / 250).
+
+Pipeline under test (config #1, the reference's img-class example):
+
+    appsrc -> tensor_transform(typecast+normalize) -> tensor_filter(jax,
+    mobilenet_v1, bfloat16) -> tensor_decoder(image_labeling) -> tensor_sink
+
+Frames stream through in batches (the TPU-native move the reference can't
+make: its tflite path is frame-at-a-time); transform+filter fuse into one
+jitted XLA program, so normalization rides the MXU with the convs.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def run_bench(batch: int, batches: int, size: int, warmup: int) -> dict:
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+
+    desc = (
+        "appsrc name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+        f"tensor_filter framework=jax model=mobilenet_v1 custom=size:{size},batch:{batch} name=f ! "
+        "tensor_decoder mode=image_labeling ! tensor_sink name=out"
+    )
+    rng = np.random.default_rng(0)
+    frames = [
+        rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8)
+        for _ in range(4)
+    ]
+
+    push_ts = {}
+    lat = []
+    done = threading.Event()
+
+    p = nt.Pipeline(desc, fuse=True)
+    with p:
+        # Warmup: first push triggers XLA compile.
+        for i in range(warmup):
+            p.push("src", frames[i % len(frames)])
+            p.pull("out", timeout=600)
+
+        def pusher():
+            for i in range(batches):
+                push_ts[i] = time.perf_counter()
+                p.push("src", frames[i % len(frames)])
+            done.set()
+
+        t = threading.Thread(target=pusher, daemon=True)
+        t0 = time.perf_counter()
+        t.start()
+        for i in range(batches):
+            p.pull("out", timeout=600)
+            lat.append(time.perf_counter() - push_ts[i])
+        t1 = time.perf_counter()
+        t.join()
+        p.eos()
+        p.wait(timeout=60)
+
+    total_frames = batch * batches
+    wall = t1 - t0
+    fps = total_frames / wall
+    lat_ms = sorted(x * 1e3 for x in lat)
+    p50 = lat_ms[len(lat_ms) // 2]
+    p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+    return {
+        "metric": "mobilenet_v1_pipeline_fps_per_chip",
+        "value": round(fps, 1),
+        "unit": "frames/sec",
+        "vs_baseline": round(fps / 250.0, 3),
+        "p50_batch_ms": round(p50, 2),
+        "p99_batch_ms": round(p99, 2),
+        "batch": batch,
+        "batches": batches,
+        "wall_s": round(wall, 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--batches", type=int, default=50)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+    result = run_bench(args.batch, args.batches, args.size, args.warmup)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

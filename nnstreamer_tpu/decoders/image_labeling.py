@@ -31,7 +31,26 @@ class ImageLabeling(Decoder):
         return Caps.new(MediaType.TEXT)
 
     def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
-        scores = tensors[0].reshape(-1)
+        scores = np.asarray(tensors[0])
+        if scores.ndim >= 2 and scores.shape[0] > 1:
+            # Batched scores [B, C]: one label per row (TPU pipelines batch
+            # frames; the reference decodes one frame per buffer).
+            flat = scores.reshape(scores.shape[0], -1)
+            idxs = np.argmax(flat, axis=1)
+            names = [
+                self.labels[i] if i < len(self.labels) else str(i) for i in idxs
+            ]
+            text = "\n".join(names)
+            new = buf.with_tensors(
+                [np.frombuffer(text.encode("utf-8"), np.uint8)], spec=None
+            )
+            new.meta.update(
+                label=names,
+                label_index=idxs,
+                score=flat[np.arange(len(idxs)), idxs].astype(np.float32),
+            )
+            return new
+        scores = scores.reshape(-1)
         idx = int(np.argmax(scores))
         label = self.labels[idx] if idx < len(self.labels) else str(idx)
         out = np.frombuffer(label.encode("utf-8"), np.uint8)

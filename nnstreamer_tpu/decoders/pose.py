@@ -49,7 +49,29 @@ class PoseEstimation(Decoder):
 
     def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
         hm = np.asarray(tensors[0], np.float32)
-        hm = hm.reshape(hm.shape[-3], hm.shape[-2], hm.shape[-1]) if hm.ndim > 3 else hm
+        if hm.ndim > 3:
+            # Batched heatmaps [..., H', W', K]: decode each frame.
+            lead = hm.shape[: hm.ndim - 3]
+            n = int(np.prod(lead))
+            frames = hm.reshape((n,) + hm.shape[-3:])
+            if n > 1:
+                rest = [np.asarray(t) for t in tensors[1:]]
+                overlays, kps = [], []
+                for i in range(n):
+                    sub = [frames[i]] + [
+                        t[i] if t.shape[:1] == (n,) else t for t in rest
+                    ]
+                    o = self._decode_one(sub, buf)
+                    overlays.append(o.tensors[0])
+                    kps.append(o.meta["keypoints"])
+                out = buf.with_tensors([np.stack(overlays)], spec=None)
+                out.meta["keypoints"] = kps
+                return out
+            hm = frames[0]
+        return self._decode_one([hm] + list(tensors[1:]), buf)
+
+    def _decode_one(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+        hm = np.asarray(tensors[0], np.float32)
         hh, hw, k = hm.shape
         flat = hm.reshape(-1, k)
         idx = flat.argmax(axis=0)

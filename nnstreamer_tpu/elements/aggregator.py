@@ -11,7 +11,9 @@ Props (reference names):
 * ``frames-flush`` — frames to drop after each output (stride; 0 => frames-out,
                      i.e. non-overlapping windows)
 * ``frames-dim``   — nnstreamer dim index to count frames along
-* ``concat``       — whether to concat (true) or emit latest window
+* ``concat``       — true (default): one concatenated tensor per window;
+                     false: the window's frames stay separate tensors in one
+                     buffer (the reference's multi-GstMemory buffer analog)
 """
 
 from __future__ import annotations
@@ -37,6 +39,9 @@ class TensorAggregator(Element):
         self.frames_out = int(self.props.get("frames_out", 1))
         self.frames_flush = int(self.props.get("frames_flush", 0)) or self.frames_out
         self.frames_dim = int(self.props.get("frames_dim", 3))
+        self.concat = str(self.props.get("concat", "true")).lower() not in (
+            "false", "0", "no",
+        )
         self._window: Optional[np.ndarray] = None
         self._axis: Optional[int] = None
 
@@ -51,10 +56,18 @@ class TensorAggregator(Element):
                 raise ElementError(
                     f"frames-dim {self.frames_dim} out of range for rank {len(dims)}"
                 )
-            dims[self.frames_dim] = dims[self.frames_dim] // self.frames_in * self.frames_out
-            out_spec = TensorsSpec(
-                (TensorSpec(tuple(dims), spec[0].dtype),), rate=spec.rate
-            )
+            frame = dims[self.frames_dim] // self.frames_in
+            if self.concat:
+                dims[self.frames_dim] = frame * self.frames_out
+                out_spec = TensorsSpec(
+                    (TensorSpec(tuple(dims), spec[0].dtype),), rate=spec.rate
+                )
+            else:
+                dims[self.frames_dim] = frame
+                one = TensorSpec(tuple(dims), spec[0].dtype)
+                out_spec = TensorsSpec(
+                    tuple(one for _ in range(self.frames_out)), rate=spec.rate
+                )
         caps = Caps.tensors(out_spec)
         self.out_caps = {p: caps for p in out_pads}
         return self.out_caps
@@ -75,7 +88,15 @@ class TensorAggregator(Element):
         while self._window.shape[axis] >= need:
             sl = [slice(None)] * self._window.ndim
             sl[axis] = slice(0, need)
-            outs.append((SRC, buf.with_tensors([self._window[tuple(sl)]], spec=None)))
+            window = self._window[tuple(sl)]
+            if self.concat:
+                tensors = [window]
+            else:
+                tensors = [
+                    np.take(window, range(i * frame_len, (i + 1) * frame_len), axis=axis)
+                    for i in range(self.frames_out)
+                ]
+            outs.append((SRC, buf.with_tensors(tensors, spec=None)))
             keep = [slice(None)] * self._window.ndim
             keep[axis] = slice(stride, None)
             self._window = self._window[tuple(keep)]

@@ -76,23 +76,24 @@ class TensorIf(Element):
 
     # -- condition ---------------------------------------------------------
     def _evaluate(self, buf: Buffer) -> bool:
-        arrays = [np.asarray(t) for t in buf.tensors]
+        # Only materialize what the condition reads: tensors may be
+        # HBM-resident jax Arrays and np.asarray is a blocking D2H copy.
         if self.custom:
             with _lock:
                 fn = _custom_conditions.get(str(self.custom))
             if fn is None:
                 raise ElementError(f"no custom tensor_if condition {self.custom!r}")
-            return bool(fn(arrays))
+            return bool(fn([np.asarray(t) for t in buf.tensors]))
         if self.compared_value == "A_VALUE":
             # option "tensor_idx:flat_element_idx" (reference uses dim coords;
             # flat index covers the same selections deterministically)
             parts = [int(v) for v in self.cv_option.split(":") if v != ""]
             t_idx = parts[0] if parts else 0
             e_idx = parts[1] if len(parts) > 1 else 0
-            value = float(arrays[t_idx].ravel()[e_idx])
+            value = float(np.asarray(buf.tensors[t_idx]).ravel()[e_idx])
         elif self.compared_value == "TENSOR_AVERAGE_VALUE":
             t_idx = int(self.cv_option or 0)
-            value = float(arrays[t_idx].astype(np.float64).mean())
+            value = float(np.asarray(buf.tensors[t_idx]).astype(np.float64).mean())
         else:
             raise ElementError(f"unknown compared_value {self.compared_value!r}")
         op = _OPERATORS[self.operator]

@@ -28,7 +28,7 @@ class TensorRateAdjust(Element):
         self.n_out = 0
         self.n_dropped = 0
         self.n_duplicated = 0
-        self._next_pts = 0  # next output slot in ns
+        self._next_pts: Optional[int] = None  # next output slot in ns
 
     def configure(self, in_caps, out_pads):
         self.in_caps = dict(in_caps)
@@ -46,6 +46,10 @@ class TensorRateAdjust(Element):
             self.n_out += 1
             return [(SRC, buf)]
         frame_ns = int(1e9 * den / num)
+        if self._next_pts is None:
+            # Anchor the slot clock at the first observed pts — streams need
+            # not start at t=0 (mid-stream segments, live sources).
+            self._next_pts = buf.pts
         outs = []
         # emit one copy per output slot covered by this input's timestamp;
         # drop inputs that land before the next slot.

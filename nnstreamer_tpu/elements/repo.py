@@ -61,6 +61,12 @@ class TensorRepoSink(SinkElement):
         super().__init__(props, name)
         self._slot = _slot(_slot_key(self.props))
 
+    def start(self):
+        # A fresh stream re-arms the slot: without this, a second pipeline
+        # reusing the slot name would see the EOS latch from the previous
+        # run and end its recurrence immediately.
+        self._slot.eos.clear()
+
     def process(self, pad, buf: Buffer):
         self._slot.q.put(buf.to_host())
         return []

@@ -191,9 +191,11 @@ class TensorSplit(Element):
         spec = src.spec
         self.out_caps = {}
         pads = sorted(out_pads, key=_pad_index)
-        if self.segments and len(pads) > len(self.segments):
+        if self.segments and len(pads) != len(self.segments):
             raise ElementError(
-                f"split has {len(pads)} out pads but only {len(self.segments)} segments"
+                f"split has {len(pads)} out pads but {len(self.segments)} segments"
+                " — every segment needs a linked pad (unlinked segments would"
+                " silently drop data)"
             )
         for i, p in enumerate(pads):
             sub = None

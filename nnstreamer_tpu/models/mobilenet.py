@@ -1,0 +1,190 @@
+"""MobileNet-v1 image classifier — benchmark config #1 flagship model.
+
+Reference analog: the reference runs ``mobilenet_v1_1.0_224_quant.tflite``
+through the tensorflow-lite sub-plugin
+(``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc`` — SURVEY
+§2.4 [UNVERIFIED], reference mount empty).  Here the model is a pure JAX
+program designed for the MXU:
+
+* **NHWC layout** with channel counts that are multiples of 8/128 lane tiling
+  where possible; all convs lower to ``lax.conv_general_dilated`` which XLA
+  tiles onto the systolic array.
+* **bfloat16 compute** by default (``custom=dtype:float32`` to override):
+  params are stored float32 (optimizer-friendly) and cast at apply time, the
+  standard TPU mixed-precision recipe.
+* BatchNorm is represented as per-channel scale/bias (inference form).  It
+  stays differentiable, so the same apply_fn serves the trainer path.
+* ``param_pspecs`` shard pointwise-conv kernels over their output-channel
+  axis ("model" mesh axis) so the parallel runner can TP-shard the classifier
+  when a mesh is present; depthwise kernels are replicated (tiny).
+
+Weights are deterministic he-normal random (seed via ``custom=seed:N``) —
+this environment has zero egress, so no pretrained checkpoint download;
+``utils/import_torch.py``-style converters can inject real weights into the
+same pytree layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .zoo import ModelBundle, register_model
+
+# (stride, out_channels) per depthwise-separable block, after the stem conv.
+# Standard MobileNet-v1 1.0 topology.
+_V1_BLOCKS: Tuple[Tuple[int, int], ...] = (
+    (1, 64),
+    (2, 128),
+    (1, 128),
+    (2, 256),
+    (1, 256),
+    (2, 512),
+    (1, 512),
+    (1, 512),
+    (1, 512),
+    (1, 512),
+    (1, 512),
+    (2, 1024),
+    (1, 1024),
+)
+
+
+def _rounded(ch: int, width: float) -> int:
+    """Width-multiplied channel count, kept a multiple of 8 for lane tiling."""
+    v = max(8, int(ch * width + 4) // 8 * 8)
+    return v
+
+
+def init_params(
+    width: float = 1.0, classes: int = 1001, seed: int = 0
+) -> Dict:
+    """He-normal random params in the canonical pytree layout."""
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
+    params: Dict = {}
+
+    def conv(key, kh, kw, cin, cout):
+        fan_in = kh * kw * cin
+        w = jax.random.normal(key, (kh, kw, cin, cout), np.float32)
+        return w * np.sqrt(2.0 / fan_in)
+
+    c_in = 3
+    c = _rounded(32, width)
+    params["stem"] = {
+        "w": conv(next(keys), 3, 3, c_in, c),
+        "scale": np.ones((c,), np.float32),
+        "bias": np.zeros((c,), np.float32),
+    }
+    cin = c
+    for i, (_stride, cout_base) in enumerate(_V1_BLOCKS):
+        cout = _rounded(cout_base, width)
+        params[f"block{i}"] = {
+            # depthwise 3x3: HWIO with feature_group_count=cin -> (3,3,1,cin)
+            "dw": conv(next(keys), 3, 3, 1, cin),
+            "dw_scale": np.ones((cin,), np.float32),
+            "dw_bias": np.zeros((cin,), np.float32),
+            # pointwise 1x1
+            "pw": conv(next(keys), 1, 1, cin, cout),
+            "pw_scale": np.ones((cout,), np.float32),
+            "pw_bias": np.zeros((cout,), np.float32),
+        }
+        cin = cout
+    params["head"] = {
+        "w": conv(next(keys), 1, 1, cin, classes),
+        "bias": np.zeros((classes,), np.float32),
+    }
+    return params
+
+
+def param_pspecs() -> Dict:
+    """PartitionSpecs for TP over a ``("data","model")`` mesh.
+
+    Pointwise kernels shard over their output-channel axis; the following
+    block's pointwise input axis shards to match, so XLA inserts at most one
+    all-gather per block pair.  Depthwise/scale/bias tensors replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict = {
+        "stem": {"w": P(None, None, None, "model"), "scale": P("model"), "bias": P("model")}
+    }
+    for i in range(len(_V1_BLOCKS)):
+        specs[f"block{i}"] = {
+            "dw": P(),
+            "dw_scale": P(),
+            "dw_bias": P(),
+            "pw": P(None, None, None, "model"),
+            "pw_scale": P("model"),
+            "pw_bias": P("model"),
+        }
+    specs["head"] = {"w": P(None, None, None, "model"), "bias": P("model")}
+    return specs
+
+
+def apply(params, x, *, compute_dtype="bfloat16", train: bool = False):
+    """Forward pass.  ``x``: NHWC float (any float dtype), returns logits."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    cdt = jnp.dtype(compute_dtype)
+    x = x.astype(cdt)
+
+    def conv2d(x, w, stride, groups=1):
+        return lax.conv_general_dilated(
+            x,
+            w.astype(cdt),
+            window_strides=(stride, stride),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+        )
+
+    def scale_bias_relu6(x, scale, bias):
+        x = x * scale.astype(cdt) + bias.astype(cdt)
+        return jnp.clip(x, 0.0, 6.0)
+
+    p = params["stem"]
+    x = conv2d(x, p["w"], 2)
+    x = scale_bias_relu6(x, p["scale"], p["bias"])
+
+    for i, (stride, _cout) in enumerate(_V1_BLOCKS):
+        b = params[f"block{i}"]
+        cin = x.shape[-1]
+        x = conv2d(x, b["dw"], stride, groups=cin)
+        x = scale_bias_relu6(x, b["dw_scale"], b["dw_bias"])
+        x = conv2d(x, b["pw"], 1)
+        x = scale_bias_relu6(x, b["pw_scale"], b["pw_bias"])
+
+    x = jnp.mean(x, axis=(1, 2), keepdims=True)  # global average pool
+    h = params["head"]
+    x = conv2d(x, h["w"], 1) + h["bias"].astype(cdt)
+    logits = x[:, 0, 0, :]
+    return logits.astype(jnp.float32)
+
+
+@register_model("mobilenet_v1")
+def _mobilenet_v1(opts: Dict[str, str]) -> ModelBundle:
+    width = float(opts.get("width", 1.0))
+    classes = int(opts.get("classes", 1001))
+    seed = int(opts.get("seed", 0))
+    size = int(opts.get("size", 224))
+    batch = int(opts.get("batch", 1))
+    dtype = opts.get("dtype", "bfloat16")
+
+    params = init_params(width=width, classes=classes, seed=seed)
+    apply_fn = functools.partial(apply, compute_dtype=dtype)
+
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(f"{classes}:{batch}", "float32"),
+        param_pspecs=param_pspecs(),
+        name="mobilenet_v1",
+    )

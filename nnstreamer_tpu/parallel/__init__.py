@@ -1,1 +1,21 @@
-"""nnstreamer_tpu.parallel"""
+"""Parallelism: device meshes, shardings, collectives, sequence parallel.
+
+TPU-native replacement for the reference's distribution stack (SURVEY
+§2.7/§2.9/§5.8): instead of TCP/MQTT/gRPC point-to-point between hosts,
+scale-out is a ``jax.sharding.Mesh`` with XLA collectives over ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    AXES,
+    local_batch,
+    make_mesh,
+    mesh_axis_size,
+    single_device_mesh,
+)
+from .sharding import (  # noqa: F401
+    batch_sharding,
+    replicate,
+    shard_batch,
+    shard_params,
+)
+from .ring import ring_attention, ring_attention_local  # noqa: F401

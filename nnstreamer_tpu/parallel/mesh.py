@@ -1,0 +1,91 @@
+"""Device-mesh construction: the TPU replacement for nnstreamer-edge topology.
+
+Reference analog: the reference distributes work by *naming hosts* —
+``tensor_query_client host=H port=P`` over TCP (SURVEY §2.7/§5.8).  On TPU
+the unit of distribution is the **ICI-connected device mesh**: we name
+logical axes and let XLA place collectives on ICI links.
+
+Axis conventions used across the framework:
+
+* ``data``   — batch (DP): streams/frames sharded across chips.
+* ``model``  — tensor parallel (TP): weight matrices split over channels/heads.
+* ``seq``    — sequence/context parallel (SP): ring attention over tokens.
+* ``expert`` — expert parallel (EP) for MoE models.
+* ``pipe``   — pipeline stages (inter-stage, software-pipelined).
+
+Any axis of size 1 is legal and free, so a single ``make_mesh`` call serves
+1-chip dev runs and v5e-8 pods alike.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+AXES = ("data", "model", "seq", "expert", "pipe")
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    devices=None,
+    data: int = 0,
+    model: int = 1,
+    seq: int = 1,
+    expert: int = 1,
+    pipe: int = 1,
+):
+    """Build a ``jax.sharding.Mesh`` with the framework's canonical axes.
+
+    ``data=0`` (default) means "absorb all remaining devices".  Example::
+
+        mesh = make_mesh(model=2)          # on 8 devices -> data=4, model=2
+        mesh = make_mesh({"data": 2, "seq": 4})
+    """
+    import jax
+    import numpy as np
+
+    sizes = {"data": data, "model": model, "seq": seq, "expert": expert, "pipe": pipe}
+    if axis_sizes:
+        unknown = set(axis_sizes) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXES}")
+        sizes.update(axis_sizes)
+
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    fixed = 1
+    for name in AXES:
+        if name != "data" and sizes[name] > 1:
+            fixed *= sizes[name]
+    if sizes["data"] in (0, None):
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes["data"] = n // fixed
+    total = sizes["data"] * fixed
+    if total != n:
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {n}"
+        )
+
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.asarray(devs).reshape(shape)
+    return jax.sharding.Mesh(arr, AXES)
+
+
+def single_device_mesh(device=None):
+    """A 1-device mesh (every axis size 1) — lets mesh-aware code run anywhere."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    return make_mesh(data=1, devices=[dev])
+
+
+def mesh_axis_size(mesh, name: str) -> int:
+    return int(mesh.shape.get(name, 1))
+
+
+def local_batch(mesh, global_batch: int) -> int:
+    d = mesh_axis_size(mesh, "data")
+    if global_batch % d:
+        raise ValueError(f"global batch {global_batch} not divisible by data={d}")
+    return global_batch // d

@@ -1,0 +1,74 @@
+"""Sharding helpers: put batches and params where the mesh wants them.
+
+Reference analog: the reference's "distribution" is serializing tensors over
+TCP to another host's pipeline (SURVEY §2.7).  Here distribution is a
+``NamedSharding`` annotation — XLA inserts the all-gathers/reduce-scatters
+and they ride ICI.  These helpers are the whole host-side API:
+
+* :func:`batch_sharding` / :func:`shard_batch` — split the leading (batch)
+  axis over the ``data`` mesh axis (the tensor_query DP path).
+* :func:`shard_params` — place a param pytree per its ``param_pspecs``
+  (TP over ``model``), replicating anything without a spec.
+* :func:`replicate` — broadcast small pytrees to every device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def _ns(mesh, spec):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec)
+
+
+def batch_sharding(mesh, ndim: int, axis: str = "data"):
+    """NamedSharding splitting dim 0 over ``axis``, replicated elsewhere."""
+    from jax.sharding import PartitionSpec as P
+
+    return _ns(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def shard_batch(mesh, x, axis: str = "data"):
+    """Device_put a host batch split over the data axis (zero-copy per shard)."""
+    import jax
+
+    return jax.device_put(x, batch_sharding(mesh, getattr(x, "ndim", 1), axis))
+
+
+def replicate(mesh, tree):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    sh = _ns(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def shard_params(mesh, params, pspecs: Optional[Any]):
+    """Place params per a matching pytree of PartitionSpecs (None→replicate)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if pspecs is None:
+        return replicate(mesh, params)
+
+    def put(x, spec):
+        spec = spec if spec is not None else P()
+        return jax.device_put(x, _ns(mesh, spec))
+
+    # pspecs may be a partial tree (dict subset); normalize with a walk.
+    def walk(p, s):
+        if isinstance(p, dict):
+            return {k: walk(v, (s or {}).get(k) if isinstance(s, dict) else None) for k, v in p.items()}
+        return put(p, s)
+
+    if isinstance(params, dict):
+        return walk(params, pspecs)
+    return jax.tree_util.tree_map(put, params, pspecs)
+
+
+def out_shardings_like(mesh, tree_pspecs):
+    import jax
+
+    return jax.tree_util.tree_map(lambda s: _ns(mesh, s), tree_pspecs)

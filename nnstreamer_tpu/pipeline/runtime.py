@@ -161,20 +161,29 @@ class _Runner:
                 metrics.count(f"{el.name}.out")
 
     def _try_groups(self) -> None:
-        """Collate one buffer per active pad (slowest-pad sync; reference:
-        tensor_mux sync-mode=slowest).  A pad stays active while it has
-        pending buffers even after EOS — data queued before EOS must still
-        pair up; the pad only drops out once EOS'd AND drained."""
+        """Collate one buffer per pad (slowest-pad sync; reference:
+        tensor_mux sync-mode=slowest).  A pad keeps pairing from its pending
+        queue after EOS — data queued before EOS must still pair up.  Once
+        any pad is EOS'd AND drained no complete group can ever form again,
+        so remaining unpairable buffers are dropped: emitting a partial
+        group would violate the element's negotiated caps (e.g. a 2-tensor
+        mux emitting 1 tensor)."""
         el = self.element
         while True:
-            active = [
+            dead = [
                 p
                 for p in self.in_pads
-                if self._pending.get(p) or p not in self._eos_pads
+                if p in self._eos_pads and not self._pending.get(p)
             ]
-            if not active or not all(self._pending.get(p) for p in active):
+            if dead:
+                n = sum(len(v) for v in self._pending.values())
+                if n:
+                    metrics.count(f"{el.name}.dropped", n)
+                    self._pending.clear()
                 return
-            group = {p: self._pending[p].pop(0) for p in active}
+            if not all(self._pending.get(p) for p in self.in_pads):
+                return
+            group = {p: self._pending[p].pop(0) for p in self.in_pads}
             with Timer(f"{el.name}.proc"):
                 outs = el.process_group(group)
             self._emit(outs)

@@ -7,7 +7,11 @@ localhost-socket multi-process rigs.  Must be set before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the dev/driver environment exports JAX_PLATFORMS=axon (a real
+# TPU tunnel) globally, so a plain setdefault would silently run the whole
+# suite on one remote chip — slow, non-hermetic, and the 8-device mesh tests
+# would fail.  Tests are hermetic by design (SURVEY §4 translation).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
