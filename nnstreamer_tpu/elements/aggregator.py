@@ -92,10 +92,11 @@ class TensorAggregator(Element):
             if self.concat:
                 tensors = [window]
             else:
-                tensors = [
-                    np.take(window, range(i * frame_len, (i + 1) * frame_len), axis=axis)
-                    for i in range(self.frames_out)
-                ]
+                tensors = []
+                for i in range(self.frames_out):
+                    fsl = [slice(None)] * window.ndim
+                    fsl[axis] = slice(i * frame_len, (i + 1) * frame_len)
+                    tensors.append(window[tuple(fsl)])
             outs.append((SRC, buf.with_tensors(tensors, spec=None)))
             keep = [slice(None)] * self._window.ndim
             keep[axis] = slice(stride, None)

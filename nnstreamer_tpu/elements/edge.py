@@ -1,0 +1,229 @@
+"""edgesrc/edgesink: pub/sub tensor transport between pipelines/hosts.
+
+Reference analog (SURVEY §2.7): ``gst/edge/gstedgesrc.c``/``gstedgesink.c``
+publish/subscribe tensor streams through the nnstreamer-edge library (TCP
+direct, or MQTT-hybrid broker discovery).  Here the transport is the
+framework wire format over TCP: an ``edgesink`` listens and fans every
+buffer out to all connected subscribers whose topic matches; an ``edgesrc``
+connects, subscribes with a topic, and injects received buffers into its
+pipeline.  This is the DCN-side stream feed of the distribution story (the
+north-star maps broker transport to DCN streaming into per-host device_put).
+
+Unlike tensor_query there is no response path and no per-message pairing —
+fire-and-forget fan-out, matching the reference's pub/sub semantics (slow
+subscribers drop: the publisher never backpressures the pipeline).
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import socket
+import threading
+from typing import Dict, Iterator, Optional, Union
+
+from ..core.buffer import Buffer, Event
+from ..core.log import logger, metrics
+from ..core.registry import register_element
+from ..utils import wire
+from .base import ElementError, SinkElement, SourceElement
+
+log = logger(__name__)
+
+
+@register_element("edgesink")
+class EdgeSink(SinkElement):
+    """Publish buffers to every connected subscriber.
+
+    Props: ``host`` (bind address), ``port`` (0 = OS-assigned; see
+    ``.bound_port``), ``topic``, ``max-queue`` (per-subscriber send queue;
+    overflow drops oldest — pub/sub never backpressures).
+    """
+
+    kind = "edgesink"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.host = str(self.props.get("host", "127.0.0.1"))
+        self.port = int(self.props.get("port", 0))
+        self.topic = str(self.props.get("topic", ""))
+        self.max_queue = int(self.props.get("max_queue", 64))
+        self._subs: Dict[int, _queue.Queue] = {}
+        self._lock = threading.Lock()
+        self._next_sub = 0
+        self._stopping = threading.Event()
+        self._listener: Optional[socket.socket] = None
+
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        threading.Thread(
+            target=self._accept_loop, name=f"{self.name}-accept", daemon=True
+        ).start()
+
+    @property
+    def bound_port(self) -> int:
+        if self._listener is None:
+            raise ElementError("edgesink not started")
+        return self._listener.getsockname()[1]
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._lock:
+            self._subs.clear()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._sub_session, args=(conn,), daemon=True,
+                name=f"{self.name}-sub",
+            ).start()
+
+    def _sub_session(self, conn: socket.socket) -> None:
+        sid = None
+        try:
+            conn.settimeout(5.0)
+            raw = wire.read_frame(conn)
+            hello = json.loads(raw.decode("utf-8")) if raw else None
+            if not isinstance(hello, dict) or hello.get("type") != "subscribe":
+                return
+            if self.topic and hello.get("topic", "") not in ("", self.topic):
+                wire.write_frame(conn, json.dumps(
+                    {"type": "nack", "reason": "topic mismatch"}).encode())
+                return
+            wire.write_frame(conn, json.dumps(
+                {"type": "ack", "topic": self.topic}).encode())
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(None)
+            q: _queue.Queue = _queue.Queue(maxsize=self.max_queue)
+            with self._lock:
+                sid = self._next_sub
+                self._next_sub += 1
+                self._subs[sid] = q
+            metrics.count(f"{self.name}.subscribers")
+            while not self._stopping.is_set():
+                try:
+                    payload = q.get(timeout=0.2)
+                except _queue.Empty:
+                    continue
+                if payload is None:  # EOS marker
+                    return
+                wire.write_frame(conn, payload)
+        except (OSError, ValueError) as e:
+            log.debug("%s: subscriber dropped: %s", self.name, e)
+        finally:
+            if sid is not None:
+                with self._lock:
+                    self._subs.pop(sid, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def process(self, pad, buf: Buffer):
+        payload = wire.encode_buffer(buf.to_host())
+        with self._lock:
+            subs = list(self._subs.values())
+        for q in subs:
+            while True:
+                try:
+                    q.put_nowait(payload)
+                    break
+                except _queue.Full:
+                    try:
+                        q.get_nowait()  # drop oldest for the slow subscriber
+                        metrics.count(f"{self.name}.dropped")
+                    except _queue.Empty:
+                        continue
+        metrics.count(f"{self.name}.published")
+        return []
+
+    def finalize(self):
+        with self._lock:
+            subs = list(self._subs.values())
+        for q in subs:
+            try:
+                q.put(None, timeout=1.0)
+            except _queue.Full:
+                pass
+        return []
+
+
+@register_element("edgesrc")
+class EdgeSrc(SourceElement):
+    """Subscribe to an edgesink and inject received buffers.
+
+    Props: ``host``, ``port``, ``topic``, ``num-buffers`` (stop after N;
+    -1 = until publisher closes).
+    """
+
+    kind = "edgesrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.host = str(self.props.get("host", "127.0.0.1"))
+        self.port = int(self.props.get("port", 0))
+        self.topic = str(self.props.get("topic", ""))
+        self.num_buffers = int(self.props.get("num_buffers", -1))
+        self._sock: Optional[socket.socket] = None
+
+    def start(self) -> None:
+        if self.port <= 0:
+            raise ElementError(f"{self.name}: port property required")
+        try:
+            self._sock = socket.create_connection((self.host, self.port), timeout=5.0)
+        except OSError as e:
+            raise ElementError(
+                f"{self.name}: cannot connect {self.host}:{self.port}: {e}"
+            ) from e
+        wire.write_frame(
+            self._sock, json.dumps({"type": "subscribe", "topic": self.topic}).encode()
+        )
+        raw = wire.read_frame(self._sock)
+        ack = json.loads(raw.decode("utf-8")) if raw else None
+        if not isinstance(ack, dict) or ack.get("type") != "ack":
+            raise ElementError(f"{self.name}: subscription rejected: {ack}")
+        self._sock.settimeout(0.2)
+
+    def stop(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def generate(self) -> Iterator[Union[Buffer, Event]]:
+        stop = getattr(self, "_stop_event", threading.Event())
+        count = 0
+        while not stop.is_set() and count != self.num_buffers:
+            sock = self._sock
+            if sock is None:
+                return
+            try:
+                raw = wire.read_frame(sock)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if raw is None:
+                return  # publisher closed: EOS
+            buf, _flags = wire.decode_buffer(raw)
+            metrics.count(f"{self.name}.received")
+            yield buf
+            count += 1

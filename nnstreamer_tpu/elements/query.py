@@ -1,0 +1,462 @@
+"""tensor_query elements: offload inference to a remote pipeline.
+
+Reference analog (SURVEY §2.7, §3.3): ``tensor_query_client`` serializes
+input tensors, sends them to an "edge server" over nnstreamer-edge TCP,
+receives results asynchronously matched by message id (GstMetaQuery), and
+pushes them downstream; ``tensor_query_serversrc`` listens and injects
+received tensors into the server-side pipeline; ``tensor_query_serversink``
+returns each result to the client connection recorded in the buffer's meta.
+Multiple clients are served concurrently.
+
+TPU-first translation: the wire is the framework's own tensor wire format
+(utils/wire.py) over a DCN-style TCP stream — this is the host-level feed
+layer of the distribution story (intra-pod scale-out is jax collectives over
+ICI, see parallel/).  A server pipeline typically batches client frames and
+runs a mesh-sharded ``tensor_filter``, so one logical query server is a
+pod-sharded service (north star: "tensor_query data-parallel pod sharding").
+
+Protocol (all frames length-prefixed, utils/wire.read_frame/write_frame):
+
+  client->server  JSON hello  {"type":"hello","caps":str,"topic":str}
+  server->client  JSON ack    {"type":"ack","caps":str}
+  client->server  tensor frame (wire buffer; meta["_query_msg"]=msg id)
+  server->client  tensor frame (same msg id echoed in meta)
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import socket
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple, Union
+
+from ..core.buffer import Buffer, Event
+from ..core.caps import Caps
+from ..core.log import logger, metrics
+from ..core.registry import register_element
+from ..utils import wire
+from .base import Element, ElementError, SourceElement, SinkElement, SRC
+
+log = logger(__name__)
+
+_META_MSG = "_query_msg"
+_META_CONN = "_query_conn"
+
+# Server cores shared between a serversrc and its serversink, keyed by the
+# ``id`` property (reference: query server data registry paired by server id).
+_servers: Dict[int, "_ServerCore"] = {}
+_servers_lock = threading.Lock()
+
+
+def _hello_frame(**kw) -> bytes:
+    return json.dumps({"type": "hello", **kw}).encode("utf-8")
+
+
+def _parse_control(raw: bytes) -> Optional[dict]:
+    """Control frames are JSON objects; tensor frames start with wire magic."""
+    if len(raw) >= 4 and int.from_bytes(raw[:4], "little") == wire.MAGIC:
+        return None
+    try:
+        msg = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        return None
+    return msg if isinstance(msg, dict) else None
+
+
+class _ServerCore:
+    """TCP listener + per-connection readers feeding one inbound queue.
+
+    The serversrc drains ``inbound``; the serversink routes responses back
+    through ``send()`` using the connection id stamped into buffer meta
+    (the GstMetaQuery analog).
+    """
+
+    def __init__(self, host: str, port: int, topic: str = ""):
+        self.topic = topic
+        self.inbound: _queue.Queue = _queue.Queue(maxsize=256)
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_locks: Dict[int, threading.Lock] = {}
+        self._next_conn = 0
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"query-accept:{self.port}", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                cid = self._next_conn
+                self._next_conn += 1
+                self._conns[cid] = conn
+                self._conn_locks[cid] = threading.Lock()
+            threading.Thread(
+                target=self._reader, args=(cid, conn), daemon=True,
+                name=f"query-conn:{self.port}:{cid}",
+            ).start()
+
+    def _reader(self, cid: int, conn: socket.socket) -> None:
+        try:
+            raw = wire.read_frame(conn)
+            hello = _parse_control(raw) if raw else None
+            if not hello or hello.get("type") != "hello":
+                log.warning("query conn %d: bad handshake", cid)
+                return
+            if self.topic and hello.get("topic", "") != self.topic:
+                wire.write_frame(conn, json.dumps(
+                    {"type": "nack", "reason": "topic mismatch"}).encode())
+                return
+            wire.write_frame(conn, json.dumps(
+                {"type": "ack", "caps": self.topic}).encode())
+            while not self._stopping.is_set():
+                raw = wire.read_frame(conn)
+                if raw is None:
+                    return
+                buf, _flags = wire.decode_buffer(raw)
+                buf.meta[_META_CONN] = cid
+                metrics.count("query_server.in")
+                while not self._stopping.is_set():
+                    try:
+                        self.inbound.put(buf, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+        except (OSError, ValueError) as e:
+            log.debug("query conn %d closed: %s", cid, e)
+        finally:
+            self.drop_conn(cid)
+
+    def send(self, cid: int, payload: bytes) -> bool:
+        with self._lock:
+            conn = self._conns.get(cid)
+            lk = self._conn_locks.get(cid)
+        if conn is None:
+            return False
+        try:
+            with lk:
+                wire.write_frame(conn, payload)
+            return True
+        except OSError:
+            self.drop_conn(cid)
+            return False
+
+    def drop_conn(self, cid: int) -> None:
+        with self._lock:
+            conn = self._conns.pop(cid, None)
+            self._conn_locks.pop(cid, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for cid in conns:
+            self.drop_conn(cid)
+
+
+def _get_server(sid: int) -> Optional[_ServerCore]:
+    with _servers_lock:
+        return _servers.get(sid)
+
+
+@register_element("tensor_query_serversrc")
+class TensorQueryServerSrc(SourceElement):
+    """Listen for query clients; push received tensors into the pipeline.
+
+    Props: ``host`` (default 127.0.0.1), ``port`` (0 = OS-assigned; read the
+    bound port via ``.bound_port``), ``id`` (pairs with the serversink of the
+    same id), ``topic`` (optional capability filter).
+    """
+
+    kind = "tensor_query_serversrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.host = str(self.props.get("host", "127.0.0.1"))
+        self.port = int(self.props.get("port", 0))
+        self.sid = int(self.props.get("id", 0))
+        self.topic = str(self.props.get("topic", ""))
+        self._core: Optional[_ServerCore] = None
+
+    def start(self) -> None:
+        with _servers_lock:
+            if self.sid in _servers:
+                raise ElementError(f"query server id={self.sid} already running")
+        core = _ServerCore(self.host, self.port, topic=self.topic)
+        with _servers_lock:
+            if self.sid in _servers:  # lost a construction race
+                core.close()
+                raise ElementError(f"query server id={self.sid} already running")
+            _servers[self.sid] = core
+        self._core = core
+
+    def stop(self) -> None:
+        with _servers_lock:
+            if _servers.get(self.sid) is self._core:
+                del _servers[self.sid]
+        if self._core is not None:
+            self._core.close()
+            self._core = None
+
+    @property
+    def bound_port(self) -> int:
+        if self._core is None:
+            raise ElementError("serversrc not started")
+        return self._core.port
+
+    def generate(self) -> Iterator[Union[Buffer, Event]]:
+        stop = getattr(self, "_stop_event", threading.Event())
+        while not stop.is_set():
+            try:
+                yield self._core.inbound.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+
+
+@register_element("tensor_query_serversink")
+class TensorQueryServerSink(SinkElement):
+    """Return each result buffer to the client connection recorded in its
+    meta.  Props: ``id`` (matches the serversrc)."""
+
+    kind = "tensor_query_serversink"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.sid = int(self.props.get("id", 0))
+
+    def process(self, pad, buf: Buffer):
+        core = _get_server(self.sid)
+        if core is None:
+            raise ElementError(f"no query server with id={self.sid}")
+        cid = buf.meta.get(_META_CONN)
+        if cid is None:
+            log.warning("%s: buffer without query connection meta; dropped", self.name)
+            metrics.count(f"{self.name}.dropped")
+            return []
+        out = buf.to_host()
+        # Do not leak server-side routing meta back to the client.
+        out.meta.pop(_META_CONN, None)
+        if core.send(int(cid), wire.encode_buffer(out)):
+            metrics.count("query_server.out")
+        else:
+            metrics.count(f"{self.name}.dropped")
+        return []
+
+
+@register_element("tensor_query_client")
+class TensorQueryClient(Element):
+    """Offload buffers to a query server; push responses downstream in
+    request order.
+
+    Props: ``host``/``port`` (server address), ``timeout`` (seconds a
+    response may take before the timeout policy fires), ``max-in-flight``
+    (pipelining window: requests outstanding before ``process`` blocks),
+    ``topic``, ``on-timeout`` (``error`` | ``drop``).
+
+    Responses arrive on a receiver thread, are re-ordered by message id (the
+    reference pairs via GstMetaQuery msg ids), and are pushed downstream
+    **asynchronously** in request order — exactly the reference's "(async)
+    edge event cb: result arrives -> push result downstream" (SURVEY §3.3).
+    """
+
+    kind = "tensor_query_client"
+    wants_async_emit = True
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.host = str(self.props.get("host", "127.0.0.1"))
+        self.port = int(self.props.get("port", 0))
+        self.timeout = float(self.props.get("timeout", 10.0))
+        self.window = int(self.props.get("max_in_flight", 8))
+        self.topic = str(self.props.get("topic", ""))
+        self.on_timeout = str(self.props.get("on_timeout", "error"))
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._next_msg = 0
+        self._emit_next = 0
+        self._pending: Dict[int, Tuple[Buffer, float]] = {}  # id -> (orig, t_sent)
+        self._done: Dict[int, Buffer] = {}  # msg id -> response awaiting its turn
+        self._cv = threading.Condition()
+        # Serializes the pop-ready+feed step across the rx thread and the
+        # timeout path so in-order delivery holds (never held with _cv).
+        self._emit_lock = threading.Lock()
+        self._rx_error: Optional[BaseException] = None
+        self._reader: Optional[threading.Thread] = None
+        self._async_emit = None  # injected by the runtime (wants_async_emit)
+
+    def start(self) -> None:
+        if self.port <= 0:
+            raise ElementError(f"{self.name}: port property required")
+        try:
+            self._sock = socket.create_connection((self.host, self.port), timeout=5.0)
+        except OSError as e:
+            raise ElementError(
+                f"{self.name}: cannot connect {self.host}:{self.port}: {e}"
+            ) from e
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        wire.write_frame(self._sock, _hello_frame(caps="other/tensors", topic=self.topic))
+        raw = wire.read_frame(self._sock)
+        ack = _parse_control(raw) if raw else None
+        if not ack or ack.get("type") != "ack":
+            raise ElementError(f"{self.name}: server rejected connection: {ack}")
+        self._sock.settimeout(0.2)
+        self._reader = threading.Thread(
+            target=self._rx_loop, name=f"{self.name}-rx", daemon=True
+        )
+        self._reader.start()
+
+    def stop(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._reader is not None:
+            self._reader.join(timeout=2.0)
+            self._reader = None
+
+    def _rx_loop(self) -> None:
+        while True:
+            sock = self._sock
+            if sock is None:
+                return
+            try:
+                raw = wire.read_frame(sock)
+            except socket.timeout:
+                continue
+            except OSError:
+                raw = None
+            if raw is None:
+                with self._cv:
+                    if self._pending and self._rx_error is None:
+                        self._rx_error = ConnectionError("query server closed connection")
+                    self._cv.notify_all()
+                return
+            try:
+                buf, _flags = wire.decode_buffer(raw)
+            except ValueError as e:
+                with self._cv:
+                    self._rx_error = e
+                    self._cv.notify_all()
+                return
+            mid = int(buf.meta.pop(_META_MSG, -1))
+            with self._cv:
+                entry = self._pending.pop(mid, None)
+                if entry is None:
+                    log.warning("%s: unmatched response msg=%d", self.name, mid)
+                    continue
+                orig, _t = entry
+                # Response keeps the request's timing identity.
+                buf.pts = orig.pts
+                buf.seqno = orig.seqno
+                self._done[mid] = buf
+                metrics.count(f"{self.name}.responses")
+                self._cv.notify_all()
+            self._drain_ready()
+
+    def _drain_ready(self) -> None:
+        """Atomically pop in-order completed responses and feed them
+        downstream.  Holding ``_emit_lock`` across pop+feed means whichever
+        thread pops the current head also delivers it before any other
+        thread can pop later items — in-order delivery under concurrency."""
+        with self._emit_lock:
+            with self._cv:
+                ready: List[Buffer] = []
+                while self._emit_next in self._done:
+                    ready.append(self._done.pop(self._emit_next))
+                    self._emit_next += 1
+                self._cv.notify_all()
+            if not ready:
+                return
+            if self._async_emit is None:  # unit use outside a pipeline
+                raise ElementError(f"{self.name}: not attached to a pipeline")
+            self._async_emit([(SRC, b) for b in ready])
+
+    def _wait_outstanding(self, below: int) -> None:
+        """Block until fewer than ``below`` requests are outstanding,
+        enforcing the per-request timeout policy on the head request."""
+        while True:
+            drain = False
+            with self._cv:
+                if self._rx_error is not None:
+                    raise ElementError(f"{self.name}: {self._rx_error}")
+                outstanding = len(self._pending) + len(self._done)
+                if outstanding < below:
+                    break
+                entry = self._pending.get(self._emit_next)
+                if entry is not None:
+                    overdue = time.monotonic() - entry[1] - self.timeout
+                    if overdue >= 0:
+                        self._pending.pop(self._emit_next)
+                        metrics.count(f"{self.name}.timeouts")
+                        if self.on_timeout != "drop":
+                            raise ElementError(
+                                f"{self.name}: no response for request "
+                                f"{self._emit_next} within {self.timeout}s"
+                            )
+                        log.warning("%s: request %d timed out; dropped",
+                                    self.name, self._emit_next)
+                        self._emit_next += 1
+                        drain = True
+                    else:
+                        self._cv.wait(timeout=min(-overdue, 0.2))
+                elif self._emit_next in self._done:
+                    drain = True
+                else:
+                    self._cv.wait(timeout=0.2)
+            if drain:
+                self._drain_ready()
+
+    def process(self, pad, buf: Buffer):
+        self._wait_outstanding(self.window)
+        host_buf = buf.to_host()
+        with self._cv:
+            mid = self._next_msg
+            self._next_msg += 1
+            self._pending[mid] = (host_buf, time.monotonic())
+        host_buf.meta[_META_MSG] = mid
+        payload = wire.encode_buffer(host_buf)
+        host_buf.meta.pop(_META_MSG, None)
+        try:
+            with self._send_lock:
+                wire.write_frame(self._sock, payload)
+        except (OSError, AttributeError) as e:
+            raise ElementError(f"{self.name}: send failed: {e}") from e
+        metrics.count(f"{self.name}.requests")
+        return []
+
+    def finalize(self):
+        # EOS: every outstanding request must resolve (or time out) before
+        # EOS propagates downstream.
+        self._wait_outstanding(1)
+        # Barrier: the rx thread may have popped the last response but not
+        # yet fed it; it feeds under _emit_lock, so taking it once here
+        # guarantees delivery happened before EOS follows.
+        with self._emit_lock:
+            pass
+        return []

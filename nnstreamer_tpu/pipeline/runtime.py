@@ -66,6 +66,10 @@ class _Runner:
         self.thread = threading.Thread(
             target=self._run, name=f"nns-{self.element.name}", daemon=True
         )
+        # Elements with their own receiver threads (query client) emit
+        # downstream asynchronously, not just from process() returns.
+        if getattr(self.element, "wants_async_emit", False):
+            self.element._async_emit = self._emit
         self.in_pads: List[str] = []
         self._eos_pads: set = set()
         self._pending: Dict[str, List[Buffer]] = {}
@@ -291,7 +295,8 @@ class Pipeline:
     def stop(self) -> None:
         self._stopping.set()
         for r in {id(r): r for r in self._runners.values()}.values():
-            r.thread.join(timeout=5.0)
+            if r.thread.ident is not None:  # start() may have failed part-way
+                r.thread.join(timeout=5.0)
         for el in self.elements.values():
             try:
                 el.stop()

@@ -112,8 +112,15 @@ def decode_buffer(raw: bytes) -> Tuple[Buffer, int]:
 
 
 def read_frame(sock) -> Optional[bytes]:
-    """Read one length-prefixed frame from a socket-like object."""
-    hdr = _read_exact(sock, 8)
+    """Read one length-prefixed frame from a socket-like object.
+
+    With a socket timeout set, ``socket.timeout`` propagates ONLY while the
+    stream is idle (no header byte read yet) — callers use that to poll
+    their stop flags.  Once a frame has started, timeouts are swallowed and
+    the read continues: dropping partially-read bytes would desync the
+    length-prefixed stream for good.
+    """
+    hdr = _read_exact(sock, 8, idle_timeout=True)
     if hdr is None:
         return None
     (length,) = struct.unpack("<Q", hdr)
@@ -124,11 +131,18 @@ def write_frame(sock, payload: bytes) -> None:
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
-def _read_exact(sock, n: int) -> Optional[bytes]:
+def _read_exact(sock, n: int, idle_timeout: bool = False) -> Optional[bytes]:
+    import socket as _socket
+
     chunks = []
     got = 0
     while got < n:
-        chunk = sock.recv(n - got)
+        try:
+            chunk = sock.recv(n - got)
+        except _socket.timeout:
+            if idle_timeout and got == 0:
+                raise
+            continue  # mid-frame stall: keep the partial bytes, keep reading
         if not chunk:
             return None
         chunks.append(chunk)

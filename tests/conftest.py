@@ -18,8 +18,32 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The interpreter may have pre-imported jax (the axon plugin does so at
+# startup), in which case the env vars above arrive too late for
+# JAX_PLATFORMS — force the platform through the live config instead.
+# XLA_FLAGS is still read at backend init, so the device count sticks.
+import sys  # noqa: E402
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    import jax
+
+    assert jax.devices()[0].platform == "cpu", (
+        "test suite must run on the virtual CPU mesh, got "
+        f"{jax.devices()[0]}"
+    )
+    assert len(jax.devices()) >= 8, (
+        f"expected 8 virtual CPU devices, got {len(jax.devices())} — "
+        "XLA_FLAGS was applied too late (backend already initialized?)"
+    )
 
 
 @pytest.fixture

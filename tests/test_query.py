@@ -1,0 +1,173 @@
+"""Distribution layer tests: tensor_query offload + edge pub/sub.
+
+Reference analog (SURVEY §4): query/edge suites run client & server
+pipelines in one process on localhost ports — "multi-node without a
+cluster".  Same here: a server pipeline (serversrc ! filter ! serversink)
+and client pipelines talk over real TCP sockets on 127.0.0.1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.pipeline.runtime import PipelineError
+from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+
+@pytest.fixture(autouse=True)
+def _models():
+    spec = TensorsSpec.from_string("4", "float32")
+    register_custom_easy(
+        "q-double", lambda ins: [ins[0] * 2], in_spec=spec, out_spec=spec,
+    )
+    yield
+
+
+def _server_pipeline(sid=0):
+    return nt.Pipeline(
+        f"tensor_query_serversrc name=ssrc port=0 id={sid} ! "
+        "tensor_filter framework=custom-easy model=q-double ! "
+        f"tensor_query_serversink id={sid}"
+    )
+
+
+def test_query_roundtrip():
+    with _server_pipeline() as srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=10 ! "
+            "tensor_sink name=out"
+        )
+        with cli:
+            for i in range(5):
+                x = np.full((4,), float(i), np.float32)
+                cli.push("src", x)
+            for i in range(5):
+                out = cli.pull("out", timeout=10)
+                np.testing.assert_allclose(out.tensors[0], np.full((4,), 2.0 * i))
+            cli.eos("src")
+            cli.wait(timeout=10)
+
+
+def test_query_preserves_order_and_meta():
+    with _server_pipeline(sid=1) as srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "max-in-flight=4 timeout=10 ! tensor_sink name=out"
+        )
+        with cli:
+            n = 12
+            for i in range(n):
+                cli.push("src", np.full((4,), float(i), np.float32))
+            outs = [cli.pull("out", timeout=10) for _ in range(n)]
+            for i, out in enumerate(outs):
+                np.testing.assert_allclose(out.tensors[0], np.full((4,), 2.0 * i))
+            cli.eos("src")
+            cli.wait(timeout=10)
+
+
+def test_query_multiple_clients_concurrently():
+    with _server_pipeline(sid=2) as srv:
+        port = srv.element("ssrc").bound_port
+        results = {}
+        errors = []
+
+        def run_client(cid):
+            try:
+                cli = nt.Pipeline(
+                    f"appsrc name=src ! tensor_query_client port={port} "
+                    "timeout=10 ! tensor_sink name=out"
+                )
+                with cli:
+                    vals = []
+                    for i in range(6):
+                        cli.push("src", np.full((4,), cid * 100.0 + i, np.float32))
+                    for _ in range(6):
+                        vals.append(float(cli.pull("out", timeout=10).tensors[0][0]))
+                    cli.eos("src")
+                    cli.wait(timeout=10)
+                results[cid] = vals
+            except Exception as e:  # noqa: BLE001
+                errors.append((cid, e))
+
+        threads = [threading.Thread(target=run_client, args=(c,)) for c in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        for cid in range(3):
+            assert results[cid] == [2 * (cid * 100.0 + i) for i in range(6)]
+
+
+def test_query_client_timeout_error():
+    # Server that never answers: a bare serversrc with no sink draining it.
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=3 ! fakesink"
+    )
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=0.5 ! "
+            "tensor_sink name=out"
+        )
+        with cli:
+            cli.push("src", np.zeros((4,), np.float32))
+            cli.eos("src")
+            with pytest.raises(PipelineError, match="no response"):
+                cli.wait(timeout=10)
+
+
+def test_query_client_timeout_drop():
+    srv = nt.Pipeline("tensor_query_serversrc name=ssrc port=0 id=4 ! fakesink")
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=0.5 "
+            "on-timeout=drop ! tensor_sink name=out"
+        )
+        with cli:
+            cli.push("src", np.zeros((4,), np.float32))
+            cli.eos("src")
+            cli.wait(timeout=10)  # drop policy: EOS flows, nothing raised
+
+
+def test_edge_pubsub_fanout():
+    pub = nt.Pipeline("appsrc name=src ! edgesink name=pub port=0")
+    with pub:
+        port = pub.element("pub").bound_port
+        subs = [
+            nt.Pipeline(f"edgesrc port={port} num-buffers=3 ! tensor_sink name=out")
+            for _ in range(2)
+        ]
+        for s in subs:
+            s.start()
+        time.sleep(0.3)  # let subscriptions land before publishing
+        for i in range(3):
+            pub.push("src", np.full((2,), float(i), np.float32))
+        try:
+            for s in subs:
+                for i in range(3):
+                    out = s.pull("out", timeout=10)
+                    np.testing.assert_allclose(out.tensors[0], np.full((2,), float(i)))
+                s.wait(timeout=10)
+        finally:
+            for s in subs:
+                s.stop()
+        pub.eos("src")
+        pub.wait(timeout=10)
+
+
+def test_edge_topic_mismatch_rejected():
+    pub = nt.Pipeline("appsrc name=src ! edgesink name=pub port=0 topic=video")
+    with pub:
+        port = pub.element("pub").bound_port
+        bad = nt.Pipeline(f"edgesrc port={port} topic=audio ! tensor_sink name=out")
+        with pytest.raises(Exception, match="rejected"):
+            bad.start()
+        bad.stop()
