@@ -154,8 +154,11 @@ class TensorSpec:
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
         if len(self.dims) > TENSOR_RANK_LIMIT:
             raise ValueError(f"rank>{TENSOR_RANK_LIMIT}: {self.dims}")
-        if any(d <= 0 for d in self.dims):
-            raise ValueError(f"non-positive dim: {self.dims}")
+        # Zero-size dims are legal for concrete arrays (e.g. an empty token
+        # piece in a FLEXIBLE stream); the *string* parse path still rejects
+        # 0 because the reference encoding uses it for "unspecified".
+        if any(d < 0 for d in self.dims):
+            raise ValueError(f"negative dim: {self.dims}")
 
     # -- constructors ------------------------------------------------------
     @classmethod

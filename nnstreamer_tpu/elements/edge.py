@@ -16,7 +16,6 @@ subscribers drop: the publisher never backpressures the pipeline).
 
 from __future__ import annotations
 
-import json
 import queue as _queue
 import socket
 import threading
@@ -26,9 +25,13 @@ from ..core.buffer import Buffer, Event
 from ..core.log import logger, metrics
 from ..core.registry import register_element
 from ..utils import wire
+from ..utils.net import TcpListener, client_handshake, server_handshake
 from .base import ElementError, SinkElement, SourceElement
 
 log = logger(__name__)
+
+#: Per-subscriber queue EOS marker (publisher reached end of stream).
+_EOS = None
 
 
 @register_element("edgesink")
@@ -51,105 +54,72 @@ class EdgeSink(SinkElement):
         self._subs: Dict[int, _queue.Queue] = {}
         self._lock = threading.Lock()
         self._next_sub = 0
-        self._stopping = threading.Event()
-        self._listener: Optional[socket.socket] = None
+        self._listener: Optional[TcpListener] = None
 
     def start(self) -> None:
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((self.host, self.port))
-        self._listener.listen(16)
-        self._listener.settimeout(0.2)
-        threading.Thread(
-            target=self._accept_loop, name=f"{self.name}-accept", daemon=True
-        ).start()
+        self._listener = TcpListener(self.host, self.port, self._sub_session,
+                                     name=self.name)
 
     @property
     def bound_port(self) -> int:
         if self._listener is None:
             raise ElementError("edgesink not started")
-        return self._listener.getsockname()[1]
+        return self._listener.port
 
     def stop(self) -> None:
-        self._stopping.set()
         if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+            self._listener.close()
             self._listener = None
         with self._lock:
             self._subs.clear()
 
-    def _accept_loop(self) -> None:
-        while not self._stopping.is_set():
-            try:
-                conn, _addr = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(
-                target=self._sub_session, args=(conn,), daemon=True,
-                name=f"{self.name}-sub",
-            ).start()
-
     def _sub_session(self, conn: socket.socket) -> None:
-        sid = None
+        stopping = self._listener.stopping
+        if server_handshake(conn, "subscribe", self.topic) is None:
+            return
+        conn.settimeout(None)
+        q: _queue.Queue = _queue.Queue(maxsize=self.max_queue)
+        with self._lock:
+            sid = self._next_sub
+            self._next_sub += 1
+            self._subs[sid] = q
+        metrics.count(f"{self.name}.subscribers")
         try:
-            conn.settimeout(5.0)
-            raw = wire.read_frame(conn)
-            hello = json.loads(raw.decode("utf-8")) if raw else None
-            if not isinstance(hello, dict) or hello.get("type") != "subscribe":
-                return
-            if self.topic and hello.get("topic", "") not in ("", self.topic):
-                wire.write_frame(conn, json.dumps(
-                    {"type": "nack", "reason": "topic mismatch"}).encode())
-                return
-            wire.write_frame(conn, json.dumps(
-                {"type": "ack", "topic": self.topic}).encode())
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn.settimeout(None)
-            q: _queue.Queue = _queue.Queue(maxsize=self.max_queue)
-            with self._lock:
-                sid = self._next_sub
-                self._next_sub += 1
-                self._subs[sid] = q
-            metrics.count(f"{self.name}.subscribers")
-            while not self._stopping.is_set():
+            while not stopping.is_set():
                 try:
                     payload = q.get(timeout=0.2)
                 except _queue.Empty:
                     continue
-                if payload is None:  # EOS marker
+                if payload is _EOS:  # publisher EOS: close -> subscriber EOS
                     return
                 wire.write_frame(conn, payload)
-        except (OSError, ValueError) as e:
-            log.debug("%s: subscriber dropped: %s", self.name, e)
         finally:
-            if sid is not None:
-                with self._lock:
-                    self._subs.pop(sid, None)
+            with self._lock:
+                self._subs.pop(sid, None)
+
+    def _offer(self, q: _queue.Queue, item) -> None:
+        """Enqueue without ever blocking the pipeline: overflow drops the
+        slow subscriber's oldest frame (pub/sub semantics)."""
+        while True:
             try:
-                conn.close()
-            except OSError:
-                pass
+                q.put_nowait(item)
+                return
+            except _queue.Full:
+                try:
+                    q.get_nowait()
+                    metrics.count(f"{self.name}.dropped")
+                except _queue.Empty:
+                    continue
 
     def process(self, pad, buf: Buffer):
-        payload = wire.encode_buffer(buf.to_host())
         with self._lock:
             subs = list(self._subs.values())
+        if not subs:
+            metrics.count(f"{self.name}.no_subscribers")
+            return []  # nobody listening: skip host copy + serialization
+        payload = wire.encode_buffer(buf.to_host())
         for q in subs:
-            while True:
-                try:
-                    q.put_nowait(payload)
-                    break
-                except _queue.Full:
-                    try:
-                        q.get_nowait()  # drop oldest for the slow subscriber
-                        metrics.count(f"{self.name}.dropped")
-                    except _queue.Empty:
-                        continue
+            self._offer(q, payload)
         metrics.count(f"{self.name}.published")
         return []
 
@@ -157,10 +127,7 @@ class EdgeSink(SinkElement):
         with self._lock:
             subs = list(self._subs.values())
         for q in subs:
-            try:
-                q.put(None, timeout=1.0)
-            except _queue.Full:
-                pass
+            self._offer(q, _EOS)  # drop-oldest guarantees the marker lands
         return []
 
 
@@ -187,17 +154,12 @@ class EdgeSrc(SourceElement):
             raise ElementError(f"{self.name}: port property required")
         try:
             self._sock = socket.create_connection((self.host, self.port), timeout=5.0)
-        except OSError as e:
+            client_handshake(self._sock, "subscribe", topic=self.topic)
+        except (OSError, ConnectionError) as e:
+            self.stop()
             raise ElementError(
-                f"{self.name}: cannot connect {self.host}:{self.port}: {e}"
+                f"{self.name}: cannot subscribe {self.host}:{self.port}: {e}"
             ) from e
-        wire.write_frame(
-            self._sock, json.dumps({"type": "subscribe", "topic": self.topic}).encode()
-        )
-        raw = wire.read_frame(self._sock)
-        ack = json.loads(raw.decode("utf-8")) if raw else None
-        if not isinstance(ack, dict) or ack.get("type") != "ack":
-            raise ElementError(f"{self.name}: subscription rejected: {ack}")
         self._sock.settimeout(0.2)
 
     def stop(self) -> None:

@@ -130,6 +130,23 @@ class TensorFilter(Element):
     # -- streaming ---------------------------------------------------------
     def process(self, pad, buf: Buffer):
         fw = self._ensure_fw()
+        if getattr(fw, "streaming", False):
+            # Streaming frameworks (llm) emit MANY buffers per input; the
+            # runner iterates this generator, so each token flows downstream
+            # while the next is still decoding (reference: llamacpp filter
+            # streams tokens as flexible tensors).
+            def stream():
+                t0 = time.perf_counter()
+                for i, outs in enumerate(fw.invoke_stream(buf.tensors)):
+                    out_buf = buf.with_tensors(list(outs), spec=None)
+                    out_buf.meta["stream_index"] = i
+                    yield (SRC, out_buf)
+                dt = time.perf_counter() - t0
+                self._n_invoked += 1
+                if self.latency_report:
+                    metrics.observe_latency(f"{self.name}.invoke", dt)
+
+            return stream()
         t0 = time.perf_counter()
         outs = fw.invoke(buf.tensors)
         dt = time.perf_counter() - t0

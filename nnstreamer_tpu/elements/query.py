@@ -25,7 +25,6 @@ Protocol (all frames length-prefixed, utils/wire.read_frame/write_frame):
 
 from __future__ import annotations
 
-import json
 import queue as _queue
 import socket
 import threading
@@ -37,6 +36,7 @@ from ..core.caps import Caps
 from ..core.log import logger, metrics
 from ..core.registry import register_element
 from ..utils import wire
+from ..utils.net import TcpListener, client_handshake, server_handshake
 from .base import Element, ElementError, SourceElement, SinkElement, SRC
 
 log = logger(__name__)
@@ -48,21 +48,6 @@ _META_CONN = "_query_conn"
 # ``id`` property (reference: query server data registry paired by server id).
 _servers: Dict[int, "_ServerCore"] = {}
 _servers_lock = threading.Lock()
-
-
-def _hello_frame(**kw) -> bytes:
-    return json.dumps({"type": "hello", **kw}).encode("utf-8")
-
-
-def _parse_control(raw: bytes) -> Optional[dict]:
-    """Control frames are JSON objects; tensor frames start with wire magic."""
-    if len(raw) >= 4 and int.from_bytes(raw[:4], "little") == wire.MAGIC:
-        return None
-    try:
-        msg = json.loads(raw.decode("utf-8"))
-    except (UnicodeDecodeError, ValueError):
-        return None
-    return msg if isinstance(msg, dict) else None
 
 
 class _ServerCore:
@@ -80,52 +65,29 @@ class _ServerCore:
         self._conn_locks: Dict[int, threading.Lock] = {}
         self._next_conn = 0
         self._lock = threading.Lock()
-        self._stopping = threading.Event()
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(16)
-        self._listener.settimeout(0.2)
-        self.port = self._listener.getsockname()[1]
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"query-accept:{self.port}", daemon=True
-        )
-        self._accept_thread.start()
+        self._listener = TcpListener(host, port, self._reader, name="query")
+        self.port = self._listener.port
 
-    def _accept_loop(self) -> None:
-        while not self._stopping.is_set():
-            try:
-                conn, addr = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                cid = self._next_conn
-                self._next_conn += 1
-                self._conns[cid] = conn
-                self._conn_locks[cid] = threading.Lock()
-            threading.Thread(
-                target=self._reader, args=(cid, conn), daemon=True,
-                name=f"query-conn:{self.port}:{cid}",
-            ).start()
+    @property
+    def _stopping(self) -> threading.Event:
+        return self._listener.stopping
 
-    def _reader(self, cid: int, conn: socket.socket) -> None:
+    def _reader(self, conn: socket.socket) -> None:
+        if server_handshake(conn, "hello", self.topic) is None:
+            log.warning("query: connection rejected at handshake")
+            return
+        conn.settimeout(0.2)
+        with self._lock:
+            cid = self._next_conn
+            self._next_conn += 1
+            self._conns[cid] = conn
+            self._conn_locks[cid] = threading.Lock()
         try:
-            raw = wire.read_frame(conn)
-            hello = _parse_control(raw) if raw else None
-            if not hello or hello.get("type") != "hello":
-                log.warning("query conn %d: bad handshake", cid)
-                return
-            if self.topic and hello.get("topic", "") != self.topic:
-                wire.write_frame(conn, json.dumps(
-                    {"type": "nack", "reason": "topic mismatch"}).encode())
-                return
-            wire.write_frame(conn, json.dumps(
-                {"type": "ack", "caps": self.topic}).encode())
             while not self._stopping.is_set():
-                raw = wire.read_frame(conn)
+                try:
+                    raw = wire.read_frame(conn)
+                except socket.timeout:
+                    continue
                 if raw is None:
                     return
                 buf, _flags = wire.decode_buffer(raw)
@@ -137,8 +99,6 @@ class _ServerCore:
                         break
                     except _queue.Full:
                         continue
-        except (OSError, ValueError) as e:
-            log.debug("query conn %d closed: %s", cid, e)
         finally:
             self.drop_conn(cid)
 
@@ -167,11 +127,7 @@ class _ServerCore:
                 pass
 
     def close(self) -> None:
-        self._stopping.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        self._listener.close()
         with self._lock:
             conns = list(self._conns)
         for cid in conns:
@@ -317,12 +273,11 @@ class TensorQueryClient(Element):
             raise ElementError(
                 f"{self.name}: cannot connect {self.host}:{self.port}: {e}"
             ) from e
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        wire.write_frame(self._sock, _hello_frame(caps="other/tensors", topic=self.topic))
-        raw = wire.read_frame(self._sock)
-        ack = _parse_control(raw) if raw else None
-        if not ack or ack.get("type") != "ack":
-            raise ElementError(f"{self.name}: server rejected connection: {ack}")
+        try:
+            client_handshake(self._sock, "hello", caps="other/tensors",
+                             topic=self.topic)
+        except ConnectionError as e:
+            raise ElementError(f"{self.name}: {e}") from e
         self._sock.settimeout(0.2)
         self._reader = threading.Thread(
             target=self._rx_loop, name=f"{self.name}-rx", daemon=True
@@ -400,7 +355,10 @@ class TensorQueryClient(Element):
     def _wait_outstanding(self, below: int) -> None:
         """Block until fewer than ``below`` requests are outstanding,
         enforcing the per-request timeout policy on the head request."""
+        stop = getattr(self, "_stop_event", None)
         while True:
+            if stop is not None and stop.is_set():
+                return  # pipeline stopping: abandon outstanding requests
             drain = False
             with self._cv:
                 if self._rx_error is not None:

@@ -1,0 +1,207 @@
+"""LLM token-streaming framework for tensor_filter.
+
+Reference analog: the llama.cpp sub-plugin
+(``ext/nnstreamer/tensor_filter/tensor_filter_llamacpp.cc``, SURVEY §2.4
+[UNVERIFIED]): ``tensor_filter framework=llamacpp`` takes a prompt buffer
+and streams generated tokens downstream as flexible tensors.  Here the
+runtime is JAX, not a wrapped C++ library:
+
+* prefill and per-token decode are TWO jitted XLA programs (same function,
+  two sequence lengths — see models/llama.py ``forward_cached``); weights
+  and KV cache never leave HBM between tokens;
+* multi-chip: ``custom=tp:N`` builds/uses a ``model``-axis mesh and jits
+  with NamedShardings from the model's ``param_pspecs`` — XLA places the
+  TP all-reduces on ICI (config #5's multi-chip token streaming);
+* each generated token is pushed downstream AS IT DECODES (the element
+  emits from a generator), giving the reference's streaming UX.
+
+Pipeline usage::
+
+    appsrc name=prompt ! tensor_filter framework=llm model=llama_tiny
+        custom=max_new:32,temperature:0.0 invoke-dynamic=true !
+        tensor_sink name=tokens
+
+Input: one uint8 tensor (UTF-8 prompt bytes) or int32 token ids ``[T]`` /
+``[B, T]``.  Output per token: ``[B]`` int32 token ids + uint8 piece bytes
+(batch 1 only), as FLEXIBLE tensors.  Tokenization is byte-level (no egress
+for real vocab files); a real tokenizer drops into :class:`ByteTokenizer`'s
+slot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.log import logger, metrics
+from ..core.registry import register_filter
+from ..core.types import TensorFormat, TensorsSpec
+from ..models import llama
+from ..models.zoo import build as build_model
+from .base import Framework, FrameworkError, parse_custom_options
+
+log = logger(__name__)
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: id = byte + n_special.  Deterministic, no vocab
+    file.  ids 0..n_special-1 are special (0=pad, 1=bos, 2=eos)."""
+
+    n_special = 3
+    bos = 1
+    eos = 2
+
+    def encode(self, text_bytes: bytes) -> List[int]:
+        return [self.bos] + [b + self.n_special for b in text_bytes]
+
+    def decode_piece(self, token_id: int) -> bytes:
+        if token_id < self.n_special:
+            return b""
+        b = token_id - self.n_special
+        return bytes([b]) if b < 256 else b""
+
+
+@register_filter("llm", aliases=("llamacpp", "llama.cpp"))
+class LLMFramework(Framework):
+    """Streaming generation.  ``custom=`` options:
+
+    ``max_new:N`` (default 32), ``temperature:F`` (0 = greedy), ``seed:N``,
+    ``tp:N`` (tensor-parallel ways over a ``model`` mesh axis),
+    ``dtype:bfloat16|float32``, plus any model-builder options
+    (``dim:…``, ``n_layers:…``) forwarded to the zoo.
+    """
+
+    name = "llm"
+    streaming = True
+
+    def __init__(self):
+        super().__init__()
+        self.bundle = None
+        self.cfg: Optional[llama.LlamaConfig] = None
+        self.tokenizer = ByteTokenizer()
+        self.max_new = 32
+        self.temperature = 0.0
+        self.seed = 0
+        self.mesh = None
+        self._prefill = None
+        self._decode = None
+
+    def open(self, props: Dict[str, object]) -> None:
+        super().open(props)
+        model = str(props.get("model") or "llama_tiny")
+        opts = parse_custom_options(str(props.get("custom", "")))
+        self.max_new = int(opts.pop("max_new", 32))
+        self.temperature = float(opts.pop("temperature", 0.0))
+        self.seed = int(opts.pop("seed", 0))
+        tp = int(opts.pop("tp", 1))
+        self.dtype = opts.get("dtype", "bfloat16")
+        try:
+            self.bundle = build_model(model, opts)
+        except KeyError as e:
+            raise FrameworkError(str(e)) from e
+        self.cfg = getattr(self.bundle, "config", None)
+        if self.cfg is None:
+            raise FrameworkError(
+                f"model {model!r} has no LlamaConfig; the llm framework needs "
+                "a decoder-LM bundle (models/llama.py)"
+            )
+        self._setup(tp)
+
+    def _setup(self, tp: int) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import shard_params
+
+        cfg = self.cfg
+        params = self.bundle.params
+
+        if tp > 1:
+            if len(jax.devices()) < tp:
+                raise FrameworkError(
+                    f"tp:{tp} needs {tp} devices, have {len(jax.devices())}")
+            self.mesh = make_mesh(model=tp, data=1,
+                                  devices=jax.devices()[:tp])
+            params = shard_params(self.mesh, params, llama.param_pspecs())
+            self.bundle.params = params
+
+        def fwd(params, tokens, cache, pos):
+            return llama.forward_cached(params, tokens, cache, pos, cfg,
+                                        compute_dtype=self.dtype)
+
+        # Same program at two sequence lengths: T=prompt (prefill bucket)
+        # and T=1 (decode).  donate the cache so decode updates in place.
+        self._fwd = jax.jit(fwd, static_argnames=(), donate_argnums=(2,))
+
+    def close(self) -> None:
+        self.bundle = None
+        self._fwd = None
+
+    def get_model_info(self):
+        flex_in = TensorsSpec.from_string("1", "uint8").replace(
+            format=TensorFormat.FLEXIBLE)
+        flex_out = TensorsSpec.from_string("1", "int32").replace(
+            format=TensorFormat.FLEXIBLE)
+        return flex_in, flex_out
+
+    # -- tokenization ------------------------------------------------------
+    def _to_tokens(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.asarray(arr)
+        if arr.dtype == np.uint8:
+            ids = self.tokenizer.encode(arr.tobytes())
+            return np.asarray([ids], np.int32)
+        toks = arr.astype(np.int32)
+        if toks.ndim == 1:
+            toks = toks[None, :]
+        if toks.ndim != 2:
+            raise FrameworkError(f"prompt must be [T] or [B,T], got {arr.shape}")
+        return toks
+
+    # -- generation --------------------------------------------------------
+    def _gen_tokens(self, prompt: np.ndarray) -> Iterator[np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        B, T = prompt.shape
+        if T >= cfg.max_seq:
+            raise FrameworkError(
+                f"prompt length {T} >= max_seq {cfg.max_seq}")
+        cache = llama.init_cache(cfg, B, dtype=self.dtype)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_params as _sp
+            cache = _sp(self.mesh, cache, llama.cache_pspecs())
+        params = self.bundle.params
+        logits, cache = self._fwd(params, jnp.asarray(prompt), cache, 0)
+        key = jax.random.PRNGKey(self.seed)
+        n = min(self.max_new, cfg.max_seq - T - 1)
+        tok = llama.sample_token(logits[:, -1], key, self.temperature)
+        for i in range(n):
+            yield np.asarray(tok)  # host copy of [B] ids — the stream output
+            if i + 1 == n:
+                break
+            key, sub = jax.random.split(key)
+            logits, cache = self._fwd(params, tok[:, None], cache, T + i)
+            tok = llama.sample_token(logits[:, -1], sub, self.temperature)
+
+    def invoke_stream(self, inputs: Sequence) -> Iterator[List[np.ndarray]]:
+        """Yield one output list per generated token: [ids [B] int32,
+        piece bytes uint8] — flexible tensors, the reference's streaming
+        contract."""
+        prompt = self._to_tokens(inputs[0])
+        for ids in self._gen_tokens(prompt):
+            metrics.count("llm.tokens")
+            piece = np.frombuffer(
+                self.tokenizer.decode_piece(int(ids[0])), np.uint8
+            ) if ids.shape[0] == 1 else np.zeros((0,), np.uint8)
+            yield [ids, piece.copy()]
+
+    def invoke(self, inputs: Sequence) -> List[np.ndarray]:
+        """Non-streaming: all generated ids as one [B, N] tensor + the
+        decoded bytes (batch 1)."""
+        chunks = [ids for ids, _ in self.invoke_stream(inputs)]
+        ids = np.stack(chunks, axis=1)
+        text = b"".join(self.tokenizer.decode_piece(int(t)) for t in ids[0])
+        return [ids, np.frombuffer(text, np.uint8).copy()]

@@ -1,0 +1,285 @@
+"""Audio models — benchmark config #4 (speech-command / wav2vec2 stream).
+
+Reference analog: the reference's audio pipelines feed ``audioconvert ->
+tensor_converter -> tensor_filter`` with a speech-commands tflite model
+(BASELINE config #4).  Two models here:
+
+* ``speech_commands`` — conv keyword spotter.  The feature frontend (framed
+  DFT magnitude -> log-mel-ish filterbank) is INSIDE the model as two
+  matmuls (frames @ DFT basis, power @ mel weights): spectrograms become
+  MXU work and fuse with the conv stack in one XLA program, instead of the
+  reference's host-side feature pipeline.
+* ``wav2vec2`` — strided conv feature encoder + bidirectional transformer
+  encoder blocks (pre-LN, GELU) on top, CTC-style vocab head; the
+  long-sequence path that exercises attention on audio streams.
+
+Inputs: float32 waveform (B, samples) in [-1, 1] @16kHz.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .zoo import ModelBundle, register_model
+
+SAMPLE_RATE = 16000
+_SPEECH_LABELS = ("silence", "unknown", "yes", "no", "up", "down", "left",
+                  "right", "on", "off", "stop", "go")
+
+
+def _dft_basis(frame: int, bins: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Real-DFT cos/sin bases (frame, bins) with a Hann window folded in."""
+    n = np.arange(frame, dtype=np.float32)
+    k = np.arange(bins, dtype=np.float32)
+    ang = 2.0 * np.pi * np.outer(n, k) / frame
+    win = (0.5 - 0.5 * np.cos(2.0 * np.pi * n / frame))[:, None]
+    return (np.cos(ang) * win).astype(np.float32), \
+        (np.sin(ang) * win).astype(np.float32)
+
+
+def _canon_wave(x, min_samples: int):
+    """Canonicalize waveform input to (B, S).  A trailing dim of 1 is a
+    mono channel axis (converter layout), not a batch of 1-sample clips."""
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    if x.ndim == 1:
+        x = x[None, :]
+    if x.ndim != 2:
+        raise ValueError(f"waveform must be (S,), (S,1), (B,S); got {x.shape}")
+    if x.shape[1] < min_samples:
+        raise ValueError(
+            f"waveform too short: {x.shape[1]} < {min_samples} samples")
+    return x
+
+
+def _mel_weights(bins: int, mels: int, sr: int, frame: int) -> np.ndarray:
+    """Triangular mel filterbank (bins, mels)."""
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    f_max = sr / 2.0
+    pts = mel_to_hz(np.linspace(hz_to_mel(20.0), hz_to_mel(f_max), mels + 2))
+    bin_hz = np.linspace(0.0, f_max, bins)
+    w = np.zeros((bins, mels), np.float32)
+    for m in range(mels):
+        lo, ctr, hi = pts[m], pts[m + 1], pts[m + 2]
+        up = (bin_hz - lo) / max(ctr - lo, 1e-6)
+        down = (hi - bin_hz) / max(hi - ctr, 1e-6)
+        w[:, m] = np.maximum(0.0, np.minimum(up, down))
+    return w
+
+
+# -- speech_commands ------------------------------------------------------
+
+def init_params_kws(classes: int = len(_SPEECH_LABELS), mels: int = 64,
+                    seed: int = 0) -> Dict:
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 16))
+
+    def conv(kh, kw, cin, cout):
+        w = jax.random.normal(next(keys), (kh, kw, cin, cout), np.float32)
+        return w * np.sqrt(2.0 / (kh * kw * cin))
+
+    def dense(cin, cout):
+        w = jax.random.normal(next(keys), (cin, cout), np.float32)
+        return w * np.sqrt(2.0 / cin)
+
+    return {
+        "c1": {"w": conv(3, 3, 1, 64), "b": np.zeros((64,), np.float32)},
+        "c2": {"w": conv(3, 3, 64, 64), "b": np.zeros((64,), np.float32)},
+        "c3": {"w": conv(3, 3, 64, 128), "b": np.zeros((128,), np.float32)},
+        "fc": {"w": dense(128, classes), "b": np.zeros((classes,), np.float32)},
+    }
+
+
+def apply_kws(params, x, *, frame: int, hop: int, bins: int, mels: int,
+              compute_dtype="bfloat16"):
+    """waveform -> logits (B, classes).  Accepts (S,), (S, 1) mono audio
+    (the converter's frames×channels layout), (B, S), or (B, S, 1)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    cdt = jnp.dtype(compute_dtype)
+    x = _canon_wave(x, frame)
+    B, S = x.shape
+    n_frames = 1 + (S - frame) // hop
+    cos_b, sin_b = _dft_basis(frame, bins)
+    mel_w = _mel_weights(bins, mels, SAMPLE_RATE, frame)
+
+    # Frame via gather of static indices, then two matmuls on the MXU.
+    idx = (np.arange(n_frames)[:, None] * hop + np.arange(frame)[None, :])
+    frames = x[:, idx]  # (B, T, frame)
+    frames = frames.astype(cdt)
+    re = frames @ jnp.asarray(cos_b, cdt)
+    im = frames @ jnp.asarray(sin_b, cdt)
+    power = re * re + im * im  # (B, T, bins)
+    mel = power @ jnp.asarray(mel_w, cdt)
+    feats = jnp.log(mel.astype(jnp.float32) + 1e-6).astype(cdt)
+    h = feats[..., None]  # (B, T, mels, 1)
+
+    def conv2d(h, w, stride):
+        return lax.conv_general_dilated(
+            h, w.astype(cdt), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    h = jnp.maximum(conv2d(h, params["c1"]["w"], 2)
+                    + params["c1"]["b"].astype(cdt), 0.0)
+    h = jnp.maximum(conv2d(h, params["c2"]["w"], 2)
+                    + params["c2"]["b"].astype(cdt), 0.0)
+    h = jnp.maximum(conv2d(h, params["c3"]["w"], 2)
+                    + params["c3"]["b"].astype(cdt), 0.0)
+    h = jnp.mean(h, axis=(1, 2))  # (B, 128)
+    logits = h @ params["fc"]["w"].astype(cdt) + params["fc"]["b"].astype(cdt)
+    return logits.astype(jnp.float32)
+
+
+@register_model("speech_commands")
+def _speech_commands(opts: Dict[str, str]) -> ModelBundle:
+    classes = int(opts.get("classes", len(_SPEECH_LABELS)))
+    seed = int(opts.get("seed", 0))
+    samples = int(opts.get("samples", SAMPLE_RATE))  # 1s window
+    batch = int(opts.get("batch", 1))
+    mels = int(opts.get("mels", 64))
+    dtype = opts.get("dtype", "bfloat16")
+
+    params = init_params_kws(classes=classes, mels=mels, seed=seed)
+    apply_fn = functools.partial(
+        apply_kws, frame=640, hop=320, bins=256, mels=mels,
+        compute_dtype=dtype)
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"{samples}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(f"{classes}:{batch}", "float32"),
+        param_pspecs=None,
+        name="speech_commands",
+    )
+
+
+# -- wav2vec2-style encoder ------------------------------------------------
+
+# Strided conv feature encoder: (kernel, stride, channels).
+_W2V_CONVS: Tuple[Tuple[int, int, int], ...] = (
+    (10, 5, 256), (3, 2, 256), (3, 2, 256), (3, 2, 256), (2, 2, 256),
+)
+
+
+def init_params_w2v(dim: int = 256, n_layers: int = 4, n_heads: int = 4,
+                    ffn: int = 512, vocab: int = 32, seed: int = 0) -> Dict:
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
+
+    def dense(cin, cout):
+        w = jax.random.normal(next(keys), (cin, cout), np.float32)
+        return w * np.sqrt(2.0 / cin)
+
+    convs = []
+    cin = 1
+    for (k, _s, ch) in _W2V_CONVS:
+        w = jax.random.normal(next(keys), (k, cin, ch), np.float32)
+        convs.append({"w": w * np.sqrt(2.0 / (k * cin)),
+                      "b": np.zeros((ch,), np.float32)})
+        cin = ch
+    L = n_layers
+    ks = iter(jax.random.split(next(keys), 8))
+
+    def stack(shape, fan_in):
+        return (jax.random.normal(next(ks), (L,) + shape, np.float32)
+                * np.sqrt(2.0 / fan_in))
+
+    layers = {
+        "wq": stack((dim, dim), dim), "wk": stack((dim, dim), dim),
+        "wv": stack((dim, dim), dim), "wo": stack((dim, dim), dim),
+        "w1": stack((dim, ffn), dim), "w2": stack((ffn, dim), ffn),
+        "ln1": np.ones((L, dim), np.float32),
+        "ln1b": np.zeros((L, dim), np.float32),
+        "ln2": np.ones((L, dim), np.float32),
+        "ln2b": np.zeros((L, dim), np.float32),
+    }
+    return {
+        "convs": convs,
+        "proj": {"w": dense(cin, dim), "b": np.zeros((dim,), np.float32)},
+        "layers": layers,
+        "head": {"w": dense(dim, vocab), "b": np.zeros((vocab,), np.float32)},
+    }
+
+
+def apply_w2v(params, x, *, n_heads: int, compute_dtype="bfloat16"):
+    """waveform -> frame logits (B, T, vocab) (CTC-style); input layouts
+    as :func:`_canon_wave`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    cdt = jnp.dtype(compute_dtype)
+    x = _canon_wave(x, _W2V_CONVS[0][0])
+    h = x.astype(cdt)[:, :, None]  # (B, S, 1) NWC
+
+    for cp, (k, s, _ch) in zip(params["convs"], _W2V_CONVS):
+        h = lax.conv_general_dilated(
+            h, cp["w"].astype(cdt), (s,), "VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        h = jax.nn.gelu(h + cp["b"].astype(cdt))
+    h = h @ params["proj"]["w"].astype(cdt) + params["proj"]["b"].astype(cdt)
+
+    B, T, D = h.shape
+    hd = D // n_heads
+
+    def layer_norm(v, g, b):
+        v32 = v.astype(jnp.float32)
+        mu = jnp.mean(v32, axis=-1, keepdims=True)
+        var = jnp.var(v32, axis=-1, keepdims=True)
+        out = (v32 - mu) / jnp.sqrt(var + 1e-5)
+        return (out.astype(cdt) * g.astype(cdt) + b.astype(cdt))
+
+    def body(h, lp):
+        v = layer_norm(h, lp["ln1"], lp["ln1b"])
+        q = (v @ lp["wq"].astype(cdt)).reshape(B, T, n_heads, hd)
+        k = (v @ lp["wk"].astype(cdt)).reshape(B, T, n_heads, hd)
+        vv = (v @ lp["wv"].astype(cdt)).reshape(B, T, n_heads, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(s * (1.0 / np.sqrt(hd)), axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cdt), vv)
+        h = h + attn.reshape(B, T, D) @ lp["wo"].astype(cdt)
+        v = layer_norm(h, lp["ln2"], lp["ln2b"])
+        h = h + jax.nn.gelu(v @ lp["w1"].astype(cdt)) @ lp["w2"].astype(cdt)
+        return h, None
+
+    h, _ = lax.scan(body, h, params["layers"])
+    logits = h @ params["head"]["w"].astype(cdt) + params["head"]["b"].astype(cdt)
+    return logits.astype(jnp.float32)
+
+
+@register_model("wav2vec2")
+def _wav2vec2(opts: Dict[str, str]) -> ModelBundle:
+    dim = int(opts.get("dim", 256))
+    n_layers = int(opts.get("n_layers", 4))
+    n_heads = int(opts.get("n_heads", 4))
+    vocab = int(opts.get("vocab", 32))
+    seed = int(opts.get("seed", 0))
+    batch = int(opts.get("batch", 1))
+    samples = int(opts.get("samples", SAMPLE_RATE))
+    dtype = opts.get("dtype", "bfloat16")
+
+    params = init_params_w2v(dim=dim, n_layers=n_layers, n_heads=n_heads,
+                             vocab=vocab, seed=seed)
+    apply_fn = functools.partial(apply_w2v, n_heads=n_heads,
+                                 compute_dtype=dtype)
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"{samples}:{batch}", "float32"),
+        out_spec=None,  # T depends on conv strides; derived per buffer
+        param_pspecs=None,
+        name="wav2vec2",
+    )

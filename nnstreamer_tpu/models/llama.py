@@ -1,0 +1,406 @@
+"""Llama-family decoder-only LM — benchmark config #5 (token streaming).
+
+Reference analog: the reference's LLM capability is the llama.cpp
+sub-plugin (``ext/nnstreamer/tensor_filter/tensor_filter_llamacpp.cc``,
+SURVEY §2.4 [UNVERIFIED]) — prompt in, generated tokens streamed out as
+flexible tensors, with the KV cache and sampling living inside the wrapped
+C++ runtime.  Here the whole decode loop is a JAX program designed for TPU:
+
+* **Stacked layers + ``lax.scan``**: all L transformer blocks live in one
+  pytree with a leading layer axis, so XLA compiles ONE block and scans it —
+  compile time stays flat as the model deepens, and remat slots in cleanly.
+* **KV cache as a functional carry**: ``[L, B, S_max, H_kv, D]`` bf16
+  buffers updated with ``lax.dynamic_update_slice`` at the decode position;
+  one fused XLA program per decode step, weights resident in HBM.
+* **GQA** (n_kv_heads <= n_heads), **RoPE**, **RMSNorm**, **SwiGLU** — the
+  Llama-2/3 block, dims kept multiples of 128 so matmuls tile onto the MXU.
+* **TP via GSPMD**: ``param_pspecs`` shard attention heads and FFN hidden
+  over the ``model`` mesh axis; jit with those shardings and XLA inserts the
+  all-reduces on ICI (no hand-written collectives).
+* **Sequence parallel**: :func:`forward_seq_parallel` runs the full forward
+  under ``shard_map`` over the ``seq`` axis with ring attention
+  (parallel/ring.py) — long-context prefill where no chip ever holds the
+  whole sequence.
+
+No egress in this environment, so weights are deterministic-random; real
+checkpoints enter by filling the same pytree layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.types import TensorFormat, TensorsSpec
+from .zoo import ModelBundle, register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_hidden: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+#: Named size presets.  ``llama2_7b`` is the reference benchmark config #5
+#: shape; the tiny presets serve tests and the CPU-mesh dry run.
+PRESETS: Dict[str, LlamaConfig] = {
+    "llama2_7b": LlamaConfig(),
+    "llama_tiny": LlamaConfig(
+        vocab=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=256, max_seq=256,
+    ),
+    "llama_small": LlamaConfig(
+        vocab=2048, dim=512, n_layers=4, n_heads=8, n_kv_heads=4,
+        ffn_hidden=1024, max_seq=1024,
+    ),
+}
+
+
+def init_params(cfg: LlamaConfig, seed: int = 0) -> Dict:
+    """Deterministic-random params; layer weights stacked on a leading axis."""
+    import jax
+
+    k_embed, k_layers, k_out = jax.random.split(jax.random.PRNGKey(seed), 3)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, np.float32)
+                * np.sqrt(2.0 / max(1, fan_in)))
+
+    L, D, H, Hkv, F = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                       cfg.ffn_hidden)
+    hd = cfg.head_dim
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "wq": norm_init(ks[0], (L, D, H * hd), D),
+        "wk": norm_init(ks[1], (L, D, Hkv * hd), D),
+        "wv": norm_init(ks[2], (L, D, Hkv * hd), D),
+        "wo": norm_init(ks[3], (L, H * hd, D), H * hd),
+        "w_gate": norm_init(ks[4], (L, D, F), D),
+        "w_up": norm_init(ks[5], (L, D, F), D),
+        "w_down": norm_init(ks[6], (L, F, D), F),
+        "ln_attn": np.ones((L, D), np.float32),
+        "ln_mlp": np.ones((L, D), np.float32),
+    }
+    return {
+        "embed": norm_init(k_embed, (cfg.vocab, D), D) * 0.5,
+        "layers": layers,
+        "ln_out": np.ones((D,), np.float32),
+        "lm_head": norm_init(k_out, (D, cfg.vocab), D),
+    }
+
+
+def param_pspecs() -> Dict:
+    """TP shardings over the ``model`` mesh axis: split heads / FFN hidden
+    on the contraction-free dim, so each matmul is local and XLA all-reduces
+    the block output once (Megatron layout, GSPMD-inserted collectives)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "w_gate": P(None, None, "model"),
+            "w_up": P(None, None, "model"),
+            "w_down": P(None, "model", None),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "ln_out": P(None),
+        "lm_head": P(None, "model"),
+    }
+
+
+def _rmsnorm(x, w, eps):
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    inv = jnp.reciprocal(jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps))
+    return (x32 * inv).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding. x: [B, T, H, D_head]; positions: [B, T] or [T]."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.asarray(positions, jnp.float32)
+    if pos.ndim == 1:
+        pos = pos[None, :]
+    ang = pos[..., None] * freqs  # [B, T, half]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(x, n_rep: int):
+    import jax.numpy as jnp
+
+    if n_rep == 1:
+        return x
+    B, T, Hkv, D = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (B, T, Hkv, n_rep, D)
+    ).reshape(B, T, Hkv * n_rep, D)
+
+
+def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
+           attn_fn=None):
+    """One transformer block.  ``kv=(k_cache, v_cache)`` enables cached
+    decode (x is the new suffix, written at ``pos_offset``); ``attn_fn``
+    overrides plain causal attention (ring attention under shard_map)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, T, D = x.shape
+    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = x.dtype
+
+    h = _rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, T, H, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, T, Hkv, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, T, Hkv, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+
+    if kv is not None:
+        k_cache, v_cache = kv  # [B, S_max, Hkv, hd]
+        k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                           (0, pos_offset, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                           (0, pos_offset, 0, 0))
+        kv = (k_cache, v_cache)
+        k_all, v_all = k_cache.astype(dt), v_cache.astype(dt)
+        S = k_all.shape[1]
+        # Rows beyond the filled prefix are masked by key-position validity.
+        k_pos = jnp.arange(S)
+        q_pos = pos_offset + jnp.arange(T)
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,T,S]
+    else:
+        k_all, v_all = k, v
+        q_pos = jnp.arange(T)
+        mask = (q_pos[None, :] <= q_pos[:, None])[None, None]
+
+    if attn_fn is not None:
+        attn = attn_fn(q, _repeat_kv(k_all, H // Hkv), _repeat_kv(v_all, H // Hkv))
+    else:
+        kr = _repeat_kv(k_all, H // Hkv)
+        vr = _repeat_kv(v_all, H // Hkv)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                       preferred_element_type=jnp.float32)
+        s = s * (1.0 / np.sqrt(hd))
+        s = jnp.where(mask, s, jnp.float32(-1e30))
+        p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), vr)
+
+    out = attn.reshape(B, T, H * hd) @ lp["wo"].astype(dt)
+    x = x + out
+
+    h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+    import jax.nn as jnn
+
+    gate = jnn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x, kv
+
+
+def forward(params, tokens, cfg: LlamaConfig, compute_dtype="bfloat16"):
+    """Full-sequence forward -> logits [B, T, vocab] (training/eval path)."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(compute_dtype)
+    B, T = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    positions = jnp.arange(T)
+
+    def body(x, lp):
+        x, _ = _block(cfg, lp, x, positions)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def init_cache(cfg: LlamaConfig, batch: int, dtype="bfloat16"):
+    """KV cache pytree: k/v of [L, B, S_max, H_kv, head_dim]."""
+    import jax.numpy as jnp
+
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_pspecs() -> Dict:
+    from jax.sharding import PartitionSpec as P
+
+    return {"k": P(None, None, None, "model", None),
+            "v": P(None, None, None, "model", None)}
+
+
+def forward_cached(params, tokens, cache, pos_offset, cfg: LlamaConfig,
+                   compute_dtype="bfloat16"):
+    """Forward a suffix with KV cache: prefill (T=prompt) and decode (T=1)
+    are the SAME program at different T -> two XLA compilations total."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(compute_dtype)
+    B, T = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    positions = pos_offset + jnp.arange(T)[None, :]
+
+    def body(x, layer):
+        lp, kc, vc = layer
+        x, (kc, vc) = _block(cfg, lp, x, positions, kv=(kc, vc),
+                             pos_offset=pos_offset)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
+                         compute_dtype="bfloat16"):
+    """Sequence-parallel full forward: tokens sharded [B, T/seq] over the
+    ``seq`` mesh axis, ring attention rotating K/V shards over ICI.
+
+    No device ever materializes the full sequence — the long-context path
+    the reference cannot express (SURVEY §2.9: SP "absent in reference").
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring import ring_attention_local
+
+    n_seq = int(mesh.shape.get("seq", 1))
+    if n_seq <= 1:
+        return forward(params, tokens, cfg, compute_dtype)
+
+    dt = jnp.dtype(compute_dtype)
+
+    def local_fwd(params, tokens):
+        B, Tl = tokens.shape
+        my = lax.axis_index("seq")
+        positions = my * Tl + jnp.arange(Tl)
+        x = params["embed"].astype(dt)[tokens]
+
+        def attn_fn(q, k, v):
+            return ring_attention_local(q, k, v, axis_name="seq", causal=True)
+
+        def body(x, lp):
+            x, _ = _block(cfg, lp, x, positions, attn_fn=attn_fn)
+            return x, None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
+        return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+    fn = jax.shard_map(
+        local_fwd, mesh=mesh,
+        in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq", None),
+        check_vma=False,
+    )
+    return jax.jit(fn)(params, tokens)
+
+
+def sample_token(logits, key, temperature: float):
+    """logits [B, vocab] -> token ids [B]."""
+    import jax
+    import jax.numpy as jnp
+
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def generate_scan(params, prompt, cfg: LlamaConfig, max_new: int,
+                  temperature: float = 0.0, seed: int = 0,
+                  compute_dtype="bfloat16"):
+    """Whole generation as ONE jitted program (prefill + lax.scan decode):
+    the throughput path for benchmarking — no host round-trip per token."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T = prompt.shape
+    cache = init_cache(cfg, B, dtype=compute_dtype)
+    logits, cache = forward_cached(params, prompt, cache, 0, cfg, compute_dtype)
+    key = jax.random.PRNGKey(seed)
+    tok0 = sample_token(logits[:, -1], key, temperature)
+
+    def step(carry, i):
+        tok, cache, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = forward_cached(params, tok[:, None], cache, T + i,
+                                       cfg, compute_dtype)
+        nxt = sample_token(logits[:, -1], sub, temperature)
+        return (nxt, cache, key), tok
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (tok0, cache, key), jnp.arange(max_new))
+    return jnp.moveaxis(toks, 0, 1)  # [B, max_new]
+
+
+# -- zoo builders ---------------------------------------------------------
+
+def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
+    cfg = PRESETS[preset]
+    overrides = {}
+    for field in ("vocab", "dim", "n_layers", "n_heads", "n_kv_heads",
+                  "ffn_hidden", "max_seq"):
+        if field in opts:
+            overrides[field] = int(opts[field])
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    seed = int(opts.get("seed", 0))
+    params = init_params(cfg, seed=seed)
+    dtype = opts.get("dtype", "bfloat16")
+
+    def apply_fn(params, tokens):
+        return forward(params, tokens, cfg, compute_dtype=dtype)
+
+    # Token streams are variable-length: FLEXIBLE format, spec per buffer.
+    in_spec = TensorsSpec.from_string("1:1", "int32").replace(
+        format=TensorFormat.FLEXIBLE)
+    out_spec = TensorsSpec.from_string(f"{cfg.vocab}:1:1", "float32").replace(
+        format=TensorFormat.FLEXIBLE)
+    bundle = ModelBundle(
+        apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
+        param_pspecs=param_pspecs(), name=preset,
+    )
+    bundle.config = cfg  # used by the llm framework for the decode loop
+    return bundle
+
+
+for _name in PRESETS:
+    register_model(_name, functools.partial(_build, _name))
+register_model("llama", functools.partial(_build, "llama_tiny"))

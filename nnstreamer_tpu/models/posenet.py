@@ -1,0 +1,141 @@
+"""PoseNet keypoint heatmap model — benchmark config #3.
+
+Reference analog: the reference runs
+``posenet_mobilenet_v1_100_257x257...tflite`` through tflite and decodes
+with ``tensordec-pose.c`` (SURVEY §2.5, BASELINE config #3).  Same backbone
+recipe as models/mobilenet.py (depthwise-separable, NHWC, bfloat16 on the
+MXU) at output stride 16, with two 1x1 heads:
+
+* heatmaps (B, H/16, W/16, K) — sigmoid keypoint confidence;
+* offsets (B, H/16, W/16, 2K) — short-range refinement (the decoder uses
+  them when present).
+
+Output layout matches the ``pose_estimation`` decoder contract: heatmaps
+(H', W', K), PoseNet-style, batch leading.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .zoo import ModelBundle, register_model
+
+_BACKBONE: Tuple[Tuple[int, int], ...] = (
+    (1, 64), (2, 128), (1, 128), (2, 256), (1, 256), (2, 512),
+    (1, 512), (1, 512), (1, 512),
+)
+KEYPOINTS = 17  # COCO-17
+
+
+def init_params(width: float = 1.0, keypoints: int = KEYPOINTS,
+                seed: int = 0) -> Dict:
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
+
+    def conv(kh, kw, cin, cout):
+        w = jax.random.normal(next(keys), (kh, kw, cin, cout), np.float32)
+        return w * np.sqrt(2.0 / (kh * kw * cin))
+
+    r = lambda ch: max(8, int(ch * width + 4) // 8 * 8)  # noqa: E731
+    params: Dict = {}
+    c = r(32)
+    params["stem"] = {"w": conv(3, 3, 3, c),
+                      "scale": np.ones((c,), np.float32),
+                      "bias": np.zeros((c,), np.float32)}
+    cin = c
+    for i, (_s, ch) in enumerate(_BACKBONE):
+        cout = r(ch)
+        params[f"block{i}"] = {
+            "dw": conv(3, 3, 1, cin),
+            "dw_scale": np.ones((cin,), np.float32),
+            "dw_bias": np.zeros((cin,), np.float32),
+            "pw": conv(1, 1, cin, cout),
+            "pw_scale": np.ones((cout,), np.float32),
+            "pw_bias": np.zeros((cout,), np.float32),
+        }
+        cin = cout
+    params["head_heat"] = {"w": conv(1, 1, cin, keypoints),
+                           "bias": np.zeros((keypoints,), np.float32)}
+    params["head_off"] = {"w": conv(1, 1, cin, 2 * keypoints),
+                          "bias": np.zeros((2 * keypoints,), np.float32)}
+    return params
+
+
+def param_pspecs() -> Dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict = {
+        "stem": {"w": P(None, None, None, "model"), "scale": P("model"),
+                 "bias": P("model")}
+    }
+    for i in range(len(_BACKBONE)):
+        specs[f"block{i}"] = {
+            "dw": P(), "dw_scale": P(), "dw_bias": P(),
+            "pw": P(None, None, None, "model"),
+            "pw_scale": P("model"), "pw_bias": P("model"),
+        }
+    specs["head_heat"] = {"w": P(), "bias": P()}
+    specs["head_off"] = {"w": P(), "bias": P()}
+    return specs
+
+
+def apply(params, x, *, compute_dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    cdt = jnp.dtype(compute_dtype)
+    x = x.astype(cdt)
+
+    def conv2d(x, w, stride, groups=1):
+        return lax.conv_general_dilated(
+            x, w.astype(cdt), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups)
+
+    def sbr(x, scale, bias):
+        return jnp.clip(x * scale.astype(cdt) + bias.astype(cdt), 0.0, 6.0)
+
+    p = params["stem"]
+    x = sbr(conv2d(x, p["w"], 2), p["scale"], p["bias"])
+    for i, (stride, _ch) in enumerate(_BACKBONE):
+        b = params[f"block{i}"]
+        x = conv2d(x, b["dw"], stride, groups=x.shape[-1])
+        x = sbr(x, b["dw_scale"], b["dw_bias"])
+        x = conv2d(x, b["pw"], 1)
+        x = sbr(x, b["pw_scale"], b["pw_bias"])
+    heat = conv2d(x, params["head_heat"]["w"], 1) + \
+        params["head_heat"]["bias"].astype(cdt)
+    off = conv2d(x, params["head_off"]["w"], 1) + \
+        params["head_off"]["bias"].astype(cdt)
+    return (jax.nn.sigmoid(heat).astype(jnp.float32),
+            off.astype(jnp.float32))
+
+
+@register_model("posenet")
+def _posenet(opts: Dict[str, str]) -> ModelBundle:
+    width = float(opts.get("width", 1.0))
+    keypoints = int(opts.get("keypoints", KEYPOINTS))
+    seed = int(opts.get("seed", 0))
+    size = int(opts.get("size", 256))
+    batch = int(opts.get("batch", 1))
+    dtype = opts.get("dtype", "bfloat16")
+
+    params = init_params(width=width, keypoints=keypoints, seed=seed)
+    apply_fn = functools.partial(apply, compute_dtype=dtype)
+    fm = size // 16
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(
+            f"{keypoints}:{fm}:{fm}:{batch},{2 * keypoints}:{fm}:{fm}:{batch}",
+            "float32,float32"),
+        param_pspecs=param_pspecs(),
+        name="posenet",
+    )

@@ -1,0 +1,229 @@
+"""SSD-MobileNet object detector — benchmark config #2.
+
+Reference analog: the reference runs ``ssd_mobilenet_v2_coco.tflite``
+through the tflite sub-plugin and decodes with
+``tensordec-boundingbox.c`` mode ssd (SURVEY §2.5, BASELINE config #2).
+TPU-first design notes:
+
+* MobileNet-v1-style depthwise-separable backbone (NHWC, bfloat16, MXU
+  tiling as models/mobilenet.py) with two detection scales; SSD extras are
+  stride-2 separable convs.
+* **Anchor decode lives inside the model** (like tflite SSD graphs embed
+  their postprocess): apply() emits corner-format normalized boxes (B,N,4)
+  and per-class scores (B,N,C) — exactly the ``bounding_boxes`` decoder's
+  ssd contract, so the whole thing fuses into one XLA program and only the
+  final small (N,4)+(N,C) tensors cross to host for NMS/overlay.
+* Anchors are precomputed numpy constants baked into the jitted program
+  (XLA folds them); scale/aspect grid matches the standard SSD recipe.
+
+Weights are deterministic-random (no egress); real checkpoints map onto the
+same pytree.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .zoo import ModelBundle, register_model
+
+# Backbone: (stride, out_ch) separable blocks after the stem (stride-2 conv).
+_BACKBONE: Tuple[Tuple[int, int], ...] = (
+    (1, 64), (2, 128), (1, 128), (2, 256), (1, 256), (2, 512),
+    (1, 512), (1, 512),          # feature map A: stride 16
+)
+_EXTRA: Tuple[Tuple[int, int], ...] = (
+    (2, 512), (1, 512),          # feature map B: stride 32
+)
+_ASPECTS = (1.0, 2.0, 0.5)
+
+
+def _anchors_for(fm: int, scale: float, next_scale: float) -> np.ndarray:
+    """SSD anchor grid for one fm x fm feature map -> (fm*fm*A, 4) cxcywh."""
+    out = []
+    centers = (np.arange(fm, dtype=np.float32) + 0.5) / fm
+    cy, cx = np.meshgrid(centers, centers, indexing="ij")
+    for a in _ASPECTS:
+        w = scale * np.sqrt(a)
+        h = scale / np.sqrt(a)
+        out.append(np.stack(
+            [cx, cy, np.full_like(cx, w), np.full_like(cy, h)], axis=-1))
+    s_extra = float(np.sqrt(scale * next_scale))
+    out.append(np.stack(
+        [cx, cy, np.full_like(cx, s_extra), np.full_like(cy, s_extra)],
+        axis=-1))
+    return np.concatenate([o.reshape(-1, 4) for o in out], axis=0)
+
+
+def num_anchors_per_cell() -> int:
+    return len(_ASPECTS) + 1
+
+
+def build_anchors(size: int) -> np.ndarray:
+    """All anchors (N,4) cxcywh normalized, for strides 16 and 32."""
+    fm_a, fm_b = size // 16, size // 32
+    return np.concatenate(
+        [_anchors_for(fm_a, 0.35, 0.6), _anchors_for(fm_b, 0.6, 0.9)], axis=0
+    ).astype(np.float32)
+
+
+def init_params(classes: int = 91, width: float = 1.0, seed: int = 0) -> Dict:
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 80))
+
+    def conv(kh, kw, cin, cout):
+        w = jax.random.normal(next(keys), (kh, kw, cin, cout), np.float32)
+        return w * np.sqrt(2.0 / (kh * kw * cin))
+
+    def sep_block(cin, cout):
+        return {
+            "dw": conv(3, 3, 1, cin), "dw_scale": np.ones((cin,), np.float32),
+            "dw_bias": np.zeros((cin,), np.float32),
+            "pw": conv(1, 1, cin, cout),
+            "pw_scale": np.ones((cout,), np.float32),
+            "pw_bias": np.zeros((cout,), np.float32),
+        }
+
+    r = lambda ch: max(8, int(ch * width + 4) // 8 * 8)  # noqa: E731
+    params: Dict = {}
+    c = r(32)
+    params["stem"] = {
+        "w": conv(3, 3, 3, c),
+        "scale": np.ones((c,), np.float32),
+        "bias": np.zeros((c,), np.float32),
+    }
+    cin = c
+    for i, (_s, ch) in enumerate(_BACKBONE):
+        params[f"block{i}"] = sep_block(cin, r(ch))
+        cin = r(ch)
+    ca = cin
+    for i, (_s, ch) in enumerate(_EXTRA):
+        params[f"extra{i}"] = sep_block(cin, r(ch))
+        cin = r(ch)
+    cb = cin
+    A = num_anchors_per_cell()
+    for tag, ch in (("a", ca), ("b", cb)):
+        params[f"head_{tag}"] = {
+            "box": conv(3, 3, ch, A * 4),
+            "box_bias": np.zeros((A * 4,), np.float32),
+            "cls": conv(3, 3, ch, A * classes),
+            "cls_bias": np.zeros((A * classes,), np.float32),
+        }
+    return params
+
+
+def param_pspecs() -> Dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict = {
+        "stem": {"w": P(None, None, None, "model"), "scale": P("model"),
+                 "bias": P("model")}
+    }
+    for i in range(len(_BACKBONE)):
+        specs[f"block{i}"] = {
+            "dw": P(), "dw_scale": P(), "dw_bias": P(),
+            "pw": P(None, None, None, "model"),
+            "pw_scale": P("model"), "pw_bias": P("model"),
+        }
+    for i in range(len(_EXTRA)):
+        specs[f"extra{i}"] = {
+            "dw": P(), "dw_scale": P(), "dw_bias": P(),
+            "pw": P(None, None, None, "model"),
+            "pw_scale": P("model"), "pw_bias": P("model"),
+        }
+    for tag in ("a", "b"):
+        specs[f"head_{tag}"] = {"box": P(), "box_bias": P(),
+                                "cls": P(), "cls_bias": P()}
+    return specs
+
+
+def apply(params, x, *, anchors, classes: int, compute_dtype="bfloat16"):
+    """NHWC image batch -> (boxes (B,N,4) corner [0,1], scores (B,N,C))."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    cdt = jnp.dtype(compute_dtype)
+    x = x.astype(cdt)
+
+    def conv2d(x, w, stride, groups=1):
+        return lax.conv_general_dilated(
+            x, w.astype(cdt), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups)
+
+    def sbr(x, scale, bias):
+        return jnp.clip(x * scale.astype(cdt) + bias.astype(cdt), 0.0, 6.0)
+
+    def sep(x, p, stride):
+        x = conv2d(x, p["dw"], stride, groups=x.shape[-1])
+        x = sbr(x, p["dw_scale"], p["dw_bias"])
+        x = conv2d(x, p["pw"], 1)
+        return sbr(x, p["pw_scale"], p["pw_bias"])
+
+    p = params["stem"]
+    x = sbr(conv2d(x, p["w"], 2), p["scale"], p["bias"])
+    for i, (stride, _ch) in enumerate(_BACKBONE):
+        x = sep(x, params[f"block{i}"], stride)
+    fm_a = x
+    for i, (stride, _ch) in enumerate(_EXTRA):
+        x = sep(x, params[f"extra{i}"], stride)
+    fm_b = x
+
+    B = x.shape[0]
+    A = num_anchors_per_cell()
+
+    def head(fm, hp):
+        box = conv2d(fm, hp["box"], 1) + hp["box_bias"].astype(cdt)
+        cls = conv2d(fm, hp["cls"], 1) + hp["cls_bias"].astype(cdt)
+        return (box.reshape(B, -1, 4).astype(jnp.float32),
+                cls.reshape(B, -1, classes).astype(jnp.float32))
+
+    box_a, cls_a = head(fm_a, params["head_a"])
+    box_b, cls_b = head(fm_b, params["head_b"])
+    deltas = jnp.concatenate([box_a, box_b], axis=1)  # (B,N,4)
+    logits = jnp.concatenate([cls_a, cls_b], axis=1)  # (B,N,C)
+
+    # Anchor decode (tflite SSD convention: deltas scaled by 10/5).
+    anc = jnp.asarray(anchors)  # (N,4) cx,cy,w,h
+    cx = deltas[..., 0] / 10.0 * anc[:, 2] + anc[:, 0]
+    cy = deltas[..., 1] / 10.0 * anc[:, 3] + anc[:, 1]
+    w = jnp.exp(jnp.clip(deltas[..., 2] / 5.0, -10.0, 10.0)) * anc[:, 2]
+    h = jnp.exp(jnp.clip(deltas[..., 3] / 5.0, -10.0, 10.0)) * anc[:, 3]
+    boxes = jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    boxes = jnp.clip(boxes, 0.0, 1.0)
+    import jax
+
+    scores = jax.nn.sigmoid(logits)
+    return boxes, scores
+
+
+@register_model("ssd_mobilenet")
+def _ssd(opts: Dict[str, str]) -> ModelBundle:
+    classes = int(opts.get("classes", 91))
+    width = float(opts.get("width", 1.0))
+    seed = int(opts.get("seed", 0))
+    size = int(opts.get("size", 320))
+    batch = int(opts.get("batch", 1))
+    dtype = opts.get("dtype", "bfloat16")
+    if size % 32:
+        raise ValueError(f"ssd size must be a multiple of 32, got {size}")
+
+    params = init_params(classes=classes, width=width, seed=seed)
+    anchors = build_anchors(size)
+    apply_fn = functools.partial(
+        apply, anchors=anchors, classes=classes, compute_dtype=dtype)
+    n = anchors.shape[0]
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(
+            f"4:{n}:{batch},{classes}:{n}:{batch}", "float32,float32"),
+        param_pspecs=param_pspecs(),
+        name="ssd_mobilenet",
+    )

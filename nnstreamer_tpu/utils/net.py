@@ -1,0 +1,123 @@
+"""Shared TCP listener + handshake scaffolding for the distribution
+elements (tensor_query server, edgesink publisher).
+
+Reference analog: the connection handshake / capability exchange inside
+nnstreamer-edge (SURVEY §2.7) — one implementation serving both the
+request/response (query) and pub/sub (edge) transports.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Callable, Optional
+
+from ..core.log import logger
+from . import wire
+
+log = logger(__name__)
+
+
+class TcpListener:
+    """Bind + accept loop; one daemon thread per connection.
+
+    ``session_cb(conn)`` runs on the connection's own thread and owns the
+    socket's lifetime (the listener closes it after the callback returns).
+    """
+
+    def __init__(self, host: str, port: int,
+                 session_cb: Callable[[socket.socket], None],
+                 name: str = "tcp"):
+        self._session_cb = session_cb
+        self._name = name
+        self._stopping = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._sock.settimeout(0.2)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept:{self.port}",
+            daemon=True,
+        ).start()
+
+    @property
+    def stopping(self) -> threading.Event:
+        return self._stopping
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._session, args=(conn,), daemon=True,
+                name=f"{self._name}-conn",
+            ).start()
+
+    def _session(self, conn: socket.socket) -> None:
+        try:
+            self._session_cb(conn)
+        except (OSError, ValueError) as e:
+            log.debug("%s: session ended: %s", self._name, e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def parse_control(raw: Optional[bytes]) -> Optional[dict]:
+    """Control frames are JSON objects; tensor frames start with the wire
+    magic.  Returns None for non-control frames."""
+    if not raw:
+        return None
+    if len(raw) >= 4 and int.from_bytes(raw[:4], "little") == wire.MAGIC:
+        return None
+    try:
+        msg = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        return None
+    return msg if isinstance(msg, dict) else None
+
+
+def server_handshake(conn: socket.socket, expect_type: str,
+                     topic: str = "") -> Optional[dict]:
+    """Read a hello frame, enforce the topic filter, reply ack/nack.
+
+    Returns the hello dict on success, None on rejection (nack sent)."""
+    conn.settimeout(5.0)
+    hello = parse_control(wire.read_frame(conn))
+    if not hello or hello.get("type") != expect_type:
+        return None
+    if topic and hello.get("topic", "") not in ("", topic):
+        wire.write_frame(conn, json.dumps(
+            {"type": "nack", "reason": "topic mismatch"}).encode())
+        return None
+    wire.write_frame(conn, json.dumps(
+        {"type": "ack", "topic": topic}).encode())
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return hello
+
+
+def client_handshake(conn: socket.socket, hello_type: str, **fields) -> dict:
+    """Send hello, await ack; raises ConnectionError on rejection."""
+    wire.write_frame(conn, json.dumps(
+        {"type": hello_type, **fields}).encode("utf-8"))
+    ack = parse_control(wire.read_frame(conn))
+    if not ack or ack.get("type") != "ack":
+        raise ConnectionError(f"server rejected connection: {ack}")
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return ack

@@ -1,0 +1,129 @@
+"""LLM path tests (benchmark config #5): KV-cache decode, TP sharding,
+ring-attention sequence parallelism, token streaming through a pipeline."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.PRESETS["llama_tiny"]
+    params = llama.init_params(cfg, seed=0)
+    return cfg, params
+
+
+def test_forward_shapes(tiny):
+    cfg, params = tiny
+    toks = np.arange(12, dtype=np.int32).reshape(2, 6) % cfg.vocab
+    logits = llama.forward(params, toks, cfg, compute_dtype="float32")
+    assert logits.shape == (2, 6, cfg.vocab)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_cached_decode_matches_full_forward(tiny):
+    """Prefill+cached decode must equal the uncached full forward — the
+    KV-cache correctness invariant."""
+    cfg, params = tiny
+    rng = np.random.default_rng(0)
+    T = 10
+    toks = rng.integers(0, cfg.vocab, (1, T), np.int32)
+
+    full = np.asarray(llama.forward(params, toks, cfg, compute_dtype="float32"))
+
+    cache = llama.init_cache(cfg, 1, dtype="float32")
+    pre, cache = llama.forward_cached(params, toks[:, :4], cache, 0, cfg,
+                                      compute_dtype="float32")
+    np.testing.assert_allclose(np.asarray(pre), full[:, :4], rtol=2e-4, atol=2e-4)
+    for i in range(4, T):
+        step, cache = llama.forward_cached(params, toks[:, i : i + 1], cache,
+                                           i, cfg, compute_dtype="float32")
+        np.testing.assert_allclose(
+            np.asarray(step[:, 0]), full[:, i], rtol=2e-4, atol=2e-4
+        )
+
+
+def test_generate_scan_deterministic(tiny):
+    cfg, params = tiny
+    prompt = np.array([[1, 5, 9, 13]], np.int32)
+    a = np.asarray(llama.generate_scan(params, prompt, cfg, max_new=8,
+                                       temperature=0.0, compute_dtype="float32"))
+    b = np.asarray(llama.generate_scan(params, prompt, cfg, max_new=8,
+                                       temperature=0.0, compute_dtype="float32"))
+    assert a.shape == (1, 8)
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < cfg.vocab).all()
+
+
+def test_seq_parallel_matches_dense(tiny):
+    """Ring-attention SP forward == single-device forward (SURVEY §5.7:
+    long-context is first-class here, absent in the reference)."""
+    import jax
+
+    from nnstreamer_tpu.parallel import make_mesh
+
+    cfg, params = tiny
+    mesh = make_mesh(seq=4, data=1, devices=jax.devices()[:4])
+    toks = np.arange(16, dtype=np.int32)[None, :] % cfg.vocab
+    dense = np.asarray(llama.forward(params, toks, cfg, compute_dtype="float32"))
+    sp = np.asarray(llama.forward_seq_parallel(mesh, params, toks, cfg,
+                                               compute_dtype="float32"))
+    np.testing.assert_allclose(sp, dense, rtol=2e-3, atol=2e-3)
+
+
+def test_tp_sharded_generation_matches_single():
+    """TP over the model axis must not change greedy outputs."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nnstreamer_tpu.parallel import make_mesh
+    from nnstreamer_tpu.parallel.sharding import shard_params
+
+    cfg = llama.PRESETS["llama_tiny"]
+    params = llama.init_params(cfg, seed=0)
+    prompt = np.array([[1, 7, 3]], np.int32)
+    ref = np.asarray(llama.generate_scan(params, prompt, cfg, max_new=6,
+                                         temperature=0.0, compute_dtype="float32"))
+
+    mesh = make_mesh(model=2, data=1, devices=jax.devices()[:2])
+    sharded = shard_params(mesh, params, llama.param_pspecs())
+    out = np.asarray(llama.generate_scan(sharded, prompt, cfg, max_new=6,
+                                         temperature=0.0, compute_dtype="float32"))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_llm_pipeline_token_streaming():
+    """Full pipeline: prompt pushed as text, tokens stream out one buffer
+    each (the reference llamacpp contract)."""
+    p = nt.Pipeline(
+        "appsrc name=src ! "
+        "tensor_filter framework=llm model=llama_tiny "
+        "custom=max_new:5,dtype:float32 invoke-dynamic=true ! "
+        "tensor_sink name=out"
+    )
+    with p:
+        p.push("src", "hi")
+        outs = [p.pull("out", timeout=120) for _ in range(5)]
+        p.eos("src")
+        p.wait(timeout=60)
+    for i, buf in enumerate(outs):
+        assert buf.meta["stream_index"] == i
+        ids = buf.tensors[0]
+        assert ids.dtype == np.int32 and ids.shape == (1,)
+        assert 0 <= int(ids[0]) < llama.PRESETS["llama_tiny"].vocab
+
+
+def test_llm_invoke_nonstream():
+    from nnstreamer_tpu.filters.llm import LLMFramework
+
+    fw = LLMFramework()
+    fw.open({"model": "llama_tiny", "custom": "max_new:4,dtype:float32"})
+    prompt = np.frombuffer(b"ab", np.uint8)
+    ids, text = fw.invoke([prompt])
+    assert ids.shape == (1, 4)
+    # determinism across invokes
+    ids2, _ = fw.invoke([prompt])
+    np.testing.assert_array_equal(ids, ids2)
+    fw.close()
