@@ -1,0 +1,171 @@
+"""datareposrc / datareposink: file-backed training datasets.
+
+Reference analog: ``gst/datarepo/gstdatareposrc.c`` / ``gstdatareposink.c``
+(SURVEY §2.8, upstream-reconstructed): raw fixed-size samples in one binary
+file described by a small JSON meta (the reference stores ``gst_caps``,
+``total_samples``, ``sample_size``), with ``start-sample-index`` /
+``stop-sample-index`` / ``epochs`` / ``is-shuffle`` dataset iteration —
+that plus trainer ``model-save-path`` is the reference's whole
+checkpoint/resume story (SURVEY §5.4).
+
+JSON meta here::
+
+    {"dims": "4:1,1:1", "types": "float32,int32",
+     "total_samples": 120, "sample_size": 20}
+
+(dims/types are our caps-string equivalents of ``gst_caps``; sample_size is
+the byte length of one sample = all tensors concatenated.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional, Union
+
+import numpy as np
+
+from ..core.buffer import Buffer, Event
+from ..core.caps import Caps
+from ..core.registry import register_element
+from ..core.types import TensorsSpec, dtype_name
+from .base import Element, ElementError, Out, SinkElement, SourceElement
+
+
+@register_element("datareposrc")
+class DataRepoSrc(SourceElement):
+    """Reads (input, label) samples from a binary file + JSON meta.
+
+    Props: ``location`` (data file), ``json`` (meta file),
+    ``start-sample-index``, ``stop-sample-index`` (inclusive; -1 = last),
+    ``epochs`` (dataset repetitions; each epoch re-emits the samples — the
+    reference drives multi-epoch training this way), ``is-shuffle``
+    (per-epoch deterministic shuffle, seeded by epoch index).
+    """
+
+    kind = "datareposrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.location = str(self.props.get("location", ""))
+        self.json_path = str(self.props.get("json", ""))
+        self.start_idx = int(self.props.get("start_sample_index", 0))
+        self.stop_idx = int(self.props.get("stop_sample_index", -1))
+        self.epochs = int(self.props.get("epochs", 1))
+        self.shuffle = str(self.props.get("is_shuffle", "false")).lower() in (
+            "true",
+            "1",
+            "yes",
+        )
+        self.spec: Optional[TensorsSpec] = None
+        self._meta = None
+
+    def _load_meta(self):
+        if self._meta is not None:
+            return
+        if not self.json_path:
+            raise ElementError("datareposrc requires json= meta path")
+        with open(self.json_path, "r") as f:
+            self._meta = json.load(f)
+        self.spec = TensorsSpec.from_string(
+            self._meta["dims"], self._meta.get("types", "uint8")
+        )
+        expect = sum(s.nbytes for s in self.spec)
+        size = int(self._meta.get("sample_size", expect))
+        if size != expect:
+            raise ElementError(
+                f"datarepo meta sample_size={size} != spec bytes {expect}"
+            )
+
+    def configure(self, in_caps, out_pads):
+        self._load_meta()
+        caps = Caps.tensors(self.spec)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def generate(self) -> Iterator[Union[Buffer, Event]]:
+        self._load_meta()
+        sample_size = sum(s.nbytes for s in self.spec)
+        with open(self.location, "rb") as f:
+            data = f.read()
+        total = int(self._meta.get("total_samples", len(data) // sample_size))
+        stop = total - 1 if self.stop_idx < 0 else min(self.stop_idx, total - 1)
+        indices = list(range(self.start_idx, stop + 1))
+        if not indices:
+            return
+        for epoch in range(self.epochs):
+            order = list(indices)
+            if self.shuffle:
+                np.random.default_rng(epoch).shuffle(order)
+            for i in order:
+                off = i * sample_size
+                raw = data[off : off + sample_size]
+                if len(raw) < sample_size:
+                    raise ElementError(f"datarepo sample {i} truncated")
+                tensors: List[np.ndarray] = []
+                pos = 0
+                for s in self.spec:
+                    n = s.nbytes
+                    arr = np.frombuffer(raw[pos : pos + n], dtype=s.dtype).reshape(
+                        s.shape
+                    )
+                    tensors.append(arr)
+                    pos += n
+                yield Buffer(tensors, spec=self.spec, meta={"sample_index": i, "epoch": epoch})
+
+
+@register_element("datareposink")
+class DataRepoSink(SinkElement):
+    """Writes incoming sample buffers to a binary file + JSON meta at EOS.
+
+    Props: ``location``, ``json``.
+    """
+
+    kind = "datareposink"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.location = str(self.props.get("location", ""))
+        self.json_path = str(self.props.get("json", ""))
+        self._f = None
+        self._count = 0
+        self._spec: Optional[TensorsSpec] = None
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(self.location)) or ".", exist_ok=True)
+        self._f = open(self.location, "wb")
+        self._count = 0
+
+    def process(self, pad: str, buf: Buffer) -> Out:
+        if self._spec is None:
+            self._spec = buf.spec
+        for t in buf.tensors:
+            self._f.write(np.ascontiguousarray(np.asarray(t)).tobytes())
+        self._count += 1
+        return []
+
+    def finalize(self) -> Out:
+        self._write_meta()
+        return []
+
+    def stop(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def _write_meta(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+        if not self.json_path or self._spec is None:
+            return
+        sample_size = sum(s.nbytes for s in self._spec)
+        meta = {
+            "dims": ",".join(
+                ":".join(str(d) for d in s.dims) for s in self._spec
+            ),
+            "types": ",".join(dtype_name(s.dtype) for s in self._spec),
+            "total_samples": self._count,
+            "sample_size": sample_size,
+        }
+        with open(self.json_path, "w") as f:
+            json.dump(meta, f)

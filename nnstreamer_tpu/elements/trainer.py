@@ -1,0 +1,157 @@
+"""tensor_trainer: on-device training as a pipeline element.
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_trainer.c`` (SURVEY
+§2.2, upstream-reconstructed): receives (input, label) tensor pairs from the
+stream, drives a trainer sub-plugin through push_data/start/stop/save-model,
+and emits per-epoch training stats (loss/accuracy) downstream as tensors.
+
+Element semantics kept: ``num-inputs``/``num-labels`` split each incoming
+buffer's tensors; ``num-training-samples``+``num-validation-samples`` define
+an epoch; each completed epoch runs a training pass and pushes ONE stats
+buffer (float64 [4]: training_loss, training_acc, val_loss, val_acc);
+``model-save-path`` is written at EOS (and on explicit ``ready-to-complete``).
+
+TPU-first difference: the epoch is not handed to a library thread (the
+reference queues into nntrainer's own event loop); the minibatch loop is a
+jitted optax scan executed synchronously — deterministic, testable, and the
+stats buffer is ready the moment the epoch's XLA program returns.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, Event
+from ..core.caps import Caps
+from ..core.registry import get as registry_get, register_element, KIND_TRAINER
+from ..core.types import TensorSpec, TensorsSpec
+from .base import Element, ElementError, Out, SRC
+
+STATS_SPEC = TensorsSpec.single(TensorSpec(name="stats", dtype="float64", dims=(4,)))
+
+
+@register_element("tensor_trainer")
+class TensorTrainer(Element):
+    """Training element.
+
+    Props: ``framework`` (trainer sub-plugin, default ``jax``), ``model``
+    (model-config passed to the sub-plugin), ``model-save-path``,
+    ``model-load-path`` (resume), ``num-inputs`` (default 1), ``num-labels``
+    (default 1), ``num-training-samples``, ``num-validation-samples``,
+    ``epochs`` (stop after N epochs; further data is ignored), plus
+    sub-plugin props (``optimizer``, ``learning-rate``, ``loss``,
+    ``batch-size``, ``mesh``...) forwarded verbatim.
+    """
+
+    kind = "tensor_trainer"
+    #: inputs and labels may arrive muxed in one buffer or on separate sink
+    #: pads (``in.sink_0`` data, ``in.sink_1`` labels) — collate when multi.
+    sync_policy = "all"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.num_inputs = int(self.props.get("num_inputs", 1))
+        self.num_labels = int(self.props.get("num_labels", 1))
+        self.n_train = int(self.props.get("num_training_samples", 0))
+        self.n_valid = int(self.props.get("num_validation_samples", 0))
+        self.epochs = int(self.props.get("epochs", 1))
+        self.save_path = str(self.props.get("model_save_path", "") or "")
+        self.fw_name = str(self.props.get("framework", "jax"))
+        self.trainer = None
+        self._pushed = 0
+        self._epochs_done = 0
+        self._stats_pts = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        cls = registry_get(KIND_TRAINER, self.fw_name)
+        self.trainer = cls()
+        self.trainer.open(self.props)
+
+    def stop(self) -> None:
+        if self.trainer is not None:
+            self.trainer.close()
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        caps = Caps.tensors(STATS_SPEC)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    # -- streaming ---------------------------------------------------------
+    def _epoch_size(self) -> int:
+        if self.n_train <= 0:
+            raise ElementError(
+                "tensor_trainer requires num-training-samples > 0"
+            )
+        return self.n_train + self.n_valid
+
+    def process(self, pad: str, buf: Buffer) -> Out:
+        if self._epochs_done >= self.epochs:
+            return []  # training complete; drain remaining pushes
+        want = self.num_inputs + self.num_labels
+        if len(buf.tensors) != want:
+            raise ElementError(
+                f"tensor_trainer expects {want} tensors/buffer "
+                f"(num-inputs={self.num_inputs} + num-labels={self.num_labels}), "
+                f"got {len(buf.tensors)}"
+            )
+        inputs = buf.tensors[: self.num_inputs]
+        labels = buf.tensors[self.num_inputs :]
+        pos = self._pushed % self._epoch_size()
+        is_validation = pos >= self.n_train
+        self.trainer.push_data(inputs, labels, is_validation)
+        self._pushed += 1
+
+        out: Out = []
+        if self._pushed % self._epoch_size() == 0:
+            out.extend(self._run_epoch())
+        return out
+
+    def process_group(self, bufs: Dict[str, Buffer]) -> Out:
+        tensors: List = []
+        for pad in sorted(bufs):
+            tensors.extend(bufs[pad].tensors)
+        merged = Buffer(tensors, pts=next(iter(bufs.values())).pts)
+        return self.process("sink", merged)
+
+    def _run_epoch(self) -> Out:
+        stats = self.trainer.train_epoch()
+        self._epochs_done += 1
+        arr = np.array(
+            [
+                stats.get("training_loss", np.nan),
+                stats.get("training_accuracy", np.nan),
+                stats.get("validation_loss", np.nan),
+                stats.get("validation_accuracy", np.nan),
+            ],
+            dtype=np.float64,
+        )
+        self._stats_pts += 1
+        out: Out = [(SRC, Buffer([arr], spec=STATS_SPEC, pts=self._stats_pts))]
+        if self._epochs_done >= self.epochs:
+            self._save()
+        return out
+
+    def _save(self) -> None:
+        if self.save_path and self.trainer is not None:
+            self.trainer.save(self.save_path)
+
+    def finalize(self) -> Out:
+        out: Out = []
+        # Partial epoch at EOS: train on what arrived (reference flushes the
+        # queue into the sub-plugin and stops).
+        if self._epochs_done < self.epochs and self.trainer is not None:
+            n_train, n_valid = self.trainer.queued()
+            if n_train:
+                out.extend(self._run_epoch())
+        self._save()
+        return out
+
+    def on_event(self, pad: str, event: Event) -> Out:
+        if event.kind == "ready-to-complete":
+            self._save()
+            return []
+        return super().on_event(pad, event)

@@ -84,8 +84,7 @@ class LLMFramework(Framework):
         self.temperature = 0.0
         self.seed = 0
         self.mesh = None
-        self._prefill = None
-        self._decode = None
+        self._fwd = None
 
     def open(self, props: Dict[str, object]) -> None:
         super().open(props)
@@ -110,7 +109,6 @@ class LLMFramework(Framework):
 
     def _setup(self, tp: int) -> None:
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..parallel.mesh import make_mesh
         from ..parallel.sharding import shard_params
@@ -176,7 +174,10 @@ class LLMFramework(Framework):
         params = self.bundle.params
         logits, cache = self._fwd(params, jnp.asarray(prompt), cache, 0)
         key = jax.random.PRNGKey(self.seed)
-        n = min(self.max_new, cfg.max_seq - T - 1)
+        # At least one token is always safe: prefill wrote cache[0:T] and the
+        # first sample needs no further cache write.  Subsequent decode steps
+        # feed at positions T..T+n-2, each of which must stay < max_seq.
+        n = max(1, min(self.max_new, cfg.max_seq - T))
         tok = llama.sample_token(logits[:, -1], key, self.temperature)
         for i in range(n):
             yield np.asarray(tok)  # host copy of [B] ids — the stream output

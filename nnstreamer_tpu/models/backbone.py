@@ -1,0 +1,104 @@
+"""Shared MobileNet-style backbone building blocks.
+
+One copy of the depthwise-separable conv recipe used by mobilenet.py (config
+#1), ssd.py (config #2) and posenet.py (config #3) — param init, apply-time
+conv helpers, and PartitionSpecs.  All NHWC, bfloat16-by-default, sized for
+MXU lane tiling (channels kept multiples of 8).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def rounded(ch: int, width: float) -> int:
+    """Width-multiplied channel count, kept a multiple of 8 for lane tiling."""
+    return max(8, int(ch * width + 4) // 8 * 8)
+
+
+def fm_size(size: int, stride: int) -> int:
+    """SAME-padded feature-map edge after ``log2(stride)`` stride-2 convs.
+
+    ceil-division chain, NOT ``size // stride`` — they differ whenever
+    ``size`` is not a multiple of ``stride`` (e.g. posenet's 257x257).
+    """
+    n = stride.bit_length() - 1
+    assert 1 << n == stride, f"stride must be a power of 2, got {stride}"
+    for _ in range(n):
+        size = -(-size // 2)
+    return size
+
+
+def he_conv(key, kh: int, kw: int, cin: int, cout: int) -> np.ndarray:
+    """He-normal conv kernel (HWIO)."""
+    import jax
+
+    w = jax.random.normal(key, (kh, kw, cin, cout), np.float32)
+    return w * np.sqrt(2.0 / (kh * kw * cin))
+
+
+def stem_params(keys, cin: int, cout: int) -> Dict:
+    return {
+        "w": he_conv(next(keys), 3, 3, cin, cout),
+        "scale": np.ones((cout,), np.float32),
+        "bias": np.zeros((cout,), np.float32),
+    }
+
+
+def sep_block_params(keys, cin: int, cout: int) -> Dict:
+    """Depthwise-separable block params: dw 3x3 (grouped) + pw 1x1."""
+    return {
+        "dw": he_conv(next(keys), 3, 3, 1, cin),
+        "dw_scale": np.ones((cin,), np.float32),
+        "dw_bias": np.zeros((cin,), np.float32),
+        "pw": he_conv(next(keys), 1, 1, cin, cout),
+        "pw_scale": np.ones((cout,), np.float32),
+        "pw_bias": np.zeros((cout,), np.float32),
+    }
+
+
+def stem_pspecs():
+    from jax.sharding import PartitionSpec as P
+
+    return {"w": P(None, None, None, "model"), "scale": P("model"),
+            "bias": P("model")}
+
+
+def sep_block_pspecs():
+    """TP sharding: pointwise kernels shard over output channels ("model"
+    axis); depthwise/scale/bias replicate (tiny)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "dw": P(), "dw_scale": P(), "dw_bias": P(),
+        "pw": P(None, None, None, "model"),
+        "pw_scale": P("model"), "pw_bias": P("model"),
+    }
+
+
+def make_ops(compute_dtype):
+    """Apply-time helpers closed over the compute dtype:
+    (conv2d, scale_bias_relu6, sep_block)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    cdt = jnp.dtype(compute_dtype)
+
+    def conv2d(x, w, stride, groups=1):
+        return lax.conv_general_dilated(
+            x, w.astype(cdt), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups)
+
+    def sbr(x, scale, bias):
+        return jnp.clip(x * scale.astype(cdt) + bias.astype(cdt), 0.0, 6.0)
+
+    def sep(x, p, stride):
+        x = conv2d(x, p["dw"], stride, groups=x.shape[-1])
+        x = sbr(x, p["dw_scale"], p["dw_bias"])
+        x = conv2d(x, p["pw"], 1)
+        return sbr(x, p["pw_scale"], p["pw_bias"])
+
+    return conv2d, sbr, sep

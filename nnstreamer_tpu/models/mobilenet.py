@@ -32,6 +32,14 @@ from typing import Dict, Tuple
 import numpy as np
 
 from ..core.types import TensorsSpec
+from .backbone import (
+    make_ops,
+    rounded,
+    sep_block_params,
+    sep_block_pspecs,
+    stem_params,
+    stem_pspecs,
+)
 from .zoo import ModelBundle, register_model
 
 # (stride, out_channels) per depthwise-separable block, after the stem conv.
@@ -53,49 +61,23 @@ _V1_BLOCKS: Tuple[Tuple[int, int], ...] = (
 )
 
 
-def _rounded(ch: int, width: float) -> int:
-    """Width-multiplied channel count, kept a multiple of 8 for lane tiling."""
-    v = max(8, int(ch * width + 4) // 8 * 8)
-    return v
-
-
 def init_params(
     width: float = 1.0, classes: int = 1001, seed: int = 0
 ) -> Dict:
     """He-normal random params in the canonical pytree layout."""
     import jax
 
+    from .backbone import he_conv
+
     keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
-    params: Dict = {}
-
-    def conv(key, kh, kw, cin, cout):
-        fan_in = kh * kw * cin
-        w = jax.random.normal(key, (kh, kw, cin, cout), np.float32)
-        return w * np.sqrt(2.0 / fan_in)
-
-    c_in = 3
-    c = _rounded(32, width)
-    params["stem"] = {
-        "w": conv(next(keys), 3, 3, c_in, c),
-        "scale": np.ones((c,), np.float32),
-        "bias": np.zeros((c,), np.float32),
-    }
-    cin = c
+    params: Dict = {"stem": stem_params(keys, 3, rounded(32, width))}
+    cin = rounded(32, width)
     for i, (_stride, cout_base) in enumerate(_V1_BLOCKS):
-        cout = _rounded(cout_base, width)
-        params[f"block{i}"] = {
-            # depthwise 3x3: HWIO with feature_group_count=cin -> (3,3,1,cin)
-            "dw": conv(next(keys), 3, 3, 1, cin),
-            "dw_scale": np.ones((cin,), np.float32),
-            "dw_bias": np.zeros((cin,), np.float32),
-            # pointwise 1x1
-            "pw": conv(next(keys), 1, 1, cin, cout),
-            "pw_scale": np.ones((cout,), np.float32),
-            "pw_bias": np.zeros((cout,), np.float32),
-        }
+        cout = rounded(cout_base, width)
+        params[f"block{i}"] = sep_block_params(keys, cin, cout)
         cin = cout
     params["head"] = {
-        "w": conv(next(keys), 1, 1, cin, classes),
+        "w": he_conv(next(keys), 1, 1, cin, classes),
         "bias": np.zeros((classes,), np.float32),
     }
     return params
@@ -110,56 +92,25 @@ def param_pspecs() -> Dict:
     """
     from jax.sharding import PartitionSpec as P
 
-    specs: Dict = {
-        "stem": {"w": P(None, None, None, "model"), "scale": P("model"), "bias": P("model")}
-    }
+    specs: Dict = {"stem": stem_pspecs()}
     for i in range(len(_V1_BLOCKS)):
-        specs[f"block{i}"] = {
-            "dw": P(),
-            "dw_scale": P(),
-            "dw_bias": P(),
-            "pw": P(None, None, None, "model"),
-            "pw_scale": P("model"),
-            "pw_bias": P("model"),
-        }
+        specs[f"block{i}"] = sep_block_pspecs()
     specs["head"] = {"w": P(None, None, None, "model"), "bias": P("model")}
     return specs
 
 
 def apply(params, x, *, compute_dtype="bfloat16", train: bool = False):
     """Forward pass.  ``x``: NHWC float (any float dtype), returns logits."""
-    import jax
     import jax.numpy as jnp
-    from jax import lax
 
     cdt = jnp.dtype(compute_dtype)
     x = x.astype(cdt)
-
-    def conv2d(x, w, stride, groups=1):
-        return lax.conv_general_dilated(
-            x,
-            w.astype(cdt),
-            window_strides=(stride, stride),
-            padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=groups,
-        )
-
-    def scale_bias_relu6(x, scale, bias):
-        x = x * scale.astype(cdt) + bias.astype(cdt)
-        return jnp.clip(x, 0.0, 6.0)
+    conv2d, sbr, sep = make_ops(cdt)
 
     p = params["stem"]
-    x = conv2d(x, p["w"], 2)
-    x = scale_bias_relu6(x, p["scale"], p["bias"])
-
+    x = sbr(conv2d(x, p["w"], 2), p["scale"], p["bias"])
     for i, (stride, _cout) in enumerate(_V1_BLOCKS):
-        b = params[f"block{i}"]
-        cin = x.shape[-1]
-        x = conv2d(x, b["dw"], stride, groups=cin)
-        x = scale_bias_relu6(x, b["dw_scale"], b["dw_bias"])
-        x = conv2d(x, b["pw"], 1)
-        x = scale_bias_relu6(x, b["pw_scale"], b["pw_bias"])
+        x = sep(x, params[f"block{i}"], stride)
 
     x = jnp.mean(x, axis=(1, 2), keepdims=True)  # global average pool
     h = params["head"]

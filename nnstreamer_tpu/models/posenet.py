@@ -22,6 +22,16 @@ from typing import Dict, Tuple
 import numpy as np
 
 from ..core.types import TensorsSpec
+from .backbone import (
+    fm_size,
+    he_conv,
+    make_ops,
+    rounded,
+    sep_block_params,
+    sep_block_pspecs,
+    stem_params,
+    stem_pspecs,
+)
 from .zoo import ModelBundle, register_model
 
 _BACKBONE: Tuple[Tuple[int, int], ...] = (
@@ -36,32 +46,15 @@ def init_params(width: float = 1.0, keypoints: int = KEYPOINTS,
     import jax
 
     keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
-
-    def conv(kh, kw, cin, cout):
-        w = jax.random.normal(next(keys), (kh, kw, cin, cout), np.float32)
-        return w * np.sqrt(2.0 / (kh * kw * cin))
-
-    r = lambda ch: max(8, int(ch * width + 4) // 8 * 8)  # noqa: E731
-    params: Dict = {}
-    c = r(32)
-    params["stem"] = {"w": conv(3, 3, 3, c),
-                      "scale": np.ones((c,), np.float32),
-                      "bias": np.zeros((c,), np.float32)}
-    cin = c
+    params: Dict = {"stem": stem_params(keys, 3, rounded(32, width))}
+    cin = rounded(32, width)
     for i, (_s, ch) in enumerate(_BACKBONE):
-        cout = r(ch)
-        params[f"block{i}"] = {
-            "dw": conv(3, 3, 1, cin),
-            "dw_scale": np.ones((cin,), np.float32),
-            "dw_bias": np.zeros((cin,), np.float32),
-            "pw": conv(1, 1, cin, cout),
-            "pw_scale": np.ones((cout,), np.float32),
-            "pw_bias": np.zeros((cout,), np.float32),
-        }
+        cout = rounded(ch, width)
+        params[f"block{i}"] = sep_block_params(keys, cin, cout)
         cin = cout
-    params["head_heat"] = {"w": conv(1, 1, cin, keypoints),
+    params["head_heat"] = {"w": he_conv(next(keys), 1, 1, cin, keypoints),
                            "bias": np.zeros((keypoints,), np.float32)}
-    params["head_off"] = {"w": conv(1, 1, cin, 2 * keypoints),
+    params["head_off"] = {"w": he_conv(next(keys), 1, 1, cin, 2 * keypoints),
                           "bias": np.zeros((2 * keypoints,), np.float32)}
     return params
 
@@ -69,16 +62,9 @@ def init_params(width: float = 1.0, keypoints: int = KEYPOINTS,
 def param_pspecs() -> Dict:
     from jax.sharding import PartitionSpec as P
 
-    specs: Dict = {
-        "stem": {"w": P(None, None, None, "model"), "scale": P("model"),
-                 "bias": P("model")}
-    }
+    specs: Dict = {"stem": stem_pspecs()}
     for i in range(len(_BACKBONE)):
-        specs[f"block{i}"] = {
-            "dw": P(), "dw_scale": P(), "dw_bias": P(),
-            "pw": P(None, None, None, "model"),
-            "pw_scale": P("model"), "pw_bias": P("model"),
-        }
+        specs[f"block{i}"] = sep_block_pspecs()
     specs["head_heat"] = {"w": P(), "bias": P()}
     specs["head_off"] = {"w": P(), "bias": P()}
     return specs
@@ -87,28 +73,15 @@ def param_pspecs() -> Dict:
 def apply(params, x, *, compute_dtype="bfloat16"):
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     cdt = jnp.dtype(compute_dtype)
     x = x.astype(cdt)
-
-    def conv2d(x, w, stride, groups=1):
-        return lax.conv_general_dilated(
-            x, w.astype(cdt), (stride, stride), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=groups)
-
-    def sbr(x, scale, bias):
-        return jnp.clip(x * scale.astype(cdt) + bias.astype(cdt), 0.0, 6.0)
+    conv2d, sbr, sep = make_ops(cdt)
 
     p = params["stem"]
     x = sbr(conv2d(x, p["w"], 2), p["scale"], p["bias"])
     for i, (stride, _ch) in enumerate(_BACKBONE):
-        b = params[f"block{i}"]
-        x = conv2d(x, b["dw"], stride, groups=x.shape[-1])
-        x = sbr(x, b["dw_scale"], b["dw_bias"])
-        x = conv2d(x, b["pw"], 1)
-        x = sbr(x, b["pw_scale"], b["pw_bias"])
+        x = sep(x, params[f"block{i}"], stride)
     heat = conv2d(x, params["head_heat"]["w"], 1) + \
         params["head_heat"]["bias"].astype(cdt)
     off = conv2d(x, params["head_off"]["w"], 1) + \
@@ -128,7 +101,9 @@ def _posenet(opts: Dict[str, str]) -> ModelBundle:
 
     params = init_params(width=width, keypoints=keypoints, seed=seed)
     apply_fn = functools.partial(apply, compute_dtype=dtype)
-    fm = size // 16
+    # SAME-padded ceil-div chain, not size//16: the reference posenet's own
+    # 257x257 input yields 17x17 heatmaps, not 16x16.
+    fm = fm_size(size, 16)
     return ModelBundle(
         apply_fn=apply_fn,
         params=params,

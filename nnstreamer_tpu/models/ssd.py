@@ -28,6 +28,15 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..core.types import TensorsSpec
+from .backbone import (
+    he_conv,
+    make_ops,
+    rounded,
+    sep_block_params,
+    sep_block_pspecs,
+    stem_params,
+    stem_pspecs,
+)
 from .zoo import ModelBundle, register_model
 
 # Backbone: (stride, out_ch) separable blocks after the stem (stride-2 conv).
@@ -42,20 +51,25 @@ _ASPECTS = (1.0, 2.0, 0.5)
 
 
 def _anchors_for(fm: int, scale: float, next_scale: float) -> np.ndarray:
-    """SSD anchor grid for one fm x fm feature map -> (fm*fm*A, 4) cxcywh."""
-    out = []
+    """SSD anchor grid for one fm x fm feature map -> (fm*fm*A, 4) cxcywh.
+
+    Layout is cell-major (y, x, a) to match the head's
+    ``(B,H,W,A*4) -> (B, H*W*A, 4)`` reshape: anchor index = (y*fm + x)*A + a.
+    """
     centers = (np.arange(fm, dtype=np.float32) + 0.5) / fm
     cy, cx = np.meshgrid(centers, centers, indexing="ij")
+    per_aspect = []
     for a in _ASPECTS:
         w = scale * np.sqrt(a)
         h = scale / np.sqrt(a)
-        out.append(np.stack(
+        per_aspect.append(np.stack(
             [cx, cy, np.full_like(cx, w), np.full_like(cy, h)], axis=-1))
     s_extra = float(np.sqrt(scale * next_scale))
-    out.append(np.stack(
+    per_aspect.append(np.stack(
         [cx, cy, np.full_like(cx, s_extra), np.full_like(cy, s_extra)],
         axis=-1))
-    return np.concatenate([o.reshape(-1, 4) for o in out], axis=0)
+    grid = np.stack(per_aspect, axis=2)  # (fm, fm, A, 4)
+    return grid.reshape(-1, 4)
 
 
 def num_anchors_per_cell() -> int:
@@ -74,43 +88,22 @@ def init_params(classes: int = 91, width: float = 1.0, seed: int = 0) -> Dict:
     import jax
 
     keys = iter(jax.random.split(jax.random.PRNGKey(seed), 80))
-
-    def conv(kh, kw, cin, cout):
-        w = jax.random.normal(next(keys), (kh, kw, cin, cout), np.float32)
-        return w * np.sqrt(2.0 / (kh * kw * cin))
-
-    def sep_block(cin, cout):
-        return {
-            "dw": conv(3, 3, 1, cin), "dw_scale": np.ones((cin,), np.float32),
-            "dw_bias": np.zeros((cin,), np.float32),
-            "pw": conv(1, 1, cin, cout),
-            "pw_scale": np.ones((cout,), np.float32),
-            "pw_bias": np.zeros((cout,), np.float32),
-        }
-
-    r = lambda ch: max(8, int(ch * width + 4) // 8 * 8)  # noqa: E731
-    params: Dict = {}
-    c = r(32)
-    params["stem"] = {
-        "w": conv(3, 3, 3, c),
-        "scale": np.ones((c,), np.float32),
-        "bias": np.zeros((c,), np.float32),
-    }
-    cin = c
+    params: Dict = {"stem": stem_params(keys, 3, rounded(32, width))}
+    cin = rounded(32, width)
     for i, (_s, ch) in enumerate(_BACKBONE):
-        params[f"block{i}"] = sep_block(cin, r(ch))
-        cin = r(ch)
+        params[f"block{i}"] = sep_block_params(keys, cin, rounded(ch, width))
+        cin = rounded(ch, width)
     ca = cin
     for i, (_s, ch) in enumerate(_EXTRA):
-        params[f"extra{i}"] = sep_block(cin, r(ch))
-        cin = r(ch)
+        params[f"extra{i}"] = sep_block_params(keys, cin, rounded(ch, width))
+        cin = rounded(ch, width)
     cb = cin
     A = num_anchors_per_cell()
     for tag, ch in (("a", ca), ("b", cb)):
         params[f"head_{tag}"] = {
-            "box": conv(3, 3, ch, A * 4),
+            "box": he_conv(next(keys), 3, 3, ch, A * 4),
             "box_bias": np.zeros((A * 4,), np.float32),
-            "cls": conv(3, 3, ch, A * classes),
+            "cls": he_conv(next(keys), 3, 3, ch, A * classes),
             "cls_bias": np.zeros((A * classes,), np.float32),
         }
     return params
@@ -119,22 +112,11 @@ def init_params(classes: int = 91, width: float = 1.0, seed: int = 0) -> Dict:
 def param_pspecs() -> Dict:
     from jax.sharding import PartitionSpec as P
 
-    specs: Dict = {
-        "stem": {"w": P(None, None, None, "model"), "scale": P("model"),
-                 "bias": P("model")}
-    }
+    specs: Dict = {"stem": stem_pspecs()}
     for i in range(len(_BACKBONE)):
-        specs[f"block{i}"] = {
-            "dw": P(), "dw_scale": P(), "dw_bias": P(),
-            "pw": P(None, None, None, "model"),
-            "pw_scale": P("model"), "pw_bias": P("model"),
-        }
+        specs[f"block{i}"] = sep_block_pspecs()
     for i in range(len(_EXTRA)):
-        specs[f"extra{i}"] = {
-            "dw": P(), "dw_scale": P(), "dw_bias": P(),
-            "pw": P(None, None, None, "model"),
-            "pw_scale": P("model"), "pw_bias": P("model"),
-        }
+        specs[f"extra{i}"] = sep_block_pspecs()
     for tag in ("a", "b"):
         specs[f"head_{tag}"] = {"box": P(), "box_bias": P(),
                                 "cls": P(), "cls_bias": P()}
@@ -148,21 +130,7 @@ def apply(params, x, *, anchors, classes: int, compute_dtype="bfloat16"):
 
     cdt = jnp.dtype(compute_dtype)
     x = x.astype(cdt)
-
-    def conv2d(x, w, stride, groups=1):
-        return lax.conv_general_dilated(
-            x, w.astype(cdt), (stride, stride), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=groups)
-
-    def sbr(x, scale, bias):
-        return jnp.clip(x * scale.astype(cdt) + bias.astype(cdt), 0.0, 6.0)
-
-    def sep(x, p, stride):
-        x = conv2d(x, p["dw"], stride, groups=x.shape[-1])
-        x = sbr(x, p["dw_scale"], p["dw_bias"])
-        x = conv2d(x, p["pw"], 1)
-        return sbr(x, p["pw_scale"], p["pw_bias"])
+    conv2d, sbr, sep = make_ops(cdt)
 
     p = params["stem"]
     x = sbr(conv2d(x, p["w"], 2), p["scale"], p["bias"])

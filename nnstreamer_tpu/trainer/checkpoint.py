@@ -1,0 +1,95 @@
+"""Checkpoint save/restore for the training path.
+
+Reference analog (SURVEY §5.4): the reference's entire checkpoint story is
+``tensor_trainer``'s ``model-save-path`` (nntrainer serializes weights) plus
+``datareposrc`` ``start-sample-index``/``epochs`` for dataset-position
+resume.  TPU-native equivalent: an orbax-style checkpoint of
+``(params, opt_state, step)`` — orbax when importable, a portable ``.npz``
+fallback otherwise — and the same dataset-position resume on datareposrc.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0) -> str:
+    """Write a checkpoint; returns the path written.
+
+    ``params`` must be a pytree of arrays.  Uses orbax when available
+    (directory checkpoint), else a single ``.npz``-style pickle file.
+    """
+    try:
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(
+            path,
+            {"params": params, "step": np.int64(step)},
+            force=True,
+        )
+        # Optimizer state rides in a sidecar pickle: orbax's untyped restore
+        # can't rebuild optax namedtuple structure, pickle can.
+        if opt_state is not None:
+            with open(path + ".opt", "wb") as f:
+                pickle.dump(_to_host(opt_state), f)
+        return path
+    except Exception:
+        pass
+    # Portable fallback: numpy pickle of host arrays.
+    host = _to_host(params)
+    blob = {"params": host, "opt_state": _to_host(opt_state), "step": int(step)}
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    return path
+
+
+def load_checkpoint(path: str) -> Tuple[Any, Optional[Any], int]:
+    """Read a checkpoint; returns (params, opt_state, step)."""
+    if os.path.isdir(path):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        blob = ckptr.restore(path)
+        opt_state = None
+        if os.path.exists(path + ".opt"):
+            with open(path + ".opt", "rb") as f:
+                opt_state = pickle.load(f)
+        return blob["params"], opt_state, int(blob.get("step", 0))
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    return blob["params"], blob.get("opt_state"), int(blob.get("step", 0))
+
+
+def _to_host(tree: Any) -> Any:
+    if tree is None:
+        return None
+    if isinstance(tree, dict):
+        return {k: _to_host(v) for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # namedtuple
+        return type(tree)(*[_to_host(v) for v in tree])
+    if isinstance(tree, (list, tuple)):
+        t = [_to_host(v) for v in tree]
+        return type(tree)(t)
+    if hasattr(tree, "shape"):
+        return np.asarray(tree)
+    return tree

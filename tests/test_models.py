@@ -113,3 +113,37 @@ def test_ssd_tp_sharding_consistent():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(scores), np.asarray(ref_scores),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_ssd_anchor_layout_matches_head():
+    """Anchor index must be cell-major (y*fm + x)*A + a — the head's
+    (B,H,W,A*4)->(B,N,4) reshape order (regression: aspect-major layout
+    decoded every box against the wrong cell's anchor)."""
+    from nnstreamer_tpu.models import ssd
+
+    size = 64
+    fm = size // 16
+    A = ssd.num_anchors_per_cell()
+    anc = ssd.build_anchors(size)
+    grid_a = anc[: fm * fm * A].reshape(fm, fm, A, 4)
+    centers = (np.arange(fm, dtype=np.float32) + 0.5) / fm
+    # all A anchors of one cell share that cell's center
+    for y in (0, fm - 1):
+        for x in (0, fm // 2):
+            np.testing.assert_allclose(grid_a[y, x, :, 0], centers[x], atol=1e-6)
+            np.testing.assert_allclose(grid_a[y, x, :, 1], centers[y], atol=1e-6)
+    # aspect varies along the per-cell axis: widths differ across a
+    widths = grid_a[0, 0, :, 2]
+    assert len(np.unique(np.round(widths, 5))) >= 3
+
+
+def test_posenet_odd_size_fm():
+    """257x257 (the reference posenet's own input) -> 17x17 heatmaps via the
+    SAME-padded ceil chain, and the declared out_spec must match reality."""
+    from nnstreamer_tpu.models import zoo
+
+    b = zoo.build("posenet", {"size": "257", "width": "0.25", "dtype": "float32"})
+    x = np.zeros((1, 257, 257, 3), np.float32)
+    heat, off = b.apply_fn(b.params, x)
+    assert heat.shape[1:3] == (17, 17)
+    assert tuple(b.out_spec[0].shape[1:3]) == (17, 17)
