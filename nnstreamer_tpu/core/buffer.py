@@ -85,7 +85,20 @@ class Buffer:
             meta=dict(self.meta),
         )
 
+    def resolve(self) -> "Buffer":
+        """Apply a deferred device->media mapping (set by fused stages whose
+        tail decoder runs on device and finishes the decode on host)."""
+        post = self.meta.get("_host_post")
+        if post is None:
+            return self
+        host = [np.asarray(t) for t in self.tensors]
+        base = self.with_tensors(host)
+        base.meta.pop("_host_post", None)
+        return post(host, base)
+
     def to_host(self) -> "Buffer":
+        if "_host_post" in self.meta:
+            return self.resolve()
         arrs = [np.asarray(t) for t in self.tensors]
         return self.with_tensors(arrs)
 

@@ -226,7 +226,30 @@ def parse_caps_string(text: str) -> Caps:
             opts = [o.strip() for o in v[1:-1].split(",") if o.strip()]
             fields[k] = tuple(_coerce(o) for o in opts)
             continue
-        fields[k] = _coerce(v)
+        # Tensor-spec fields stay raw strings: '.' separates tensors there
+        # (dimensions=4.10 is two 1-D tensors), so numeric coercion would
+        # corrupt them (float 4.10 -> "4.1").
+        fields[k] = v if k in ("dimensions", "types", "names") else _coerce(v)
+    if media in (
+        MediaType.TENSORS.value,
+        MediaType.FLEX_TENSORS.value,
+        "other/tensor",
+    ) and "dimensions" in fields:
+        # Reference caps syntax: tensors separated by '.' inside one field
+        # (``dimensions=3:224:224:1.10:1:1:1,types=uint8.float32``).
+        dims = str(fields.pop("dimensions")).replace(".", ",")
+        types = str(fields.pop("types", "uint8")).replace(".", ",")
+        names = str(fields.pop("names", "")).replace(".", ",")
+        fields.pop("num_tensors", None)
+        fmt = fields.pop("format", "static")
+        rate = fields.pop("framerate", (0, 1))
+        if media == MediaType.FLEX_TENSORS.value:
+            fmt = "flexible"
+        if media == "other/tensor":
+            media = MediaType.TENSORS.value
+        fields["spec"] = TensorsSpec.from_string(
+            dims, types, names, format=fmt, rate=rate if isinstance(rate, tuple) else (0, 1)
+        )
     return Caps.new(media, **fields)
 
 

@@ -44,6 +44,12 @@ class Decoder:
         """Pure-JAX decode for fusion; None => host decode."""
         return None
 
+    # When device_fn is provided, ``host_post`` (if also defined) maps the
+    # fetched (tiny) device outputs into the final media buffer on the host —
+    # lazily, at the pipeline edge, so the D2H roundtrip latency never blocks
+    # the streaming threads.  None => device outputs ARE the final payload.
+    host_post = None
+
 
 def load_labels(path_or_name: str) -> List[str]:
     """Load a labels file (one label per line, reference format).  A few

@@ -137,6 +137,7 @@ class DataRepoSink(SinkElement):
         self._count = 0
 
     def process(self, pad: str, buf: Buffer) -> Out:
+        buf = buf.resolve()
         if self._spec is None:
             self._spec = buf.spec
         for t in buf.tensors:

@@ -39,3 +39,8 @@ class TensorDecoder(Element):
 
     def device_fn(self, in_spec):
         return self.decoder.device_fn(in_spec)
+
+    @property
+    def host_post(self):
+        """Deferred host mapping paired with the decoder's device_fn."""
+        return self.decoder.host_post

@@ -47,6 +47,8 @@ class TensorSink(SinkElement):
 
     def process(self, pad, buf: Buffer):
         metrics.count(f"{self.name}.frames")
+        if self._callbacks:
+            buf = buf.resolve()
         for cb in self._callbacks:
             cb(buf)
         stop = getattr(self, "_stop_event", None)
@@ -135,6 +137,6 @@ class FileSink(SinkElement):
             self._f = None
 
     def process(self, pad, buf):
-        for t in buf.tensors:
+        for t in buf.resolve().tensors:
             self._f.write(np.asarray(t).tobytes())
         return []

@@ -138,6 +138,7 @@ class TensorTrainer(Element):
     def _save(self) -> None:
         if self.save_path and self.trainer is not None:
             self.trainer.save(self.save_path)
+            self._saved_at_epoch = self._epochs_done
 
     def finalize(self) -> Out:
         out: Out = []
@@ -147,7 +148,8 @@ class TensorTrainer(Element):
             n_train, n_valid = self.trainer.queued()
             if n_train:
                 out.extend(self._run_epoch())
-        self._save()
+        if getattr(self, "_saved_at_epoch", None) != self._epochs_done:
+            self._save()
         return out
 
     def on_event(self, pad: str, event: Event) -> Out:

@@ -56,6 +56,12 @@ class FusedElement(Element):
         self._fn = None
         self._out_spec: Optional[TensorsSpec] = None
         self._in_spec = specs[0]
+        # Tail element may pair its device_fn with a deferred host mapping
+        # (e.g. image_labeling: device argmax -> host label text).  The fused
+        # stage emits the tiny device outputs with an async D2H already in
+        # flight; the sink resolves `_host_post` in the app thread, so the
+        # tunnel's D2H roundtrip adds pipeline depth, not throughput.
+        self._host_post = getattr(elements[-1], "host_post", None)
         self._build(specs[0])
 
     def _build(self, in_spec: TensorsSpec) -> None:
@@ -95,7 +101,13 @@ class FusedElement(Element):
 
         arrays = tuple(jnp.asarray(t) for t in buf.tensors)
         out = self._fn(arrays)
-        return [(SRC, buf.with_tensors(list(out), spec=self._out_spec))]
+        new = buf.with_tensors(list(out), spec=self._out_spec)
+        if self._host_post is not None:
+            for t in out:
+                if hasattr(t, "copy_to_host_async"):
+                    t.copy_to_host_async()
+            new.meta["_host_post"] = self._host_post
+        return [(SRC, new)]
 
     def finalize(self):
         outs = []

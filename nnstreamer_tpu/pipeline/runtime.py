@@ -95,6 +95,15 @@ class _Runner:
                 metrics.count(f"{self.element.name}.dropped")
                 continue
             for port in ports:
+                # Deferred host-post buffers stay lazy all the way to sinks
+                # (resolved in the app thread); any mid-pipeline host element
+                # needs the real payload now.
+                if (
+                    isinstance(item, Buffer)
+                    and "_host_post" in item.meta
+                    and not isinstance(port.stage.element, SinkElement)
+                ):
+                    item = item.resolve()
                 port.stage.feed(port.pad, item)
 
     def _broadcast(self, item) -> None:

@@ -17,28 +17,19 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 
-def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
-    out: Dict[str, np.ndarray] = {}
-    if isinstance(tree, dict):
-        for k in sorted(tree):
-            out.update(_flatten(tree[k], f"{prefix}{k}/"))
-    elif isinstance(tree, (list, tuple)):
-        for i, v in enumerate(tree):
-            out.update(_flatten(v, f"{prefix}{i}/"))
-    else:
-        out[prefix.rstrip("/")] = np.asarray(tree)
-    return out
-
-
 def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0) -> str:
     """Write a checkpoint; returns the path written.
 
     ``params`` must be a pytree of arrays.  Uses orbax when available
-    (directory checkpoint), else a single ``.npz``-style pickle file.
+    (directory checkpoint), else a single pickle file.  A *failed* orbax
+    save propagates — falling back there would leave a partial orbax
+    directory shadowing the fallback file.
     """
     try:
         import orbax.checkpoint as ocp
-
+    except ImportError:
+        ocp = None
+    if ocp is not None:
         path = os.path.abspath(path)
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(
@@ -52,8 +43,6 @@ def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0
             with open(path + ".opt", "wb") as f:
                 pickle.dump(_to_host(opt_state), f)
         return path
-    except Exception:
-        pass
     # Portable fallback: numpy pickle of host arrays.
     host = _to_host(params)
     blob = {"params": host, "opt_state": _to_host(opt_state), "step": int(step)}

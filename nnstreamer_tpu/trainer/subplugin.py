@@ -22,7 +22,6 @@ import numpy as np
 
 from ..core.log import logger
 from ..core.registry import register_trainer
-from ..core.types import TensorsSpec
 
 log = logger("trainer")
 
@@ -64,8 +63,23 @@ class TrainerSubplugin:
     def load(self, path: str) -> None:
         raise NotImplementedError
 
+    def queued(self) -> Tuple[int, int]:
+        """(n_train, n_valid) samples awaiting train_epoch; the element uses
+        this at EOS to decide whether a partial epoch remains."""
+        return (0, 0)
+
     def close(self) -> None:
         pass
+
+
+def _stack_labels(labels) -> "np.ndarray":
+    """Stack per-sample labels into a batch, collapsing only the trailing
+    singleton a scalar-class label carries ([1] per sample -> [B]); one-hot
+    rows keep their class dimension even when the batch has one sample."""
+    y = np.stack(labels)
+    if y.ndim == 2 and y.shape[1] == 1:
+        y = y[:, 0]
+    return y
 
 
 def _build_mlp(layer_sizes: List[int], seed: int):
@@ -187,6 +201,12 @@ class JaxTrainer(TrainerSubplugin):
 
     # -- data --------------------------------------------------------------
     def push_data(self, inputs, labels, is_validation: bool) -> None:
+        if len(inputs) != 1 or len(labels) != 1:
+            # Silently training on inputs[0] would corrupt multi-input runs.
+            raise TrainerError(
+                f"{self.name} trains single-input/single-label models; got "
+                f"{len(inputs)} inputs, {len(labels)} labels"
+            )
         sample = ([np.asarray(t) for t in inputs], [np.asarray(t) for t in labels])
         with self._lock:
             (self._valid if is_validation else self._train).append(sample)
@@ -247,9 +267,7 @@ class JaxTrainer(TrainerSubplugin):
         for off in range(0, len(train), bs):
             chunk = train[off : off + bs]
             x = np.stack([s[0][0] for s in chunk])
-            y = np.stack([s[1][0] for s in chunk]).squeeze()
-            if y.ndim == 0:
-                y = y[None]
+            y = _stack_labels([s[1][0] for s in chunk])
             if self._sharding is not None and x.shape[0] % self._sharding.mesh.size == 0:
                 x = jax.device_put(x, self._sharding)
             self.params, self.opt_state, loss, acc = self._step_fn(
@@ -267,9 +285,7 @@ class JaxTrainer(TrainerSubplugin):
         }
         if valid:
             x = np.stack([s[0][0] for s in valid])
-            y = np.stack([s[1][0] for s in valid]).squeeze()
-            if y.ndim == 0:
-                y = y[None]
+            y = _stack_labels([s[1][0] for s in valid])
             vl, va = self._eval_fn(self.params, x, y)
             stats["validation_loss"] = float(vl)
             stats["validation_accuracy"] = float(va)

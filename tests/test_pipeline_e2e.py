@@ -159,3 +159,53 @@ def test_error_propagates():
         with pytest.raises(Exception):
             for _ in range(100):
                 p.pull("out", timeout=0.3)
+
+
+def test_appsrc_caps_fuses_through_decoder():
+    """appsrc caps carry the tensor spec, so transform+filter+decoder fuse
+    into ONE XLA stage, with the label mapping deferred to the sink
+    (host_post) — the headline bench topology."""
+    desc = (
+        "appsrc name=src caps=other/tensors,dimensions=4:4,types=float32 ! "
+        "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:4:4 ! "
+        "tensor_decoder mode=image_labeling option1=digits ! "
+        "tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, fuse=True)
+    fused = [s for s in p.stages if len(s.node_ids) > 1]
+    assert fused and len(fused[0].node_ids) == 2
+
+    x = np.zeros((4, 4), np.float32)
+    x[np.arange(4), [2, 0, 3, 1]] = 5.0
+    with p:
+        p.push("src", x)
+        buf = p.pull("out", timeout=15)
+        p.eos()
+        p.wait(timeout=15)
+    assert list(buf.meta["label_index"]) == [2, 0, 3, 1]
+    assert buf.meta["label"] == ["2", "0", "3", "1"]
+    assert bytes(buf.tensors[0]).decode() == "2\n0\n3\n1"
+
+
+def test_image_labeling_fused_matches_host():
+    desc = (
+        "appsrc name=src caps=other/tensors,dimensions=10:3,types=float32 ! "
+        "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:10:3 ! "
+        "tensor_decoder mode=image_labeling option1=digits ! "
+        "tensor_sink name=out"
+    )
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((3, 10)).astype(np.float32)
+    outs = {}
+    for fuse in (False, True):
+        p = nt.Pipeline(desc, fuse=fuse)
+        with p:
+            p.push("src", x)
+            outs[fuse] = p.pull("out", timeout=15)
+            p.eos()
+            p.wait(timeout=15)
+    a, b = outs[False], outs[True]
+    assert list(a.meta["label_index"]) == list(b.meta["label_index"])
+    assert a.meta["label"] == b.meta["label"]
+    np.testing.assert_allclose(a.meta["score"], b.meta["score"], rtol=1e-6)
+    assert bytes(a.tensors[0]) == bytes(b.tensors[0])

@@ -133,3 +133,39 @@ def test_parse_fraction():
     assert parse_fraction("30/1") == (30, 1)
     assert parse_fraction("15") == (15, 1)
     assert parse_fraction((24, 2)) == (24, 2)
+
+
+class TestTensorCapsString:
+    """Caps strings carrying tensor specs (reference caps syntax:
+    ``other/tensors,num_tensors=2,dimensions=3:4.5:6,types=uint8.float32``)."""
+
+    def test_single_tensor_spec(self):
+        from nnstreamer_tpu.core.caps import parse_caps_string
+
+        caps = parse_caps_string(
+            "other/tensors,dimensions=3:224:224:8,types=uint8"
+        )
+        spec = caps.spec
+        assert spec is not None and len(spec) == 1
+        assert spec[0].shape == (8, 224, 224, 3)
+        assert spec[0].dtype == np.uint8
+
+    def test_multi_tensor_dot_syntax(self):
+        from nnstreamer_tpu.core.caps import parse_caps_string
+
+        caps = parse_caps_string(
+            "other/tensors,num_tensors=2,dimensions=3:4.5:6,types=uint8.float32"
+        )
+        spec = caps.spec
+        assert len(spec) == 2
+        assert spec[0].dims == (3, 4) and spec[0].dtype == np.uint8
+        assert spec[1].dims == (5, 6) and spec[1].dtype == np.float32
+
+    def test_flexible_media(self):
+        from nnstreamer_tpu.core.caps import parse_caps_string
+        from nnstreamer_tpu.core.types import TensorFormat
+
+        caps = parse_caps_string(
+            "other/tensors-flexible,dimensions=2:2,types=int32"
+        )
+        assert caps.spec.format == TensorFormat.FLEXIBLE
