@@ -20,7 +20,7 @@ import dataclasses
 from enum import Enum
 from typing import Any, Dict, Optional, Tuple, Union
 
-from .types import TensorsSpec
+from .types import TensorsSpec, parse_fraction
 
 
 class MediaType(str, Enum):
@@ -242,13 +242,13 @@ def parse_caps_string(text: str) -> Caps:
         names = str(fields.pop("names", "")).replace(".", ",")
         fields.pop("num_tensors", None)
         fmt = fields.pop("format", "static")
-        rate = fields.pop("framerate", (0, 1))
+        rate = parse_fraction(fields.pop("framerate", (0, 1)))
         if media == MediaType.FLEX_TENSORS.value:
             fmt = "flexible"
         if media == "other/tensor":
             media = MediaType.TENSORS.value
         fields["spec"] = TensorsSpec.from_string(
-            dims, types, names, format=fmt, rate=rate if isinstance(rate, tuple) else (0, 1)
+            dims, types, names, format=fmt, rate=rate
         )
     return Caps.new(media, **fields)
 

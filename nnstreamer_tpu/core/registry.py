@@ -56,6 +56,7 @@ _BUILTIN_MODULES = [
     "nnstreamer_tpu.elements.edge",
     "nnstreamer_tpu.elements.datarepo",
     "nnstreamer_tpu.elements.trainer",
+    "nnstreamer_tpu.elements.shm",
     "nnstreamer_tpu.filters.custom_easy",
     "nnstreamer_tpu.filters.jax_fw",
     "nnstreamer_tpu.filters.python3",

@@ -115,7 +115,11 @@ class TensorConverter(Element):
                 raise ElementError("raw video bytes need width/height caps")
             stride = ((w * c + 3) // 4) * 4
             if frame.size == h * stride:
-                frame = frame.reshape(h, stride)[:, : w * c].reshape(h, w, c)
+                from ..native import strip_stride
+
+                frame = strip_stride(
+                    frame, rows=h, row_bytes=w * c, src_stride=stride
+                ).reshape(h, w, c)
             elif frame.size == h * w * c:
                 frame = frame.reshape(h, w, c)
             else:

@@ -1,0 +1,142 @@
+"""Shared-memory pipeline hand-off elements.
+
+Reference analog: GStreamer's ``shmsink``/``shmsrc`` (used by nnstreamer
+deployments to link pipelines across processes on one host without the TCP
+stack; upstream-reconstructed, SURVEY §2.7 context).  The TPU build backs
+them with the native SPSC ring (``nnstreamer_tpu.native.ShmRing``, C++ —
+POSIX shm + lock-free atomics), carrying ``other/tensors`` buffers in the
+standard wire format.
+
+``shmsink socket-path=/name`` publishes; ``shmsrc socket-path=/name`` in a
+second process (or the same one) consumes.  ``wait-for-connection`` on the
+sink and ``is-live`` semantics follow the GStreamer originals loosely: the
+sink blocks when the ring is full (backpressure) unless ``drop=true``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps
+from ..core.log import logger, metrics
+from ..core.registry import register_element
+from ..native import ShmRing, available as native_available
+from ..utils.wire import decode_buffer, encode_buffer
+from .base import ElementError, SinkElement, SourceElement
+
+log = logger(__name__)
+
+
+def _ring_name(props) -> str:
+    name = str(props.get("socket_path", props.get("name_prop", "")) or "")
+    if not name:
+        raise ElementError("shm element needs socket-path=<shm name>")
+    return name if name.startswith("/") else "/" + name
+
+
+@register_element("shmsink")
+class ShmSink(SinkElement):
+    """Publish buffers into a shared-memory ring.
+
+    Props: ``socket-path`` (shm name), ``shm-size`` (slot bytes, default
+    1 MiB), ``buffers`` (ring slots, default 8), ``drop`` (drop newest when
+    full instead of blocking).
+    """
+
+    kind = "shmsink"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        if not native_available():
+            raise ElementError("shmsink requires the native library")
+        self.ring_name = _ring_name(self.props)
+        self.slot_bytes = int(self.props.get("shm_size", 1 << 20))
+        self.nslots = int(self.props.get("buffers", 8))
+        self.drop = bool(self.props.get("drop", False))
+        self._ring: Optional[ShmRing] = None
+
+    def start(self) -> None:
+        self._ring = ShmRing.create(self.ring_name, self.nslots, self.slot_bytes)
+
+    def stop(self) -> None:
+        if self._ring is not None:
+            self._ring.close_write()
+            self._ring.free()
+            self._ring = None
+
+    def process(self, pad, buf: Buffer):
+        payload = encode_buffer(buf.resolve().to_host())
+        stop = getattr(self, "_stop_event", None)
+        while not self._ring.try_put(payload):
+            if self.drop:
+                metrics.count(f"{self.name}.dropped")
+                return []
+            if stop is not None and stop.is_set():
+                return []
+            time.sleep(0.001)  # ring full: backpressure
+        metrics.count(f"{self.name}.frames")
+        return []
+
+    def finalize(self):
+        if self._ring is not None:
+            self._ring.close_write()
+        return []
+
+
+@register_element("shmsrc")
+class ShmSrc(SourceElement):
+    """Consume buffers from a shared-memory ring published by ``shmsink``.
+
+    Props: ``socket-path``, ``num-buffers`` (-1 = until producer closes),
+    ``connect-timeout`` seconds to wait for the producer's ring to appear.
+    """
+
+    kind = "shmsrc"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        if not native_available():
+            raise ElementError("shmsrc requires the native library")
+        self.ring_name = _ring_name(self.props)
+        self.num_buffers = int(self.props.get("num_buffers", -1))
+        self.connect_timeout = float(self.props.get("connect_timeout", 10.0))
+        self._ring: Optional[ShmRing] = None
+
+    def configure(self, in_caps, out_pads):
+        self.out_caps = {p: Caps.any() for p in out_pads}
+        return self.out_caps
+
+    def start(self) -> None:
+        deadline = time.monotonic() + self.connect_timeout
+        while True:
+            try:
+                self._ring = ShmRing.open(self.ring_name)
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.02)
+
+    def stop(self) -> None:
+        if self._ring is not None:
+            self._ring.free()
+            self._ring = None
+
+    def generate(self):
+        n = 0
+        stop = getattr(self, "_stop_event", None)
+        while self.num_buffers < 0 or n < self.num_buffers:
+            data = self._ring.try_get()
+            if data is None:
+                if self._ring.closed:
+                    return  # producer EOS'd and ring drained
+                if stop is not None and stop.is_set():
+                    return
+                time.sleep(0.001)
+                continue
+            buf, _flags = decode_buffer(data)
+            metrics.count(f"{self.name}.frames")
+            n += 1
+            yield buf

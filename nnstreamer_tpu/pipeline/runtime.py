@@ -221,6 +221,10 @@ class Pipeline:
         if isinstance(graph, str):
             graph = parse_launch(graph)
         graph.validate()
+        # Start the native-lib build (if any) now, off the streaming threads.
+        from ..native import prewarm
+
+        prewarm()
         self.graph = graph
         self.fuse = fuse
         self.capacity = queue_capacity or get_config().queue_capacity

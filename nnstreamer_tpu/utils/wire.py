@@ -112,7 +112,8 @@ def decode_buffer(raw: bytes) -> Tuple[Buffer, int]:
 
 
 def read_frame(sock) -> Optional[bytes]:
-    """Read one length-prefixed frame from a socket-like object.
+    """Read one crc-protected, length-prefixed frame from a socket-like
+    object (``u64 len | payload | u32 crc32``).
 
     With a socket timeout set, ``socket.timeout`` propagates ONLY while the
     stream is idle (no header byte read yet) — callers use that to poll
@@ -120,15 +121,30 @@ def read_frame(sock) -> Optional[bytes]:
     the read continues: dropping partially-read bytes would desync the
     length-prefixed stream for good.
     """
+    from ..native import wire_check
+
     hdr = _read_exact(sock, 8, idle_timeout=True)
     if hdr is None:
         return None
     (length,) = struct.unpack("<Q", hdr)
-    return _read_exact(sock, length)
+    payload = _read_exact(sock, length)
+    if payload is None:
+        return None
+    tail = _read_exact(sock, 4)
+    if tail is None:
+        return None
+    (crc,) = struct.unpack("<I", tail)
+    if not wire_check(payload, crc):
+        raise ValueError("wire frame crc mismatch (corrupt stream)")
+    return payload
 
 
 def write_frame(sock, payload: bytes) -> None:
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    """Send one frame with length prefix + trailing crc32 (native-assembled
+    single-copy gather when the C++ library is available)."""
+    from ..native import wire_gather
+
+    sock.sendall(wire_gather([payload]))
 
 
 def _read_exact(sock, n: int, idle_timeout: bool = False) -> Optional[bytes]:

@@ -1,0 +1,177 @@
+"""Native (C++) layer: crc32, stride repack, wire gather, shm ring,
+shmsrc/shmsink elements.
+
+Reference analog for the test shape: SSAT suites drive two pipelines through
+an IPC boundary on one host (SURVEY §4 "multi-node without a cluster");
+here shmsink/shmsrc pipelines talk through the POSIX shm ring, including a
+real second process.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import zlib
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for native library"
+)
+
+
+class TestCrc32:
+    def test_matches_zlib(self, rng):
+        for n in (0, 1, 7, 8, 64, 100_000):
+            data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            assert native.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+    def test_seeded_chaining(self, rng):
+        a = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+        assert native.crc32(b, seed=native.crc32(a)) == native.crc32(a + b)
+
+
+class TestStripStride:
+    def test_strided_rows(self):
+        src = np.arange(64, dtype=np.uint8)
+        out = native.strip_stride(src, rows=4, row_bytes=10, src_stride=16)
+        exp = np.concatenate([src[i * 16 : i * 16 + 10] for i in range(4)])
+        assert np.array_equal(out, exp)
+
+    def test_dense_passthrough(self):
+        src = np.arange(40, dtype=np.uint8)
+        out = native.strip_stride(src, rows=4, row_bytes=10, src_stride=10)
+        assert np.array_equal(out, src)
+
+
+class TestWireGather:
+    def test_frame_layout(self):
+        import struct
+
+        frame = native.wire_gather([b"hello", b"world"])
+        (ln,) = struct.unpack_from("<Q", frame, 0)
+        assert ln == 10
+        assert frame[8:18] == b"helloworld"
+        (crc,) = struct.unpack_from("<I", frame, 18)
+        assert native.wire_check(b"helloworld", crc)
+        assert not native.wire_check(b"helloworlX", crc)
+
+
+class TestShmRing:
+    def test_roundtrip_and_capacity(self):
+        r = native.ShmRing.create("/nnstpu_t1", 4, 256)
+        try:
+            c = native.ShmRing.open("/nnstpu_t1")
+            assert c.try_get() is None
+            for i in range(4):
+                assert r.try_put(bytes([i]) * (i + 1))
+            assert not r.try_put(b"overflow")  # full
+            for i in range(4):
+                assert c.try_get() == bytes([i]) * (i + 1)
+            assert r.try_put(b"again")
+            assert c.try_get() == b"again"
+            c.free()
+        finally:
+            r.free()
+
+    def test_close_signals_consumer(self):
+        r = native.ShmRing.create("/nnstpu_t2", 2, 64)
+        try:
+            assert not r.closed
+            r.close_write()
+            assert r.closed
+        finally:
+            r.free()
+
+    def test_oversize_payload_rejected(self):
+        r = native.ShmRing.create("/nnstpu_t3", 2, 16)
+        try:
+            with pytest.raises(ValueError):
+                r.try_put(b"x" * 17)
+        finally:
+            r.free()
+
+
+def _consumer_proc(q):
+    import nnstreamer_tpu as nt
+
+    p = nt.Pipeline(
+        "shmsrc socket-path=/nnstpu_e2e ! tensor_sink name=out"
+    )
+    with p:
+        got = []
+        for _ in range(3):
+            got.append(p.pull("out", timeout=20))
+        p.wait(timeout=20)
+    q.put([np.asarray(b.tensors[0]).tolist() for b in got])
+
+
+class TestShmElements:
+    def test_same_process_pipelines(self):
+        sink_pipe = nt.Pipeline(
+            "appsrc name=src ! shmsink socket-path=/nnstpu_sp buffers=4"
+        )
+        with sink_pipe:
+            src_pipe = nt.Pipeline("shmsrc socket-path=/nnstpu_sp ! tensor_sink name=out")
+            with src_pipe:
+                for i in range(3):
+                    sink_pipe.push("src", np.full((2, 2), i, np.int32))
+                outs = [src_pipe.pull("out", timeout=10) for _ in range(3)]
+                sink_pipe.eos()
+                sink_pipe.wait(timeout=10)
+                src_pipe.wait(timeout=10)
+        for i, b in enumerate(outs):
+            assert np.array_equal(b.tensors[0], np.full((2, 2), i, np.int32))
+
+    def test_cross_process(self):
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        sink_pipe = nt.Pipeline(
+            "appsrc name=src ! shmsink socket-path=/nnstpu_e2e buffers=4"
+        )
+        with sink_pipe:
+            proc = ctx.Process(target=_consumer_proc, args=(q,))
+            proc.start()
+            try:
+                for i in range(3):
+                    sink_pipe.push("src", np.array([i, i + 1], np.float32))
+                sink_pipe.eos()
+                sink_pipe.wait(timeout=20)
+                got = q.get(timeout=30)
+            finally:
+                proc.join(timeout=30)
+                if proc.is_alive():
+                    proc.terminate()
+        assert got == [[0.0, 1.0], [1.0, 2.0], [2.0, 3.0]]
+
+    def test_pts_and_meta_survive(self):
+        sink_pipe = nt.Pipeline("appsrc name=src ! shmsink socket-path=/nnstpu_meta")
+        with sink_pipe:
+            src_pipe = nt.Pipeline("shmsrc socket-path=/nnstpu_meta ! tensor_sink name=out")
+            with src_pipe:
+                buf = nt.Buffer([np.ones(3, np.uint8)], pts=12345)
+                buf.meta["label"] = "hi"
+                sink_pipe.push("src", buf)
+                out = src_pipe.pull("out", timeout=10)
+                sink_pipe.eos()
+                sink_pipe.wait(timeout=10)
+                src_pipe.wait(timeout=10)
+        assert out.pts == 12345
+        assert out.meta["label"] == "hi"
+
+
+def test_ring_create_refuses_live_duplicate():
+    r = native.ShmRing.create("/nnstpu_live", 2, 64)
+    try:
+        with pytest.raises(OSError):
+            native.ShmRing.create("/nnstpu_live", 2, 64)
+    finally:
+        r.free()
+    # After free (owner unlinked), the name is reusable.
+    r2 = native.ShmRing.create("/nnstpu_live", 2, 64)
+    r2.free()

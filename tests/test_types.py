@@ -169,3 +169,15 @@ class TestTensorCapsString:
             "other/tensors-flexible,dimensions=2:2,types=int32"
         )
         assert caps.spec.format == TensorFormat.FLEXIBLE
+
+    def test_framerate_in_spec(self):
+        from nnstreamer_tpu.core.caps import parse_caps_string
+
+        caps = parse_caps_string(
+            "other/tensors,dimensions=2:2,types=int32,framerate=30/1"
+        )
+        assert caps.spec.rate == (30, 1)
+        caps = parse_caps_string(
+            "other/tensors,dimensions=2:2,types=int32,framerate=15"
+        )
+        assert caps.spec.rate == (15, 1)
