@@ -131,11 +131,16 @@ class ShmSrc(SourceElement):
             data = self._ring.try_get()
             if data is None:
                 if self._ring.closed:
-                    return  # producer EOS'd and ring drained
-                if stop is not None and stop.is_set():
+                    # Producer EOS'd — but a buffer may have been committed
+                    # between our empty read and the close: drain fully.
+                    data = self._ring.try_get()
+                    if data is None:
+                        return
+                elif stop is not None and stop.is_set():
                     return
-                time.sleep(0.001)
-                continue
+                else:
+                    time.sleep(0.001)
+                    continue
             buf, _flags = decode_buffer(data)
             metrics.count(f"{self.name}.frames")
             n += 1

@@ -202,8 +202,22 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
         q_pos = jnp.arange(T)
         mask = (q_pos[None, :] <= q_pos[:, None])[None, None]
 
+    # Static pos_offset=0 means "prefill into an empty cache": the fresh
+    # k/v ARE the filled cache rows, so attention reduces to causal
+    # attention over the prompt — the flash kernel's case — instead of a
+    # masked sweep over all S_max cache rows.
+    prefill = kv is not None and type(pos_offset) is int and pos_offset == 0
+
     if attn_fn is not None:
         attn = attn_fn(q, _repeat_kv(k_all, H // Hkv), _repeat_kv(v_all, H // Hkv))
+    elif kv is None or prefill:
+        # Blockwise flash kernel (Pallas; falls back to plain XLA attention
+        # internally when T doesn't tile into its blocks).
+        from ..ops.attention import flash_attention
+
+        kr = _repeat_kv(k, H // Hkv)
+        vr = _repeat_kv(v, H // Hkv)
+        attn = flash_attention(q, kr, vr, causal=True)
     else:
         kr = _repeat_kv(k_all, H // Hkv)
         vr = _repeat_kv(v_all, H // Hkv)

@@ -123,7 +123,7 @@ int nns_wire_check(const uint8_t *payload, uint64_t len, uint32_t crc) {
 // ---------------------------------------------------------------------------
 
 struct RingHeader {
-  uint32_t magic;     // 'NSRG'
+  std::atomic<uint32_t> magic;  // 'NSRG'; stored LAST (release) at create
   uint32_t nslots;
   uint64_t slot_bytes;
   uint64_t owner_pid;          // producer pid, for stale-ring detection
@@ -163,7 +163,7 @@ static int ring_owner_alive(const char *name) {
   if (mem == MAP_FAILED) return -1;
   RingHeader *h = (RingHeader *)mem;
   int alive = 0;
-  if (h->magic == RING_MAGIC && h->owner_pid > 0)
+  if (h->magic.load(std::memory_order_acquire) == RING_MAGIC && h->owner_pid > 0)
     alive = (kill((pid_t)h->owner_pid, 0) == 0 || errno == EPERM) ? 1 : 0;
   munmap(mem, sizeof(RingHeader));
   return alive;
@@ -193,13 +193,15 @@ void *nns_ring_create(const char *name, uint32_t nslots, uint64_t slot_bytes) {
   }
   Ring *r = new Ring();
   r->hdr = (RingHeader *)mem;
-  r->hdr->magic = RING_MAGIC;
   r->hdr->nslots = nslots;
   r->hdr->slot_bytes = slot_bytes;
   r->hdr->owner_pid = (uint64_t)getpid();
   r->hdr->head.store(0);
   r->hdr->tail.store(0);
   r->hdr->closed.store(0);
+  // Publish last: a concurrent nns_ring_open polling this mapping must not
+  // see the magic before the geometry fields are valid.
+  r->hdr->magic.store(RING_MAGIC, std::memory_order_release);
   r->lens = (uint64_t *)((uint8_t *)mem + sizeof(RingHeader));
   r->slots = (uint8_t *)(r->lens + nslots);
   r->map_bytes = total;
@@ -223,7 +225,7 @@ void *nns_ring_open(const char *name) {
     return nullptr;
   }
   RingHeader *h = (RingHeader *)mem;
-  if (h->magic != RING_MAGIC ||
+  if (h->magic.load(std::memory_order_acquire) != RING_MAGIC ||
       (uint64_t)st.st_size < ring_bytes(h->nslots, h->slot_bytes)) {
     munmap(mem, (size_t)st.st_size);
     close(fd);

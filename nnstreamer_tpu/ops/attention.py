@@ -1,0 +1,155 @@
+"""Flash (blockwise, online-softmax) attention as a Pallas TPU kernel.
+
+The reference delegates attention to whatever runtime it wraps (llama.cpp's
+internal kernels for the LLM filter — SURVEY §5.7); the TPU build owns the
+kernel.  This is the memory-bound case Pallas exists for: the naive path
+materializes the [S, S] score matrix in HBM, the flash kernel keeps one
+[block_q, block_k] tile in VMEM and carries the softmax running max/sum so
+HBM traffic stays O(S·D).
+
+Layouts: q/k/v are [B, S, H, D] (heads after seq, matching models/llama.py).
+GQA is handled by the caller (repeat kv heads first).  On non-TPU backends
+the kernel runs in interpreter mode — bit-accurate, slow, test-friendly —
+and :func:`attention_reference` provides the plain-XLA fallback used when
+shapes don't tile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def attention_reference(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Plain-XLA attention (the flash kernel's semantics, materialized)."""
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        # kv may be longer than q (prefix/cache): align q to the BACK of kv.
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        kpos = jnp.arange(sk)[None, :]
+        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_offset: int):
+    """One (batch*head, q-block) grid cell: stream kv blocks through VMEM."""
+    block_q, d = q_ref.shape
+    skv = k_ref.shape[0]
+    nk = skv // block_k
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    j = pl.program_id(1)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            # absolute positions; q aligned to back of kv via q_offset
+            abs_q = qpos + j * block_q + q_offset
+            abs_k = kpos + kb * block_k
+            s = jnp.where(abs_k <= abs_q, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        # exp(-inf - -inf) would be nan; clamp the shift for fully-masked rows
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m, shift) - shift)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # Skip kv blocks entirely above the causal diagonal: the last row of
+        # this q block attends up to j*block_q + block_q - 1 + q_offset.
+        last_k = j * block_q + block_q - 1 + q_offset
+        upper = jnp.minimum(last_k // block_k + 1, nk)
+    else:
+        upper = nk
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+# Deferred import so `ops` stays importable without pallas (older jax).
+try:  # pragma: no cover - environment probe
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Blockwise attention for [B, S, H, D] tensors.
+
+    Falls back to :func:`attention_reference` when Pallas is unavailable or
+    the sequence lengths don't tile into (block_q, block_k).
+    """
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    scale_v = (d ** -0.5) if scale is None else scale
+    if (
+        not _HAVE_PALLAS
+        or sq % block_q
+        or skv % block_k
+        or k.shape != v.shape
+        or k.shape[2] != h
+    ):
+        return attention_reference(q, k, v, causal=causal, scale=scale_v)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [B, S, H, D] -> [B*H, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_k=block_k,
+        causal=causal,
+        scale=scale_v,
+        q_offset=skv - sq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

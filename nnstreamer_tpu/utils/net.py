@@ -93,21 +93,32 @@ def parse_control(raw: Optional[bytes]) -> Optional[dict]:
     return msg if isinstance(msg, dict) else None
 
 
+PROTOCOL_VERSION = 2  # v2: crc32-trailed wire frames
+
+
 def server_handshake(conn: socket.socket, expect_type: str,
                      topic: str = "") -> Optional[dict]:
-    """Read a hello frame, enforce the topic filter, reply ack/nack.
+    """Read a hello frame, enforce version + topic, reply ack/nack.
 
     Returns the hello dict on success, None on rejection (nack sent)."""
     conn.settimeout(5.0)
     hello = parse_control(wire.read_frame(conn))
     if not hello or hello.get("type") != expect_type:
         return None
+    if hello.get("proto", 0) != PROTOCOL_VERSION:
+        # Frame layout differs across versions: reject at connect time
+        # instead of desyncing mid-stream.
+        wire.write_frame(conn, json.dumps(
+            {"type": "nack",
+             "reason": f"protocol version {hello.get('proto')} != "
+                       f"{PROTOCOL_VERSION}"}).encode())
+        return None
     if topic and hello.get("topic", "") not in ("", topic):
         wire.write_frame(conn, json.dumps(
             {"type": "nack", "reason": "topic mismatch"}).encode())
         return None
     wire.write_frame(conn, json.dumps(
-        {"type": "ack", "topic": topic}).encode())
+        {"type": "ack", "topic": topic, "proto": PROTOCOL_VERSION}).encode())
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return hello
 
@@ -115,7 +126,7 @@ def server_handshake(conn: socket.socket, expect_type: str,
 def client_handshake(conn: socket.socket, hello_type: str, **fields) -> dict:
     """Send hello, await ack; raises ConnectionError on rejection."""
     wire.write_frame(conn, json.dumps(
-        {"type": hello_type, **fields}).encode("utf-8"))
+        {"type": hello_type, "proto": PROTOCOL_VERSION, **fields}).encode("utf-8"))
     ack = parse_control(wire.read_frame(conn))
     if not ack or ack.get("type") != "ack":
         raise ConnectionError(f"server rejected connection: {ack}")

@@ -1,0 +1,91 @@
+"""ops: flash attention (Pallas) and NMS.
+
+The Pallas kernel runs in interpreter mode on the CPU test backend —
+bit-faithful to the TPU kernel's math, slow, hermetic (SURVEY §4
+translation: hermetic unit tests against golden references).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops.attention import attention_reference, flash_attention
+from nnstreamer_tpu.ops.nms import nms_jax, nms_numpy
+
+
+@pytest.fixture
+def qkv(rng):
+    def make(b, sq, skv, h, d):
+        q = jnp.asarray(rng.standard_normal((b, sq, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, skv, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, skv, h, d)), jnp.float32)
+        return q, k, v
+
+    return make
+
+
+class TestFlashAttention:
+    def test_matches_reference(self, qkv):
+        q, k, v = qkv(2, 128, 128, 2, 64)
+        ref = attention_reference(q, k, v)
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+    def test_causal(self, qkv):
+        q, k, v = qkv(1, 128, 128, 2, 64)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+        # causality: perturbing future kv must not change earlier rows
+        k2 = k.at[:, 64:].set(0.0)
+        v2 = v.at[:, 64:].set(0.0)
+        a = flash_attention(q, k2, v2, causal=True, block_q=64, block_k=64)
+        np.testing.assert_allclose(
+            np.asarray(a)[:, :64], np.asarray(out)[:, :64], atol=3e-5
+        )
+
+    def test_kv_longer_than_q(self, qkv):
+        """Cached-prefix shape: q aligned to the back of kv."""
+        q, k, v = qkv(1, 64, 192, 2, 64)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+    def test_non_tiling_falls_back(self, qkv):
+        q, k, v = qkv(1, 100, 100, 2, 64)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_bf16_io(self, qkv):
+        q, k, v = (t.astype(jnp.bfloat16) for t in qkv(1, 128, 128, 1, 64))
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        assert out.dtype == jnp.bfloat16
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+        )
+
+    def test_jittable(self, qkv):
+        q, k, v = qkv(1, 128, 128, 2, 64)
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=64, block_k=64))
+        out = f(q, k, v)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+class TestNmsParity:
+    def test_jax_matches_numpy(self, rng):
+        n = 50
+        centers = rng.uniform(0, 10, (n, 2))
+        sizes = rng.uniform(0.5, 3, (n, 2))
+        boxes = np.concatenate([centers - sizes / 2, centers + sizes / 2], 1).astype(np.float32)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+        ref = nms_numpy(boxes, scores, 0.5, 10)
+        idx, valid = nms_jax(jnp.asarray(boxes), jnp.asarray(scores), 0.5, 10)
+        got = np.asarray(idx)[np.asarray(valid)]
+        assert got.tolist() == ref.tolist()
