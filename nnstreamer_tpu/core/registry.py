@@ -57,10 +57,14 @@ _BUILTIN_MODULES = [
     "nnstreamer_tpu.elements.datarepo",
     "nnstreamer_tpu.elements.trainer",
     "nnstreamer_tpu.elements.shm",
+    "nnstreamer_tpu.elements.mqtt",
+    "nnstreamer_tpu.elements.grpc_io",
     "nnstreamer_tpu.filters.custom_easy",
     "nnstreamer_tpu.filters.jax_fw",
     "nnstreamer_tpu.filters.python3",
     "nnstreamer_tpu.filters.llm",
+    "nnstreamer_tpu.filters.torch_fw",
+    "nnstreamer_tpu.filters.gated",
     "nnstreamer_tpu.decoders.image_labeling",
     "nnstreamer_tpu.decoders.bounding_boxes",
     "nnstreamer_tpu.decoders.pose",

@@ -1,0 +1,107 @@
+"""PyTorch framework sub-plugin (host CPU) + torch->JAX weight import.
+
+Reference analog: ``ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc``
+(SURVEY §2.4) — wraps libtorch TorchScript models.  Here: ``torch`` (CPU
+build) executes TorchScript files or registered ``nn.Module`` objects as a
+host filter stage.  This is the interop path; the TPU-first route is
+importing the weights into a JAX model (:func:`state_dict_to_tree`) so the
+model fuses and runs on-device like everything else.
+
+Props:
+
+* ``model`` — path to a TorchScript ``.pt``/``.pth`` file, a registered
+  object name (see :func:`register_torch_module`), or an ``nn.Module`` /
+  callable passed programmatically;
+* ``input``/``inputtype`` on the element supply specs (TorchScript does not
+  expose shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.registry import register_filter
+from ..core.types import TensorsSpec
+from .base import Framework, FrameworkError
+
+_registered: Dict[str, object] = {}
+
+
+def register_torch_module(name: str, module) -> None:
+    """Expose an ``nn.Module``/callable to pipelines as ``model=<name>``."""
+    _registered[name] = module
+
+
+@register_filter("torch")
+@register_filter("pytorch")
+class TorchFramework(Framework):
+    name = "torch"
+
+    def __init__(self):
+        super().__init__()
+        self._mod = None
+
+    def open(self, props: Dict[str, object]) -> None:
+        super().open(props)
+        try:
+            import torch
+        except ImportError as e:  # pragma: no cover - torch is baked in here
+            raise FrameworkError(f"torch not available: {e}") from e
+        model = props.get("model")
+        if callable(model) or hasattr(model, "forward"):
+            self._mod = model
+        elif isinstance(model, str) and model in _registered:
+            self._mod = _registered[model]
+        elif isinstance(model, str) and model.endswith((".pt", ".pth", ".ts")):
+            try:
+                self._mod = torch.jit.load(model, map_location="cpu")
+            except (OSError, RuntimeError) as e:
+                raise FrameworkError(f"cannot load TorchScript {model!r}: {e}") from e
+        else:
+            raise FrameworkError(
+                f"torch framework: model {model!r} is neither a TorchScript "
+                f"path, a registered module {sorted(_registered)}, nor a Module"
+            )
+        if hasattr(self._mod, "eval"):
+            self._mod.eval()
+
+    def invoke(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        import torch
+
+        with torch.no_grad():
+            tins = [torch.from_numpy(np.ascontiguousarray(a)) for a in inputs]
+            out = self._mod(*tins)
+        if isinstance(out, (list, tuple)):
+            return [o.detach().cpu().numpy() for o in out]
+        return [out.detach().cpu().numpy()]
+
+    def pure_fn(self) -> Optional[Callable]:
+        return None  # host-only runtime: not fusable into XLA
+
+    def get_model_info(self):
+        return None, None  # TorchScript carries no shape metadata
+
+    def close(self) -> None:
+        self._mod = None
+
+
+# -- torch -> JAX weight import ---------------------------------------------
+
+def state_dict_to_tree(state_dict, *, conv_keys: Sequence[str] = ("conv",),
+                       transpose_linear: bool = True) -> Dict[str, np.ndarray]:
+    """Convert a torch ``state_dict`` into a flat {name: numpy} tree with
+    JAX-conventional layouts: conv weights OIHW -> HWIO, linear weights
+    [out, in] -> [in, out].  The caller maps the flat names onto its model's
+    pytree structure.
+    """
+    out: Dict[str, np.ndarray] = {}
+    for key, tensor in state_dict.items():
+        a = tensor.detach().cpu().numpy() if hasattr(tensor, "detach") else np.asarray(tensor)
+        if a.ndim == 4 and any(c in key for c in conv_keys):
+            a = np.transpose(a, (2, 3, 1, 0))  # OIHW -> HWIO
+        elif a.ndim == 2 and transpose_linear and key.endswith(("weight", "w")):
+            a = a.T
+        out[key] = a
+    return out

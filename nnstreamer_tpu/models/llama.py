@@ -184,6 +184,7 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
+    mask = None
     if kv is not None:
         k_cache, v_cache = kv  # [B, S_max, Hkv, hd]
         k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
@@ -193,14 +194,13 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
         kv = (k_cache, v_cache)
         k_all, v_all = k_cache.astype(dt), v_cache.astype(dt)
         S = k_all.shape[1]
-        # Rows beyond the filled prefix are masked by key-position validity.
+        # Rows beyond the filled prefix are masked by key-position validity
+        # (consumed only by the masked decode path below).
         k_pos = jnp.arange(S)
         q_pos = pos_offset + jnp.arange(T)
         mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,T,S]
     else:
         k_all, v_all = k, v
-        q_pos = jnp.arange(T)
-        mask = (q_pos[None, :] <= q_pos[:, None])[None, None]
 
     # Static pos_offset=0 means "prefill into an empty cache": the fresh
     # k/v ARE the filled cache rows, so attention reduces to causal

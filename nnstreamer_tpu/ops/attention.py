@@ -3,15 +3,23 @@
 The reference delegates attention to whatever runtime it wraps (llama.cpp's
 internal kernels for the LLM filter — SURVEY §5.7); the TPU build owns the
 kernel.  This is the memory-bound case Pallas exists for: the naive path
-materializes the [S, S] score matrix in HBM, the flash kernel keeps one
-[block_q, block_k] tile in VMEM and carries the softmax running max/sum so
-HBM traffic stays O(S·D).
+materializes the [S, S] score matrix in HBM; the flash kernel never does.
+
+Kernel structure (VMEM-bounded for any sequence length):
+
+* q is tiled into ``block_q`` rows via BlockSpec (pipelined by Pallas);
+* k/v stay in HBM (``memory_space=ANY``) and are streamed through a
+  double-buffered VMEM scratch ``block_k`` rows at a time with explicit
+  async DMA — so VMEM use is O(block_q·d + 2·block_k·d), independent of S;
+* the softmax running max/sum ride in registers across k blocks;
+* causal q-blocks stop their kv stream at the diagonal — skipped blocks are
+  never even fetched from HBM.
 
 Layouts: q/k/v are [B, S, H, D] (heads after seq, matching models/llama.py).
 GQA is handled by the caller (repeat kv heads first).  On non-TPU backends
-the kernel runs in interpreter mode — bit-accurate, slow, test-friendly —
-and :func:`attention_reference` provides the plain-XLA fallback used when
-shapes don't tile.
+the public entry falls back to :func:`attention_reference` (compiled XLA)
+unless ``interpret=True`` is passed explicitly (tests do, for bit-faithful
+kernel coverage on CPU).
 """
 
 from __future__ import annotations
@@ -21,6 +29,14 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+try:  # pragma: no cover - environment probe
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
 
 
 def attention_reference(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
@@ -39,63 +55,90 @@ def attention_reference(q, k, v, *, causal: bool = False, scale: Optional[float]
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+def _flash_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_k: int, causal: bool,
                   scale: float, q_offset: int):
-    """One (batch*head, q-block) grid cell: stream kv blocks through VMEM."""
+    """One (batch*head, q-block) grid cell.
+
+    q_ref/o_ref: VMEM [block_q, d] tiles; k_hbm/v_hbm: the full [BH, Skv, d]
+    arrays left in HBM — kv blocks are DMA'd through a 2-slot VMEM scratch.
+    """
     block_q, d = q_ref.shape
-    skv = k_ref.shape[0]
+    skv = k_hbm.shape[1]
     nk = skv // block_k
+    i = pl.program_id(0)
+    j = pl.program_id(1)
 
     q = q_ref[:].astype(jnp.float32) * scale
     qpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    j = pl.program_id(1)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        kblk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        if causal:
-            # absolute positions; q aligned to back of kv via q_offset
-            abs_q = qpos + j * block_q + q_offset
-            abs_k = kpos + kb * block_k
-            s = jnp.where(abs_k <= abs_q, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        # exp(-inf - -inf) would be nan; clamp the shift for fully-masked rows
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - shift)
-        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m, shift) - shift)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
     if causal:
-        # Skip kv blocks entirely above the causal diagonal: the last row of
-        # this q block attends up to j*block_q + block_q - 1 + q_offset.
+        # The last row of this q block attends up to j*block_q + block_q - 1
+        # + q_offset; kv blocks past it are never fetched.
         last_k = j * block_q + block_q - 1 + q_offset
         upper = jnp.minimum(last_k // block_k + 1, nk)
     else:
         upper = nk
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
+    def scoped(kbuf, vbuf, ksem, vsem):
+        def kdma(slot, kb):
+            return pltpu.make_async_copy(
+                k_hbm.at[i, pl.ds(kb * block_k, block_k), :], kbuf.at[slot],
+                ksem.at[slot])
 
-# Deferred import so `ops` stays importable without pallas (older jax).
-try:  # pragma: no cover - environment probe
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+        def vdma(slot, kb):
+            return pltpu.make_async_copy(
+                v_hbm.at[i, pl.ds(kb * block_k, block_k), :], vbuf.at[slot],
+                vsem.at[slot])
 
-    _HAVE_PALLAS = True
-except ImportError:  # pragma: no cover
-    _HAVE_PALLAS = False
+        kdma(0, 0).start()
+        vdma(0, 0).start()
+
+        def body(kb, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(kb, 2)
+            nxt = jax.lax.rem(kb + 1, 2)
+
+            @pl.when(kb + 1 < upper)
+            def _():  # prefetch next kv block while computing this one
+                kdma(nxt, kb + 1).start()
+                vdma(nxt, kb + 1).start()
+
+            kdma(slot, kb).wait()
+            vdma(slot, kb).wait()
+            kblk = kbuf[slot].astype(jnp.float32)
+            vblk = vbuf[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if causal:
+                abs_q = qpos + j * block_q + q_offset
+                abs_k = kpos + kb * block_k
+                s = jnp.where(abs_k <= abs_q, s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            # exp(-inf - -inf) would be nan; clamp the shift for masked rows
+            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - shift)
+            alpha = jnp.exp(jnp.where(jnp.isfinite(m), m, shift) - shift)
+            l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p, vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc0 = jnp.zeros((block_q, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        kbuf=pltpu.VMEM((2, block_k, d), k_hbm.dtype),
+        vbuf=pltpu.VMEM((2, block_k, d), v_hbm.dtype),
+        ksem=pltpu.SemaphoreType.DMA((2,)),
+        vsem=pltpu.SemaphoreType.DMA((2,)),
+    )
 
 
 def flash_attention(
@@ -111,22 +154,30 @@ def flash_attention(
 ):
     """Blockwise attention for [B, S, H, D] tensors.
 
-    Falls back to :func:`attention_reference` when Pallas is unavailable or
-    the sequence lengths don't tile into (block_q, block_k).
+    Uses the Pallas kernel on TPU backends (or anywhere when
+    ``interpret=True`` is forced); otherwise — including non-tiling shapes —
+    falls back to :func:`attention_reference`.
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
     scale_v = (d ** -0.5) if scale is None else scale
+    if interpret is None:
+        interpret = False
+        if jax.default_backend() != "tpu":
+            # Interpreter mode is for tests; production non-TPU backends get
+            # the compiled XLA path.
+            return attention_reference(q, k, v, causal=causal, scale=scale_v)
     if (
         not _HAVE_PALLAS
         or sq % block_q
         or skv % block_k
         or k.shape != v.shape
         or k.shape[2] != h
+        # Mosaic DMA slices must align the minor dim to the 128-lane tiling;
+        # interpreter mode has no such constraint.
+        or (not interpret and d % 128)
     ):
         return attention_reference(q, k, v, causal=causal, scale=scale_v)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
 
     # [B, S, H, D] -> [B*H, S, D]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -145,8 +196,8 @@ def flash_attention(
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # kv stay in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),

@@ -1,0 +1,118 @@
+"""Extra framework sub-plugins: torch, gated onnxruntime/tflite.
+
+Reference analog: ``tests/nnstreamer_filter_extensions_common`` — one
+conformance surface per framework, skipped gracefully when the runtime
+isn't built (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.elements.base import ElementError
+
+torch = pytest.importorskip("torch")
+
+
+class TestTorchFramework:
+    def test_registered_module_in_pipeline(self):
+        from nnstreamer_tpu.filters.torch_fw import register_torch_module
+
+        class Doubler(torch.nn.Module):
+            def forward(self, x):
+                return x * 2
+
+        register_torch_module("doubler", Doubler())
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_filter framework=torch model=doubler ! "
+            "tensor_sink name=out"
+        )
+        with p:
+            p.push("src", np.arange(6, dtype=np.float32).reshape(2, 3))
+            out = p.pull("out", timeout=10)
+            p.eos()
+            p.wait(timeout=10)
+        np.testing.assert_allclose(
+            np.asarray(out.tensors[0]), np.arange(6, dtype=np.float32).reshape(2, 3) * 2
+        )
+
+    def test_torchscript_file(self, tmp_path):
+        class AddOne(torch.nn.Module):
+            def forward(self, x):
+                return x + 1
+
+        path = str(tmp_path / "addone.pt")
+        torch.jit.script(AddOne()).save(path)
+        s = nt.SingleShot(framework="torch", model=path)
+        (out,) = s.invoke(np.zeros((2, 2), np.float32))
+        np.testing.assert_allclose(out, np.ones((2, 2), np.float32))
+        s.close()
+
+    def test_multi_output(self):
+        from nnstreamer_tpu.filters.torch_fw import register_torch_module
+
+        class TwoHeads(torch.nn.Module):
+            def forward(self, x):
+                return x.sum(dim=1), x.max(dim=1).values
+
+        register_torch_module("twoheads", TwoHeads())
+        s = nt.SingleShot(framework="torch", model="twoheads")
+        outs = s.invoke(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert len(outs) == 2
+        np.testing.assert_allclose(outs[0], [3.0, 12.0])
+        np.testing.assert_allclose(outs[1], [2.0, 5.0])
+        s.close()
+
+    def test_bad_model_falls_through_with_clear_error(self):
+        with pytest.raises(ElementError, match="torch"):
+            nt.SingleShot(framework="torch", model="nosuch_model_xyz")
+
+
+class TestStateDictImport:
+    def test_layout_conversion(self):
+        from nnstreamer_tpu.filters.torch_fw import state_dict_to_tree
+
+        sd = {
+            "features.conv0.weight": torch.zeros(8, 3, 3, 3),  # OIHW
+            "classifier.weight": torch.zeros(10, 32),  # [out, in]
+            "classifier.bias": torch.zeros(10),
+        }
+        tree = state_dict_to_tree(sd)
+        assert tree["features.conv0.weight"].shape == (3, 3, 3, 8)  # HWIO
+        assert tree["classifier.weight"].shape == (32, 10)
+        assert tree["classifier.bias"].shape == (10,)
+
+    def test_torch_linear_matches_jax_matmul(self):
+        from nnstreamer_tpu.filters.torch_fw import state_dict_to_tree
+
+        lin = torch.nn.Linear(4, 3)
+        x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+        with torch.no_grad():
+            ref = lin(torch.from_numpy(x)).numpy()
+        tree = state_dict_to_tree(lin.state_dict())
+        got = x @ tree["weight"] + tree["bias"]
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+class TestGatedFrameworks:
+    def test_onnxruntime_gated_error(self):
+        try:
+            import onnxruntime  # noqa: F401
+
+            pytest.skip("onnxruntime installed; gate not exercised")
+        except ImportError:
+            pass
+        with pytest.raises(ElementError, match="onnxruntime"):
+            nt.SingleShot(framework="onnxruntime", model="x.onnx")
+
+    def test_tflite_gated_error(self):
+        try:
+            import tensorflow  # noqa: F401
+
+            pytest.skip("tensorflow installed; gate not exercised")
+        except ImportError:
+            pass
+        with pytest.raises(ElementError, match="TFLite"):
+            nt.SingleShot(framework="tensorflow-lite", model="m.tflite")

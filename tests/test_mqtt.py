@@ -1,0 +1,164 @@
+"""MQTT-lite broker + mqttsrc/mqttsink elements.
+
+Reference analog: ``tests/mqtt`` SSAT suite — local broker, publish and
+subscribe pipelines on localhost (SURVEY §4: "MQTT tests spin a local
+mosquitto broker or skip"; here the broker is in-repo, so no skip).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.elements.base import ElementError
+from nnstreamer_tpu.utils.broker import MqttLiteBroker, topic_matches
+
+
+class TestTopicMatching:
+    def test_exact_and_wildcards(self):
+        assert topic_matches("a/b", "a/b")
+        assert not topic_matches("a/b", "a/c")
+        assert topic_matches("a/+/c", "a/x/c")
+        assert not topic_matches("a/+/c", "a/x/y")
+        assert topic_matches("a/#", "a/x/y")
+        assert topic_matches("#", "anything/at/all")
+        assert topic_matches("", "x")
+        assert not topic_matches("a/b/c", "a/b")
+
+
+class TestBrokerPipelines:
+    def test_pub_sub_roundtrip(self):
+        with MqttLiteBroker() as broker:
+            src_pipe = nt.Pipeline(
+                f"mqttsrc host=127.0.0.1 port={broker.port} topic=cam/0 "
+                "num-buffers=3 ! tensor_sink name=out"
+            )
+            with src_pipe:
+                sink_pipe = nt.Pipeline(
+                    f"appsrc name=src ! mqttsink host=127.0.0.1 "
+                    f"port={broker.port} topic=cam/0"
+                )
+                with sink_pipe:
+                    for i in range(3):
+                        sink_pipe.push("src", np.full((2,), i, np.int16))
+                    outs = [src_pipe.pull("out", timeout=15) for _ in range(3)]
+                    sink_pipe.eos()
+                    sink_pipe.wait(timeout=10)
+                src_pipe.wait(timeout=10)
+        for i, b in enumerate(outs):
+            assert np.array_equal(b.tensors[0], np.full((2,), i, np.int16))
+
+    def test_topic_filter_blocks_other_topics(self):
+        with MqttLiteBroker(retain=False) as broker:
+            src_pipe = nt.Pipeline(
+                f"mqttsrc port={broker.port} topic=cam/1 num-buffers=1 ! "
+                "tensor_sink name=out"
+            )
+            with src_pipe:
+                pub = nt.Pipeline(
+                    f"appsrc name=src ! mqttsink port={broker.port} topic=cam/0"
+                )
+                pub2 = nt.Pipeline(
+                    f"appsrc name=src ! mqttsink port={broker.port} topic=cam/1"
+                )
+                with pub, pub2:
+                    pub.push("src", np.array([1], np.uint8))
+                    pub2.push("src", np.array([2], np.uint8))
+                    out = src_pipe.pull("out", timeout=15)
+                    pub.eos(), pub2.eos()
+                    pub.wait(timeout=10), pub2.wait(timeout=10)
+                src_pipe.wait(timeout=10)
+        assert out.tensors[0][0] == 2
+
+    def test_retained_message_reaches_late_subscriber(self):
+        with MqttLiteBroker() as broker:
+            pub = nt.Pipeline(
+                f"appsrc name=src ! mqttsink port={broker.port} topic=state"
+            )
+            with pub:
+                pub.push("src", np.array([42], np.uint8))
+                pub.eos()
+                pub.wait(timeout=10)
+            # subscriber connects AFTER the publisher is gone
+            sub = nt.Pipeline(
+                f"mqttsrc port={broker.port} topic=state num-buffers=1 ! "
+                "tensor_sink name=out"
+            )
+            with sub:
+                out = sub.pull("out", timeout=15)
+                sub.wait(timeout=10)
+        assert out.tensors[0][0] == 42
+
+    def test_rebase_sync_sets_transit(self):
+        with MqttLiteBroker() as broker:
+            sub = nt.Pipeline(
+                f"mqttsrc port={broker.port} topic=t sync=rebase "
+                "num-buffers=1 ! tensor_sink name=out"
+            )
+            with sub:
+                pub = nt.Pipeline(
+                    f"appsrc name=src ! mqttsink port={broker.port} topic=t"
+                )
+                with pub:
+                    pub.push("src", nt.Buffer([np.zeros(1, np.uint8)], pts=1000))
+                    out = sub.pull("out", timeout=15)
+                    pub.eos()
+                    pub.wait(timeout=10)
+                sub.wait(timeout=10)
+        assert "transit_ns" in out.meta
+        assert out.pts != 1000  # rebased onto local timeline
+
+    def test_no_broker_clear_error(self):
+        p = nt.Pipeline(
+            "appsrc name=src ! mqttsink port=59999 connect-timeout=0.3"
+        )
+        with pytest.raises(Exception, match="broker"):
+            with p:
+                p.push("src", np.zeros(1, np.uint8))
+                p.eos()
+                p.wait(timeout=10)
+
+
+class TestGrpcElements:
+    def test_roundtrip(self):
+        pytest.importorskip("grpc")
+        port = 55191
+        src_pipe = nt.Pipeline(
+            f"tensor_src_grpc host=127.0.0.1 port={port} num-buffers=3 ! "
+            "tensor_sink name=out"
+        )
+        with src_pipe:
+            sink_pipe = nt.Pipeline(
+                f"appsrc name=src ! tensor_sink_grpc host=127.0.0.1 port={port}"
+            )
+            with sink_pipe:
+                for i in range(3):
+                    sink_pipe.push("src", np.full((3,), i, np.float32))
+                outs = [src_pipe.pull("out", timeout=15) for _ in range(3)]
+                sink_pipe.eos()
+                sink_pipe.wait(timeout=10)
+            src_pipe.wait(timeout=10)
+        for i, b in enumerate(outs):
+            assert np.array_equal(b.tensors[0], np.full((3,), i, np.float32))
+
+    def test_meta_survives(self):
+        pytest.importorskip("grpc")
+        port = 55192
+        src_pipe = nt.Pipeline(
+            f"tensor_src_grpc host=127.0.0.1 port={port} num-buffers=1 ! "
+            "tensor_sink name=out"
+        )
+        with src_pipe:
+            sink_pipe = nt.Pipeline(
+                f"appsrc name=src ! tensor_sink_grpc host=127.0.0.1 port={port}"
+            )
+            with sink_pipe:
+                buf = nt.Buffer([np.arange(4, dtype=np.int32)], pts=777)
+                buf.meta["tag"] = "x"
+                sink_pipe.push("src", buf)
+                out = src_pipe.pull("out", timeout=15)
+                sink_pipe.eos()
+                sink_pipe.wait(timeout=10)
+            src_pipe.wait(timeout=10)
+        assert out.pts == 777 and out.meta["tag"] == "x"

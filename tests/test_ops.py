@@ -32,18 +32,18 @@ class TestFlashAttention:
     def test_matches_reference(self, qkv):
         q, k, v = qkv(2, 128, 128, 2, 64)
         ref = attention_reference(q, k, v)
-        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        out = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
     def test_causal(self, qkv):
         q, k, v = qkv(1, 128, 128, 2, 64)
         ref = attention_reference(q, k, v, causal=True)
-        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
         # causality: perturbing future kv must not change earlier rows
         k2 = k.at[:, 64:].set(0.0)
         v2 = v.at[:, 64:].set(0.0)
-        a = flash_attention(q, k2, v2, causal=True, block_q=64, block_k=64)
+        a = flash_attention(q, k2, v2, causal=True, block_q=64, block_k=64, interpret=True)
         np.testing.assert_allclose(
             np.asarray(a)[:, :64], np.asarray(out)[:, :64], atol=3e-5
         )
@@ -52,7 +52,7 @@ class TestFlashAttention:
         """Cached-prefix shape: q aligned to the back of kv."""
         q, k, v = qkv(1, 64, 192, 2, 64)
         ref = attention_reference(q, k, v, causal=True)
-        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
     def test_non_tiling_falls_back(self, qkv):
@@ -63,7 +63,7 @@ class TestFlashAttention:
 
     def test_bf16_io(self, qkv):
         q, k, v = (t.astype(jnp.bfloat16) for t in qkv(1, 128, 128, 1, 64))
-        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        out = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
         assert out.dtype == jnp.bfloat16
         ref = attention_reference(q, k, v)
         np.testing.assert_allclose(
@@ -72,7 +72,7 @@ class TestFlashAttention:
 
     def test_jittable(self, qkv):
         q, k, v = qkv(1, 128, 128, 2, 64)
-        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=64, block_k=64))
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True))
         out = f(q, k, v)
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
