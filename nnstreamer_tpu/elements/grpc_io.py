@@ -14,7 +14,7 @@ sub-plugin behind meson options the same way.
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator
 
 from ..core.buffer import Buffer
 from ..core.caps import Caps
@@ -43,19 +43,24 @@ def _require_grpc():
 
 @register_element("tensor_sink_grpc")
 class TensorSinkGrpc(SinkElement):
-    """Stream buffers out over a gRPC bidi call (client mode) or serve them
-    (server mode).  Props: ``host``, ``port``, ``server`` (bool)."""
+    """Stream buffers out over a gRPC bidi call (client side; the paired
+    ``tensor_src_grpc`` is the server).  Props: ``host``, ``port``."""
 
     kind = "tensor_sink_grpc"
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
         self.grpc = _require_grpc()
+        if self.props.get("server"):
+            raise ElementError(
+                "tensor_sink_grpc is the stream's client side; run "
+                "tensor_src_grpc as the server instead"
+            )
         self.host = str(self.props.get("host", "127.0.0.1"))
         self.port = int(self.props.get("port", 55115))
-        self.server_mode = bool(self.props.get("server", False))
         self._channel = None
         self._queue = None
+        self._call = None
 
     def start(self) -> None:
         grpc = self.grpc
@@ -79,13 +84,32 @@ class TensorSinkGrpc(SinkElement):
         self._call = send(frames())
 
     def process(self, pad, buf: Buffer):
+        if self._queue is None:
+            raise ElementError(f"{self.name}: stream already finalized")
         self._queue.put(bytes(wire.encode_buffer(buf.resolve().to_host())))
         metrics.count(f"{self.name}.sent")
         return []
 
-    def stop(self) -> None:
+    def finalize(self):
+        self._drain()
+        return []
+
+    def _drain(self) -> None:
+        """End the request stream and wait for the RPC to finish so queued
+        tail frames reach the server before the channel drops."""
         if self._queue is not None:
             self._queue.put(None)
+            self._queue = None
+        if self._call is not None:
+            try:
+                for _ in self._call:  # response stream ends when server done
+                    pass
+            except self.grpc.RpcError as e:
+                log.warning("%s: stream ended with %s", self.name, e)
+            self._call = None
+
+    def stop(self) -> None:
+        self._drain()
         if self._channel is not None:
             self._channel.close()
             self._channel = None

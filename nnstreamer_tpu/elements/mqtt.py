@@ -16,7 +16,6 @@ sync=rebase`` rewrites pts to the local monotonic timeline using it.
 
 from __future__ import annotations
 
-import json
 import socket
 import time
 from typing import Iterator, Optional, Union
@@ -33,18 +32,18 @@ log = logger(__name__)
 
 def _connect(host: str, port: int, role: str, topic: str,
              timeout: float) -> socket.socket:
+    from ..utils.net import client_handshake
+
     deadline = time.monotonic() + timeout
     last: Optional[Exception] = None
     while time.monotonic() < deadline:
         try:
             conn = socket.create_connection((host, port), timeout=2.0)
-            wire.write_frame(conn, json.dumps({"type": role, "topic": topic}).encode())
-            ack = wire.read_frame(conn)
-            msg = json.loads(ack.decode()) if ack else {}
-            if msg.get("type") != "ack":
-                raise ConnectionError(f"broker rejected {role}: {msg}")
+            conn.settimeout(2.0)
+            # Shared handshake: carries PROTOCOL_VERSION so frame-layout
+            # mismatches are rejected at connect, not mid-stream.
+            client_handshake(conn, role, topic=topic)
             conn.settimeout(0.2)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return conn
         except (OSError, ValueError, ConnectionError) as e:
             last = e

@@ -59,10 +59,14 @@ class TensorTrainer(Element):
         self.epochs = int(self.props.get("epochs", 1))
         self.save_path = str(self.props.get("model_save_path", "") or "")
         self.fw_name = str(self.props.get("framework", "jax"))
+        # Reference: tensor_trainer arms nnstreamer_watchdog around the
+        # sub-plugin; a wedged train step must surface, not hang the stage.
+        self.wd_timeout = float(self.props.get("watchdog_timeout", 0.0))
         self.trainer = None
         self._pushed = 0
         self._epochs_done = 0
         self._stats_pts = 0
+        self._hung: Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -118,7 +122,40 @@ class TensorTrainer(Element):
         return self.process("sink", merged)
 
     def _run_epoch(self) -> Out:
-        stats = self.trainer.train_epoch()
+        if self._hung:
+            raise ElementError(self._hung)
+        if self.wd_timeout > 0:
+            # The epoch runs on a helper thread so a genuinely wedged
+            # sub-plugin step surfaces as an element error instead of
+            # hanging the stage (the wedged thread itself is daemonized —
+            # Python can't kill it, matching the reference watchdog's
+            # "report, don't recover" semantics).
+            import threading
+
+            box: Dict[str, object] = {}
+
+            def run():
+                try:
+                    box["stats"] = self.trainer.train_epoch()
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    box["exc"] = e
+
+            t = threading.Thread(
+                target=run, name=f"{self.name}-epoch", daemon=True
+            )
+            t.start()
+            t.join(self.wd_timeout)
+            if t.is_alive():
+                self._hung = (
+                    f"{self.name}: trainer epoch exceeded watchdog timeout "
+                    f"{self.wd_timeout}s"
+                )
+                raise ElementError(self._hung)
+            if "exc" in box:
+                raise box["exc"]
+            stats = box["stats"]
+        else:
+            stats = self.trainer.train_epoch()
         self._epochs_done += 1
         arr = np.array(
             [

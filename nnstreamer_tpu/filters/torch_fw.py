@@ -89,19 +89,31 @@ class TorchFramework(Framework):
 
 # -- torch -> JAX weight import ---------------------------------------------
 
-def state_dict_to_tree(state_dict, *, conv_keys: Sequence[str] = ("conv",),
-                       transpose_linear: bool = True) -> Dict[str, np.ndarray]:
+def state_dict_to_tree(
+    state_dict,
+    *,
+    transpose_linear: bool = True,
+    embed_keys: Sequence[str] = ("embed", "wte", "wpe", "lut"),
+) -> Dict[str, np.ndarray]:
     """Convert a torch ``state_dict`` into a flat {name: numpy} tree with
-    JAX-conventional layouts: conv weights OIHW -> HWIO, linear weights
-    [out, in] -> [in, out].  The caller maps the flat names onto its model's
-    pytree structure.
+    JAX-conventional layouts: 4-D (conv) weights OIHW -> HWIO, 2-D linear
+    weights [out, in] -> [in, out].  Embedding tables ([vocab, dim], matched
+    by ``embed_keys`` substrings) keep their layout — transposing them would
+    break token-indexed lookup.  The caller maps the flat names onto its
+    model's pytree structure.
     """
     out: Dict[str, np.ndarray] = {}
     for key, tensor in state_dict.items():
         a = tensor.detach().cpu().numpy() if hasattr(tensor, "detach") else np.asarray(tensor)
-        if a.ndim == 4 and any(c in key for c in conv_keys):
+        lk = key.lower()
+        if a.ndim == 4:
             a = np.transpose(a, (2, 3, 1, 0))  # OIHW -> HWIO
-        elif a.ndim == 2 and transpose_linear and key.endswith(("weight", "w")):
+        elif (
+            a.ndim == 2
+            and transpose_linear
+            and key.endswith(("weight", "w"))
+            and not any(e in lk for e in embed_keys)
+        ):
             a = a.T
         out[key] = a
     return out

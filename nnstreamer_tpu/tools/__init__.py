@@ -1,0 +1,1 @@
+"""Developer tools (reference analog: ``tools/development`` — SURVEY §2.8)."""

@@ -93,12 +93,24 @@ class MqttLiteBroker:
 
     # -- client session ----------------------------------------------------
     def _session(self, conn: socket.socket) -> None:
+        from .net import PROTOCOL_VERSION
+
         conn.settimeout(0.2)
         hello = parse_control(self._read_idle(conn))
         if not hello or hello.get("type") not in ("pub", "sub"):
             conn.close()
             return
-        wire.write_frame(conn, json.dumps({"type": "ack"}).encode())
+        if hello.get("proto", 0) != PROTOCOL_VERSION:
+            # Same policy as net.server_handshake: frame layouts differ
+            # across versions, so reject at connect instead of desyncing.
+            wire.write_frame(conn, json.dumps(
+                {"type": "nack",
+                 "reason": f"protocol version {hello.get('proto')} != "
+                           f"{PROTOCOL_VERSION}"}).encode())
+            conn.close()
+            return
+        wire.write_frame(conn, json.dumps(
+            {"type": "ack", "proto": PROTOCOL_VERSION}).encode())
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if hello["type"] == "pub":
             self._pub_loop(conn, str(hello.get("topic", "")))

@@ -1,0 +1,85 @@
+"""Profiling hooks: XLA device traces + pipeline metrics export.
+
+Reference analog (SURVEY §5.1): the reference's per-filter latency
+properties plus GStreamer tracers / gst-shark for deeper dives.  TPU
+equivalents:
+
+* :func:`trace` — context manager around ``jax.profiler`` producing an
+  xplane trace viewable in TensorBoard/XProf (device timelines, HBM);
+* :func:`metrics_text` — the process metrics in Prometheus text format
+  (frames in/out, queue depths via gauges, per-stage latency quantiles);
+* :func:`start_metrics_server` — a ``/metrics`` HTTP endpoint (SURVEY
+  §5.5 "a /metrics-style counter set").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.server
+import re
+import threading
+from typing import Optional
+
+from ..core.log import logger, metrics
+
+log = logger(__name__)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace for the enclosed block (no-op if the jax
+    profiler is unavailable on this backend)."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except (RuntimeError, NotImplementedError) as e:  # pragma: no cover
+        log.warning("jax profiler unavailable: %s", e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def metrics_text() -> str:
+    """Render the global metrics registry in Prometheus text format."""
+    lines = []
+    for name, value in sorted(metrics.snapshot().items()):
+        lines.append(f"nnstpu_{_prom_name(name)} {value:.9g}")
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.rstrip("/") not in ("", "/metrics"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = metrics_text().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1"):
+    """Serve ``/metrics`` on a daemon thread; returns the HTTPServer (its
+    ``server_port`` reports the bound port; call ``shutdown()`` to stop)."""
+    srv = http.server.ThreadingHTTPServer((host, port), _MetricsHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name=f"metrics:{srv.server_port}").start()
+    return srv

@@ -1,0 +1,74 @@
+"""Timeout watchdog for hang detection.
+
+Reference analog: ``nnstreamer_watchdog.c`` (SURVEY §2.1/§5.3) — a GLib
+timer the trainer/query elements arm around operations that can wedge
+(sub-plugin train step, remote response wait); firing raises an element
+error instead of hanging the pipeline forever.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class Watchdog:
+    """Arm/feed/disarm timer.  If ``timeout`` elapses without a feed, the
+    ``on_timeout`` callback fires (once per arming) on the watchdog thread.
+
+    >>> wd = Watchdog(5.0, lambda: pipeline.abort("trainer hung"))
+    >>> with wd:                  # armed
+    ...     for batch in data:
+    ...         step(batch)
+    ...         wd.feed()         # still alive
+    """
+
+    def __init__(self, timeout: float, on_timeout: Callable[[], None]):
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        self._fired = False
+
+    def arm(self) -> "Watchdog":
+        with self._lock:
+            self._fired = False
+            self._schedule_locked()
+        return self
+
+    def _schedule_locked(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        self._timer = threading.Timer(self.timeout, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self) -> None:
+        with self._lock:
+            if self._fired or self._timer is None:
+                return
+            self._fired = True
+        self.on_timeout()
+
+    def feed(self) -> None:
+        """Reset the countdown (call from the watched loop)."""
+        with self._lock:
+            if self._timer is None:
+                return
+            self._schedule_locked()
+
+    def disarm(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def __enter__(self) -> "Watchdog":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()

@@ -1,0 +1,140 @@
+"""Aux subsystems: watchdog, metrics endpoint, profiler render, parser CLI.
+
+Reference analogs: nnstreamer_watchdog.c, the latency/throughput properties
+(SURVEY §5.1/§5.3/§5.5), tools/development/parser (§2.8).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.utils.profiler import metrics_text, start_metrics_server
+from nnstreamer_tpu.utils.watchdog import Watchdog
+
+
+class TestWatchdog:
+    def test_fires_without_feed(self):
+        fired = threading.Event()
+        with Watchdog(0.05, fired.set):
+            assert fired.wait(1.0)
+
+    def test_feed_defers(self):
+        fired = threading.Event()
+        with Watchdog(0.15, fired.set) as wd:
+            for _ in range(4):
+                time.sleep(0.05)
+                wd.feed()
+            assert not fired.is_set()
+        time.sleep(0.25)
+        assert not fired.is_set()  # disarmed on exit
+
+    def test_fires_once(self):
+        count = []
+        wd = Watchdog(0.03, lambda: count.append(1)).arm()
+        time.sleep(0.2)
+        wd.disarm()
+        assert count == [1]
+        assert wd.fired
+
+    def test_trainer_watchdog_times_out_hung_subplugin(self):
+        from nnstreamer_tpu.core.registry import register_trainer
+        from nnstreamer_tpu.trainer.subplugin import TrainerSubplugin
+
+        @register_trainer("hang")
+        class HangingTrainer(TrainerSubplugin):
+            name = "hang"
+
+            def push_data(self, inputs, labels, is_validation):
+                pass
+
+            def train_epoch(self):
+                time.sleep(2.0)
+                return {}
+
+            def save(self, path):
+                return path
+
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_trainer framework=hang "
+            "num-training-samples=1 epochs=1 watchdog-timeout=0.1 ! "
+            "fakesink",
+        )
+        with p:
+            p.push("src", [np.zeros(2, np.float32), np.zeros(1, np.int32)])
+            p.eos()
+            from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+            with pytest.raises(PipelineError, match="watchdog"):
+                p.wait(timeout=30)
+
+
+class TestMetricsEndpoint:
+    def test_prometheus_text(self):
+        metrics.count("aux.test.frames", 3)
+        text = metrics_text()
+        assert "nnstpu_aux_test_frames 3" in text
+
+    def test_http_metrics(self):
+        metrics.count("aux.http.hits", 7)
+        srv = start_metrics_server(port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_port}/metrics", timeout=5
+            ).read().decode()
+            assert "nnstpu_aux_http_hits 7" in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.server_port}/nope", timeout=5
+                )
+        finally:
+            srv.shutdown()
+
+
+class TestParserCli:
+    def test_valid_pipeline(self, capsys):
+        from nnstreamer_tpu.tools.parse import main
+
+        rc = main(["videotestsrc num-buffers=1 ! tensor_converter ! tensor_sink name=out"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "VALID: 3 elements" in out
+
+    def test_invalid_pipeline(self, capsys):
+        from nnstreamer_tpu.tools.parse import main
+
+        rc = main(["videotestsrc !"])
+        err = capsys.readouterr().err
+        assert rc == 1 and "INVALID" in err
+
+    def test_dot_output(self, capsys):
+        from nnstreamer_tpu.tools.parse import main
+
+        rc = main(["--dot", "videotestsrc ! tensor_sink"])
+        out = capsys.readouterr().out
+        assert rc == 0 and out.startswith("digraph") and "->" in out
+
+    def test_plan_shows_fusion(self, capsys):
+        from nnstreamer_tpu.tools.parse import main
+
+        rc = main([
+            "--plan",
+            "appsrc caps=other/tensors,dimensions=4:4,types=float32 ! "
+            "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:4:4 ! "
+            "tensor_decoder mode=image_labeling option1=digits ! tensor_sink",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0 and "fused:" in out
+
+    def test_unknown_element_rejected(self, capsys):
+        from nnstreamer_tpu.tools.parse import main
+
+        rc = main(["badelem ! tensor_sink"])
+        err = capsys.readouterr().err
+        assert rc == 1 and "unknown element" in err

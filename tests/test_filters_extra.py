@@ -108,11 +108,11 @@ class TestGatedFrameworks:
             nt.SingleShot(framework="onnxruntime", model="x.onnx")
 
     def test_tflite_gated_error(self):
-        try:
-            import tensorflow  # noqa: F401
-
-            pytest.skip("tensorflow installed; gate not exercised")
-        except ImportError:
-            pass
+        for mod in ("tflite_runtime", "tensorflow"):
+            try:
+                __import__(mod)
+                pytest.skip(f"{mod} installed; gate not exercised")
+            except ImportError:
+                pass
         with pytest.raises(ElementError, match="TFLite"):
             nt.SingleShot(framework="tensorflow-lite", model="m.tflite")
