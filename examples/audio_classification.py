@@ -1,0 +1,25 @@
+"""Config #4: speech-command classification over windowed audio.
+
+Reference analog: the audio examples built on tensor_aggregator windows +
+a tflite speech model.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+pipe = nt.Pipeline(
+    "audiotestsrc num-buffers=16 samplesperbuffer=1000 rate=16000 freq=880 format=F32LE ! "
+    "tensor_converter ! "
+    "tensor_aggregator frames-in=1000 frames-out=16000 frames-flush=16000 frames-dim=1 ! "
+    "tensor_filter framework=jax model=speech_commands custom=dtype:float32 ! "
+    "tensor_sink name=out",
+)
+with pipe:
+    buf = pipe.pull("out", timeout=300)
+    pipe.wait(timeout=60)
+scores = np.asarray(buf.tensors[0]).ravel()
+print("command scores shape:", scores.shape, "argmax:", int(scores.argmax()))

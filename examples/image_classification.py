@@ -1,0 +1,31 @@
+"""Config #1: MobileNet-v1 image classification (the headline bench topology).
+
+Reference analog: the stock image-classification example pipeline
+(videotestsrc ! tensor_converter ! tensor_transform ! tensor_filter
+framework=tensorflow-lite model=mobilenet_v1 ! tensor_decoder
+mode=image_labeling ! ...). Here the transform, model, and decoder argmax
+fuse into one XLA program.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+BATCH, SIZE = 8, 224
+
+pipe = nt.Pipeline(
+    f"appsrc name=src caps=other/tensors,dimensions=3:{SIZE}:{SIZE}:{BATCH},types=uint8 ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+    f"tensor_filter framework=jax model=mobilenet_v1 custom=size:{SIZE},batch:{BATCH} ! "
+    "tensor_decoder mode=image_labeling ! tensor_sink name=out",
+)
+print("plan:", [s.element.name for s in pipe.stages])
+rng = np.random.default_rng(0)
+with pipe:
+    pipe.push("src", rng.integers(0, 256, (BATCH, SIZE, SIZE, 3), dtype=np.uint8))
+    buf = pipe.pull("out", timeout=300)
+    pipe.eos(); pipe.wait(timeout=60)
+print("labels:", buf.meta["label"][:4], "scores:", np.round(buf.meta["score"][:4], 3))

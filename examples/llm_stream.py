@@ -1,0 +1,27 @@
+"""Config #5: LLM token streaming through the llm filter framework.
+
+Reference analog: tensor_filter_llamacpp.cc — prompt in, generated tokens
+streamed out as flexible tensors. Here decode is a jitted lax.scan with a
+TP/SP-shardable KV cache; prefill uses the Pallas flash-attention kernel.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+pipe = nt.Pipeline(
+    "appsrc name=src ! "
+    "tensor_filter framework=llm model=llama_tiny custom=max_new:12 ! "
+    "tensor_sink name=out",
+)
+with pipe:
+    pipe.push("src", np.array([[1, 17, 42, 9]], np.int32))
+    toks = []
+    for _ in range(12):
+        b = pipe.pull("out", timeout=600)
+        toks.append(int(np.asarray(b.tensors[0]).ravel()[0]))
+    pipe.eos(); pipe.wait(timeout=60)
+print("generated tokens:", toks)

@@ -1,0 +1,28 @@
+"""Config #2: SSD-MobileNet detection with bounding-box decode (device NMS).
+
+Reference analog: the object-detection example with
+tensor_decoder mode=bounding_boxes (tensordec-boundingbox.c).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+pipe = nt.Pipeline(
+    "videotestsrc num-buffers=2 width=96 height=96 pattern=ball ! "
+    "tensor_converter ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+    "tensor_filter framework=jax model=ssd_mobilenet custom=size:96,classes:7 ! "
+    "tensor_decoder mode=bounding_boxes option3=0.0 option4=96:96 ! "
+    "tensor_sink name=out",
+)
+with pipe:
+    for i in range(2):
+        buf = pipe.pull("out", timeout=300)
+        dets = buf.meta.get("detections", [])
+        print(f"frame {i}: overlay {buf.tensors[0].shape}, {len(dets)} detections;"
+              f" first: {dets[0] if dets else None}")
+    pipe.wait(timeout=60)

@@ -1,0 +1,28 @@
+"""Config #3: PoseNet keypoints (heatmap -> skeleton decode).
+
+Reference analog: tensor_decoder mode=pose_estimation (tensordec-pose.c).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+pipe = nt.Pipeline(
+    "videotestsrc num-buffers=1 width=96 height=96 pattern=ball ! "
+    "tensor_converter ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+    "tensor_filter framework=jax model=posenet custom=size:96,width:0.5 ! "
+    "tensor_decoder mode=pose_estimation option2=96:96 option3=0.0 ! "
+    "tensor_sink name=out",
+)
+with pipe:
+    buf = pipe.pull("out", timeout=300)
+    pipe.wait(timeout=60)
+kps = buf.meta.get("keypoints")
+print("first keypoints:", [
+    {k: round(float(v), 1) for k, v in kp.items()} if isinstance(kp, dict) else kp
+    for kp in (kps or [])[:3]
+])

@@ -1,0 +1,28 @@
+"""Remote inference offload: tensor_query client/server on localhost.
+
+Reference analog: SURVEY §3.3 — client serializes tensors to an edge
+server, the server pipeline runs inference, results return by msg id.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+server = nt.Pipeline(
+    "tensor_query_serversrc port=0 name=ssrc ! "
+    "tensor_filter framework=jax model=scaler custom=scale:10.0,dims:4 ! "
+    "tensor_query_serversink",
+)
+with server:
+    port = server.element("ssrc").bound_port
+    client = nt.Pipeline(
+        f"appsrc name=src ! tensor_query_client port={port} ! tensor_sink name=out",
+    )
+    with client:
+        client.push("src", np.arange(4, dtype=np.float32))
+        out = client.pull("out", timeout=120)
+        client.eos(); client.wait(timeout=60)
+print("offloaded result:", np.asarray(out.tensors[0]))

@@ -1,0 +1,26 @@
+"""Zero-copy cross-pipeline hand-off via the native C++ shm ring.
+
+Reference analog: GStreamer shmsink/shmsrc between two pipelines on one
+host (no TCP stack in the path).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+producer = nt.Pipeline("appsrc name=src ! shmsink socket-path=/nns_example")
+with producer:
+    consumer = nt.Pipeline(
+        "shmsrc socket-path=/nns_example ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,mul:0.5 ! "
+        "tensor_sink name=out",
+    )
+    with consumer:
+        for i in range(3):
+            producer.push("src", np.full((4,), 2 * i, np.uint8))
+        results = [np.asarray(consumer.pull("out", timeout=60).tensors[0]) for _ in range(3)]
+        producer.eos(); producer.wait(timeout=60); consumer.wait(timeout=60)
+print("halved:", [r.tolist() for r in results])

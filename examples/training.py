@@ -1,0 +1,39 @@
+"""On-device training: datareposrc -> tensor_trainer with the optax
+sub-plugin, epoch stats downstream, checkpoint at EOS.
+
+Reference analog: SURVEY §3.4 (datareposrc + tensor_trainer + nntrainer).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json, os, tempfile
+import numpy as np
+import nnstreamer_tpu as nt
+
+tmp = tempfile.mkdtemp()
+data_path, json_path = os.path.join(tmp, "xor.bin"), os.path.join(tmp, "xor.json")
+ckpt = os.path.join(tmp, "model.ckpt")
+
+x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]] * 8, np.float32)
+y = (x[:, 0].astype(np.int32) ^ x[:, 1].astype(np.int32))[:, None]
+with open(data_path, "wb") as f:
+    for xi, yi in zip(x, y):
+        f.write(xi.tobytes()); f.write(yi.tobytes())
+json.dump({"dims": "2,1", "types": "float32,int32",
+           "total_samples": len(x),
+           "sample_size": x[0].nbytes + y[0].nbytes}, open(json_path, "w"))
+
+pipe = nt.Pipeline(
+    f"datareposrc location={data_path} json={json_path} epochs=3 ! "
+    f"tensor_trainer framework=jax model=mlp:2:16:2 num-training-samples={len(x)} "
+    f"epochs=3 batch-size=8 learning-rate=0.1 model-save-path={ckpt} ! "
+    "tensor_sink name=stats",
+)
+with pipe:
+    for epoch in range(3):
+        s = np.asarray(pipe.pull("stats", timeout=300).tensors[0])
+        print(f"epoch {epoch}: loss={s[0]:.4f} acc={s[1]:.3f}")
+    pipe.wait(timeout=120)
+print("checkpoint written:", os.path.exists(ckpt) or os.path.exists(ckpt + ".opt"))

@@ -45,7 +45,15 @@ def _connect(host: str, port: int, role: str, topic: str,
             client_handshake(conn, role, topic=topic)
             conn.settimeout(0.2)
             return conn
-        except (OSError, ValueError, ConnectionError) as e:
+        except (ConnectionRefusedError, ConnectionResetError, TimeoutError) as e:
+            # Broker not up yet / mid-restart: transient, keep retrying.
+            last = e
+            time.sleep(0.05)
+        except ConnectionError as e:
+            # An explicit nack (version/topic rejection) is deterministic —
+            # retrying would hammer the broker and bury the reason.
+            raise ElementError(f"broker {host}:{port} rejected {role}: {e}") from e
+        except (OSError, ValueError) as e:
             last = e
             time.sleep(0.05)
     raise ElementError(f"cannot reach broker {host}:{port}: {last}")

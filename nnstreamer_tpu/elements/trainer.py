@@ -125,35 +125,16 @@ class TensorTrainer(Element):
         if self._hung:
             raise ElementError(self._hung)
         if self.wd_timeout > 0:
-            # The epoch runs on a helper thread so a genuinely wedged
-            # sub-plugin step surfaces as an element error instead of
-            # hanging the stage (the wedged thread itself is daemonized —
-            # Python can't kill it, matching the reference watchdog's
-            # "report, don't recover" semantics).
-            import threading
+            from ..utils.watchdog import call_with_watchdog
 
-            box: Dict[str, object] = {}
-
-            def run():
-                try:
-                    box["stats"] = self.trainer.train_epoch()
-                except BaseException as e:  # noqa: BLE001 - re-raised below
-                    box["exc"] = e
-
-            t = threading.Thread(
-                target=run, name=f"{self.name}-epoch", daemon=True
-            )
-            t.start()
-            t.join(self.wd_timeout)
-            if t.is_alive():
-                self._hung = (
-                    f"{self.name}: trainer epoch exceeded watchdog timeout "
-                    f"{self.wd_timeout}s"
+            try:
+                stats = call_with_watchdog(
+                    self.trainer.train_epoch, self.wd_timeout,
+                    what=f"{self.name} trainer epoch",
                 )
-                raise ElementError(self._hung)
-            if "exc" in box:
-                raise box["exc"]
-            stats = box["stats"]
+            except TimeoutError as e:
+                self._hung = str(e)
+                raise ElementError(self._hung) from e
         else:
             stats = self.trainer.train_epoch()
         self._epochs_done += 1

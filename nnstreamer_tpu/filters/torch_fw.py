@@ -89,30 +89,40 @@ class TorchFramework(Framework):
 
 # -- torch -> JAX weight import ---------------------------------------------
 
+_EMBED_SEGMENTS = frozenset(
+    {"embed", "embedding", "embeddings", "embed_tokens", "tok_embeddings",
+     "wte", "wpe"}
+)
+
+
 def state_dict_to_tree(
     state_dict,
     *,
     transpose_linear: bool = True,
-    embed_keys: Sequence[str] = ("embed", "wte", "wpe", "lut"),
+    embed_keys: Sequence[str] = (),
 ) -> Dict[str, np.ndarray]:
     """Convert a torch ``state_dict`` into a flat {name: numpy} tree with
     JAX-conventional layouts: 4-D (conv) weights OIHW -> HWIO, 2-D linear
-    weights [out, in] -> [in, out].  Embedding tables ([vocab, dim], matched
-    by ``embed_keys`` substrings) keep their layout — transposing them would
-    break token-indexed lookup.  The caller maps the flat names onto its
+    weights [out, in] -> [in, out].  Embedding tables ([vocab, dim]) keep
+    their layout — transposing them would break token-indexed lookup.
+    Embeddings are recognized by EXACT dotted-path segments (``embed``,
+    ``embed_tokens``, ``wte``, ...; extend via ``embed_keys``) so linear
+    layers that merely contain the substring (GPT-NeoX's ``embed_out`` LM
+    head) are still transposed.  The caller maps the flat names onto its
     model's pytree structure.
     """
+    embed_names = _EMBED_SEGMENTS | {str(k).lower() for k in embed_keys}
     out: Dict[str, np.ndarray] = {}
     for key, tensor in state_dict.items():
         a = tensor.detach().cpu().numpy() if hasattr(tensor, "detach") else np.asarray(tensor)
-        lk = key.lower()
+        segments = {s for s in key.lower().split(".")}
         if a.ndim == 4:
             a = np.transpose(a, (2, 3, 1, 0))  # OIHW -> HWIO
         elif (
             a.ndim == 2
             and transpose_linear
             and key.endswith(("weight", "w"))
-            and not any(e in lk for e in embed_keys)
+            and not (segments & embed_names)
         ):
             a = a.T
         out[key] = a

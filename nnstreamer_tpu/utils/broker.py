@@ -20,7 +20,6 @@ Control frames are JSON (type=hello/ack/sub/pub); payload frames carry
 
 from __future__ import annotations
 
-import json
 import queue as _queue
 import socket
 import threading
@@ -93,25 +92,14 @@ class MqttLiteBroker:
 
     # -- client session ----------------------------------------------------
     def _session(self, conn: socket.socket) -> None:
-        from .net import PROTOCOL_VERSION
+        from .net import finish_server_handshake
 
         conn.settimeout(0.2)
         hello = parse_control(self._read_idle(conn))
-        if not hello or hello.get("type") not in ("pub", "sub"):
+        hello = finish_server_handshake(conn, hello, ("pub", "sub"))
+        if hello is None:
             conn.close()
             return
-        if hello.get("proto", 0) != PROTOCOL_VERSION:
-            # Same policy as net.server_handshake: frame layouts differ
-            # across versions, so reject at connect instead of desyncing.
-            wire.write_frame(conn, json.dumps(
-                {"type": "nack",
-                 "reason": f"protocol version {hello.get('proto')} != "
-                           f"{PROTOCOL_VERSION}"}).encode())
-            conn.close()
-            return
-        wire.write_frame(conn, json.dumps(
-            {"type": "ack", "proto": PROTOCOL_VERSION}).encode())
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if hello["type"] == "pub":
             self._pub_loop(conn, str(hello.get("topic", "")))
         else:

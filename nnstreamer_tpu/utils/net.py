@@ -96,14 +96,16 @@ def parse_control(raw: Optional[bytes]) -> Optional[dict]:
 PROTOCOL_VERSION = 2  # v2: crc32-trailed wire frames
 
 
-def server_handshake(conn: socket.socket, expect_type: str,
-                     topic: str = "") -> Optional[dict]:
-    """Read a hello frame, enforce version + topic, reply ack/nack.
+def finish_server_handshake(conn: socket.socket, hello: Optional[dict],
+                            expect_types, topic: str = "") -> Optional[dict]:
+    """Validate an already-read hello and reply ack/nack (the shared half of
+    every server-side handshake: version gate, topic filter, TCP_NODELAY).
 
-    Returns the hello dict on success, None on rejection (nack sent)."""
-    conn.settimeout(5.0)
-    hello = parse_control(wire.read_frame(conn))
-    if not hello or hello.get("type") != expect_type:
+    ``expect_types`` is one type string or a tuple of acceptable ones.
+    Returns the hello dict on success, None on rejection."""
+    if isinstance(expect_types, str):
+        expect_types = (expect_types,)
+    if not hello or hello.get("type") not in expect_types:
         return None
     if hello.get("proto", 0) != PROTOCOL_VERSION:
         # Frame layout differs across versions: reject at connect time
@@ -121,6 +123,16 @@ def server_handshake(conn: socket.socket, expect_type: str,
         {"type": "ack", "topic": topic, "proto": PROTOCOL_VERSION}).encode())
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return hello
+
+
+def server_handshake(conn: socket.socket, expect_type: str,
+                     topic: str = "") -> Optional[dict]:
+    """Read a hello frame, enforce version + topic, reply ack/nack.
+
+    Returns the hello dict on success, None on rejection (nack sent)."""
+    conn.settimeout(5.0)
+    hello = parse_control(wire.read_frame(conn))
+    return finish_server_handshake(conn, hello, expect_type, topic)
 
 
 def client_handshake(conn: socket.socket, hello_type: str, **fields) -> dict:

@@ -72,3 +72,28 @@ class Watchdog:
 
     def __exit__(self, *exc) -> None:
         self.disarm()
+
+
+def call_with_watchdog(fn: Callable, timeout: float, what: str = "call"):
+    """Run ``fn()`` on a helper thread; raise TimeoutError if it exceeds
+    ``timeout`` seconds.  The wedged thread is daemonized (Python cannot
+    kill it) — "report, don't recover", like the reference watchdog.  Used
+    by tensor_trainer around the sub-plugin epoch."""
+    import threading
+
+    box: dict = {}
+
+    def run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            box["exc"] = e
+
+    t = threading.Thread(target=run, name=f"watchdog-{what}", daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError(f"{what} exceeded watchdog timeout {timeout}s")
+    if "exc" in box:
+        raise box["exc"]
+    return box["result"]
