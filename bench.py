@@ -1,20 +1,25 @@
 #!/usr/bin/env python
-"""Headline benchmark: MobileNet-v1 classification pipeline, frames/sec/chip.
+"""Benchmarks for the five BASELINE.md configs.
 
-BASELINE.json KPI: "frames/sec/chip on tensor_filter pipeline; p50 per-frame
-latency".  North star: >=2000 fps aggregate on a v5e-8 => 250 fps/chip is
-parity (vs_baseline = fps_per_chip / 250).
-
-Pipeline under test (config #1, the reference's img-class example):
+Default (no args) = config #1, the headline: MobileNet-v1 classification
+pipeline, frames/sec/chip.  BASELINE.json KPI: "frames/sec/chip on
+tensor_filter pipeline; p50 per-frame latency".  North star: >=2000 fps
+aggregate on a v5e-8 => 250 fps/chip is parity (vs_baseline =
+fps_per_chip / 250).
 
     appsrc -> tensor_transform(typecast+normalize) -> tensor_filter(jax,
     mobilenet_v1, bfloat16) -> tensor_decoder(image_labeling) -> tensor_sink
 
 Frames stream through in batches (the TPU-native move the reference can't
-make: its tflite path is frame-at-a-time); transform+filter fuse into one
-jitted XLA program, so normalization rides the MXU with the convs.
+make: its tflite path is frame-at-a-time); transform+filter+decoder fuse
+into one jitted XLA program, so normalization rides the MXU with the convs
+and only argmax indices come home.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Other configs (--config): detection (#2 SSD + bounding boxes), pose (#3),
+audio (#4 speech commands), llm (#5 token streaming, tokens/sec).
+
+Prints ONE JSON line per config run:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 from __future__ import annotations
@@ -26,36 +31,22 @@ import threading
 import time
 
 
-def run_bench(batch: int, batches: int, size: int, warmup: int) -> dict:
-    import numpy as np
-
+def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
+                    warmup: int, metric: str, baseline_fps: float) -> dict:
     import nnstreamer_tpu as nt
 
-    desc = (
-        f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
-        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
-        f"tensor_filter framework=jax model=mobilenet_v1 custom=size:{size},batch:{batch} name=f ! "
-        "tensor_decoder mode=image_labeling ! tensor_sink name=out"
-    )
-    rng = np.random.default_rng(0)
-    frames = [
-        rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8)
-        for _ in range(4)
-    ]
-
+    frames = [make_frame(i) for i in range(4)]
     push_ts = {}
     lat = []
-    done = threading.Event()
 
-    # Deep in-flight window: the whole chain is ONE fused async stage, so
-    # queue capacity bounds how many batches pipeline H2D/compute/D2H.
-    # Keep total pushed bytes modest (batches*batch*size*size*3) — host->TPU
-    # links are burst-friendly; a short, deeply-pipelined run measures the
-    # framework, not the transport's sustained cap.
+    # Deep in-flight window: fused chains are ONE async stage, so queue
+    # capacity bounds how many batches pipeline H2D/compute/D2H.  Keep total
+    # pushed bytes modest — host->TPU links are burst-friendly; a short,
+    # deeply-pipelined run measures the framework, not the transport's
+    # sustained cap.
     p = nt.Pipeline(desc, fuse=True, queue_capacity=16)
     with p:
-        # Warmup: first push triggers XLA compile.
-        for i in range(warmup):
+        for i in range(warmup):  # first push triggers XLA compile
             p.push("src", frames[i % len(frames)])
             p.pull("out", timeout=600)
 
@@ -63,7 +54,6 @@ def run_bench(batch: int, batches: int, size: int, warmup: int) -> dict:
             for i in range(batches):
                 push_ts[i] = time.perf_counter()
                 p.push("src", frames[i % len(frames)])
-            done.set()
 
         t = threading.Thread(target=pusher, daemon=True)
         t0 = time.perf_counter()
@@ -76,34 +66,167 @@ def run_bench(batch: int, batches: int, size: int, warmup: int) -> dict:
         p.eos()
         p.wait(timeout=60)
 
-    total_frames = batch * batches
     wall = t1 - t0
-    fps = total_frames / wall
+    fps = batch * batches / wall
     lat_ms = sorted(x * 1e3 for x in lat)
-    p50 = lat_ms[len(lat_ms) // 2]
-    p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
     return {
-        "metric": "mobilenet_v1_pipeline_fps_per_chip",
+        "metric": metric,
         "value": round(fps, 1),
         "unit": "frames/sec",
-        "vs_baseline": round(fps / 250.0, 3),
-        "p50_batch_ms": round(p50, 2),
-        "p99_batch_ms": round(p99, 2),
+        "vs_baseline": round(fps / baseline_fps, 3),
+        "p50_batch_ms": round(lat_ms[len(lat_ms) // 2], 2),
+        "p99_batch_ms": round(lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))], 2),
         "batch": batch,
         "batches": batches,
         "wall_s": round(wall, 3),
     }
 
 
+def bench_classification(batch: int, batches: int, size: int, warmup: int) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+        f"tensor_filter framework=jax model=mobilenet_v1 custom=size:{size},batch:{batch} name=f ! "
+        "tensor_decoder mode=image_labeling ! tensor_sink name=out"
+    )
+    return _pipeline_bench(
+        desc,
+        lambda i: rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8),
+        batch, batches, warmup,
+        "mobilenet_v1_pipeline_fps_per_chip", 250.0,
+    )
+
+
+def bench_detection(batch: int, batches: int, size: int, warmup: int) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+        f"tensor_filter framework=jax model=ssd_mobilenet custom=size:{size},classes:91 name=f ! "
+        f"tensor_decoder mode=bounding_boxes option3=0.5 option4={size}:{size} ! "
+        "tensor_sink name=out"
+    )
+    r = _pipeline_bench(
+        desc,
+        lambda i: rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8),
+        batch, batches, warmup,
+        "ssd_mobilenet_detection_fps_per_chip", 250.0,
+    )
+    return r
+
+
+def bench_pose(batch: int, batches: int, size: int, warmup: int) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        f"tensor_filter framework=jax model=posenet custom=size:{size} name=f ! "
+        f"tensor_decoder mode=pose_estimation option2={size}:{size} option3=0.3 ! "
+        "tensor_sink name=out"
+    )
+    return _pipeline_bench(
+        desc,
+        lambda i: rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8),
+        batch, batches, warmup,
+        "posenet_pipeline_fps_per_chip", 250.0,
+    )
+
+
+def bench_audio(batch: int, batches: int, warmup: int) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    samples = 16000  # 1s windows @16kHz
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions={samples}:{batch},types=float32 ! "
+        "tensor_filter framework=jax model=speech_commands custom=dtype:float32 name=f ! "
+        "tensor_sink name=out"
+    )
+    return _pipeline_bench(
+        desc,
+        lambda i: rng.standard_normal((batch, samples)).astype(np.float32),
+        batch, batches, warmup,
+        "speech_commands_windows_per_sec_per_chip", 250.0,
+    )
+
+
+def bench_llm(batches: int, warmup: int, model: str = "llama_small",
+              max_new: int = 64, prompt_len: int = 32) -> dict:
+    """Config #5: tokens/sec through the llm filter (jitted prefill +
+    lax.scan decode).  vs_baseline compares against the reference's
+    llama.cpp CPU path order of magnitude (~20 tok/s)."""
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+
+    rng = np.random.default_rng(0)
+    desc = (
+        "appsrc name=src ! "
+        f"tensor_filter framework=llm model={model} custom=max_new:{max_new} ! "
+        "tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc)
+    toks = 0
+    with p:
+        prompt = rng.integers(1, 400, (1, prompt_len), dtype=np.int32)
+        for _ in range(warmup):
+            p.push("src", prompt)
+            for _ in range(max_new):
+                p.pull("out", timeout=900)
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            p.push("src", prompt)
+            for _ in range(max_new):
+                p.pull("out", timeout=900)
+                toks += 1
+        wall = time.perf_counter() - t0
+        p.eos()
+        p.wait(timeout=60)
+    tps = toks / wall
+    return {
+        "metric": f"{model}_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / 20.0, 3),
+        "max_new": max_new,
+        "prompt_len": prompt_len,
+        "wall_s": round(wall, 3),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="classification",
+                    choices=["classification", "detection", "pose", "audio",
+                             "llm", "all"])
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--batches", type=int, default=32)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--llm-model", default="llama_small")
     args = ap.parse_args()
-    result = run_bench(args.batch, args.batches, args.size, args.warmup)
-    print(json.dumps(result))
+
+    runners = {
+        "classification": lambda: bench_classification(
+            args.batch, args.batches, args.size, args.warmup),
+        "detection": lambda: bench_detection(
+            args.batch, args.batches, args.size, args.warmup),
+        "pose": lambda: bench_pose(
+            args.batch, args.batches, args.size, args.warmup),
+        "audio": lambda: bench_audio(args.batch, args.batches, args.warmup),
+        "llm": lambda: bench_llm(max(1, args.batches // 8), 1,
+                                 model=args.llm_model),
+    }
+    todo = list(runners) if args.config == "all" else [args.config]
+    for name in todo:
+        print(json.dumps(runners[name]()))
     return 0
 
 
