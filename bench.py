@@ -32,7 +32,8 @@ import time
 
 
 def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
-                    warmup: int, metric: str, baseline_fps: float) -> dict:
+                    warmup: int, metric: str, baseline_fps: float,
+                    unit: str = "frames/sec", pulls_per_push: int = 1) -> dict:
     import nnstreamer_tpu as nt
 
     frames = [make_frame(i) for i in range(4)]
@@ -48,7 +49,8 @@ def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
     with p:
         for i in range(warmup):  # first push triggers XLA compile
             p.push("src", frames[i % len(frames)])
-            p.pull("out", timeout=600)
+            for _ in range(pulls_per_push):
+                p.pull("out", timeout=600)
 
         def pusher():
             for i in range(batches):
@@ -59,7 +61,8 @@ def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
         t0 = time.perf_counter()
         t.start()
         for i in range(batches):
-            p.pull("out", timeout=600)
+            for _ in range(pulls_per_push):
+                p.pull("out", timeout=600)
             lat.append(time.perf_counter() - push_ts[i])
         t1 = time.perf_counter()
         t.join()
@@ -72,7 +75,7 @@ def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
     return {
         "metric": metric,
         "value": round(fps, 1),
-        "unit": "frames/sec",
+        "unit": unit,
         "vs_baseline": round(fps / baseline_fps, 3),
         "p50_batch_ms": round(lat_ms[len(lat_ms) // 2], 2),
         "p99_batch_ms": round(lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))], 2),
@@ -107,7 +110,7 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int) -> dict:
     desc = (
         f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
         "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
-        f"tensor_filter framework=jax model=ssd_mobilenet custom=size:{size},classes:91 name=f ! "
+        f"tensor_filter framework=jax model=ssd_mobilenet custom=size:{size},classes:91,batch:{batch} name=f ! "
         f"tensor_decoder mode=bounding_boxes option3=0.5 option4={size}:{size} ! "
         "tensor_sink name=out"
     )
@@ -116,6 +119,7 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int) -> dict:
         lambda i: rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8),
         batch, batches, warmup,
         "ssd_mobilenet_detection_fps_per_chip", 250.0,
+        pulls_per_push=batch,  # batched detection un-batches at the decoder
     )
     return r
 
@@ -127,7 +131,7 @@ def bench_pose(batch: int, batches: int, size: int, warmup: int) -> dict:
     desc = (
         f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
         "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
-        f"tensor_filter framework=jax model=posenet custom=size:{size} name=f ! "
+        f"tensor_filter framework=jax model=posenet custom=size:{size},batch:{batch} name=f ! "
         f"tensor_decoder mode=pose_estimation option2={size}:{size} option3=0.3 ! "
         "tensor_sink name=out"
     )
@@ -146,7 +150,7 @@ def bench_audio(batch: int, batches: int, warmup: int) -> dict:
     samples = 16000  # 1s windows @16kHz
     desc = (
         f"appsrc name=src caps=other/tensors,dimensions={samples}:{batch},types=float32 ! "
-        "tensor_filter framework=jax model=speech_commands custom=dtype:float32 name=f ! "
+        f"tensor_filter framework=jax model=speech_commands custom=dtype:float32,batch:{batch} name=f ! "
         "tensor_sink name=out"
     )
     return _pipeline_bench(
@@ -154,6 +158,7 @@ def bench_audio(batch: int, batches: int, warmup: int) -> dict:
         lambda i: rng.standard_normal((batch, samples)).astype(np.float32),
         batch, batches, warmup,
         "speech_commands_windows_per_sec_per_chip", 250.0,
+        unit="windows/sec",
     )
 
 

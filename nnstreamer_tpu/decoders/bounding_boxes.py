@@ -68,7 +68,30 @@ class BoundingBoxes(Decoder):
         )
 
     # -- decode ------------------------------------------------------------
-    def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
+    def decode(self, tensors: List[np.ndarray], buf: Buffer):
+        # Batched buffers ([B, N, ...] per tensor) decode per frame and are
+        # emitted as B separate video buffers — NMS must never mix boxes of
+        # different frames, and the negotiated caps (one WxH RGBA frame per
+        # buffer) stay truthful.  The reference decodes one frame per
+        # buffer; TPU pipelines batch upstream and un-batch here.
+        first = np.asarray(tensors[0])
+        if first.ndim >= 3:
+            outs = []
+            for b in range(first.shape[0]):
+                overlay, dets = self._decode_one(
+                    [np.asarray(t)[b] for t in tensors]
+                )
+                o = buf.with_tensors([overlay], spec=None)
+                o.meta["detections"] = dets
+                o.meta["batch_index"] = b
+                outs.append(o)
+            return outs
+        overlay, detections = self._decode_one(tensors)
+        out = buf.with_tensors([overlay], spec=None)
+        out.meta["detections"] = detections
+        return out
+
+    def _decode_one(self, tensors: List[np.ndarray]):
         if self.format in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd"):
             boxes, scores, classes = self._decode_ssd(tensors)
         elif self.format in ("yolov5", "yolov8", "yolo"):
@@ -89,10 +112,7 @@ class BoundingBoxes(Decoder):
                     "label": self.labels[ci] if ci < len(self.labels) else str(ci),
                 }
             )
-        overlay = self._draw(detections)
-        out = buf.with_tensors([overlay], spec=None)
-        out.meta["detections"] = detections
-        return out
+        return self._draw(detections), detections
 
     def _decode_ssd(self, tensors):
         boxes = np.asarray(tensors[0], np.float32).reshape(-1, 4)

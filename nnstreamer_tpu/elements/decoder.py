@@ -35,6 +35,10 @@ class TensorDecoder(Element):
 
         tensors = [np.asarray(t) for t in buf.tensors]
         out = self.decoder.decode(tensors, buf)
+        # A decoder may un-batch one buffer into several (bounding_boxes on
+        # batched streams emits one video frame per batch row).
+        if isinstance(out, list):
+            return [(SRC, o) for o in out]
         return [(SRC, out)]
 
     def device_fn(self, in_spec):

@@ -93,6 +93,9 @@ class LLMFramework(Framework):
         self.max_new = int(opts.pop("max_new", 32))
         self.temperature = float(opts.pop("temperature", 0.0))
         self.seed = int(opts.pop("seed", 0))
+        # Tokens decoded per device roundtrip (stream granularity): tokens
+        # still stream downstream one-by-one, in bursts of this size.
+        self.chunk = max(1, int(opts.pop("stream_chunk", 8)))
         tp = int(opts.pop("tp", 1))
         self.dtype = opts.get("dtype", "bfloat16")
         try:
@@ -133,9 +136,35 @@ class LLMFramework(Framework):
         # and T=1 (decode).  donate the cache so decode updates in place.
         self._fwd = jax.jit(fwd, static_argnames=(), donate_argnums=(2,))
 
+        temperature = self.temperature
+
+        def decode_chunk(params, tok, cache, key, pos0, length):
+            """`length` decode steps as ONE program (lax.scan): the host sees
+            one roundtrip per chunk, not per token — over a remote/tunneled
+            device this is the difference between ~5 and ~100s of tok/s."""
+            import jax.numpy as jnp
+            from jax import lax
+
+            def step(carry, i):
+                tok, cache, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = llama.forward_cached(
+                    params, tok[:, None], cache, pos0 + i, cfg,
+                    compute_dtype=self.dtype)
+                nxt = llama.sample_token(logits[:, -1], sub, temperature)
+                return (nxt, cache, key), nxt
+
+            (tok, cache, key), toks = lax.scan(
+                step, (tok, cache, key), jnp.arange(length))
+            return jnp.moveaxis(toks, 0, 1), tok, cache, key  # [B, length]
+
+        self._decode_chunk = jax.jit(
+            decode_chunk, static_argnames=("length",), donate_argnums=(2,))
+
     def close(self) -> None:
         self.bundle = None
         self._fwd = None
+        self._decode_chunk = None
 
     def get_model_info(self):
         flex_in = TensorsSpec.from_string("1", "uint8").replace(
@@ -179,13 +208,23 @@ class LLMFramework(Framework):
         # feed at positions T..T+n-2, each of which must stay < max_seq.
         n = max(1, min(self.max_new, cfg.max_seq - T))
         tok = llama.sample_token(logits[:, -1], key, self.temperature)
-        for i in range(n):
-            yield np.asarray(tok)  # host copy of [B] ids — the stream output
-            if i + 1 == n:
-                break
-            key, sub = jax.random.split(key)
-            logits, cache = self._fwd(params, tok[:, None], cache, T + i)
-            tok = llama.sample_token(logits[:, -1], sub, self.temperature)
+        yield np.asarray(tok)
+        done = 1
+        pos = T
+        while done < n:
+            # Chunked decode; a shorter tail chunk costs one extra compile
+            # (two cached programs total: full chunk + tail).
+            want = n - done
+            length = min(self.chunk, want, cfg.max_seq - 1 - pos)
+            if length <= 0:
+                return
+            toks, tok, cache, key = self._decode_chunk(
+                params, tok, cache, key, pos, length=length)
+            host = np.asarray(toks)  # ONE roundtrip per chunk
+            for j in range(length):
+                yield host[:, j]
+            done += length
+            pos += length
 
     def invoke_stream(self, inputs: Sequence) -> Iterator[List[np.ndarray]]:
         """Yield one output list per generated token: [ids [B] int32,

@@ -18,17 +18,33 @@ from typing import Tuple
 import numpy as np
 
 
+def _box_areas(boxes: np.ndarray) -> np.ndarray:
+    return np.maximum(0.0, boxes[..., 2] - boxes[..., 0]) * np.maximum(
+        0.0, boxes[..., 3] - boxes[..., 1]
+    )
+
+
+def iou_row(box: np.ndarray, box_area: float, boxes: np.ndarray,
+            areas: np.ndarray) -> np.ndarray:
+    """IoU of one corner-format box against (N,4) boxes — the single
+    implementation of the IoU convention (degenerate boxes -> 0, eps-guarded
+    divide) shared by :func:`iou_matrix` and :func:`nms_numpy`."""
+    ix1 = np.maximum(box[0], boxes[:, 0])
+    iy1 = np.maximum(box[1], boxes[:, 1])
+    ix2 = np.minimum(box[2], boxes[:, 2])
+    iy2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.maximum(0.0, ix2 - ix1) * np.maximum(0.0, iy2 - iy1)
+    union = box_area + areas - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
+
+
 def iou_matrix(boxes: np.ndarray) -> np.ndarray:
     """Pairwise IoU for corner-format boxes (N,4) -> (N,N)."""
-    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
-    area = np.maximum(0.0, x2 - x1) * np.maximum(0.0, y2 - y1)
-    ix1 = np.maximum(x1[:, None], x1[None, :])
-    iy1 = np.maximum(y1[:, None], y1[None, :])
-    ix2 = np.minimum(x2[:, None], x2[None, :])
-    iy2 = np.minimum(y2[:, None], y2[None, :])
-    inter = np.maximum(0.0, ix2 - ix1) * np.maximum(0.0, iy2 - iy1)
-    union = area[:, None] + area[None, :] - inter
-    return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
+    boxes = boxes.astype(np.float64)
+    areas = _box_areas(boxes)
+    return np.stack(
+        [iou_row(boxes[i], areas[i], boxes, areas) for i in range(len(boxes))]
+    ) if len(boxes) else np.zeros((0, 0))
 
 
 def nms_numpy(
@@ -37,18 +53,23 @@ def nms_numpy(
     iou_threshold: float = 0.5,
     max_out: int = 100,
 ) -> np.ndarray:
-    """Greedy NMS; returns indices of kept boxes, best-first."""
+    """Greedy NMS; returns indices of kept boxes, best-first.
+
+    O(K·N) memory/work (one IoU row per kept box) — never materializes the
+    N×N matrix, so large candidate sets (batched streams) stay cheap."""
+    boxes = boxes.astype(np.float64)
+    areas = _box_areas(boxes)
     order = np.argsort(-scores)
     keep = []
     suppressed = np.zeros(len(boxes), bool)
-    iou = iou_matrix(boxes.astype(np.float64))
     for i in order:
         if suppressed[i]:
             continue
         keep.append(i)
         if len(keep) >= max_out:
             break
-        suppressed |= iou[i] > iou_threshold
+        iou = iou_row(boxes[i], areas[i], boxes, areas)
+        suppressed |= iou > iou_threshold
         suppressed[i] = True
     return np.asarray(keep, np.int64)
 

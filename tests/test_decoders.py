@@ -170,3 +170,29 @@ def test_detection_pipeline_e2e():
         out = p.pull("out", timeout=10)
     assert out.tensors[0].shape == (64, 64, 4)
     assert len(out.meta["detections"]) == 1
+
+
+def test_bounding_boxes_batched_frames_independent(rng):
+    """Batched detection buffers decode per frame: NMS never mixes frames
+    and detections come back as one list per frame."""
+    from nnstreamer_tpu.core.registry import get as reg_get, KIND_DECODER
+
+    dec = reg_get(KIND_DECODER, "bounding_boxes")(
+        {"option1": "ssd", "option3": "0.5", "option4": "32:32"}
+    )
+    n = 6
+    boxes = np.tile(np.array([[0.1, 0.1, 0.4, 0.4]], np.float32), (2, n, 1))
+    scores = np.zeros((2, n, 3), np.float32)
+    scores[0, 0, 1] = 0.9   # frame 0: one confident box
+    scores[1, 0, 2] = 0.8   # frame 1: one confident box, other class
+    scores[1, 1, 2] = 0.75  # same spot -> NMS suppresses within the frame
+    buf = nt.Buffer([boxes, scores])
+    outs = dec.decode([boxes, scores], buf)
+    assert isinstance(outs, list) and len(outs) == 2  # one buffer per frame
+    d0 = outs[0].meta["detections"]
+    d1 = outs[1].meta["detections"]
+    assert len(d0) == 1 and d0[0]["class_index"] == 1
+    assert len(d1) == 1 and d1[0]["class_index"] == 2
+    for o in outs:
+        assert o.tensors[0].shape == (32, 32, 4)  # caps-true single frames
+    assert [o.meta["batch_index"] for o in outs] == [0, 1]
