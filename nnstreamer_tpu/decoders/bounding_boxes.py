@@ -74,13 +74,13 @@ class BoundingBoxes(Decoder):
         # different frames, and the negotiated caps (one WxH RGBA frame per
         # buffer) stay truthful.  The reference decodes one frame per
         # buffer; TPU pipelines batch upstream and un-batch here.
-        first = np.asarray(tensors[0])
-        if first.ndim >= 3:
+        ndim = getattr(tensors[0], "ndim", None)
+        if ndim is None:
+            ndim = np.asarray(tensors[0]).ndim
+        if ndim >= 3:
             outs = []
-            for b in range(first.shape[0]):
-                overlay, dets = self._decode_one(
-                    [np.asarray(t)[b] for t in tensors]
-                )
+            for b, frame in enumerate(self._split_frames(tensors)):
+                overlay, dets = self._decode_one(frame)
                 o = buf.with_tensors([overlay], spec=None)
                 o.meta["detections"] = dets
                 o.meta["batch_index"] = b
@@ -91,13 +91,59 @@ class BoundingBoxes(Decoder):
         out.meta["detections"] = detections
         return out
 
-    def _decode_one(self, tensors: List[np.ndarray]):
-        if self.format in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd"):
-            boxes, scores, classes = self._decode_ssd(tensors)
-        elif self.format in ("yolov5", "yolov8", "yolo"):
-            boxes, scores, classes = self._decode_yolo(tensors)
+    def _split_frames(self, tensors):
+        """Per-frame inputs for a batched buffer.  SSD-format device arrays
+        go through a jitted top-k prefilter FIRST (SURVEY §7 hard-parts:
+        "NMS on TPU -> top-k based approximation"): only K=4*max_detections
+        candidates per frame cross to the host instead of the full
+        [B, N, C] score tensor — the host-side greedy NMS then runs on K
+        boxes, not thousands."""
+        n = tensors[0].shape[1]
+        k = 4 * self.max_detections
+        if self.format in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd") and n > k:
+            tb, ts, tc = self._device_topk(tensors[0], tensors[1], k)
+            return [
+                ("triple", (tb[b], ts[b], tc[b])) for b in range(tb.shape[0])
+            ]
+        return [
+            ("raw", [np.asarray(t)[b] for t in tensors])
+            for b in range(tensors[0].shape[0])
+        ]
+
+    def _device_topk(self, boxes, scores, k: int):
+        import jax
+        import jax.numpy as jnp
+
+        fn = getattr(self, "_topk_fn", None)
+        if fn is None:
+            @jax.jit
+            def fn(b, s):
+                s = s.reshape(s.shape[0], s.shape[1], -1)
+                cls = jnp.argmax(s, axis=-1).astype(jnp.int32)  # [B, N]
+                sc = jnp.max(s, axis=-1)                        # [B, N]
+                top_sc, idx = jax.lax.top_k(sc, k)              # [B, K]
+                top_b = jnp.take_along_axis(
+                    b.reshape(b.shape[0], -1, 4), idx[..., None], axis=1)
+                top_c = jnp.take_along_axis(cls, idx, axis=1)
+                return top_b, top_sc, top_c
+
+            self._topk_fn = fn
+        tb, ts, tc = fn(jnp.asarray(boxes), jnp.asarray(scores))
+        return np.asarray(tb), np.asarray(ts), np.asarray(tc)
+
+    def _decode_one(self, frame):
+        if isinstance(frame, tuple) and frame[0] == "triple":
+            boxes, scores, classes = frame[1]
+            m = scores >= self.threshold
+            boxes, scores, classes = boxes[m], scores[m], classes[m]
         else:
-            raise ValueError(f"unknown bounding-box format {self.format!r}")
+            tensors = frame[1] if isinstance(frame, tuple) else frame
+            if self.format in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd"):
+                boxes, scores, classes = self._decode_ssd(tensors)
+            elif self.format in ("yolov5", "yolov8", "yolo"):
+                boxes, scores, classes = self._decode_yolo(tensors)
+            else:
+                raise ValueError(f"unknown bounding-box format {self.format!r}")
 
         keep = nms_numpy(boxes, scores, self.iou_threshold, self.max_detections)
         detections = []

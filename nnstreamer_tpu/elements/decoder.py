@@ -31,10 +31,11 @@ class TensorDecoder(Element):
         return self.out_caps
 
     def process(self, pad, buf):
-        import numpy as np
-
-        tensors = [np.asarray(t) for t in buf.tensors]
-        out = self.decoder.decode(tensors, buf)
+        # Tensors go to the decoder as-is (possibly device-resident jax
+        # Arrays from an upstream fused stage): decoders that can prefilter
+        # on device (bounding_boxes top-k) avoid fetching the full model
+        # output; the rest np.asarray what they need.
+        out = self.decoder.decode(list(buf.tensors), buf)
         # A decoder may un-batch one buffer into several (bounding_boxes on
         # batched streams emits one video frame per batch row).
         if isinstance(out, list):

@@ -12,7 +12,11 @@ reference's TensorRT/SNPE/EdgeTPU CUDA/NPU sub-plugins
 * ``accelerator=true:tpu`` etc. maps to jax device selection; bfloat16
   execution via ``custom=dtype:bfloat16``;
 * batching: the model's leading dim is the batch dim (NHWC video batches map
-  straight onto the MXU).
+  straight onto the MXU);
+* multi-chip: ``mesh=data:N`` (element prop or ``custom=mesh:data:N``)
+  shards the batch dim over an N-device ``data`` mesh axis — the north
+  star's "query layer shards camera-stream batches over ICI"; params are
+  replicated and XLA places the collectives.
 """
 
 from __future__ import annotations
@@ -46,6 +50,13 @@ class JaxFramework(Framework):
         if model in (None, ""):
             raise FrameworkError("jax framework needs model=<zoo name|module:attr>")
         opts = parse_custom_options(str(props.get("custom", "")))
+        mesh_prop = str(props.get("mesh", "") or "")
+        mesh_custom = str(opts.pop("mesh", "") or "")
+        if mesh_prop and mesh_custom and mesh_prop != mesh_custom:
+            raise FrameworkError(
+                f"conflicting mesh specs: prop mesh={mesh_prop!r} vs "
+                f"custom=mesh:{mesh_custom!r}")
+        mesh_spec = mesh_prop or mesh_custom
         try:
             self.bundle = build_model(model, opts)
         except KeyError as e:
@@ -69,11 +80,54 @@ class JaxFramework(Framework):
             params = jax.device_put(params, self._device)
             self.bundle.params = params
 
+        self._sharding = None
+        if mesh_spec:
+            self._setup_mesh(mesh_spec, params)
+            params = self.bundle.params
+        constrain = self._constrain
+
         def run(*inputs):
-            out = apply_fn(params, *inputs)
+            out = apply_fn(params, *constrain(inputs))
             return out if isinstance(out, (tuple, list)) else (out,)
 
         self._jitted = jax.jit(run)
+
+    def _constrain(self, arrays):
+        """Apply the data-parallel sharding constraint to every input (one
+        implementation shared by the standalone and fused paths)."""
+        if self._sharding is None:
+            return tuple(arrays)
+        import jax
+
+        return tuple(
+            jax.lax.with_sharding_constraint(x, self._sharding)
+            for x in arrays
+        )
+
+    def _setup_mesh(self, spec: str, params) -> None:
+        """``data:N`` — batch-dim sharding over an ICI mesh; params are
+        replicated explicitly so every chip holds a copy."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import make_mesh
+
+        parts = spec.split(":")
+        axis = parts[0] or "data"
+        if axis != "data":
+            raise FrameworkError(
+                f"jax framework shards the batch dim only (mesh=data:N); "
+                f"got axis {axis!r} — model/tensor parallel belongs to the "
+                "llm framework (custom=tp:N)"
+            )
+        n = int(parts[1]) if len(parts) > 1 else len(jax.devices())
+        if len(jax.devices()) < n:
+            raise FrameworkError(
+                f"mesh=data:{n} needs {n} devices, have {len(jax.devices())}")
+        mesh = make_mesh(data=n, devices=jax.devices()[:n])
+        self._sharding = NamedSharding(mesh, P("data"))
+        replicated = NamedSharding(mesh, P())
+        self.bundle.params = jax.device_put(params, replicated)
 
     def close(self):
         self.bundle = None
@@ -96,9 +150,10 @@ class JaxFramework(Framework):
             return None
         apply_fn = self.bundle.apply_fn
         params = self.bundle.params
+        constrain = self._constrain
 
         def fn(arrays):
-            out = apply_fn(params, *arrays)
+            out = apply_fn(params, *constrain(arrays))
             return out if isinstance(out, tuple) else (
                 tuple(out) if isinstance(out, list) else (out,)
             )

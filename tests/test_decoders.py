@@ -196,3 +196,31 @@ def test_bounding_boxes_batched_frames_independent(rng):
     for o in outs:
         assert o.tensors[0].shape == (32, 32, 4)  # caps-true single frames
     assert [o.meta["batch_index"] for o in outs] == [0, 1]
+
+
+def test_bounding_boxes_device_topk_matches_host(rng):
+    """SSD prefilter: with N >> 4*max_detections the decoder top-ks on
+    device; detections must match the pure-host path."""
+    from nnstreamer_tpu.core.registry import get as reg_get, KIND_DECODER
+
+    n, c, b = 600, 5, 2
+    boxes = rng.uniform(0, 1, (b, n, 2)).astype(np.float32)
+    boxes = np.concatenate([boxes, boxes + rng.uniform(0.05, 0.3, (b, n, 2)).astype(np.float32)], -1)
+    scores = rng.uniform(0, 1, (b, n, c)).astype(np.float32) ** 3
+
+    def run(max_det):
+        dec = reg_get(KIND_DECODER, "bounding_boxes")(
+            {"option1": "ssd", "option3": "0.6", "option4": "32:32",
+             "option6": str(max_det)}
+        )
+        buf = nt.Buffer([boxes, scores])
+        return dec.decode([boxes, scores], buf)
+
+    outs_dev = run(20)    # 4*20=80 < 600 -> device top-k path
+    outs_host = run(200)  # 4*200 >= 600 -> host path
+    for od, oh in zip(outs_dev, outs_host):
+        dd, dh = od.meta["detections"], oh.meta["detections"][:20]
+        assert [d["class_index"] for d in dd] == [d["class_index"] for d in dh]
+        np.testing.assert_allclose(
+            [d["score"] for d in dd], [d["score"] for d in dh], rtol=1e-6
+        )

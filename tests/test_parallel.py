@@ -85,3 +85,33 @@ def test_shard_params_tp_matmul():
 
     out = f(sharded, xs)
     np.testing.assert_allclose(np.asarray(out), x @ w)
+
+
+def test_jax_filter_data_parallel_mesh():
+    """tensor_filter framework=jax mesh=data:8 shards the batch dim over
+    the virtual 8-device mesh (north star: query-layer DP sharding)."""
+    import nnstreamer_tpu as nt
+
+    p = nt.Pipeline(
+        "appsrc name=src caps=other/tensors,dimensions=4:8,types=float32 ! "
+        "tensor_filter framework=jax model=scaler custom=scale:3.0,dims:4:8 "
+        "mesh=data:8 ! tensor_sink name=out"
+    )
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    with p:
+        p.push("src", x)
+        out = p.pull("out", timeout=60)
+        p.eos()
+        p.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(out.tensors[0]), x * 3.0)
+
+
+def test_jax_filter_mesh_too_big_rejected():
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.elements.base import ElementError
+
+    with pytest.raises(ElementError, match="devices"):
+        nt.Pipeline(
+            "appsrc ! tensor_filter framework=jax model=scaler "
+            "custom=dims:4 mesh=data:64 ! tensor_sink name=o"
+        )
