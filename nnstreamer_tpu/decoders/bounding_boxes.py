@@ -105,9 +105,9 @@ class BoundingBoxes(Decoder):
             return [
                 ("triple", (tb[b], ts[b], tc[b])) for b in range(tb.shape[0])
             ]
+        host = [np.asarray(t) for t in tensors]  # ONE device fetch per tensor
         return [
-            ("raw", [np.asarray(t)[b] for t in tensors])
-            for b in range(tensors[0].shape[0])
+            ("raw", [t[b] for t in host]) for b in range(host[0].shape[0])
         ]
 
     def _device_topk(self, boxes, scores, k: int):

@@ -30,6 +30,10 @@ from .base import ElementError, SinkElement, SourceElement
 log = logger(__name__)
 
 
+class BrokerRejected(ElementError):
+    """Deterministic broker nack (version/topic): never retried."""
+
+
 def _connect(host: str, port: int, role: str, topic: str,
              timeout: float) -> socket.socket:
     from ..utils.net import client_handshake
@@ -52,7 +56,8 @@ def _connect(host: str, port: int, role: str, topic: str,
         except ConnectionError as e:
             # An explicit nack (version/topic rejection) is deterministic —
             # retrying would hammer the broker and bury the reason.
-            raise ElementError(f"broker {host}:{port} rejected {role}: {e}") from e
+            raise BrokerRejected(
+                f"broker {host}:{port} rejected {role}: {e}") from e
         except (OSError, ValueError) as e:
             last = e
             time.sleep(0.05)
@@ -111,6 +116,10 @@ class MqttSrc(SourceElement):
         self.num_buffers = int(self.props.get("num_buffers", -1))
         self.sync = str(self.props.get("sync", "none"))  # none | rebase
         self.connect_timeout = float(self.props.get("connect_timeout", 10.0))
+        # Reference: nnstreamer-edge reconnects MQTT-hybrid subscribers on
+        # broker loss (SURVEY §5.3).  Opt-in: with reconnect=false (default)
+        # a closed broker ends the stream immediately (EOS) — no stall.
+        self.reconnect = bool(self.props.get("reconnect", False))
         self._conn: Optional[socket.socket] = None
 
     def configure(self, in_caps, out_pads):
@@ -128,6 +137,29 @@ class MqttSrc(SourceElement):
             finally:
                 self._conn = None
 
+    def _reconnect(self, stop) -> bool:
+        metrics.count(f"{self.name}.reconnects")
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+        deadline = time.monotonic() + self.connect_timeout
+        while time.monotonic() < deadline:
+            if stop is not None and stop.is_set():
+                return False
+            try:
+                self._conn = _connect(self.host, self.port, "sub", self.topic, 1.0)
+                return True
+            except BrokerRejected:
+                raise  # deterministic rejection: surface, don't hammer
+            except ElementError:
+                time.sleep(0.2)
+        log.warning("%s: broker did not come back within %.1fs",
+                    self.name, self.connect_timeout)
+        return False
+
     def generate(self) -> Iterator[Union[Buffer, Event]]:
         n = 0
         stop = getattr(self, "_stop_event", None)
@@ -140,9 +172,13 @@ class MqttSrc(SourceElement):
                 continue
             except (OSError, ValueError) as e:
                 log.warning("%s: broker connection lost: %s", self.name, e)
+                if self.reconnect and self._reconnect(stop):
+                    continue
                 return
-            if frame is None:
-                return  # broker closed
+            if frame is None:  # broker closed the stream
+                if self.reconnect and self._reconnect(stop):
+                    continue
+                return
             buf, _flags = wire.decode_buffer(frame)
             if self.sync == "rebase" and "mono_ns" in buf.meta:
                 # ntputil analog: rebase the publisher's monotonic pts onto

@@ -120,7 +120,11 @@ class JaxFramework(Framework):
                 f"got axis {axis!r} — model/tensor parallel belongs to the "
                 "llm framework (custom=tp:N)"
             )
-        n = int(parts[1]) if len(parts) > 1 else len(jax.devices())
+        try:
+            n = int(parts[1]) if len(parts) > 1 else len(jax.devices())
+        except ValueError:
+            raise FrameworkError(
+                f"bad mesh spec {spec!r}: expected data:N") from None
         if len(jax.devices()) < n:
             raise FrameworkError(
                 f"mesh=data:{n} needs {n} devices, have {len(jax.devices())}")

@@ -162,3 +162,68 @@ class TestGrpcElements:
                 sink_pipe.wait(timeout=10)
             src_pipe.wait(timeout=10)
         assert out.pts == 777 and out.meta["tag"] == "x"
+
+
+class TestReconnect:
+    def test_subscriber_survives_broker_restart(self):
+        """Broker dies and comes back on the same port: the subscriber
+        reconnects and keeps receiving (reference: nnstreamer-edge
+        MQTT-hybrid reconnection)."""
+        broker = MqttLiteBroker().start()
+        port = broker.port
+        sub = nt.Pipeline(
+            f"mqttsrc port={port} topic=t num-buffers=2 reconnect=true connect-timeout=10 ! "
+            "tensor_sink name=out"
+        )
+        with sub:
+            pub = nt.Pipeline(f"appsrc name=src ! mqttsink port={port} topic=t")
+            with pub:
+                pub.push("src", np.array([1], np.uint8))
+                first = sub.pull("out", timeout=15)
+                pub.eos()
+                pub.wait(timeout=10)
+            broker.stop()
+            import time as _t0
+
+            broker2 = None
+            for _ in range(50):  # port release can lag the close()
+                try:
+                    broker2 = MqttLiteBroker(port=port, retain=False).start()
+                    break
+                except OSError:
+                    _t0.sleep(0.1)
+            assert broker2 is not None, "could not rebind broker port"
+            try:
+                pub2 = nt.Pipeline(f"appsrc name=src ! mqttsink port={port} topic=t")
+                with pub2:
+                    # publish until the reconnected subscriber gets one
+                    import time as _t
+
+                    second = None
+                    for i in range(100):
+                        pub2.push("src", np.array([2], np.uint8))
+                        try:
+                            second = sub.pull("out", timeout=0.3)
+                            break
+                        except TimeoutError:
+                            _t.sleep(0.1)
+                    pub2.eos()
+                    pub2.wait(timeout=10)
+                assert second is not None, "no buffer after broker restart"
+                sub.wait(timeout=15)
+            finally:
+                broker2.stop()
+        assert first.tensors[0][0] == 1
+        assert second.tensors[0][0] == 2
+
+    def test_no_reconnect_by_default(self):
+        broker = MqttLiteBroker().start()
+        port = broker.port
+        sub = nt.Pipeline(
+            f"mqttsrc port={port} topic=t num-buffers=5 ! "
+            "tensor_sink name=out"
+        )
+        with sub:
+            broker.stop()
+            # source should end (EOS), not hang
+            sub.wait(timeout=15)
