@@ -74,7 +74,11 @@ class ImageLabeling(Decoder):
 
         def fn(arrays):
             scores = arrays[0]
-            flat = scores.reshape(batch, -1)
+            # Batch from the RUNTIME shape, not the negotiated spec: a
+            # truncated tail batch (num-buffers not batch-aligned) retraces
+            # with its own leading dim.
+            b = scores.shape[0] if scores.ndim >= 2 else 1
+            flat = scores.reshape(b, -1)
             idx = jnp.argmax(flat, axis=1).astype(jnp.int32)
             score = jnp.take_along_axis(flat, idx[:, None], axis=1)[:, 0]
             return (idx, score.astype(jnp.float32))

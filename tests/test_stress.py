@@ -97,3 +97,59 @@ def test_many_pipelines_sequentially_no_leak():
             p.wait(timeout=30)
     after = threading.active_count()
     assert after - before < 10, f"thread leak: {before} -> {after}"
+
+
+def test_device_videotestsrc_matches_host_patterns():
+    """videotestsrc device=true generates the same gradient/ball frames as
+    the host path, batched, device-resident."""
+    for pattern in ("smpte", "ball", "black", "white"):
+        host = nt.Pipeline(
+            f"videotestsrc num-buffers=3 width=12 height=10 pattern={pattern} ! "
+            "tensor_converter ! tensor_sink name=out"
+        )
+        with host:
+            frames = [np.asarray(host.pull("out", timeout=30).tensors[0])[0]
+                      for _ in range(3)]
+            host.wait(timeout=30)
+        dev = nt.Pipeline(
+            f"videotestsrc device=true batch=3 num-buffers=3 width=12 "
+            f"height=10 pattern={pattern} ! tensor_sink name=out"
+        )
+        with dev:
+            batch = np.asarray(dev.pull("out", timeout=30).tensors[0])
+            dev.wait(timeout=30)
+        assert batch.shape == (3, 10, 12, 3)
+        for i in range(3):
+            np.testing.assert_array_equal(batch[i], frames[i], err_msg=pattern)
+
+
+def test_device_videotestsrc_fuses_with_filter():
+    p = nt.Pipeline(
+        "videotestsrc device=true batch=4 num-buffers=4 width=8 height=8 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_filter framework=jax model=average custom=dims:3:8:8:4 ! "
+        "tensor_sink name=out"
+    )
+    fused = [s for s in p.stages if len(s.node_ids) > 1]
+    assert fused, "device source output should fuse transform+filter"
+    with p:
+        out = p.pull("out", timeout=30)
+        p.wait(timeout=30)
+    assert np.asarray(out.tensors[0]).shape[0] == 4
+
+
+def test_device_videotestsrc_num_buffers_contract():
+    """num-buffers counts frames exactly, even when not batch-aligned."""
+    p = nt.Pipeline(
+        "videotestsrc device=true batch=4 num-buffers=5 width=4 height=4 ! "
+        "tensor_sink name=out"
+    )
+    with p:
+        shapes = []
+        while True:
+            try:
+                shapes.append(np.asarray(p.pull("out", timeout=5).tensors[0]).shape[0])
+            except TimeoutError:
+                break
+        p.wait(timeout=10)
+    assert sum(shapes) == 5 and shapes == [4, 1]
