@@ -102,10 +102,7 @@ def bench_classification(batch: int, batches: int, size: int, warmup: int,
     import numpy as np
 
     if source == "videotestsrc":
-        # Shallow queues + a drain phase: the free-running source must not
-        # pre-compute the measured batches while the first compile runs.
-        drain = 4 * _SOURCE_QUEUE_CAPACITY + 8  # > total queue slots
-        total = (warmup + drain + batches) * batch
+        total = _source_total_frames(batch, batches, warmup)
         desc = (
             f"videotestsrc device=true batch={batch} "
             f"num-buffers={total} width={size} height={size} name=src ! "
@@ -117,7 +114,7 @@ def bench_classification(batch: int, batches: int, size: int, warmup: int,
             f"tensor_decoder mode=image_labeling ! tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY}"
         )
         return _source_driven_bench(
-            desc, batch, batches, warmup + drain,
+            desc, batch, batches, warmup,
             "mobilenet_v1_pipeline_fps_per_chip", 250.0, source,
         )
     rng = np.random.default_rng(0)
@@ -137,21 +134,38 @@ def bench_classification(batch: int, batches: int, size: int, warmup: int,
     return r
 
 
+def _drain_batches() -> int:
+    """Batches pulled (and discarded) before timing starts: must exceed the
+    total queue slots across stages, or batches pre-computed during the
+    first compile leak into the measured window."""
+    return 4 * _SOURCE_QUEUE_CAPACITY + 8
+
+
+def _source_total_frames(batch: int, batches: int, warmup: int) -> int:
+    """num-buffers for a free-running source: warmup + drain + measured."""
+    return (warmup + _drain_batches() + batches) * batch
+
+
 def _source_driven_bench(desc: str, batch: int, batches: int, warmup: int,
-                         metric: str, baseline_fps: float, source: str) -> dict:
+                         metric: str, baseline_fps: float, source: str,
+                         pulls_per_batch: int = 1) -> dict:
     """Benchmark a pipeline whose source free-runs (no app pushes): pull
-    `batches` batch-buffers off the sink and measure wall time."""
+    `batches` batch-buffers off the sink and measure wall time.  The
+    caller builds desc with num-buffers=_source_total_frames(...) and this
+    runner burns warmup+_drain_batches() pulls before timing.
+    ``pulls_per_batch`` accounts for decoders that un-batch."""
     import nnstreamer_tpu as nt
 
     p = nt.Pipeline(desc, fuse=True, queue_capacity=_SOURCE_QUEUE_CAPACITY)
     lat = []
     with p:
-        for _ in range(warmup):  # compile + drain pre-buffered batches
-            p.pull("out", timeout=600)
+        for _ in range((warmup + _drain_batches()) * pulls_per_batch):
+            p.pull("out", timeout=600)  # compile + drain pre-buffered
         t0 = time.perf_counter()
         prev = t0
         for _ in range(batches):
-            p.pull("out", timeout=600)
+            for _ in range(pulls_per_batch):
+                p.pull("out", timeout=600)
             now = time.perf_counter()
             lat.append(now - prev)
             prev = now
@@ -164,42 +178,35 @@ def _source_driven_bench(desc: str, batch: int, batches: int, warmup: int,
 
 
 def bench_detection(batch: int, batches: int, size: int, warmup: int) -> dict:
-    import numpy as np
-
-    rng = np.random.default_rng(0)
+    total = _source_total_frames(batch, batches, warmup)
     desc = (
-        f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
+        f"videotestsrc device=true batch={batch} num-buffers={total} "
+        f"width={size} height={size} pattern=ball name=src ! "
         "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
         f"tensor_filter framework=jax model=ssd_mobilenet custom=size:{size},classes:91,batch:{batch} name=f ! "
         f"tensor_decoder mode=bounding_boxes option3=0.5 option4={size}:{size} ! "
-        "tensor_sink name=out"
+        f"tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY * batch}"
     )
-    r = _pipeline_bench(
-        desc,
-        lambda i: rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8),
-        batch, batches, warmup,
-        "ssd_mobilenet_detection_fps_per_chip", 250.0,
-        pulls_per_push=batch,  # batched detection un-batches at the decoder
+    return _source_driven_bench(
+        desc, batch, batches, warmup,
+        "ssd_mobilenet_detection_fps_per_chip", 250.0, "videotestsrc",
+        pulls_per_batch=batch,  # batched detection un-batches at the decoder
     )
-    return r
 
 
 def bench_pose(batch: int, batches: int, size: int, warmup: int) -> dict:
-    import numpy as np
-
-    rng = np.random.default_rng(0)
+    total = _source_total_frames(batch, batches, warmup)
     desc = (
-        f"appsrc name=src caps=other/tensors,dimensions=3:{size}:{size}:{batch},types=uint8 ! "
+        f"videotestsrc device=true batch={batch} num-buffers={total} "
+        f"width={size} height={size} pattern=ball name=src ! "
         "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
         f"tensor_filter framework=jax model=posenet custom=size:{size},batch:{batch} name=f ! "
         f"tensor_decoder mode=pose_estimation option2={size}:{size} option3=0.3 ! "
-        "tensor_sink name=out"
+        f"tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY}"
     )
-    return _pipeline_bench(
-        desc,
-        lambda i: rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8),
-        batch, batches, warmup,
-        "posenet_pipeline_fps_per_chip", 250.0,
+    return _source_driven_bench(
+        desc, batch, batches, warmup,
+        "posenet_pipeline_fps_per_chip", 250.0, "videotestsrc",
     )
 
 

@@ -189,11 +189,13 @@ class VideoTestSrc(SourceElement):
             make = self._device_batch_fn()
             # num-buffers counts FRAMES (host-path contract); the device
             # path emits full batches and truncates the tail batch so the
-            # total frame count matches exactly.
+            # total frame count matches exactly.  The frame index wraps at
+            # 2^30 (int32-safe under jit; patterns repeat anyway at far
+            # shorter periods, so the seam is invisible).
             emitted = 0
             i = 0
             while emitted < num:
-                arr = make(i * self.batch)
+                arr = make((i * self.batch) % (1 << 30))
                 take = min(self.batch, num - emitted)
                 if take < self.batch:
                     arr = arr[:take]

@@ -101,7 +101,15 @@ class FusedElement(Element):
 
         arrays = tuple(jnp.asarray(t) for t in buf.tensors)
         out = self._fn(arrays)
-        new = buf.with_tensors(list(out), spec=self._out_spec)
+        # A truncated tail batch (device sources with non-aligned
+        # num-buffers) has a different leading dim than the negotiated
+        # spec: let the buffer derive its spec from the actual arrays so
+        # wire/shm consumers see truthful byte counts.
+        spec = self._out_spec
+        if spec is not None and len(out) and hasattr(out[0], "shape"):
+            if tuple(out[0].shape) != spec[0].shape:
+                spec = None
+        new = buf.with_tensors(list(out), spec=spec)
         if self._host_post is not None:
             for t in out:
                 if hasattr(t, "copy_to_host_async"):
