@@ -183,9 +183,20 @@ class EdgeSrc(SourceElement):
                 continue
             except OSError:
                 return
+            except ValueError as e:  # corrupt frame (CRC mismatch)
+                log.warning("%s: corrupt frame, treating as connection "
+                            "loss: %s", self.name, e)
+                metrics.count(f"{self.name}.corrupt")
+                return
             if raw is None:
                 return  # publisher closed: EOS
-            buf, _flags = wire.decode_buffer(raw)
+            try:
+                buf, _flags = wire.decode_buffer(raw)
+            except ValueError as e:
+                log.warning("%s: corrupt payload, treating as connection "
+                            "loss: %s", self.name, e)
+                metrics.count(f"{self.name}.corrupt")
+                return
             metrics.count(f"{self.name}.received")
             yield buf
             count += 1

@@ -306,6 +306,11 @@ class TensorQueryClient(Element):
                 continue
             except OSError:
                 raw = None
+            except ValueError as e:  # corrupt frame (CRC mismatch)
+                with self._cv:
+                    self._rx_error = e
+                    self._cv.notify_all()
+                return
             if raw is None:
                 with self._cv:
                     if self._pending and self._rx_error is None:

@@ -137,9 +137,13 @@ class LLMFramework(Framework):
             return llama.forward_cached(params, tokens, cache, pos, cfg,
                                         compute_dtype=self.dtype)
 
-        # Same program at two sequence lengths: T=prompt (prefill bucket)
-        # and T=1 (decode).  donate the cache so decode updates in place.
-        self._fwd = jax.jit(fwd, static_argnames=(), donate_argnums=(2,))
+        # Prefill program (only ever called with pos=0).  pos is STATIC so
+        # the trace sees a Python int and models/llama.py's prefill branch
+        # (flash attention over the prompt, not a masked sweep over all
+        # max_seq cache rows) actually compiles in; a traced pos would make
+        # `type(pos_offset) is int` False at trace time.  Cache donated so
+        # prefill writes in place.
+        self._fwd = jax.jit(fwd, static_argnums=(3,), donate_argnums=(2,))
 
         temperature = self.temperature
 

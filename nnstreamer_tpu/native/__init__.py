@@ -58,11 +58,16 @@ def _build() -> Optional[str]:
     return out
 
 
-def _load() -> Optional[ctypes.CDLL]:
+def _load(block: bool = True) -> Optional[ctypes.CDLL]:
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
-    with _lock:
+    # Hot-path callers pass block=False: while another thread (prewarm) holds
+    # the lock for the up-to-120s first compile, they get None immediately and
+    # use their pure-Python fallback instead of stalling the stream.
+    if not _lock.acquire(blocking=block):
+        return None
+    try:
         if _lib is not None or _load_failed:
             return _lib
         path = _build()
@@ -106,7 +111,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.nns_ring_free.restype = None
         lib.nns_ring_free.argtypes = [ctypes.c_void_p]
         _lib = lib
-    return _lib
+        return _lib
+    finally:
+        _lock.release()
 
 
 def available() -> bool:
@@ -136,7 +143,7 @@ def _to_u8(data) -> np.ndarray:
 
 def crc32(data, seed: int = 0) -> int:
     a = _to_u8(data)
-    lib = _load()
+    lib = _load(block=False)
     if lib is None:
         import zlib
 
@@ -153,7 +160,7 @@ def strip_stride(src, rows: int, row_bytes: int, src_stride: int) -> np.ndarray:
     flat = _to_u8(src)
     if flat.nbytes < rows * src_stride - (src_stride - row_bytes):
         raise ValueError("source smaller than rows*stride")
-    lib = _load()
+    lib = _load(block=False)
     if lib is None:
         view = np.lib.stride_tricks.as_strided(
             flat, shape=(rows, row_bytes), strides=(src_stride, 1)
@@ -172,7 +179,7 @@ def wire_gather(segments: list):
     Returns a buffer-protocol object (memoryview on the native path — no
     second copy; ``socket.sendall`` and slicing both accept it)."""
     arrs = [_to_u8(s) for s in segments]
-    lib = _load()
+    lib = _load(block=False)
     if lib is None:
         import struct as _struct
         import zlib
@@ -193,7 +200,7 @@ def wire_gather(segments: list):
 
 def wire_check(payload, crc: int) -> bool:
     a = _to_u8(payload)
-    lib = _load()
+    lib = _load(block=False)
     if lib is None:
         import zlib
 

@@ -157,6 +157,11 @@ def flash_attention(
     Uses the Pallas kernel on TPU backends (or anywhere when
     ``interpret=True`` is forced); otherwise — including non-tiling shapes —
     falls back to :func:`attention_reference`.
+
+    TPU-kernel shape requirements (else the XLA fallback runs): ``S_q`` a
+    multiple of ``block_q``, ``S_kv`` of ``block_k``, and head dim ``D`` a
+    multiple of 128 (Mosaic DMA lane tiling).  Llama-2-7B's head_dim=128
+    qualifies; the toy test presets (head_dim 32/64) intentionally fall back.
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
