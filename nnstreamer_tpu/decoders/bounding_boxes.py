@@ -35,6 +35,24 @@ from ..core.types import TensorsSpec
 from ..ops.nms import center_to_corner, nms_numpy
 from .base import Decoder, load_labels
 
+def _ssd_topk(boxes, scores, k: int):
+    """Pure-JAX SSD prefilter shared by the fused device_fn and the unfused
+    _device_topk path (they must stay numerically identical — both feed
+    ``_decode_one``'s "triple" contract): per-anchor class argmax + top-k.
+    boxes [B,N,4], scores [B,N,C] -> ([B,K,4] f32, [B,K] f32, [B,K] i32)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    s = scores.reshape(scores.shape[0], scores.shape[1], -1)
+    cls = jnp.argmax(s, axis=-1).astype(jnp.int32)
+    sc = jnp.max(s, axis=-1)
+    top_sc, idx = lax.top_k(sc, k)
+    top_b = jnp.take_along_axis(
+        boxes.reshape(boxes.shape[0], -1, 4), idx[..., None], axis=1)
+    top_c = jnp.take_along_axis(cls, idx, axis=1)
+    return (top_b.astype(jnp.float32), top_sc.astype(jnp.float32), top_c)
+
+
 _PALETTE = np.array(
     [
         [230, 25, 75, 255], [60, 180, 75, 255], [255, 225, 25, 255],
@@ -116,18 +134,8 @@ class BoundingBoxes(Decoder):
 
         fn = getattr(self, "_topk_fn", None)
         if fn is None:
-            @jax.jit
-            def fn(b, s):
-                s = s.reshape(s.shape[0], s.shape[1], -1)
-                cls = jnp.argmax(s, axis=-1).astype(jnp.int32)  # [B, N]
-                sc = jnp.max(s, axis=-1)                        # [B, N]
-                top_sc, idx = jax.lax.top_k(sc, k)              # [B, K]
-                top_b = jnp.take_along_axis(
-                    b.reshape(b.shape[0], -1, 4), idx[..., None], axis=1)
-                top_c = jnp.take_along_axis(cls, idx, axis=1)
-                return top_b, top_sc, top_c
-
-            self._topk_fn = fn
+            fn = self._topk_fn = jax.jit(
+                lambda b, s: _ssd_topk(b, s, k))
         tb, ts, tc = fn(jnp.asarray(boxes), jnp.asarray(scores))
         return np.asarray(tb), np.asarray(ts), np.asarray(tc)
 
@@ -159,6 +167,85 @@ class BoundingBoxes(Decoder):
                 }
             )
         return self._draw(detections), detections
+
+    # -- fusion ------------------------------------------------------------
+    # The whole prefilter joins the fused XLA program: per-anchor class
+    # argmax + top-k run on device, only [B,K] candidates cross to the host
+    # (async D2H started by the fused stage), and threshold/NMS/overlay
+    # resolve in ``host_post`` at the sink edge.  The fused path emits ONE
+    # buffer per (possibly batched) input with stacked overlays [B,H,W,4]
+    # and per-frame ``meta["detections"]`` lists; the unfused host path
+    # keeps the reference's one-video-frame-per-buffer un-batching.
+    def device_fn(self, in_spec: TensorsSpec):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..core.types import TensorSpec
+
+        fmt = self.format
+        if fmt in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd"):
+            if len(in_spec) < 2:
+                return None
+            bshape = in_spec[0].shape  # (B, N, 4)
+            if len(bshape) != 3:
+                return None
+            batch, n = bshape[0], bshape[1]
+            k = min(4 * self.max_detections, n)
+
+            def fn(arrays):
+                return _ssd_topk(arrays[0], arrays[1], k)
+
+        elif fmt in ("yolov5", "yolov8", "yolo"):
+            if len(in_spec) != 1 or len(in_spec[0].shape) != 3:
+                return None
+            batch, n, width = in_spec[0].shape
+            if width < 5:
+                return None
+            k = min(4 * self.max_detections, n)
+
+            def fn(arrays):
+                pred = arrays[0].astype(jnp.float32)
+                xywh, obj, cls = pred[..., :4], pred[..., 4], pred[..., 5:]
+                sc_all = (obj[..., None] * cls if cls.shape[-1]
+                          else obj[..., None])
+                classes = jnp.argmax(sc_all, axis=-1).astype(jnp.int32)
+                sc = jnp.max(sc_all, axis=-1)
+                top_sc, idx = lax.top_k(sc, k)
+                cx, cy = xywh[..., 0], xywh[..., 1]
+                w2, h2 = xywh[..., 2] / 2, xywh[..., 3] / 2
+                boxes = jnp.stack(
+                    [cx - w2, cy - h2, cx + w2, cy + h2], axis=-1)
+                top_b = jnp.take_along_axis(boxes, idx[..., None], axis=1)
+                top_c = jnp.take_along_axis(classes, idx, axis=1)
+                return (top_b, top_sc, top_c)
+
+        else:
+            return None
+
+        out_spec = TensorsSpec((
+            TensorSpec.from_shape((batch, k, 4), np.float32),
+            TensorSpec.from_shape((batch, k), np.float32),
+            TensorSpec.from_shape((batch, k), np.int32),
+        ))
+        return fn, out_spec
+
+    def host_post(self, arrays, buf: Buffer) -> Buffer:
+        tb = np.asarray(arrays[0], np.float32)
+        ts = np.asarray(arrays[1], np.float32)
+        tc = np.asarray(arrays[2])
+        b = tb.shape[0]
+        overlays, dets = [], []
+        for i in range(b):
+            overlay, d = self._decode_one(("triple", (tb[i], ts[i], tc[i])))
+            overlays.append(overlay)
+            dets.append(d)
+        if b == 1:
+            new = buf.with_tensors([overlays[0]], spec=None)
+            new.meta["detections"] = dets[0]
+            return new
+        new = buf.with_tensors([np.stack(overlays)], spec=None)
+        new.meta["detections"] = dets
+        return new
 
     def _decode_ssd(self, tensors):
         boxes = np.asarray(tensors[0], np.float32).reshape(-1, 4)

@@ -94,6 +94,74 @@ class PoseEstimation(Decoder):
         out.meta["keypoints"] = keypoints
         return out
 
+    # -- fusion ------------------------------------------------------------
+    # Heatmap argmax runs inside the fused XLA program; only [B,K] indices
+    # and scores (plus the first-K offset pairs, replicating the host
+    # path's math bit-for-bit) cross to the host with async D2H in flight.
+    # Keypoint dicts and the skeleton overlay resolve in ``host_post`` at
+    # the sink edge.  Batched fused output is ONE buffer with stacked
+    # overlays [B,H,W,4] (same shape the host path's batched decode emits).
+    def device_fn(self, in_spec: TensorsSpec):
+        import jax.numpy as jnp
+
+        from ..core.types import TensorSpec
+
+        shape = in_spec[0].shape
+        if len(shape) != 4:
+            return None
+        batch, hh, hw, k = shape
+        self._fused_grid = (hh, hw)
+        have_off = len(in_spec) > 1
+
+        def fn(arrays):
+            hm = arrays[0].astype(jnp.float32)
+            b = hm.shape[0]
+            flat = hm.reshape(b, -1, k)
+            idx = jnp.argmax(flat, axis=1).astype(jnp.int32)  # [B, K]
+            score = jnp.take_along_axis(flat, idx[:, None, :], axis=1)[:, 0]
+            outs = [idx, score.astype(jnp.float32)]
+            if have_off:
+                off = arrays[1].astype(jnp.float32).reshape(b, -1, 2)[:, :k]
+                outs.append(off)
+            return tuple(outs)
+
+        specs = [
+            TensorSpec.from_shape((batch, k), np.int32),
+            TensorSpec.from_shape((batch, k), np.float32),
+        ]
+        if have_off:
+            specs.append(TensorSpec.from_shape((batch, k, 2), np.float32))
+        return fn, TensorsSpec(tuple(specs))
+
+    def host_post(self, arrays, buf: Buffer) -> Buffer:
+        hh, hw = self._fused_grid
+        idx = np.asarray(arrays[0])
+        scores = np.asarray(arrays[1], np.float32)
+        off = np.asarray(arrays[2], np.float32) if len(arrays) > 2 else None
+        b, k = idx.shape
+        overlays, kps_all = [], []
+        for i in range(b):
+            ys, xs = np.unravel_index(idx[i], (hh, hw))
+            px = (xs + 0.5) / hw * self.out_w
+            py = (ys + 0.5) / hh * self.out_h
+            if off is not None:
+                px = px + off[i, :, 0] / hw * self.out_w
+                py = py + off[i, :, 1] / hh * self.out_h
+            kps = [
+                {"x": float(px[j]), "y": float(py[j]),
+                 "score": float(scores[i, j])}
+                for j in range(k)
+            ]
+            overlays.append(self._draw(kps))
+            kps_all.append(kps)
+        if b == 1:
+            new = buf.with_tensors([overlays[0]], spec=None)
+            new.meta["keypoints"] = kps_all[0]
+            return new
+        new = buf.with_tensors([np.stack(overlays)], spec=None)
+        new.meta["keypoints"] = kps_all
+        return new
+
     def _draw(self, kps) -> np.ndarray:
         overlay = np.zeros((self.out_h, self.out_w, 4), np.uint8)
         green = np.array([60, 220, 60, 255], np.uint8)

@@ -210,7 +210,15 @@ class VideoTestSrc(SourceElement):
 @register_element("audiotestsrc")
 class AudioTestSrc(SourceElement):
     """Deterministic audio: sine wave.  Props: ``freq``, ``samplesperbuffer``,
-    ``num-buffers``, ``rate``, ``channels``, ``format`` (S16LE/F32LE/U8)."""
+    ``num-buffers``, ``rate``, ``channels``, ``format`` (S16LE/F32LE/U8).
+
+    TPU-first extension (same shape as videotestsrc's): ``device=true``
+    synthesizes the sine **on device** as a jitted XLA program and emits
+    batched float32 ``other/tensors`` windows ``[batch, samplesperbuffer]``
+    that stay in HBM — zero host->device traffic.  In device mode
+    ``num-buffers`` counts WINDOWS (the frame analog), channels=1, and the
+    format is float32.
+    """
 
     kind = "audiotestsrc"
 
@@ -222,19 +230,63 @@ class AudioTestSrc(SourceElement):
         self.sample_rate = int(self.props.get("rate", 44100))
         self.channels = int(self.props.get("channels", 1))
         self.format = str(self.props.get("format", "S16LE"))
+        self.device = bool(self.props.get("device", False))
+        self.batch = int(self.props.get("batch", 1))
 
     def configure(self, in_caps, out_pads):
-        caps = Caps.new(
-            MediaType.AUDIO,
-            format=self.format,
-            rate=self.sample_rate,
-            channels=self.channels,
-        )
+        if self.device:
+            spec = TensorsSpec.from_string(
+                f"{self.spb}:{self.batch}", "float32")
+            caps = Caps.tensors(spec)
+        else:
+            caps = Caps.new(
+                MediaType.AUDIO,
+                format=self.format,
+                rate=self.sample_rate,
+                channels=self.channels,
+            )
         self.out_caps = {p: caps for p in out_pads}
         return self.out_caps
 
+    def _device_batch_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        spb, rate, freq = self.spb, self.sample_rate, self.freq
+
+        def one(n0, j):  # batch row j -> [spb] float32 sine
+            # Exact int32 sample index folded by the sample rate: for
+            # integer freq, n -> n+rate shifts phase by whole cycles (sin
+            # unchanged), and n < rate keeps float32 phase math exact.
+            # n0 < rate (caller folds with Python ints — no overflow) and
+            # j*spb <= batch*spb, so the sum stays well within int32.
+            n = jnp.mod(n0 + j * spb + jnp.arange(spb, dtype=jnp.int32), rate)
+            return jnp.sin(2 * jnp.pi * freq * n.astype(jnp.float32) / rate)
+
+        @jax.jit
+        def make(n0):
+            return jax.vmap(lambda j: one(n0, j))(jnp.arange(self.batch))
+
+        return make
+
     def generate(self):
         num = self.num_buffers if self.num_buffers >= 0 else 1 << 62
+        if self.device:
+            make = self._device_batch_fn()
+            emitted = 0
+            i = 0
+            while emitted < num:
+                # Base sample index folded by `rate` in exact Python ints
+                # (exact wrap: see _device_batch_fn).
+                arr = make((i * self.batch * self.spb) % self.sample_rate)
+                take = min(self.batch, num - emitted)
+                if take < self.batch:
+                    arr = arr[:take]
+                pts = int(1e9 * emitted * self.spb / self.sample_rate)
+                yield Buffer([arr], pts=pts)
+                emitted += take
+                i += 1
+            return
         t0 = 0
         for i in range(num):
             n = np.arange(t0, t0 + self.spb, dtype=np.float64)

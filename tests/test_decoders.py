@@ -224,3 +224,96 @@ def test_bounding_boxes_device_topk_matches_host(rng):
         np.testing.assert_allclose(
             [d["score"] for d in dd], [d["score"] for d in dh], rtol=1e-6
         )
+
+
+class TestFusedDecodePaths:
+    """device_fn + host_post (the fused deferred-D2H path) must reproduce
+    the host ``decode`` results for every decoder that offers fusion."""
+
+    def _run_fused(self, dec, tensors):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.core.types import TensorsSpec
+
+        spec = TensorsSpec.of(tensors)
+        df = dec.device_fn(spec)
+        assert df is not None
+        fn, out_spec = df
+        outs = fn(tuple(jnp.asarray(t) for t in tensors))
+        assert len(outs) == len(out_spec)
+        host = [np.asarray(o) for o in outs]
+        return dec.host_post(host, Buffer(host))
+
+    def test_bounding_boxes_ssd_fused_matches_host(self):
+        rng = np.random.default_rng(3)
+        n, c = 64, 7
+        boxes = np.sort(rng.random((1, n, 4), np.float32), axis=-1)
+        scores = rng.random((1, n, c)).astype(np.float32) * 0.6
+        scores[0, 5, 2] = 0.97  # one clear winner avoids tie-order flakes
+        d = BoundingBoxes({"option1": "ssd", "option3": "0.9",
+                           "option4": "64:64"})
+        fused = self._run_fused(d, [boxes, scores])
+        host = d.decode([boxes, scores], Buffer([boxes, scores]))
+        hd = host[0].meta["detections"] if isinstance(host, list) else host.meta["detections"]
+        fd = fused.meta["detections"]
+        assert len(fd) == len(hd) == 1
+        assert fd[0]["class_index"] == hd[0]["class_index"] == 2
+        np.testing.assert_allclose(fd[0]["box"], hd[0]["box"], rtol=1e-6)
+
+    def test_bounding_boxes_yolo_fused_matches_host(self):
+        d = BoundingBoxes({"option1": "yolov5", "option4": "64:64"})
+        pred = np.zeros((1, 4, 9), np.float32)
+        pred[0, 0] = [0.5, 0.5, 0.2, 0.2, 0.9, 0, 0.8, 0, 0]
+        pred[0, 1] = [0.2, 0.2, 0.1, 0.1, 0.1, 0, 0, 0, 0.3]
+        fused = self._run_fused(d, [pred])
+        dets = fused.meta["detections"]
+        assert len(dets) == 1
+        assert dets[0]["class_index"] == 1
+        np.testing.assert_allclose(dets[0]["box"], [0.4, 0.4, 0.6, 0.6],
+                                   atol=1e-6)
+
+    def test_bounding_boxes_fused_batched_stacks(self):
+        rng = np.random.default_rng(5)
+        boxes = np.sort(rng.random((3, 32, 4), np.float32), axis=-1)
+        scores = rng.random((3, 32, 6)).astype(np.float32)
+        d = BoundingBoxes({"option1": "ssd", "option3": "0.5",
+                           "option4": "32:32"})
+        fused = self._run_fused(d, [boxes, scores])
+        assert fused.tensors[0].shape == (3, 32, 32, 4)
+        assert len(fused.meta["detections"]) == 3
+
+    def test_pose_fused_matches_host(self):
+        k = 17
+        hm = np.zeros((1, 8, 8, k), np.float32)
+        for i in range(k):
+            hm[0, i % 8, (i * 3) % 8, i] = 1.0
+        off = np.zeros((1, 8, 8, 2 * k), np.float32)
+        d = PoseEstimation({"option2": "80:80"})
+        fused = self._run_fused(d, [hm, off])
+        host = d.decode([hm[0], off[0]], Buffer([hm[0]]))
+        for a, b in zip(fused.meta["keypoints"], host.meta["keypoints"]):
+            assert a["x"] == pytest.approx(b["x"], abs=1e-4)
+            assert a["y"] == pytest.approx(b["y"], abs=1e-4)
+            assert a["score"] == pytest.approx(b["score"], abs=1e-6)
+        np.testing.assert_array_equal(fused.tensors[0], host.tensors[0])
+
+    def test_segment_fused_matches_host(self):
+        rng = np.random.default_rng(11)
+        x = rng.random((2, 16, 16, 7)).astype(np.float32)
+        d = ImageSegment({})
+        fused = self._run_fused(d, [x])
+        assert fused.tensors[0].shape == (2, 16, 16, 4)
+        for i in range(2):
+            host = d.decode([x[i]], Buffer([x[i]]))
+            np.testing.assert_array_equal(fused.tensors[0][i], host.tensors[0])
+            np.testing.assert_array_equal(
+                fused.meta["class_map"][i], host.meta["class_map"])
+
+    def test_segment_device_output_is_one_byte_per_pixel(self):
+        from nnstreamer_tpu.core.types import TensorsSpec
+
+        d = ImageSegment({})
+        fn, out_spec = d.device_fn(
+            TensorsSpec.of([np.zeros((2, 8, 8, 5), np.float32)]))
+        assert out_spec[0].dtype == np.uint8
+        assert out_spec[0].shape == (2, 8, 8)

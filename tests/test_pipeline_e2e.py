@@ -209,3 +209,51 @@ def test_image_labeling_fused_matches_host():
     assert a.meta["label"] == b.meta["label"]
     np.testing.assert_allclose(a.meta["score"], b.meta["score"], rtol=1e-6)
     assert bytes(a.tensors[0]) == bytes(b.tensors[0])
+
+
+def test_detection_decoder_fuses_and_defers():
+    """Config #2 topology: transform+filter+bounding_boxes fuse into ONE XLA
+    stage; NMS/overlay resolve lazily at the sink (host_post), one buffer
+    per batch with per-frame detections in meta."""
+    desc = (
+        "videotestsrc device=true batch=2 num-buffers=4 width=64 height=64 "
+        "pattern=ball name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_filter framework=jax model=ssd_mobilenet "
+        "custom=size:64,classes:5,batch:2 name=f ! "
+        "tensor_decoder mode=bounding_boxes option3=0.3 option4=64:64 ! "
+        "tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, fuse=True)
+    fused = [s for s in p.stages if len(s.node_ids) > 1]
+    assert fused and len(fused[0].node_ids) == 3  # transform+filter+decoder
+    with p:
+        bufs = [p.pull("out", timeout=120) for _ in range(2)]
+        p.wait(timeout=60)
+    for b in bufs:
+        assert b.tensors[0].shape == (2, 64, 64, 4)
+        assert len(b.meta["detections"]) == 2
+        for frame_dets in b.meta["detections"]:
+            for det in frame_dets:
+                assert set(det) == {"box", "score", "class_index", "label"}
+
+
+def test_audiotestsrc_device_matches_host_sine():
+    """Device-generated windows must match the host sine path sample-for-
+    sample (float32 tolerance)."""
+    from nnstreamer_tpu.elements.source import AudioTestSrc
+
+    host = AudioTestSrc({"format": "F32LE", "samplesperbuffer": 800,
+                         "rate": 16000, "num_buffers": 4})
+    host.configure({}, ["src"])
+    host_windows = [b.tensors[0][:, 0] for b in host.generate()]
+
+    dev = AudioTestSrc({"device": True, "batch": 2, "samplesperbuffer": 800,
+                        "rate": 16000, "num_buffers": 4})
+    dev.configure({}, ["src"])
+    bufs = list(dev.generate())
+    assert len(bufs) == 2  # 4 windows, batch=2
+    got = np.concatenate([np.asarray(b.tensors[0]) for b in bufs], axis=0)
+    want = np.stack(host_windows)
+    # float32 sine vs the host's float64 path: ~1e-4 amplitude tolerance
+    np.testing.assert_allclose(got, want, atol=2e-4)
