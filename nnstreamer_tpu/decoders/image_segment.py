@@ -79,6 +79,10 @@ class ImageSegment(Decoder):
 
     def host_post(self, arrays, buf: Buffer) -> Buffer:
         classes = np.asarray(arrays[0]).astype(np.int64)
+        if classes.ndim == 3 and classes.shape[0] == 1:
+            # Collapse batch-1 like the host decode path (np.squeeze) so
+            # the output honors the negotiated one-frame RGBA caps.
+            classes = classes[0]
         overlay = _COLORS[classes % len(_COLORS)]
         out = buf.with_tensors([overlay], spec=None)
         out.meta["class_map"] = classes

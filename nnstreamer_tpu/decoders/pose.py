@@ -70,25 +70,31 @@ class PoseEstimation(Decoder):
             hm = frames[0]
         return self._decode_one([hm] + list(tensors[1:]), buf)
 
+    def _keypoints(self, idx, scores, off, hh: int, hw: int):
+        """Flat heatmap argmax indices -> keypoint dicts.  The ONLY place
+        the coordinate math lives: both the host decode path and the fused
+        ``host_post`` call it, so they cannot diverge."""
+        ys, xs = np.unravel_index(idx, (hh, hw))
+        # scale heatmap coords to overlay pixels
+        px = (xs + 0.5) / hw * self.out_w
+        py = (ys + 0.5) / hh * self.out_h
+        if off is not None:  # short-range offsets (K,2) in heatmap cells
+            px = px + off[:, 0] / hw * self.out_w
+            py = py + off[:, 1] / hh * self.out_h
+        return [
+            {"x": float(px[i]), "y": float(py[i]), "score": float(scores[i])}
+            for i in range(len(idx))
+        ]
+
     def _decode_one(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
         hm = np.asarray(tensors[0], np.float32)
         hh, hw, k = hm.shape
         flat = hm.reshape(-1, k)
         idx = flat.argmax(axis=0)
         scores = flat[idx, np.arange(k)]
-        ys, xs = np.unravel_index(idx, (hh, hw))
-        # scale heatmap coords to overlay pixels
-        px = (xs + 0.5) / hw * self.out_w
-        py = (ys + 0.5) / hh * self.out_h
-        if len(tensors) > 1:  # short-range offsets (K,2) in heatmap cells
-            off = np.asarray(tensors[1], np.float32).reshape(-1, 2)[:k]
-            px = px + off[:, 0] / hw * self.out_w
-            py = py + off[:, 1] / hh * self.out_h
-
-        keypoints = [
-            {"x": float(px[i]), "y": float(py[i]), "score": float(scores[i])}
-            for i in range(k)
-        ]
+        off = (np.asarray(tensors[1], np.float32).reshape(-1, 2)[:k]
+               if len(tensors) > 1 else None)
+        keypoints = self._keypoints(idx, scores, off, hh, hw)
         overlay = self._draw(keypoints)
         out = buf.with_tensors([overlay], spec=None)
         out.meta["keypoints"] = keypoints
@@ -138,20 +144,12 @@ class PoseEstimation(Decoder):
         idx = np.asarray(arrays[0])
         scores = np.asarray(arrays[1], np.float32)
         off = np.asarray(arrays[2], np.float32) if len(arrays) > 2 else None
-        b, k = idx.shape
+        b = idx.shape[0]
         overlays, kps_all = [], []
         for i in range(b):
-            ys, xs = np.unravel_index(idx[i], (hh, hw))
-            px = (xs + 0.5) / hw * self.out_w
-            py = (ys + 0.5) / hh * self.out_h
-            if off is not None:
-                px = px + off[i, :, 0] / hw * self.out_w
-                py = py + off[i, :, 1] / hh * self.out_h
-            kps = [
-                {"x": float(px[j]), "y": float(py[j]),
-                 "score": float(scores[i, j])}
-                for j in range(k)
-            ]
+            kps = self._keypoints(
+                idx[i], scores[i], off[i] if off is not None else None,
+                hh, hw)
             overlays.append(self._draw(kps))
             kps_all.append(kps)
         if b == 1:

@@ -70,15 +70,25 @@ PRESETS: Dict[str, LlamaConfig] = {
 }
 
 
-def init_params(cfg: LlamaConfig, seed: int = 0) -> Dict:
-    """Deterministic-random params; layer weights stacked on a leading axis."""
-    import jax
+def init_params(cfg: LlamaConfig, seed: int = 0, dtype="float32") -> Dict:
+    """Deterministic-random params; layer weights stacked on a leading axis.
 
+    ``dtype`` is the storage dtype of the generated weights.  7B-scale runs
+    pass ``bfloat16`` so the full parameter set is materialized directly on
+    device at 2 bytes/param (13.5 GB — fits one v5e chip's HBM; an f32
+    intermediate would not), standing in for a real checkpoint upload the
+    zero-egress environment can't do.  Real checkpoints enter by filling
+    the same pytree layout.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
     k_embed, k_layers, k_out = jax.random.split(jax.random.PRNGKey(seed), 3)
 
     def norm_init(key, shape, fan_in):
-        return (jax.random.normal(key, shape, np.float32)
-                * np.sqrt(2.0 / max(1, fan_in)))
+        scale = np.sqrt(2.0 / max(1, fan_in)).astype(np.float32)
+        return jax.random.normal(key, shape, dt) * scale.astype(dt)
 
     L, D, H, Hkv, F = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
                        cfg.ffn_hidden)
@@ -396,8 +406,12 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     seed = int(opts.get("seed", 0))
-    params = init_params(cfg, seed=seed)
     dtype = opts.get("dtype", "bfloat16")
+    # param_dtype=bfloat16 generates weights directly at 2 bytes/param on
+    # device (required to fit 7B in one chip's HBM); default float32 keeps
+    # the test presets' numerics unchanged.
+    params = init_params(cfg, seed=seed,
+                         dtype=opts.get("param_dtype", "float32"))
 
     def apply_fn(params, tokens):
         return forward(params, tokens, cfg, compute_dtype=dtype)

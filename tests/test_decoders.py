@@ -287,7 +287,9 @@ class TestFusedDecodePaths:
         hm = np.zeros((1, 8, 8, k), np.float32)
         for i in range(k):
             hm[0, i % 8, (i * 3) % 8, i] = 1.0
-        off = np.zeros((1, 8, 8, 2 * k), np.float32)
+        # Non-zero offsets: the offset application path must match too.
+        off = np.linspace(-0.4, 0.4, 8 * 8 * 2 * k).astype(
+            np.float32).reshape(1, 8, 8, 2 * k)
         d = PoseEstimation({"option2": "80:80"})
         fused = self._run_fused(d, [hm, off])
         host = d.decode([hm[0], off[0]], Buffer([hm[0]]))
@@ -308,6 +310,17 @@ class TestFusedDecodePaths:
             np.testing.assert_array_equal(fused.tensors[0][i], host.tensors[0])
             np.testing.assert_array_equal(
                 fused.meta["class_map"][i], host.meta["class_map"])
+
+    def test_segment_fused_batch1_squeezes(self):
+        rng = np.random.default_rng(13)
+        x = rng.random((1, 8, 8, 5)).astype(np.float32)
+        d = ImageSegment({})
+        fused = self._run_fused(d, [x])
+        host = d.decode([x[0]], Buffer([x[0]]))
+        assert fused.tensors[0].shape == (8, 8, 4)  # batch-1 collapsed
+        np.testing.assert_array_equal(fused.tensors[0], host.tensors[0])
+        np.testing.assert_array_equal(fused.meta["class_map"],
+                                      host.meta["class_map"])
 
     def test_segment_device_output_is_one_byte_per_pixel(self):
         from nnstreamer_tpu.core.types import TensorsSpec
