@@ -33,6 +33,73 @@ import time
 
 _SOURCE_QUEUE_CAPACITY = 4
 
+#: Peak dense-matmul throughput per chip by device kind (bf16 FLOP/s) —
+#: public spec-sheet numbers, used only for the MFU report field.
+_PEAK_FLOPS = {
+    "tpu v5 lite": 197e12, "tpu v5e": 197e12,
+    "tpu v5p": 459e12, "tpu v5": 459e12,
+    "tpu v4": 275e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12,
+}
+
+
+def _peak_flops_per_chip():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+def _fused_stage_flops(p):
+    """FLOPs of the pipeline's fused XLA program per batch, from the
+    compiled executable's own cost analysis (no hand-counted model tables).
+    None when there is no fused stage or the backend can't report it."""
+    try:
+        import jax.numpy as jnp
+
+        for s in p.stages:
+            el = s.element
+            fn = getattr(el, "_fn", None)
+            in_spec = getattr(el, "_in_spec", None)
+            if fn is None or in_spec is None:
+                continue
+            args = tuple(jnp.zeros(t.shape, t.dtype) for t in in_spec)
+            ca = fn.lower(args).compile().cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else {}
+            fl = float(ca.get("flops", 0.0))
+            if fl > 0:
+                return fl
+            # e.g. a fused pure-preprocess stage: keep looking for the
+            # model's fused stage.
+    except Exception:  # noqa: BLE001 - report field only, never fail a bench
+        return None
+    return None
+
+
+def _add_mfu(r: dict, p, batch: int) -> dict:
+    """mfu = achieved model FLOP/s / chip peak (VERDICT r1 item #9)."""
+    flops = _fused_stage_flops(p)
+    peak = _peak_flops_per_chip()
+    if flops and peak:
+        r["flops_per_batch"] = round(flops)
+        r["mfu"] = round((r["value"] / batch) * flops / peak, 4)
+    return r
+
+
+def _stage_breakdown() -> dict:
+    """p50 ms of each pipeline stage's processing timer for the run."""
+    from nnstreamer_tpu.core.log import metrics as _m
+
+    snap = _m.snapshot()
+    out = {}
+    for name, v in snap.items():
+        if name.endswith(".proc.p50") or name.endswith(".push.p50"):
+            out[name.rsplit(".p50", 1)[0]] = round(v * 1e3, 2)
+    return out
+
 
 def _stats(lat, batch, batches, wall, metric, baseline_fps, unit,
            e2e=None):
@@ -66,6 +133,11 @@ def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
     frames = [make_frame(i) for i in range(4)]
     push_ts = {}
     lat = []
+
+    from nnstreamer_tpu.core.log import metrics as _metrics
+
+    _metrics.reset()  # per-bench stage timers (global registry otherwise
+    # accumulates across --config all runs and mixes pipelines)
 
     # Deep in-flight window: fused chains are ONE async stage, so queue
     # capacity bounds how many batches pipeline H2D/compute/D2H.  Keep total
@@ -107,8 +179,11 @@ def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
     wall = t1 - t0
     if not lat:  # --batches 1 leaves no steady-state gap; report the wall
         lat = [wall]
-    return _stats(lat, batch, batches, wall, metric, baseline_fps, unit,
-                  e2e=e2e)
+    r = _stats(lat, batch, batches, wall, metric, baseline_fps, unit,
+               e2e=e2e)
+    _add_mfu(r, p, batch)
+    r["stages"] = _stage_breakdown()
+    return r
 
 
 def bench_classification(batch: int, batches: int, size: int, warmup: int,
@@ -174,7 +249,9 @@ def _source_driven_bench(desc: str, batch: int, batches: int, warmup: int,
     runner burns warmup+_drain_batches() pulls before timing.
     ``pulls_per_batch`` accounts for decoders that un-batch."""
     import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics as _metrics
 
+    _metrics.reset()  # per-bench stage timers
     p = nt.Pipeline(desc, fuse=True, queue_capacity=_SOURCE_QUEUE_CAPACITY)
     lat = []
     with p:
@@ -193,6 +270,8 @@ def _source_driven_bench(desc: str, batch: int, batches: int, warmup: int,
     wall = t1 - t0
     r = _stats(lat, batch, batches, wall, metric, baseline_fps, "frames/sec")
     r["source"] = source
+    _add_mfu(r, p, batch)
+    r["stages"] = _stage_breakdown()
     return r
 
 

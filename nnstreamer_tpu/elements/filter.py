@@ -33,6 +33,32 @@ from .base import Element, ElementError, SRC
 log = logger(__name__)
 
 
+def _parse_input_combination(s: str) -> Optional[List[int]]:
+    """``input-combination=0,2`` — indices of the incoming buffer's tensors
+    fed to the model (reference: tensor_filter_common.c input-combination)."""
+    s = s.strip()
+    if not s:
+        return None
+    return [int(v) for v in s.split(",")]
+
+
+def _parse_output_combination(s: str) -> Optional[List[Tuple[str, int]]]:
+    """``output-combination=i0,o0`` — compose the output buffer from input
+    tensors (``iN``, pass-through) and model outputs (``oN``); bare digits
+    mean ``oN`` (reference: tensor_filter_common.c output-combination)."""
+    s = s.strip()
+    if not s:
+        return None
+    combo: List[Tuple[str, int]] = []
+    for tok in s.split(","):
+        tok = tok.strip().lower()
+        if tok.startswith(("i", "o")):
+            combo.append((tok[0], int(tok[1:])))
+        else:
+            combo.append(("o", int(tok)))
+    return combo
+
+
 def _load_framework(props: Dict[str, object]) -> Framework:
     """framework= name or 'auto' (priority list from config)."""
     fw_name = str(props.get("framework", "auto")).lower()
@@ -72,6 +98,13 @@ class TensorFilter(Element):
         self._out_spec: Optional[TensorsSpec] = None
         self._lat_ema: Optional[float] = None
         self._n_invoked = 0
+        import threading
+
+        self._fw_lock = threading.Lock()  # process vs reload_model swap
+        self.input_combination = _parse_input_combination(
+            str(self.props.get("input_combination", "")))
+        self.output_combination = _parse_output_combination(
+            str(self.props.get("output_combination", "")))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -104,14 +137,26 @@ class TensorFilter(Element):
             )
         src = next(iter(in_caps.values()), Caps.any())
         up_spec = src.spec
+        self._up_spec = up_spec
+        # input-combination selects which upstream tensors feed the model:
+        # the spec check applies to the SELECTED subset.
+        model_up = up_spec
+        if up_spec is not None and self.input_combination is not None:
+            if any(i >= len(up_spec) for i in self.input_combination):
+                raise ElementError(
+                    f"{self.name}: input-combination {self.input_combination} "
+                    f"out of range for upstream spec {up_spec}")
+            model_up = TensorsSpec(
+                tuple(up_spec[i] for i in self.input_combination),
+                rate=up_spec.rate)
         if fw_in is None:
-            fw_in = up_spec
-        elif up_spec is not None and not up_spec.is_flexible:
-            if len(up_spec) != len(fw_in) or not all(
-                a.is_compatible(b) for a, b in zip(up_spec, fw_in)
+            fw_in = model_up
+        elif model_up is not None and not model_up.is_flexible:
+            if len(model_up) != len(fw_in) or not all(
+                a.is_compatible(b) for a, b in zip(model_up, fw_in)
             ):
                 raise ElementError(
-                    f"{self.name}: upstream spec {up_spec} does not match model "
+                    f"{self.name}: upstream spec {model_up} does not match model "
                     f"input {fw_in}"
                 )
         self._in_spec = fw_in
@@ -120,16 +165,52 @@ class TensorFilter(Element):
             if fw_out is None:
                 fw_in2, fw_out = fw.get_model_info()
         self._out_spec = fw_out
+        final_out = self._combined_out_spec(fw_out)
         fmt = TensorFormat.FLEXIBLE if self.invoke_dynamic else TensorFormat.STATIC
-        if fw_out is not None:
-            fw_out = fw_out.replace(format=fmt)
-        caps = Caps.tensors(fw_out)
+        if final_out is not None:
+            final_out = final_out.replace(format=fmt)
+        caps = Caps.tensors(final_out)
         self.out_caps = {p: caps for p in out_pads}
         return self.out_caps
 
+    def _combined_out_spec(self, fw_out):
+        """Output spec after output-combination (iN = upstream tensor,
+        oN = model output)."""
+        if self.output_combination is None:
+            return fw_out
+        parts = []
+        for tag, i in self.output_combination:
+            pool = self._up_spec if tag == "i" else fw_out
+            if pool is None or i >= len(pool):
+                return None  # unknown statically; derived per buffer
+            parts.append(pool[i])
+        return TensorsSpec(tuple(parts))
+
+    def _select_inputs(self, tensors):
+        if self.input_combination is None:
+            return list(tensors)
+        if any(i >= len(tensors) for i in self.input_combination):
+            raise ElementError(
+                f"{self.name}: input-combination {self.input_combination} "
+                f"out of range (buffer has {len(tensors)} tensors)")
+        return [tensors[i] for i in self.input_combination]
+
+    def _compose_outputs(self, in_tensors, outs):
+        if self.output_combination is None:
+            return list(outs)
+        final = []
+        for tag, i in self.output_combination:
+            pool = in_tensors if tag == "i" else outs
+            if i >= len(pool):
+                raise ElementError(
+                    f"{self.name}: output-combination {tag}{i} out of range")
+            final.append(pool[i])
+        return final
+
     # -- streaming ---------------------------------------------------------
     def process(self, pad, buf: Buffer):
-        fw = self._ensure_fw()
+        with self._fw_lock:  # pairs with reload_model's swap
+            fw = self._ensure_fw()
         if getattr(fw, "streaming", False):
             # Streaming frameworks (llm) emit MANY buffers per input; the
             # runner iterates this generator, so each token flows downstream
@@ -137,8 +218,10 @@ class TensorFilter(Element):
             # streams tokens as flexible tensors).
             def stream():
                 t0 = time.perf_counter()
-                for i, outs in enumerate(fw.invoke_stream(buf.tensors)):
-                    out_buf = buf.with_tensors(list(outs), spec=None)
+                ins = self._select_inputs(buf.tensors)
+                for i, outs in enumerate(fw.invoke_stream(ins)):
+                    final = self._compose_outputs(buf.tensors, list(outs))
+                    out_buf = buf.with_tensors(final, spec=None)
                     out_buf.meta["stream_index"] = i
                     yield (SRC, out_buf)
                 dt = time.perf_counter() - t0
@@ -148,14 +231,21 @@ class TensorFilter(Element):
 
             return stream()
         t0 = time.perf_counter()
-        outs = fw.invoke(buf.tensors)
+        with self._fw_lock:
+            # Held across the invoke so reload_model cannot close the
+            # framework out from under an in-flight call.  No contention
+            # cost: invokes are already serialized on the stage thread.
+            outs = fw.invoke(self._select_inputs(buf.tensors))
         dt = time.perf_counter() - t0
         self._n_invoked += 1
         if self.latency_report:
             metrics.observe_latency(f"{self.name}.invoke", dt)
             self._lat_ema = dt if self._lat_ema is None else 0.9 * self._lat_ema + 0.1 * dt
-        spec = self._out_spec if not self.invoke_dynamic else None
-        return [(SRC, buf.with_tensors(list(outs), spec=spec))]
+        final = self._compose_outputs(buf.tensors, list(outs))
+        spec = None
+        if not self.invoke_dynamic:
+            spec = self._combined_out_spec(self._out_spec)
+        return [(SRC, buf.with_tensors(final, spec=spec))]
 
     # -- fusion ------------------------------------------------------------
     def device_fn(self, in_spec: TensorsSpec):
@@ -168,7 +258,68 @@ class TensorFilter(Element):
             _, out_spec = fw.get_model_info()
         if out_spec is None:
             return None
-        return fn, out_spec
+        if self.input_combination is None and self.output_combination is None:
+            return fn, out_spec
+        # Combinations fuse too: select/compose around the model fn.
+        combined = self._combined_out_spec(out_spec)
+        if combined is None:
+            return None  # statically unknown output: host path handles it
+
+        combo_in, combo_out = self.input_combination, self.output_combination
+
+        def wrapped(arrays):
+            model_in = (tuple(arrays[i] for i in combo_in)
+                        if combo_in is not None else arrays)
+            outs = fn(model_in)
+            if combo_out is None:
+                return outs
+            return tuple(
+                (arrays if tag == "i" else outs)[i] for tag, i in combo_out)
+
+        return wrapped, combined
+
+    # -- model reload (reference: tensor_filter_common.c ReloadModel) ------
+    def reload_model(self, model: Optional[object] = None) -> None:
+        """Swap the model without rebuilding the pipeline.
+
+        Builds a fresh framework instance from the element's props (with
+        ``model`` overridden when given), verifies the new model's I/O spec
+        still matches what was negotiated, then atomically swaps it in —
+        in-flight ``process`` calls finish on the old instance.  NOTE: a
+        filter already compiled into a FUSED stage keeps running the old
+        jitted program (XLA traced it at plan time); reload applies to the
+        element's own invoke path, matching the reference's per-element
+        semantics.
+        """
+        props = dict(self.props)
+        if model is not None:
+            props["model"] = model
+        new_fw = _load_framework(props)
+        new_in, new_out = new_fw.get_model_info()
+        for have, new, what in ((self._in_spec, new_in, "input"),
+                                (self._out_spec, new_out, "output")):
+            if have is not None and new is not None and not have.is_flexible:
+                if len(have) != len(new) or not all(
+                    a.is_compatible(b) for a, b in zip(have, new)
+                ):
+                    new_fw.close()
+                    raise ElementError(
+                        f"{self.name}: reload {what} spec {new} does not "
+                        f"match negotiated {have}")
+        if new_in is not None:
+            new_fw.set_input_spec(self._in_spec or new_in)
+        with self._fw_lock:
+            # The lock also guards in-flight invokes (process holds it for
+            # the whole call), so closing old here cannot race one.
+            old, self.fw = self.fw, new_fw
+            if old is not None and not getattr(old, "streaming", False):
+                old.close()
+            # Streaming frameworks may have a live generator still decoding
+            # on the old instance: drop the reference and let GC release
+            # its device buffers when the stream finishes.
+        if model is not None:
+            self.props["model"] = model
+        log.info("%s: model reloaded (%s)", self.name, props.get("model"))
 
     # -- introspection (reference: latency/throughput read-only props) -----
     @property

@@ -23,7 +23,7 @@ from ..core.buffer import Buffer, Event
 from ..core.caps import Caps, MediaType, parse_caps_string, video_bpp
 from ..core.registry import register_element
 from ..core.types import TensorsSpec, parse_fraction
-from .base import SourceElement, SRC
+from .base import ElementError, SourceElement, SRC
 
 
 @register_element("appsrc")
@@ -332,14 +332,40 @@ class FileSrc(SourceElement):
             yield Buffer([np.frombuffer(data[off : off + self.blocksize], np.uint8)])
 
 
+#: IIO scan-element wire formats: name -> (numpy dtype, is_signed)
+_IIO_FORMATS = {
+    "s16le": np.dtype("<i2"), "u16le": np.dtype("<u2"),
+    "s32le": np.dtype("<i4"), "u32le": np.dtype("<u4"),
+    "s8": np.dtype("i1"), "u8": np.dtype("u1"),
+    "f32le": np.dtype("<f4"), "f64le": np.dtype("<f8"),
+}
+
+
 @register_element("tensor_src_iio")
 class TensorSrcIIO(SourceElement):
-    """Industrial-I/O sensor source (reference: gsttensor_srciio.c).
+    """Industrial-I/O sensor source (reference: ``gsttensor_srciio.c``).
 
-    No IIO bus exists in this environment; the element reads from a
-    pluggable sampler callable (``sampler`` prop or a registered synthetic
-    default) at ``frequency`` Hz, preserving the reference's buffered-scan
-    semantics (``buffer-capacity`` samples per emitted tensor).
+    The reference reads buffered scans from an IIO character device
+    (``/dev/iio:deviceN``): interleaved per-channel raw samples, converted
+    to processed values via each channel's scale/offset, ``buffer-capacity``
+    samples per emitted buffer, paced by a trigger.  This element keeps
+    those semantics against any byte stream:
+
+    * ``device=<path>`` — a file, FIFO, or char device of interleaved raw
+      records; ``device=tcp://host:port`` — the same records over a socket
+      (sensors are remote in a TPU-pod deployment).
+    * ``scan-format`` (default ``s16le``) — per-channel wire format;
+      ``channels`` — channels per record; processed value =
+      ``(raw + offset) * scale`` (IIO convention; default offset 0 scale 1).
+    * ``buffer-capacity`` samples per emitted ``[capacity, channels]``
+      float32 tensor; short tail reads are dropped (a partial scan never
+      violates the negotiated caps).
+    * ``trigger=data`` (default) emits as soon as a full scan is read;
+      ``trigger=timer`` paces emission at ``frequency`` Hz (the reference's
+      sysfs-trigger analog).
+    * With no ``device``, a pluggable ``sampler`` callable (or the builtin
+      deterministic pseudo-sensor) generates samples — the hermetic-test
+      mode, also used when no sensor bus exists.
     """
 
     kind = "tensor_src_iio"
@@ -351,6 +377,20 @@ class TensorSrcIIO(SourceElement):
         self.channels = int(self.props.get("channels", 3))
         self.num_buffers = int(self.props.get("num_buffers", 16))
         self.sampler = self.props.get("sampler")  # callable i -> np[channels]
+        self.device = str(self.props.get("device", "") or "")
+        fmt = str(self.props.get("scan_format", "s16le")).lower()
+        if fmt not in _IIO_FORMATS:
+            raise ElementError(
+                f"{self.name}: unknown scan-format {fmt!r} "
+                f"(one of {sorted(_IIO_FORMATS)})")
+        self.scan_dtype = _IIO_FORMATS[fmt]
+        self.scale = float(self.props.get("scale", 1.0))
+        self.offset = float(self.props.get("offset", 0.0))
+        self.trigger = str(self.props.get("trigger", "data")).lower()
+        if self.trigger not in ("data", "timer"):
+            raise ElementError(
+                f"{self.name}: trigger must be data|timer, got {self.trigger!r}")
+        self._stream = None
 
     def configure(self, in_caps, out_pads):
         spec = TensorsSpec.from_string(
@@ -360,9 +400,102 @@ class TensorSrcIIO(SourceElement):
         self.out_caps = {p: caps for p in out_pads}
         return self.out_caps
 
+    # -- device backend ----------------------------------------------------
+    def start(self) -> None:
+        if not self.device:
+            return
+        if self.device.startswith("tcp://"):
+            import socket as _socket
+
+            host, port = self.device[6:].rsplit(":", 1)
+            try:
+                sock = _socket.create_connection((host, int(port)), timeout=5.0)
+            except OSError as e:
+                raise ElementError(
+                    f"{self.name}: cannot reach sensor stream "
+                    f"{self.device}: {e}") from e
+            # Short timeout: _read_scan polls the stop event between
+            # recv()s, so a paused sender never blocks pipeline shutdown.
+            sock.settimeout(0.2)
+            self._sock = sock
+            self._stream = None
+        else:
+            try:
+                self._stream = open(self.device, "rb")
+            except OSError as e:
+                raise ElementError(
+                    f"{self.name}: cannot open device {self.device!r}: {e}"
+                ) from e
+
+    def stop(self) -> None:
+        if self._stream is not None:
+            try:
+                self._stream.close()
+            except OSError:
+                pass
+            self._stream = None
+        sock = getattr(self, "_sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _read_scan(self, stop) -> Optional[np.ndarray]:
+        """One full buffered scan: [capacity, channels] processed float32,
+        or None at EOF / short tail / stop."""
+        import socket as _socket
+
+        need = self.capacity * self.channels * self.scan_dtype.itemsize
+        if self._stream is not None:
+            data = self._stream.read(need)
+            if data is None or len(data) < need:
+                return None
+        else:  # socket: accumulate with stop-aware timeouts
+            parts, got = [], 0
+            while got < need:
+                if stop.is_set():
+                    return None
+                try:
+                    chunk = self._sock.recv(need - got)
+                except _socket.timeout:
+                    continue
+                except OSError:
+                    return None
+                if not chunk:
+                    return None  # sender closed
+                parts.append(chunk)
+                got += len(chunk)
+            data = b"".join(parts)
+        raw = np.frombuffer(data, self.scan_dtype).astype(np.float32)
+        raw = raw.reshape(self.capacity, self.channels)
+        return (raw + np.float32(self.offset)) * np.float32(self.scale)
+
     def generate(self):
+        import time as _time
+
+        stop = getattr(self, "_stop_event", threading.Event())
+        num = self.num_buffers if self.num_buffers >= 0 else 1 << 62
+        period = (self.capacity / self.frequency) if self.frequency > 0 else 0.0
+        next_t = _time.monotonic()
+        if self.device:
+            for i in range(num):
+                if stop.is_set():
+                    return
+                scan = self._read_scan(stop)
+                if scan is None:
+                    return  # sensor stream ended: EOS
+                if self.trigger == "timer":
+                    next_t += period
+                    delay = next_t - _time.monotonic()
+                    if delay > 0 and stop.wait(delay):
+                        return
+                pts = int(1e9 * i * self.capacity / max(self.frequency, 1e-9))
+                yield Buffer([scan], pts=pts)
+            return
         i = 0
-        for _ in range(self.num_buffers):
+        for _ in range(num):
             rows = []
             for _ in range(self.capacity):
                 if callable(self.sampler):
