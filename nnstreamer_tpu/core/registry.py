@@ -60,6 +60,7 @@ _BUILTIN_MODULES = [
     "nnstreamer_tpu.elements.mqtt",
     "nnstreamer_tpu.elements.grpc_io",
     "nnstreamer_tpu.filters.custom_easy",
+    "nnstreamer_tpu.filters.custom_so",
     "nnstreamer_tpu.filters.jax_fw",
     "nnstreamer_tpu.filters.python3",
     "nnstreamer_tpu.filters.llm",

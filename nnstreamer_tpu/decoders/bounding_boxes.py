@@ -79,6 +79,18 @@ class BoundingBoxes(Decoder):
         self.out_w, self.out_h = int(w), int(h)
         self.iou_threshold = float(self.option(5) or 0.5)
         self.max_detections = int(self.option(6) or 100)
+        # option7: where greedy NMS runs when the decoder is fused.
+        # "host" (default) = top-k prefilter on device, NMS at the sink
+        # edge; "device" = the whole decode (threshold+NMS) inside the
+        # fused XLA program via ops.nms.nms_jax — only final detections
+        # ever cross to the host.
+        nms_opt = (self.option(7) or "host").lower()
+        if nms_opt.startswith("nms:"):
+            nms_opt = nms_opt[4:]
+        if nms_opt not in ("host", "device"):
+            raise ValueError(f"option7 (nms placement) must be host|device, "
+                             f"got {nms_opt!r}")
+        self.nms_mode = nms_opt
 
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
         return Caps.new(
@@ -222,6 +234,36 @@ class BoundingBoxes(Decoder):
         else:
             return None
 
+        if self.nms_mode == "device":
+            import jax
+
+            from ..ops.nms import nms_jax
+
+            m = self.max_detections
+            thr, iou_thr = self.threshold, self.iou_threshold
+
+            def fn_nms(arrays):
+                tb, ts, tc = fn(arrays)
+                masked = jnp.where(ts >= thr, ts, -jnp.inf)
+
+                def per_frame(b, s):
+                    idx, valid = nms_jax(b, s, iou_thr, m)
+                    return (jnp.take(b, idx, axis=0),
+                            jnp.where(valid, jnp.take(s, idx), 0.0),
+                            idx, valid)
+
+                kb, ks, kidx, kv = jax.vmap(per_frame)(tb, masked)
+                kc = jnp.take_along_axis(tc, kidx, axis=1)
+                return (kb, ks, kc, kv.astype(jnp.uint8))
+
+            out_spec = TensorsSpec((
+                TensorSpec.from_shape((batch, m, 4), np.float32),
+                TensorSpec.from_shape((batch, m), np.float32),
+                TensorSpec.from_shape((batch, m), np.int32),
+                TensorSpec.from_shape((batch, m), np.uint8),
+            ))
+            return fn_nms, out_spec
+
         out_spec = TensorsSpec((
             TensorSpec.from_shape((batch, k, 4), np.float32),
             TensorSpec.from_shape((batch, k), np.float32),
@@ -233,10 +275,27 @@ class BoundingBoxes(Decoder):
         tb = np.asarray(arrays[0], np.float32)
         ts = np.asarray(arrays[1], np.float32)
         tc = np.asarray(arrays[2])
+        valid = np.asarray(arrays[3]).astype(bool) if len(arrays) > 3 else None
         b = tb.shape[0]
         overlays, dets = [], []
         for i in range(b):
-            overlay, d = self._decode_one(("triple", (tb[i], ts[i], tc[i])))
+            if valid is not None:
+                # device-NMS path: arrays ARE the final detections
+                d = [
+                    {
+                        "box": [float(v) for v in tb[i, j]],
+                        "score": float(ts[i, j]),
+                        "class_index": int(tc[i, j]),
+                        "label": (self.labels[int(tc[i, j])]
+                                  if int(tc[i, j]) < len(self.labels)
+                                  else str(int(tc[i, j]))),
+                    }
+                    for j in range(tb.shape[1]) if valid[i, j]
+                ]
+                overlay = self._draw(d)
+            else:
+                overlay, d = self._decode_one(
+                    ("triple", (tb[i], ts[i], tc[i])))
             overlays.append(overlay)
             dets.append(d)
         if b == 1:

@@ -233,8 +233,11 @@ class TensorFilter(Element):
         t0 = time.perf_counter()
         with self._fw_lock:
             # Held across the invoke so reload_model cannot close the
-            # framework out from under an in-flight call.  No contention
-            # cost: invokes are already serialized on the stage thread.
+            # framework out from under an in-flight call; re-read self.fw
+            # here — a reload may have swapped it since the earlier peek.
+            # No contention cost: invokes are serialized on the stage
+            # thread anyway.
+            fw = self._ensure_fw()
             outs = fw.invoke(self._select_inputs(buf.tensors))
         dt = time.perf_counter() - t0
         self._n_invoked += 1

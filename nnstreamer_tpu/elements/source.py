@@ -390,7 +390,10 @@ class TensorSrcIIO(SourceElement):
         if self.trigger not in ("data", "timer"):
             raise ElementError(
                 f"{self.name}: trigger must be data|timer, got {self.trigger!r}")
-        self._stream = None
+        self._fd = None
+        self._sock = None
+        self._is_fifo = False
+        self._saw_data = False
 
     def configure(self, in_caps, out_pads):
         spec = TensorsSpec.from_string(
@@ -418,22 +421,34 @@ class TensorSrcIIO(SourceElement):
             # recv()s, so a paused sender never blocks pipeline shutdown.
             sock.settimeout(0.2)
             self._sock = sock
-            self._stream = None
+            self._fd = None
         else:
+            import os as _os
+
             try:
-                self._stream = open(self.device, "rb")
+                # O_NONBLOCK: FIFOs/char devices must never block shutdown —
+                # _read_scan polls the stop event between reads.  Harmless
+                # for regular files.
+                self._fd = _os.open(self.device,
+                                    _os.O_RDONLY | _os.O_NONBLOCK)
+                import stat as _stat
+
+                self._is_fifo = _stat.S_ISFIFO(_os.fstat(self._fd).st_mode)
             except OSError as e:
                 raise ElementError(
                     f"{self.name}: cannot open device {self.device!r}: {e}"
                 ) from e
 
     def stop(self) -> None:
-        if self._stream is not None:
+        fd = getattr(self, "_fd", None)
+        if fd is not None:
+            import os as _os
+
             try:
-                self._stream.close()
+                _os.close(fd)
             except OSError:
                 pass
-            self._stream = None
+            self._fd = None
         sock = getattr(self, "_sock", None)
         if sock is not None:
             try:
@@ -444,16 +459,40 @@ class TensorSrcIIO(SourceElement):
 
     def _read_scan(self, stop) -> Optional[np.ndarray]:
         """One full buffered scan: [capacity, channels] processed float32,
-        or None at EOF / short tail / stop."""
+        or None at EOF / short tail / stop.  Both paths poll the stop
+        event so a stalled sensor never blocks pipeline shutdown."""
+        import os as _os
+        import select as _select
         import socket as _socket
 
         need = self.capacity * self.channels * self.scan_dtype.itemsize
-        if self._stream is not None:
-            data = self._stream.read(need)
-            if data is None or len(data) < need:
-                return None
+        parts, got = [], 0
+        if getattr(self, "_fd", None) is not None:
+            fd = self._fd
+            while got < need:
+                if stop.is_set():
+                    return None
+                r, _, _ = _select.select([fd], [], [], 0.2)
+                if not r:
+                    continue
+                try:
+                    chunk = _os.read(fd, need - got)
+                except BlockingIOError:
+                    continue
+                except OSError:
+                    return None
+                if chunk == b"":
+                    # FIFO before any writer connects reads as EOF: keep
+                    # waiting for the sensor until data has flowed once.
+                    if self._is_fifo and not self._saw_data:
+                        if stop.wait(0.05):
+                            return None
+                        continue
+                    return None  # real EOF
+                self._saw_data = True
+                parts.append(chunk)
+                got += len(chunk)
         else:  # socket: accumulate with stop-aware timeouts
-            parts, got = [], 0
             while got < need:
                 if stop.is_set():
                     return None
@@ -467,7 +506,7 @@ class TensorSrcIIO(SourceElement):
                     return None  # sender closed
                 parts.append(chunk)
                 got += len(chunk)
-            data = b"".join(parts)
+        data = b"".join(parts)
         raw = np.frombuffer(data, self.scan_dtype).astype(np.float32)
         raw = raw.reshape(self.capacity, self.channels)
         return (raw + np.float32(self.offset)) * np.float32(self.scale)

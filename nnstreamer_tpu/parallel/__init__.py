@@ -19,3 +19,10 @@ from .sharding import (  # noqa: F401
     shard_params,
 )
 from .ring import ring_attention, ring_attention_local  # noqa: F401
+from .distributed import (  # noqa: F401
+    global_device_count,
+    global_mesh,
+    initialize as distributed_initialize,
+    is_initialized as distributed_is_initialized,
+    local_device_count,
+)

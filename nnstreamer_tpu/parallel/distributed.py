@@ -1,0 +1,103 @@
+"""Multi-host runtime initialization (the DCN side of the comm backend).
+
+Reference analog (SURVEY §2.7/§5.8): the reference's multi-machine story
+is nnstreamer-edge TCP/MQTT point-to-point — every cross-host hop moves
+tensors through sockets.  The TPU-native equivalent splits the job:
+
+* **ICI**: collectives INSIDE a pod slice (data/tensor/sequence sharding
+  over a ``Mesh``) — XLA-inserted, never touching host code;
+* **DCN**: cross-pod / host-level coordination — ``jax.distributed``
+  (one controller process per host, all devices become globally
+  addressable), while the stream-feed layer stays on the framework wire
+  protocol (query/edge elements).
+
+This module wraps ``jax.distributed`` so pipelines can opt in with env
+vars alone (the standard cluster launch shape), and provides
+``global_mesh`` for building meshes over every process's devices.
+
+Single-process (the common case, and all CI here): everything degrades to
+local devices with no coordinator.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core.log import logger
+
+log = logger(__name__)
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host runtime.  Args default from the standard env
+    vars (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID`` — also set by TPU pod launchers).  Returns True if
+    a multi-process runtime was initialized, False for the single-process
+    fallback.  Idempotent."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        np_env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(np_env) if np_env is not None else None
+    if process_id is None:
+        pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+
+    if not coordinator_address:
+        # Single process is the quiet default ONLY with no coordinator
+        # configured at all; a coordinator with missing counts falls
+        # through to jax.distributed.initialize, which auto-detects (TPU
+        # pods) or fails loudly — never a silent local-only mesh.
+        log.debug("single-process runtime (no coordinator configured)")
+        return False
+    if num_processes == 1:
+        log.debug("single-process runtime (num_processes=1)")
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info("joined distributed runtime: process %s of %s via %s",
+             process_id, num_processes, coordinator_address)
+    return True
+
+
+def global_device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def local_device_count() -> int:
+    import jax
+
+    return len(jax.local_devices())
+
+
+def global_mesh(**axes: int):
+    """Mesh over ALL processes' devices (== :func:`make_mesh` over
+    ``jax.devices()``, which is global after :func:`initialize`).  Axis
+    sizes multiply to the global device count; one axis may be -1 to
+    absorb the rest (make_mesh semantics)."""
+    from .mesh import make_mesh
+
+    return make_mesh(**axes)

@@ -1,0 +1,152 @@
+"""Loadable custom-filter ABI tests: compile real .so filters with the
+system toolchain and drive them through the framework and a pipeline
+(reference analogs: tensor_filter_custom.c / tensor_filter_cpp.cc and the
+custom_example_* .so's in the reference's test tree — SURVEY §2.3/§4)."""
+
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters.custom_so import include_dir
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain")
+
+_CPP_SCALER = r"""
+#include <cstring>
+#include <cstdlib>
+#include "nnstpu_cppclass.hh"
+
+// scale:<f> parsed from the custom= prop string.
+class Scaler : public nnstpu::Filter {
+ public:
+  explicit Scaler(const char *props) : scale_(2.0f) {
+    const char *p = std::strstr(props, "scale:");
+    if (p) scale_ = std::strtof(p + 6, nullptr);
+  }
+  int getInputInfo(nnstpu_tensors_info *i) override {
+    i->num = 1;
+    i->info[0].rank = 2;
+    i->info[0].dims[0] = 2;
+    i->info[0].dims[1] = 3;
+    i->info[0].dtype = NNSTPU_FLOAT32;
+    return 0;
+  }
+  int getOutputInfo(nnstpu_tensors_info *i) override { return getInputInfo(i); }
+  int invoke(const void *const *in, void *const *out) override {
+    const float *x = static_cast<const float *>(in[0]);
+    float *y = static_cast<float *>(out[0]);
+    for (int k = 0; k < 6; ++k) y[k] = x[k] * scale_;
+    return 0;
+  }
+ private:
+  float scale_;
+};
+NNSTPU_REGISTER_FILTER(Scaler)
+"""
+
+_C_VTABLE = r"""
+/* Hand-rolled C vtable (no C++ class sugar): u8 -> i32 cast + add. */
+#include <stdlib.h>
+#include "nnstpu_custom.h"
+
+static void *c_init(const char *props) { (void)props; return malloc(1); }
+static void c_finish(void *p) { free(p); }
+static int c_in(void *p, nnstpu_tensors_info *i) {
+  (void)p;
+  i->num = 1;
+  i->info[0].rank = 1;
+  i->info[0].dims[0] = 4;
+  i->info[0].dtype = NNSTPU_UINT8;
+  return 0;
+}
+static int c_out(void *p, nnstpu_tensors_info *i) {
+  (void)p;
+  i->num = 1;
+  i->info[0].rank = 1;
+  i->info[0].dims[0] = 4;
+  i->info[0].dtype = NNSTPU_INT32;
+  return 0;
+}
+static int c_invoke(void *p, const void *const *in, void *const *out) {
+  (void)p;
+  const unsigned char *x = (const unsigned char *)in[0];
+  int *y = (int *)out[0];
+  for (int k = 0; k < 4; ++k) y[k] = (int)x[k] + 100;
+  return 0;
+}
+static const nnstpu_custom_class vt = {
+    NNSTPU_CUSTOM_ABI_VERSION, c_init, c_finish, c_in, c_out, c_invoke};
+const nnstpu_custom_class *nnstpu_custom_get(void) { return &vt; }
+"""
+
+
+def _build(tmp_path, name, source, cpp=True):
+    src = tmp_path / f"{name}.{'cc' if cpp else 'c'}"
+    src.write_text(source)
+    so = tmp_path / f"lib{name}.so"
+    subprocess.run(
+        [("g++" if cpp else "gcc"), "-O2", "-shared", "-fPIC",
+         f"-I{include_dir()}", "-o", str(so), str(src)],
+        check=True, capture_output=True, timeout=120)
+    return str(so)
+
+
+def test_cpp_class_filter_single_shot(tmp_path):
+    so = _build(tmp_path, "scaler", _CPP_SCALER)
+    s = nt.SingleShot(framework="custom", model=so, custom="scale:3.0")
+    assert s.in_spec[0].shape == (2, 3)
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = s.invoke(x)
+    np.testing.assert_allclose(out[0], 3.0 * x)
+    s.close()
+
+
+def test_c_vtable_filter_dtype_mapping(tmp_path):
+    so = _build(tmp_path, "adder", _C_VTABLE, cpp=False)
+    s = nt.SingleShot(framework="custom", model=so)
+    assert s.in_spec[0].dtype == np.uint8
+    assert s.out_spec[0].dtype == np.int32
+    out = s.invoke(np.array([1, 2, 3, 4], np.uint8))
+    np.testing.assert_array_equal(out[0], [101, 102, 103, 104])
+    s.close()
+
+
+def test_so_filter_in_pipeline(tmp_path):
+    so = _build(tmp_path, "pscaler", _CPP_SCALER)
+    p = nt.Pipeline(
+        f"appsrc name=src ! tensor_filter framework=custom model={so} "
+        "custom=scale:2.0 ! tensor_sink name=out",
+        fuse=False,
+    )
+    with p:
+        x = np.ones((2, 3), np.float32)
+        p.push("src", x)
+        out = p.pull("out", timeout=15)
+        p.eos()
+        p.wait(timeout=15)
+    np.testing.assert_allclose(out.tensors[0], 2.0 * x)
+
+
+def test_missing_symbol_rejected(tmp_path):
+    src = tmp_path / "empty.cc"
+    src.write_text("extern \"C\" int unrelated(void) { return 0; }\n")
+    so = tmp_path / "libempty.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(so), str(src)],
+                   check=True, capture_output=True, timeout=120)
+    from nnstreamer_tpu.filters.base import FrameworkError
+    from nnstreamer_tpu.filters.custom_so import CustomSoFramework
+
+    with pytest.raises(FrameworkError, match="nnstpu_custom_get"):
+        CustomSoFramework().open({"model": str(so)})
+
+
+def test_bad_path_rejected():
+    from nnstreamer_tpu.filters.base import FrameworkError
+    from nnstreamer_tpu.filters.custom_so import CustomSoFramework
+
+    with pytest.raises(FrameworkError, match="existing .so"):
+        CustomSoFramework().open({"model": "no/such/filter.so"})

@@ -330,3 +330,42 @@ class TestFusedDecodePaths:
             TensorsSpec.of([np.zeros((2, 8, 8, 5), np.float32)]))
         assert out_spec[0].dtype == np.uint8
         assert out_spec[0].shape == (2, 8, 8)
+
+    def test_bounding_boxes_device_nms_matches_host(self):
+        """option7=device runs threshold+greedy NMS inside the fused
+        program; detections must match the host NMS path (distinct scores
+        avoid tie-order ambiguity)."""
+        rng = np.random.default_rng(7)
+        n = 48
+        boxes = np.sort(rng.random((1, n, 4), np.float32), axis=-1)
+        # distinct, well-separated scores
+        scores = np.zeros((1, n, 3), np.float32)
+        scores[0, :, 1] = np.linspace(0.95, 0.05, n)
+        host_dec = BoundingBoxes({"option1": "ssd", "option3": "0.4",
+                                  "option4": "64:64"})
+        dev_dec = BoundingBoxes({"option1": "ssd", "option3": "0.4",
+                                 "option4": "64:64", "option7": "device"})
+        fused = self._run_fused(dev_dec, [boxes, scores])
+        host = self._run_fused(host_dec, [boxes, scores])
+        fd, hd = fused.meta["detections"], host.meta["detections"]
+        assert len(fd) == len(hd) > 0
+        for a, b in zip(fd, hd):
+            assert a["class_index"] == b["class_index"]
+            assert a["score"] == pytest.approx(b["score"], abs=1e-5)
+            np.testing.assert_allclose(a["box"], b["box"], atol=1e-6)
+        np.testing.assert_array_equal(fused.tensors[0], host.tensors[0])
+
+    def test_device_nms_respects_max_detections(self):
+        rng = np.random.default_rng(9)
+        # far-apart boxes -> nothing suppressed; cap must bound output
+        n = 32
+        centers = np.linspace(0.05, 0.95, n, dtype=np.float32)
+        boxes = np.stack([centers - 0.01, centers - 0.01,
+                          centers + 0.01, centers + 0.01], axis=-1)[None]
+        scores = rng.random((1, n, 2)).astype(np.float32) * 0.4 + 0.5
+        d = BoundingBoxes({"option1": "ssd", "option3": "0.1",
+                           "option4": "32:32", "option6": "5",
+                           "option7": "device"})
+        fused = self._run_fused(d, [boxes, scores])
+        dets = fused.meta["detections"]  # B==1 collapses to one frame's list
+        assert len(dets) == 5

@@ -332,3 +332,39 @@ def test_iio_tcp_backend():
     np.testing.assert_allclose(np.asarray(b0.tensors[0]), want[:capacity])
     np.testing.assert_allclose(np.asarray(b1.tensors[0]), want[capacity:])
     srv.close()
+
+
+def test_iio_fifo_backend_and_clean_shutdown(tmp_path):
+    """FIFO sensor: reader must wait for the writer, deliver scans, and —
+    critically — never hang pipeline shutdown when the writer stalls."""
+    import os
+    import threading as th
+    import time as _t
+
+    channels, capacity = 2, 4
+    fifo = str(tmp_path / "sensor.fifo")
+    os.mkfifo(fifo)
+    raw = np.arange(capacity * channels, dtype="<i2")
+
+    def write_one_then_stall():
+        fd = os.open(fifo, os.O_WRONLY)
+        os.write(fd, raw.tobytes())
+        _t.sleep(30)  # stall: shutdown must not wait for us
+        os.close(fd)
+
+    t = th.Thread(target=write_one_then_stall, daemon=True)
+    t.start()
+    p = nt.Pipeline(
+        f"tensor_src_iio device={fifo} channels={channels} "
+        f"buffer-capacity={capacity} scan-format=s16le num-buffers=-1 ! "
+        "tensor_sink name=out",
+        fuse=False,
+    )
+    t0 = _t.monotonic()
+    with p:
+        b = p.pull("out", timeout=15)
+        np.testing.assert_allclose(
+            np.asarray(b.tensors[0]),
+            raw.astype(np.float32).reshape(capacity, channels))
+        # exit with the writer stalled mid-scan
+    assert _t.monotonic() - t0 < 10, "shutdown hung on a stalled FIFO writer"
