@@ -1,0 +1,93 @@
+"""Loadable custom-filter example: compile a C++ filter to a .so and run it.
+
+Reference analog: the custom_example_* filters in the reference's test tree
+(tensor_filter_custom.c / tensor_filter_cpp.cc usage).  The filter here
+subclasses ``nnstpu::Filter`` (native/include/nnstpu_cppclass.hh) and is
+compiled with the system toolchain at run time; real deployments ship the
+prebuilt .so and just point ``model=`` at it.
+
+    python examples/custom_filter_so.py
+"""
+
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters.custom_so import include_dir
+
+SOURCE = r"""
+#include <cstring>
+#include <cstdlib>
+#include "nnstpu_cppclass.hh"
+
+// Running mean over the innermost dim; `custom=bias:<f>` adds a constant.
+class MeanBias : public nnstpu::Filter {
+ public:
+  explicit MeanBias(const char *props) : bias_(0.f) {
+    const char *p = std::strstr(props, "bias:");
+    if (p) bias_ = std::strtof(p + 5, nullptr);
+  }
+  int getInputInfo(nnstpu_tensors_info *i) override {
+    i->num = 1;
+    i->info[0].rank = 2;       // [4, 8] float32
+    i->info[0].dims[0] = 4;
+    i->info[0].dims[1] = 8;
+    i->info[0].dtype = NNSTPU_FLOAT32;
+    return 0;
+  }
+  int getOutputInfo(nnstpu_tensors_info *i) override {
+    i->num = 1;
+    i->info[0].rank = 1;       // [4] float32
+    i->info[0].dims[0] = 4;
+    i->info[0].dtype = NNSTPU_FLOAT32;
+    return 0;
+  }
+  int invoke(const void *const *in, void *const *out) override {
+    const float *x = static_cast<const float *>(in[0]);
+    float *y = static_cast<float *>(out[0]);
+    for (int r = 0; r < 4; ++r) {
+      float s = 0.f;
+      for (int c = 0; c < 8; ++c) s += x[r * 8 + c];
+      y[r] = s / 8.f + bias_;
+    }
+    return 0;
+  }
+ private:
+  float bias_;
+};
+NNSTPU_REGISTER_FILTER(MeanBias)
+"""
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="nnstpu_custom_")
+    src = os.path.join(tmp, "meanbias.cc")
+    so = os.path.join(tmp, "libmeanbias.so")
+    with open(src, "w") as f:
+        f.write(SOURCE)
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", f"-I{include_dir()}",
+         "-o", so, src],
+        check=True)
+    print(f"built {so}")
+
+    p = nt.Pipeline(
+        f"appsrc name=src ! "
+        f"tensor_filter framework=custom model={so} custom=bias:10.0 ! "
+        "tensor_sink name=out",
+        fuse=False,
+    )
+    with p:
+        x = np.arange(32, dtype=np.float32).reshape(4, 8)
+        p.push("src", x)
+        out = p.pull("out", timeout=30)
+        p.eos()
+        p.wait(timeout=10)
+    print("input row means + 10:", np.asarray(out.tensors[0]))
+
+
+if __name__ == "__main__":
+    main()

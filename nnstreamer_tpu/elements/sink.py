@@ -47,6 +47,18 @@ class TensorSink(SinkElement):
 
     def process(self, pad, buf: Buffer):
         metrics.count(f"{self.name}.frames")
+        if (self.to_host and not self._callbacks and not self.drop
+                and self._q.qsize() < 16):
+            # The app will pop host arrays: start the D2H now so the copy
+            # overlaps the queue dwell time instead of being paid inside
+            # pop() — over a remote/tunneled device this is a full RTT per
+            # buffer off the pull path.  Gated: a drop=true sink may never
+            # pop this buffer, and a backed-up queue (>=16 deep) would turn
+            # prefetch into unbounded host copies + wasted transfer, so
+            # those cases pay the copy lazily at pop as before.
+            for t in buf.tensors:
+                if hasattr(t, "copy_to_host_async"):
+                    t.copy_to_host_async()
         if self._callbacks:
             buf = buf.resolve()
         for cb in self._callbacks:
