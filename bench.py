@@ -313,10 +313,16 @@ def bench_pose(batch: int, batches: int, size: int, warmup: int) -> dict:
 
 
 def bench_audio(batch: int, batches: int, warmup: int,
-                source: str = "audiotestsrc") -> dict:
+                source: str = "audiotestsrc",
+                model: str = "speech_commands") -> dict:
+    """Config #4 names both speech-command AND wav2vec2; ``model`` selects
+    (wav2vec2 emits per-frame vocab logits via flexible output)."""
     import numpy as np
 
     samples = 16000  # 1s windows @16kHz
+    mopts = f"dtype:float32,batch:{batch}"
+    if model == "wav2vec2":
+        mopts += f",samples:{samples}"
     if source == "audiotestsrc":
         # Device-generated windows (the audio analog of the videotestsrc
         # device source): zero H2D in the loop, measures the pipeline.
@@ -324,27 +330,27 @@ def bench_audio(batch: int, batches: int, warmup: int,
         desc = (
             f"audiotestsrc device=true batch={batch} num-buffers={total} "
             f"samplesperbuffer={samples} rate=16000 name=src ! "
-            f"tensor_filter framework=jax model=speech_commands "
-            f"custom=dtype:float32,batch:{batch} name=f ! "
+            f"tensor_filter framework=jax model={model} "
+            f"custom={mopts} name=f ! "
             f"tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY}"
         )
         r = _source_driven_bench(
             desc, batch, batches, warmup,
-            "speech_commands_windows_per_sec_per_chip", 250.0, source,
+            f"{model}_windows_per_sec_per_chip", 250.0, source,
         )
         r["unit"] = "windows/sec"
         return r
     rng = np.random.default_rng(0)
     desc = (
         f"appsrc name=src caps=other/tensors,dimensions={samples}:{batch},types=float32 ! "
-        f"tensor_filter framework=jax model=speech_commands custom=dtype:float32,batch:{batch} name=f ! "
+        f"tensor_filter framework=jax model={model} custom={mopts} name=f ! "
         "tensor_sink name=out"
     )
     r = _pipeline_bench(
         desc,
         lambda i: rng.standard_normal((batch, samples)).astype(np.float32),
         batch, batches, warmup,
-        "speech_commands_windows_per_sec_per_chip", 250.0,
+        f"{model}_windows_per_sec_per_chip", 250.0,
         unit="windows/sec",
     )
     r["source"] = source
@@ -422,6 +428,8 @@ def main() -> int:
                     choices=["audiotestsrc", "appsrc"],
                     help="audio config: device-generated windows (default) "
                          "or host-fed appsrc windows")
+    ap.add_argument("--audio-model", default="speech_commands",
+                    choices=["speech_commands", "wav2vec2"])
     args = ap.parse_args()
 
     runners = {
@@ -432,7 +440,7 @@ def main() -> int:
         "pose": lambda: bench_pose(
             args.batch, args.batches, args.size, args.warmup),
         "audio": lambda: bench_audio(args.batch, args.batches, args.warmup,
-                                     args.audio_source),
+                                     args.audio_source, args.audio_model),
         "llm": lambda: bench_llm(max(1, args.batches // 8), 1,
                                  model=args.llm_model),
         "llm7b": lambda: bench_llm(2, 1, model="llama2_7b"),
