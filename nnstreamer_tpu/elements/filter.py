@@ -219,11 +219,21 @@ class TensorFilter(Element):
             def stream():
                 t0 = time.perf_counter()
                 ins = self._select_inputs(buf.tensors)
+                # One-step lookahead so the FINAL buffer can carry
+                # ``stream_last`` — consumers that must know when a
+                # request's stream ends (tensor_query streaming responses)
+                # need the marker on a data buffer, not a separate event.
+                prev = None
                 for i, outs in enumerate(fw.invoke_stream(ins)):
+                    if prev is not None:
+                        yield (SRC, prev)
                     final = self._compose_outputs(buf.tensors, list(outs))
                     out_buf = buf.with_tensors(final, spec=None)
                     out_buf.meta["stream_index"] = i
-                    yield (SRC, out_buf)
+                    prev = out_buf
+                if prev is not None:
+                    prev.meta["stream_last"] = True
+                    yield (SRC, prev)
                 dt = time.perf_counter() - t0
                 self._n_invoked += 1
                 if self.latency_report:

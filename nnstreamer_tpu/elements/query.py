@@ -44,6 +44,10 @@ log = logger(__name__)
 _META_MSG = "_query_msg"
 _META_CONN = "_query_conn"
 
+#: Placeholder in ``_done`` for a fully-streamed request: advances the
+#: in-order cursor without emitting (its buffers already went downstream).
+_STREAM_DONE = object()
+
 # Server cores shared between a serversrc and its serversink, keyed by the
 # ``id`` property (reference: query server data registry paired by server id).
 _servers: Dict[int, "_ServerCore"] = {}
@@ -237,6 +241,19 @@ class TensorQueryClient(Element):
     reference pairs via GstMetaQuery msg ids), and are pushed downstream
     **asynchronously** in request order — exactly the reference's "(async)
     edge event cb: result arrives -> push result downstream" (SURVEY §3.3).
+
+    Streaming servers (an ``llm`` filter behind the query pair) return MANY
+    responses per request, tagged ``stream_index`` with ``stream_last`` on
+    the final one.  Streamed responses are delivered immediately in arrival
+    order (tokens must not wait on the reorder cursor); request-order
+    reordering applies to plain (one-response) requests only, so
+    interleaving streamed and plain requests on one client trades strict
+    cross-request ordering for live token delivery.  For a streamed
+    request, ``timeout`` bounds the INTER-TOKEN gap (each arriving token is
+    progress and re-arms the clock), not the total generation time; with
+    ``on-timeout=drop`` an aborted stream is terminated downstream by an
+    empty ``stream_last`` + ``stream_aborted`` buffer so aggregating
+    consumers never hang.
     """
 
     kind = "tensor_query_client"
@@ -256,6 +273,8 @@ class TensorQueryClient(Element):
         self._emit_next = 0
         self._pending: Dict[int, Tuple[Buffer, float]] = {}  # id -> (orig, t_sent)
         self._done: Dict[int, Buffer] = {}  # msg id -> response awaiting its turn
+        self._streaming: set = set()  # mids that have streamed >= 1 response
+        self._aborted: set = set()  # timed-out streams: drop late tokens quietly
         self._cv = threading.Condition()
         # Serializes the pop-ready+feed step across the rx thread and the
         # timeout path so in-order delivery holds (never held with _cv).
@@ -324,20 +343,58 @@ class TensorQueryClient(Element):
                     self._rx_error = e
                     self._cv.notify_all()
                 return
-            mid = int(buf.meta.pop(_META_MSG, -1))
-            with self._cv:
-                entry = self._pending.pop(mid, None)
-                if entry is None:
-                    log.warning("%s: unmatched response msg=%d", self.name, mid)
-                    continue
-                orig, _t = entry
-                # Response keeps the request's timing identity.
-                buf.pts = orig.pts
-                buf.seqno = orig.seqno
+            self._handle_response(buf)
+
+    def _handle_response(self, buf: Buffer) -> None:
+        """Pair one received response with its request and deliver it.
+
+        A server pipeline with a streaming filter (llm) returns MANY
+        responses per request, each tagged stream_index and the final one
+        stream_last (the buffers' own meta rides the wire).  Streamed
+        responses are delivered in ARRIVAL order immediately — the
+        per-request reorder machinery applies to plain responses (config
+        #5: "tensor_filter + tensor_query" token streaming).
+        """
+        mid = int(buf.meta.pop(_META_MSG, -1))
+        streamed = "stream_index" in buf.meta
+        emit_now: Optional[Buffer] = None
+        with self._cv:
+            entry = self._pending.get(mid)
+            if entry is None:
+                if mid in self._aborted:
+                    # late tokens of a timed-out (dropped) stream
+                    if buf.meta.get("stream_last"):
+                        self._aborted.discard(mid)
+                    metrics.count(f"{self.name}.late_dropped")
+                else:
+                    log.warning("%s: unmatched response msg=%d",
+                                self.name, mid)
+                return
+            orig, _t = entry
+            # Response keeps the request's timing identity.
+            buf.pts = orig.pts
+            buf.seqno = orig.seqno
+            if streamed:
+                # keep-alive: each token resets the request's timeout
+                self._pending[mid] = (orig, time.monotonic())
+                self._streaming.add(mid)
+                if buf.meta.get("stream_last"):
+                    self._pending.pop(mid)
+                    self._streaming.discard(mid)
+                    self._done[mid] = _STREAM_DONE
+                emit_now = buf
+            else:
+                self._pending.pop(mid)
                 self._done[mid] = buf
-                metrics.count(f"{self.name}.responses")
-                self._cv.notify_all()
-            self._drain_ready()
+            metrics.count(f"{self.name}.responses")
+            self._cv.notify_all()
+        if emit_now is not None:
+            with self._emit_lock:
+                if self._async_emit is None:
+                    raise ElementError(
+                        f"{self.name}: not attached to a pipeline")
+                self._async_emit([(SRC, emit_now)])
+        self._drain_ready()
 
     def _drain_ready(self) -> None:
         """Atomically pop in-order completed responses and feed them
@@ -348,7 +405,9 @@ class TensorQueryClient(Element):
             with self._cv:
                 ready: List[Buffer] = []
                 while self._emit_next in self._done:
-                    ready.append(self._done.pop(self._emit_next))
+                    b = self._done.pop(self._emit_next)
+                    if b is not _STREAM_DONE:  # stream already delivered
+                        ready.append(b)
                     self._emit_next += 1
                 self._cv.notify_all()
             if not ready:
@@ -375,16 +434,30 @@ class TensorQueryClient(Element):
                 if entry is not None:
                     overdue = time.monotonic() - entry[1] - self.timeout
                     if overdue >= 0:
-                        self._pending.pop(self._emit_next)
+                        mid = self._emit_next
+                        self._pending.pop(mid)
                         metrics.count(f"{self.name}.timeouts")
                         if self.on_timeout != "drop":
                             raise ElementError(
                                 f"{self.name}: no response for request "
-                                f"{self._emit_next} within {self.timeout}s"
+                                f"{mid} within {self.timeout}s"
                             )
                         log.warning("%s: request %d timed out; dropped",
-                                    self.name, self._emit_next)
-                        self._emit_next += 1
+                                    self.name, mid)
+                        if mid in self._streaming:
+                            # A partial stream already went downstream:
+                            # terminate it so aggregating consumers never
+                            # hang, and swallow late tokens quietly.  The
+                            # terminator goes through _done so the drain
+                            # emits it and advances the cursor itself.
+                            self._streaming.discard(mid)
+                            self._aborted.add(mid)
+                            term = entry[0].with_tensors([])
+                            term.meta.update(stream_last=True,
+                                             stream_aborted=True)
+                            self._done[mid] = term
+                        else:
+                            self._emit_next += 1
                         drain = True
                     else:
                         self._cv.wait(timeout=min(-overdue, 0.2))

@@ -171,3 +171,146 @@ def test_edge_topic_mismatch_rejected():
         with pytest.raises(Exception, match="rejected"):
             bad.start()
         bad.stop()
+
+
+def test_query_streaming_llm_tokens():
+    """Config #5 as described: token streaming THROUGH tensor_query — one
+    prompt request, many streamed responses (stream_index/stream_last),
+    delivered in generation order."""
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=9 ! "
+        "tensor_filter framework=llm model=llama_tiny "
+        "custom=max_new:6,stream_chunk:3 invoke-dynamic=true ! "
+        "tensor_query_serversink id=9"
+    )
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=60 ! "
+            "tensor_sink name=out"
+        )
+        with cli:
+            cli.push("src", np.array([1, 5, 9, 2], np.int32))
+            toks = [cli.pull("out", timeout=60) for _ in range(6)]
+            assert [b.meta["stream_index"] for b in toks] == list(range(6))
+            assert toks[-1].meta.get("stream_last") is True
+            assert all("stream_last" not in b.meta for b in toks[:-1])
+            ids = [int(np.asarray(b.tensors[0])[0]) for b in toks]
+            assert all(0 <= i for i in ids)
+            cli.eos("src")
+            cli.wait(timeout=30)
+
+    # determinism: direct filter path must produce the same ids
+    from nnstreamer_tpu.filters.llm import LLMFramework
+
+    fw = LLMFramework()
+    fw.open({"model": "llama_tiny", "custom": "max_new:6,stream_chunk:3"})
+    direct = [int(i[0]) for i, _ in fw.invoke_stream(
+        [np.array([1, 5, 9, 2], np.int32)])]
+    assert ids == direct
+
+
+def test_query_streaming_then_plain_requests():
+    """Back-to-back streamed requests on one client: bookkeeping must
+    release each slot (stream_last) and indices restart per request."""
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=10 ! "
+        "tensor_filter framework=llm model=llama_tiny "
+        "custom=max_new:2 invoke-dynamic=true ! "
+        "tensor_query_serversink id=10"
+    )
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=60 ! "
+            "tensor_sink name=out"
+        )
+        with cli:
+            for _ in range(3):  # three prompts, 2 tokens each
+                cli.push("src", np.array([3, 4], np.int32))
+            got = [cli.pull("out", timeout=60) for _ in range(6)]
+            assert [b.meta["stream_index"] for b in got] == [0, 1] * 3
+            cli.eos("src")
+            cli.wait(timeout=30)
+
+
+def _client_harness():
+    """TensorQueryClient with an injected emit collector, no socket."""
+    from nnstreamer_tpu.elements.query import TensorQueryClient, _META_MSG
+
+    cli = TensorQueryClient({"port": 1})
+    emitted = []
+    cli._async_emit = lambda outs: emitted.extend(b for _, b in outs)
+    return cli, emitted, _META_MSG
+
+
+def test_plain_response_waits_for_stream_done_cursor():
+    """A plain response for request 1 arriving BEFORE request 0's stream
+    finishes is held by the reorder cursor, then released when the
+    _STREAM_DONE placeholder advances past request 0."""
+    import time as _time
+
+    cli, emitted, META = _client_harness()
+    now = _time.monotonic()
+    cli._pending = {0: (nt.Buffer([np.zeros(1)]), now),
+                    1: (nt.Buffer([np.zeros(1)]), now)}
+    cli._next_msg = 2
+
+    def resp(mid, **meta):
+        b = nt.Buffer([np.asarray([float(mid)])])
+        b.meta[META] = mid
+        b.meta.update(meta)
+        return b
+
+    # request 1's PLAIN response arrives first: must be held
+    cli._handle_response(resp(1))
+    assert emitted == []
+    # request 0 streams two tokens; each emits immediately
+    cli._handle_response(resp(0, stream_index=0))
+    assert len(emitted) == 1
+    cli._handle_response(resp(0, stream_index=1, stream_last=True))
+    # stream done -> cursor passes 0 -> plain response for 1 released
+    assert len(emitted) == 3
+    assert emitted[0].meta["stream_index"] == 0
+    assert emitted[1].meta["stream_last"] is True
+    assert float(np.asarray(emitted[2].tensors[0])[0]) == 1.0
+    assert cli._pending == {} and cli._done == {}
+
+
+def test_stream_timeout_drop_terminates_downstream():
+    """on-timeout=drop mid-stream: downstream gets an empty stream_last +
+    stream_aborted terminator, and late tokens are swallowed quietly."""
+    import time as _time
+
+    cli, emitted, META = _client_harness()
+    cli.on_timeout = "drop"
+    cli.timeout = 0.01
+    now = _time.monotonic()
+    cli._pending = {0: (nt.Buffer([np.zeros(1)]), now)}
+    cli._next_msg = 1
+
+    tok = nt.Buffer([np.asarray([7.0])])
+    tok.meta[META] = 0
+    tok.meta["stream_index"] = 0
+    cli._handle_response(tok)
+    assert len(emitted) == 1  # first token delivered
+    _time.sleep(0.05)
+    cli._wait_outstanding(1)  # head request now overdue -> dropped
+    assert len(emitted) == 2
+    term = emitted[1]
+    assert term.meta.get("stream_last") is True
+    assert term.meta.get("stream_aborted") is True
+    assert len(term.tensors) == 0
+    # late token after the abort: dropped without an unmatched-warning path
+    late = nt.Buffer([np.asarray([8.0])])
+    late.meta[META] = 0
+    late.meta["stream_index"] = 1
+    cli._handle_response(late)
+    assert len(emitted) == 2
+    assert 0 in cli._aborted
+    fin = nt.Buffer([])
+    fin.meta[META] = 0
+    fin.meta["stream_index"] = 2
+    fin.meta["stream_last"] = True
+    cli._handle_response(fin)
+    assert 0 not in cli._aborted  # abort bookkeeping cleaned up
