@@ -232,8 +232,10 @@ class TensorQueryClient(Element):
     """Offload buffers to a query server; push responses downstream in
     request order.
 
-    Props: ``host``/``port`` (server address), ``timeout`` (seconds a
-    response may take before the timeout policy fires), ``max-in-flight``
+    Props: ``host``/``port`` (server address) or ``hosts=h1:p1,h2:p2``
+    (round-robin fan-out over several servers — the reference's coarse
+    data-parallel offload, SURVEY §2.9), ``timeout`` (seconds a response
+    may take before the timeout policy fires), ``max-in-flight``
     (pipelining window: requests outstanding before ``process`` blocks),
     ``topic``, ``on-timeout`` (``error`` | ``drop``).
 
@@ -280,44 +282,78 @@ class TensorQueryClient(Element):
         # timeout path so in-order delivery holds (never held with _cv).
         self._emit_lock = threading.Lock()
         self._rx_error: Optional[BaseException] = None
-        self._reader: Optional[threading.Thread] = None
+        self._socks: List[socket.socket] = []
+        self._readers: List[threading.Thread] = []
         self._async_emit = None  # injected by the runtime (wants_async_emit)
 
+    def _destinations(self) -> List[Tuple[str, int]]:
+        """``hosts="h1:p1,h2:p2"`` (round-robin fan-out, the reference's
+        coarse data-parallel offload — SURVEY §2.9) or single host/port."""
+        spec = str(self.props.get("hosts", "") or "")
+        if not spec:
+            if self.port <= 0:
+                raise ElementError(f"{self.name}: port property required")
+            return [(self.host, self.port)]
+        dests = []
+        for part in spec.split(","):
+            host, _, port = part.strip().rpartition(":")
+            try:
+                dests.append((host or "127.0.0.1", int(port)))
+            except ValueError:
+                raise ElementError(
+                    f"{self.name}: bad hosts entry {part!r} "
+                    "(expected host:port)") from None
+        return dests
+
     def start(self) -> None:
-        if self.port <= 0:
-            raise ElementError(f"{self.name}: port property required")
-        try:
-            self._sock = socket.create_connection((self.host, self.port), timeout=5.0)
-        except OSError as e:
-            raise ElementError(
-                f"{self.name}: cannot connect {self.host}:{self.port}: {e}"
-            ) from e
-        try:
-            client_handshake(self._sock, "hello", caps="other/tensors",
-                             topic=self.topic)
-        except ConnectionError as e:
-            raise ElementError(f"{self.name}: {e}") from e
-        self._sock.settimeout(0.2)
-        self._reader = threading.Thread(
-            target=self._rx_loop, name=f"{self.name}-rx", daemon=True
-        )
-        self._reader.start()
+        self._socks = []
+        self._readers = []
+        for host, port in self._destinations():
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+            except OSError as e:
+                self.stop()
+                raise ElementError(
+                    f"{self.name}: cannot connect {host}:{port}: {e}"
+                ) from e
+            try:
+                client_handshake(sock, "hello", caps="other/tensors",
+                                 topic=self.topic)
+            except (ConnectionError, OSError) as e:
+                # OSError covers a handshake-phase socket.timeout; close
+                # the half-open socket before tearing down the others.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self.stop()
+                raise ElementError(f"{self.name}: {e}") from e
+            sock.settimeout(0.2)
+            self._socks.append(sock)
+        self._sock = self._socks[0]  # back-compat for single-dest callers
+        for i, sock in enumerate(self._socks):
+            t = threading.Thread(
+                target=self._rx_loop, args=(sock, i),
+                name=f"{self.name}-rx{i}", daemon=True,
+            )
+            t.start()
+            self._readers.append(t)
 
     def stop(self) -> None:
-        sock, self._sock = self._sock, None
-        if sock is not None:
+        socks, self._socks = getattr(self, "_socks", []), []
+        self._sock = None
+        for sock in socks:
             try:
                 sock.close()
             except OSError:
                 pass
-        if self._reader is not None:
-            self._reader.join(timeout=2.0)
-            self._reader = None
+        for t in getattr(self, "_readers", []):
+            t.join(timeout=2.0)
+        self._readers = []
 
-    def _rx_loop(self) -> None:
+    def _rx_loop(self, sock, idx: int = 0) -> None:
         while True:
-            sock = self._sock
-            if sock is None:
+            if self._sock is None:  # stop() ran
                 return
             try:
                 raw = wire.read_frame(sock)
@@ -332,7 +368,12 @@ class TensorQueryClient(Element):
                 return
             if raw is None:
                 with self._cv:
-                    if self._pending and self._rx_error is None:
+                    # Only requests ROUTED TO THIS SOCKET are lost when a
+                    # server closes: a fan-out peer going away must not
+                    # poison requests pending on healthy servers.
+                    n = max(1, len(self._socks))
+                    mine = any(m % n == idx for m in self._pending)
+                    if mine and self._rx_error is None:
                         self._rx_error = ConnectionError("query server closed connection")
                     self._cv.notify_all()
                 return
@@ -480,7 +521,13 @@ class TensorQueryClient(Element):
         host_buf.meta.pop(_META_MSG, None)
         try:
             with self._send_lock:
-                wire.write_frame(self._sock, payload)
+                # Round-robin over destinations: coarse DP fan-out when
+                # ``hosts=`` lists several servers; responses re-order by
+                # msg id regardless of which server answered.
+                socks = self._socks
+                if not socks:
+                    raise ElementError(f"{self.name}: not connected")
+                wire.write_frame(socks[mid % len(socks)], payload)
         except (OSError, AttributeError) as e:
             raise ElementError(f"{self.name}: send failed: {e}") from e
         metrics.count(f"{self.name}.requests")

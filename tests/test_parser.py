@@ -108,3 +108,41 @@ def test_branch_then_continue_linear():
     t = [n for n in g.nodes.values() if n.kind == "tensor_transform"][0]
     assert t.props["mode"] == "typecast"
     assert t.props["option"] == "float32"
+
+
+class TestInspectTool:
+    """gst-inspect analog (tools/inspect.py)."""
+
+    def test_list_all_covers_registries(self):
+        import io
+
+        from nnstreamer_tpu.tools import inspect as insp
+
+        out = io.StringIO()
+        insp.list_all(out=out)
+        text = out.getvalue()
+        for header in ("== element", "== filter", "== decoder",
+                       "== converter"):
+            assert header in text
+        for name in ("tensor_filter", "tensor_mux", "jax", "custom",
+                     "bounding_boxes"):
+            assert name in text
+
+    def test_show_detail_and_missing(self):
+        import io
+
+        from nnstreamer_tpu.tools import inspect as insp
+
+        out = io.StringIO()
+        assert insp.show("tensor_filter", out=out)
+        text = out.getvalue()
+        assert "elements/filter.py" in text or "elements.filter" in text
+        assert not insp.show("definitely_not_registered", out=io.StringIO())
+
+    def test_cli(self):
+        from nnstreamer_tpu.tools.inspect import main
+
+        assert main([]) == 0
+        assert main(["tensor_sink"]) == 0
+        assert main(["--kind", "filter"]) == 0
+        assert main(["nope_nope"]) == 1

@@ -314,3 +314,38 @@ def test_stream_timeout_drop_terminates_downstream():
     fin.meta["stream_last"] = True
     cli._handle_response(fin)
     assert 0 not in cli._aborted  # abort bookkeeping cleaned up
+
+
+def test_query_client_round_robin_fanout():
+    """hosts=h1:p1,h2:p2 round-robins requests over two servers (the
+    reference's coarse DP offload); responses come back in request order
+    with each server's distinct transform applied alternately."""
+    register_custom_easy(
+        "q-triple", lambda ins: [ins[0] * 3],
+        in_spec=TensorsSpec.from_string("4", "float32"),
+        out_spec=TensorsSpec.from_string("4", "float32"))
+    srv_a = nt.Pipeline(
+        "tensor_query_serversrc name=sa port=0 id=20 ! "
+        "tensor_filter framework=custom-easy model=q-double ! "
+        "tensor_query_serversink id=20")
+    srv_b = nt.Pipeline(
+        "tensor_query_serversrc name=sb port=0 id=21 ! "
+        "tensor_filter framework=custom-easy model=q-triple ! "
+        "tensor_query_serversink id=21")
+    with srv_a, srv_b:
+        pa = srv_a.element("sa").bound_port
+        pb = srv_b.element("sb").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! "
+            f"tensor_query_client hosts=127.0.0.1:{pa},127.0.0.1:{pb} "
+            "timeout=15 ! tensor_sink name=out")
+        with cli:
+            for i in range(6):
+                cli.push("src", np.full((4,), float(i + 1), np.float32))
+            outs = [cli.pull("out", timeout=15) for _ in range(6)]
+            cli.eos("src")
+            cli.wait(timeout=15)
+    # request i went to server i%2: even -> x2, odd -> x3; order preserved
+    for i, b in enumerate(outs):
+        factor = 2.0 if i % 2 == 0 else 3.0
+        np.testing.assert_allclose(b.tensors[0], (i + 1) * factor)
