@@ -25,7 +25,10 @@ class Decoder:
     mode: str = "base"
 
     def __init__(self, props: Dict[str, object]):
-        self.props = dict(props)
+        # Keep the SAME dict the element was built with (not a copy): the
+        # pipeline's unknown-property check needs the decoder's reads of
+        # optionN/etc. recorded on the element's tracked props.
+        self.props = props if isinstance(props, dict) else dict(props)
 
     def option(self, n: int, default: str = "") -> str:
         v = self.props.get(f"option{n}", default)

@@ -37,6 +37,66 @@ class ElementError(RuntimeError):
     pass
 
 
+class _TrackedProps(dict):
+    """Property dict recording which keys the element consulted.
+
+    Lets the pipeline reject unknown (typo'd) properties at startup the
+    way ``gst_parse_launch`` errors on "no property 'foo' in element" —
+    without requiring every element to declare a schema: any key the
+    element never read by the time the pipeline is up is unknown.
+    """
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.accessed = set()
+
+    def get(self, key, default=None):
+        self.accessed.add(key)
+        return super().get(key, default)
+
+    def __getitem__(self, key):
+        self.accessed.add(key)
+        return super().__getitem__(key)
+
+    def __contains__(self, key) -> bool:
+        self.accessed.add(key)
+        return super().__contains__(key)
+
+    def pop(self, key, *a):
+        self.accessed.add(key)
+        return super().pop(key, *a)
+
+    def setdefault(self, key, default=None):
+        self.accessed.add(key)
+        return super().setdefault(key, default)
+
+    # Enumerating the dict counts as consuming every key: sub-plugins that
+    # forward props wholesale (e.g. the trainer's zoo-model opts via
+    # ``props.items()``) understand the full set by construction.
+    def _touch_all(self):
+        self.accessed.update(super().keys())
+
+    def items(self):
+        self._touch_all()
+        return super().items()
+
+    def keys(self):
+        self._touch_all()
+        return super().keys()
+
+    def values(self):
+        self._touch_all()
+        return super().values()
+
+    def __iter__(self):
+        self._touch_all()
+        return super().__iter__()
+
+    def copy(self):
+        self._touch_all()
+        return dict(self)
+
+
 class Element:
     """Base streaming element."""
 
@@ -48,10 +108,20 @@ class Element:
     sync_policy: str = "any"
 
     def __init__(self, props: Optional[Dict[str, object]] = None, name: Optional[str] = None):
-        self.props: Dict[str, object] = dict(props or {})
+        self.props: Dict[str, object] = _TrackedProps(props or {})
         self.name = name or self.kind
         self.in_caps: Dict[str, Caps] = {}
         self.out_caps: Dict[str, Caps] = {}
+
+    def unknown_props(self) -> set:
+        """Property keys never consulted by the element (typos).  Checked
+        by the pipeline after startup, once every lazy reader has run."""
+        p = self.props
+        if not isinstance(p, _TrackedProps):
+            return set()
+        # raw dict.keys: enumerating through the tracked interface would
+        # itself mark every key accessed
+        return set(dict.keys(p)) - p.accessed
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

@@ -40,7 +40,12 @@ class AppSrc(SourceElement):
         super().__init__(props, name)
         cap = self.props.get("caps")
         self._caps = parse_caps_string(str(cap)) if cap else Caps.any()
-        self._q: _queue.Queue = _queue.Queue(maxsize=int(self.props.get("max_buffers", 64)))
+        self.block = bool(self.props.get("block", True))
+        # block=false matches GStreamer appsrc semantics: push never blocks
+        # and the feed queue grows unbounded (max-buffers is the bound only
+        # in blocking mode).
+        cap_n = int(self.props.get("max_buffers", 64)) if self.block else 0
+        self._q: _queue.Queue = _queue.Queue(maxsize=cap_n)
         self._eos = threading.Event()
 
     def configure(self, in_caps, out_pads):

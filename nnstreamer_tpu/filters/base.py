@@ -45,7 +45,9 @@ class Framework:
         """Load the model; raise FrameworkError when the model prop is
         unusable (framework=auto uses this to fall through the priority
         list)."""
-        self.props = dict(props)
+        # Keep the element's own (tracked) dict: reads here and in
+        # subclasses count toward the pipeline's unknown-property check.
+        self.props = props if isinstance(props, dict) else dict(props)
 
     def close(self) -> None:
         pass

@@ -301,6 +301,18 @@ class Pipeline:
         for el in self.elements.values():
             el._stop_event = self._stopping  # lets blocking sinks shed on stop
             el.start()
+        # Reject typo'd properties like gst_parse_launch ("no property X in
+        # element"): by now every element (and its lazy start()-time
+        # readers) consulted what it understands.
+        unknown = {
+            el.name: sorted(u)
+            for el in self.elements.values()
+            if (u := el.unknown_props())
+        }
+        if unknown:
+            self.stop()
+            raise PipelineError(
+                f"unknown element properties (typo?): {unknown}")
         for r in {id(r): r for r in self._runners.values()}.values():
             r.thread.start()
         return self

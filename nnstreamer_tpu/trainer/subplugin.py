@@ -44,7 +44,8 @@ class TrainerSubplugin:
         self.props: Dict[str, object] = {}
 
     def open(self, props: Dict[str, object]) -> None:
-        self.props = dict(props)
+        # Keep the element's own (tracked) dict — see filters/base.py.
+        self.props = props if isinstance(props, dict) else dict(props)
 
     def push_data(
         self, inputs: Sequence[np.ndarray], labels: Sequence[np.ndarray], is_validation: bool

@@ -105,8 +105,14 @@ class MqttLiteBroker:
         else:
             self._sub_loop(conn, str(hello.get("topic", "#")))
 
+    def _stopping(self) -> bool:
+        # session threads may observe stop() clearing _listener mid-read:
+        # a vanished listener means "stopping", never an AttributeError
+        listener = self._listener
+        return listener is None or listener.stopping.is_set()
+
     def _read_idle(self, conn) -> Optional[bytes]:
-        while not self._listener.stopping.is_set():
+        while not self._stopping():
             try:
                 return wire.read_frame(conn)
             except socket.timeout:
@@ -116,7 +122,7 @@ class MqttLiteBroker:
         return None
 
     def _pub_loop(self, conn: socket.socket, default_topic: str) -> None:
-        while not self._listener.stopping.is_set():
+        while not self._stopping():
             try:
                 frame = wire.read_frame(conn)
             except socket.timeout:
@@ -155,7 +161,7 @@ class MqttLiteBroker:
         for f in backlog:
             self._offer(q, f)
         try:
-            while not self._listener.stopping.is_set():
+            while not self._stopping():
                 try:
                     item = q.get(timeout=0.2)
                 except _queue.Empty:

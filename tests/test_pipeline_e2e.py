@@ -368,3 +368,26 @@ def test_iio_fifo_backend_and_clean_shutdown(tmp_path):
             raw.astype(np.float32).reshape(capacity, channels))
         # exit with the writer stalled mid-scan
     assert _t.monotonic() - t0 < 10, "shutdown hung on a stalled FIFO writer"
+
+
+def test_unknown_property_rejected_at_startup():
+    """gst_parse_launch behavior: a typo'd element property fails pipeline
+    startup with the element and key named, instead of being silently
+    ignored."""
+    from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+    p = nt.Pipeline(
+        "videotestsrc num-bufers=4 width=8 height=8 ! "  # typo'd num-buffers
+        "tensor_converter ! tensor_sink name=out"
+    )
+    with pytest.raises(PipelineError, match="num_bufers"):
+        p.start()
+
+    # correct spelling still starts
+    p2 = nt.Pipeline(
+        "videotestsrc num-buffers=1 width=8 height=8 ! "
+        "tensor_converter ! tensor_sink name=out"
+    )
+    with p2:
+        p2.pull("out", timeout=10)
+        p2.wait(timeout=10)

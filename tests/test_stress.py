@@ -153,3 +153,64 @@ def test_device_videotestsrc_num_buffers_contract():
                 break
         p.wait(timeout=10)
     assert sum(shapes) == 5 and shapes == [4, 1]
+
+
+def test_concurrent_streaming_clients_one_server():
+    """Several clients stream LLM tokens from ONE query server
+    concurrently: every client gets its full, correctly-ordered stream
+    (per-connection msg pairing under interleaved generation)."""
+    import threading
+
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=60 ! "
+        "tensor_filter framework=llm model=llama_tiny "
+        "custom=max_new:4,stream_chunk:2 invoke-dynamic=true ! "
+        "tensor_query_serversink id=60"
+    )
+    results = {}
+    errors = []
+
+    def run_client(cid, port):
+        try:
+            cli = nt.Pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "timeout=120 ! tensor_sink name=out"
+            )
+            with cli:
+                cli.push("src", np.array([cid + 1, 7, 3], np.int32))
+                toks = [cli.pull("out", timeout=120) for _ in range(4)]
+                cli.eos("src")
+                cli.wait(timeout=30)
+            results[cid] = (
+                [b.meta["stream_index"] for b in toks],
+                [int(np.asarray(b.tensors[0])[0]) for b in toks],
+                toks[-1].meta.get("stream_last"),
+            )
+        except Exception as e:  # noqa: BLE001 - surfaced via the errors list
+            errors.append((cid, e))
+
+    with srv:
+        port = srv.element("ssrc").bound_port
+        threads = [
+            threading.Thread(target=run_client, args=(i, port))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+    assert not errors, errors
+    assert set(results) == {0, 1, 2}
+    for cid, (idxs, ids, last) in results.items():
+        assert idxs == [0, 1, 2, 3]
+        assert last is True
+        # different prompts -> generation streams are per-client
+    # determinism: same prompt gives same ids regardless of concurrency
+    from nnstreamer_tpu.filters.llm import LLMFramework
+
+    fw = LLMFramework()
+    fw.open({"model": "llama_tiny", "custom": "max_new:4,stream_chunk:2"})
+    for cid in range(3):
+        direct = [int(i[0]) for i, _ in fw.invoke_stream(
+            [np.array([cid + 1, 7, 3], np.int32)])]
+        assert results[cid][1] == direct
