@@ -1,0 +1,55 @@
+"""Config #5 as BASELINE.json words it: "Llama-2 token streaming
+(tensor_filter + tensor_query)" — a query SERVER owns the model (TP-
+shardable over the pod mesh via ``custom=tp:N``), clients send prompts
+and receive the generated tokens streamed back one buffer each, tagged
+``stream_index`` with ``stream_last`` on the final one.
+
+    python examples/llm_query_stream.py            # tiny preset, quick
+    python examples/llm_query_stream.py llama2_7b  # real 7B (needs ~14 GB HBM)
+"""
+
+import sys
+
+import numpy as np
+
+import nnstreamer_tpu as nt
+
+
+def main():
+    model = sys.argv[1] if len(sys.argv) > 1 else "llama_tiny"
+    custom = "max_new:16,stream_chunk:4"
+    if model == "llama2_7b":
+        custom += ",param_dtype:bfloat16,max_seq:1024"
+    server = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=5 ! "
+        f"tensor_filter framework=llm model={model} custom={custom} "
+        "invoke-dynamic=true ! "
+        "tensor_query_serversink id=5"
+    )
+    with server:
+        port = server.element("ssrc").bound_port
+        print(f"query server up on :{port} (model={model})")
+        client = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "timeout=600 ! tensor_sink name=out"
+        )
+        with client:
+            client.push("src", "stream me some tokens")
+            text = bytearray()
+            while True:
+                buf = client.pull("out", timeout=600)
+                ids = np.asarray(buf.tensors[0])
+                piece = (bytes(np.asarray(buf.tensors[1]))
+                         if len(buf.tensors) > 1 else b"")
+                text += piece
+                print(f"  token[{buf.meta['stream_index']:2d}] id={int(ids[0])}"
+                      f" piece={piece!r}")
+                if buf.meta.get("stream_last"):
+                    break
+            client.eos()
+            client.wait(timeout=60)
+    print(f"decoded bytes: {bytes(text)!r}")
+
+
+if __name__ == "__main__":
+    main()

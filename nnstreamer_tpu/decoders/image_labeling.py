@@ -24,7 +24,10 @@ class ImageLabeling(Decoder):
 
     def __init__(self, props):
         super().__init__(props)
-        labels = self.option(1) or str(props.get("labels", "")) or "imagenet-mini"
+        # read both prop spellings unconditionally (property-check safe)
+        opt1 = self.option(1)
+        labels_prop = str(props.get("labels", ""))
+        labels = opt1 or labels_prop or "imagenet-mini"
         self.labels = load_labels(labels)
 
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:

@@ -105,6 +105,11 @@ class TensorFilter(Element):
             str(self.props.get("input_combination", "")))
         self.output_combination = _parse_output_combination(
             str(self.props.get("output_combination", "")))
+        # eager reads: inputtype/outputtype are legal without input/output
+        # dims (the conditional reads in configure would otherwise leave
+        # them "unknown" to the property check)
+        self.props.get("inputtype")
+        self.props.get("outputtype")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

@@ -37,8 +37,11 @@ class TensorSink(SinkElement):
         super().__init__(props, name)
         cap = int(self.props.get("max_buffers", 1024))
         self.drop = bool(self.props.get("drop", False))
-        # accepted for reference familiarity; callbacks fire regardless
-        self.emit_signals = bool(self.props.get("emit_signals", True))
+        # accepted for reference familiarity (both the reference's
+        # "emit-signal" and appsink's "emit-signals" spellings); callbacks
+        # fire regardless
+        self.emit_signals = bool(self.props.get(
+            "emit_signal", self.props.get("emit_signals", True)))
         self._q: _queue.Queue = _queue.Queue(maxsize=cap)
         self._callbacks: List[Callable[[Buffer], None]] = []
         self.to_host = bool(self.props.get("to_host", True))

@@ -43,9 +43,11 @@ class AppSrc(SourceElement):
         self.block = bool(self.props.get("block", True))
         # block=false matches GStreamer appsrc semantics: push never blocks
         # and the feed queue grows unbounded (max-buffers is the bound only
-        # in blocking mode).
-        cap_n = int(self.props.get("max_buffers", 64)) if self.block else 0
-        self._q: _queue.Queue = _queue.Queue(maxsize=cap_n)
+        # in blocking mode — still read unconditionally so the pairing
+        # block=false max-buffers=N stays a legal property set).
+        cap_n = int(self.props.get("max_buffers", 64))
+        self._q: _queue.Queue = _queue.Queue(
+            maxsize=cap_n if self.block else 0)
         self._eos = threading.Event()
 
     def configure(self, in_caps, out_pads):

@@ -295,6 +295,10 @@ class Pipeline:
 
     # -- control plane -----------------------------------------------------
     def start(self) -> "Pipeline":
+        if getattr(self, "_dead", False):
+            raise PipelineError(
+                "pipeline failed startup validation and was stopped; "
+                "build a new Pipeline")
         if self._started:
             return self
         self._started = True
@@ -311,6 +315,7 @@ class Pipeline:
         }
         if unknown:
             self.stop()
+            self._dead = True  # elements stopped: this instance is done
             raise PipelineError(
                 f"unknown element properties (typo?): {unknown}")
         for r in {id(r): r for r in self._runners.values()}.values():

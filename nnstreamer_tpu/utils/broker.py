@@ -79,6 +79,8 @@ class MqttLiteBroker:
     def stop(self) -> None:
         if self._listener is not None:
             self._listener.close()
+            self._listener = None  # lets start() rebind; also the signal
+            # session threads poll via _stopping()
         with self._lock:
             for _, q in self._subs.values():
                 self._offer(q, None)
