@@ -164,3 +164,17 @@ def test_query_service_pod_sharded():
             np.testing.assert_allclose(out.tensors[0], 4.0 * x)
             cli.eos("src")
             cli.wait(timeout=10)
+
+
+def test_graft_dryrun_detection_dp():
+    """The driver's DP-inference proof (__graft_entry__._dryrun_detection_dp)
+    runs on the 8-device CPU mesh."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import __graft_entry__ as g
+
+    g._dryrun_detection_dp(8)
