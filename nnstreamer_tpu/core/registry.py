@@ -39,6 +39,7 @@ _builtins_loaded = False
 #: import time (the analog of .so constructors calling nnstreamer_filter_probe).
 _BUILTIN_MODULES = [
     "nnstreamer_tpu.elements.source",
+    "nnstreamer_tpu.elements.video",
     "nnstreamer_tpu.elements.converter",
     "nnstreamer_tpu.elements.transform",
     "nnstreamer_tpu.elements.filter",

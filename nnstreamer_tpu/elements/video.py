@@ -1,0 +1,229 @@
+"""GStreamer-core compatibility elements: queue, videoconvert, videoscale.
+
+Reference pipelines lean on GStreamer base elements the reference repo
+does not implement but every example assumes (the stock object-detection
+pipeline is ``v4l2src ! videoconvert ! videoscale ! ... ! tensor_filter``;
+``queue`` appears wherever a stage boundary is wanted — SURVEY §1 "There
+is no scheduler layer: scheduling IS GStreamer").  This module provides
+the analogs so reference pipeline strings run as written:
+
+* ``queue`` — in this runtime every element already runs on its own
+  stage thread with a bounded feed queue, so ``queue`` is a passthrough
+  marker; its GStreamer sizing properties are accepted for compatibility.
+* ``videoconvert`` — channel-order/format conversion between the RGB
+  family and GRAY8 on ``video/x-raw`` frames (``format=`` selects the
+  target; default passthrough).
+* ``videoscale`` — resize to ``width=``/``height=`` via nearest (default)
+  or bilinear ``method=``.
+
+These are host elements (media boundary, like the reference's); the
+tensor path after ``tensor_converter`` is where device fusion begins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer
+from ..core.caps import Caps, MediaType
+from ..core.registry import register_element
+from .base import Element, ElementError, SRC
+
+
+@register_element("queue")
+class Queue(Element):
+    """Stage-boundary marker (GStreamer ``queue``).
+
+    Threading/buffering is inherent to this runtime (one thread + bounded
+    queue per stage), so data passes straight through; the reference's
+    sizing/leaky properties are accepted for pipeline-string
+    compatibility.
+    """
+
+    kind = "queue"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        # accepted for compatibility; the runtime's per-stage queues are
+        # sized by Pipeline(queue_capacity=...)
+        for p in ("max_size_buffers", "max_size_bytes", "max_size_time",
+                  "leaky", "silent"):
+            self.props.get(p)
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        self.out_caps = {p: src for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf):
+        return [(SRC, buf)]
+
+
+#: channel index order of each RGB-family format (None = alpha slot)
+_CHANNEL_ORDER = {
+    "RGB": (0, 1, 2), "BGR": (2, 1, 0),
+    "RGBA": (0, 1, 2, None), "BGRA": (2, 1, 0, None),
+    "ARGB": (None, 0, 1, 2), "ABGR": (None, 2, 1, 0),
+    "RGBx": (0, 1, 2, None), "BGRx": (2, 1, 0, None),
+}
+
+#: ITU-R BT.601 luma weights (the GStreamer videoconvert default)
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def _to_rgba(frame: np.ndarray, fmt: str) -> np.ndarray:
+    """[H, W, C] in ``fmt`` -> [H, W, 4] RGBA (alpha preserved; opaque for
+    alpha-less formats).  ``_CHANNEL_ORDER[fmt][i]`` names which RGB
+    component lives in the format's channel ``i`` (None = the alpha/pad
+    slot)."""
+    if fmt == "GRAY8":
+        rgba = np.repeat(frame[..., :1], 4, axis=-1)
+        rgba[..., 3] = 255
+        return rgba
+    order = _CHANNEL_ORDER[fmt]
+    rgba = np.full(frame.shape[:2] + (4,), 255, frame.dtype)
+    for i, tgt in enumerate(order):
+        rgba[..., 3 if tgt is None else tgt] = frame[..., i]
+    return rgba
+
+
+def _from_rgba(rgba: np.ndarray, fmt: str) -> np.ndarray:
+    """[H, W, 4] RGBA -> [H, W, C] in ``fmt`` (alpha carried into alpha
+    slots; dropped for alpha-less formats, as GStreamer videoconvert does)."""
+    if fmt == "GRAY8":
+        y = (rgba[..., :3].astype(np.float32) @ _LUMA).round()
+        return np.clip(y, 0, 255).astype(np.uint8)[..., None]
+    order = _CHANNEL_ORDER[fmt]
+    out = np.empty(rgba.shape[:2] + (len(order),), rgba.dtype)
+    for i, tgt in enumerate(order):
+        out[..., i] = rgba[..., 3 if tgt is None else tgt]
+    return out
+
+
+@register_element("videoconvert")
+class VideoConvert(Element):
+    """Convert ``video/x-raw`` frames between the RGB family and GRAY8.
+
+    ``format=`` names the output format; without it frames pass through
+    (the reference negotiates; this runtime's negotiation is explicit).
+    """
+
+    kind = "videoconvert"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.format = str(self.props.get("format", "") or "")
+        if self.format and self.format not in _CHANNEL_ORDER and \
+                self.format != "GRAY8":
+            raise ElementError(
+                f"{self.name}: unsupported format {self.format!r} "
+                f"(one of {sorted(_CHANNEL_ORDER) + ['GRAY8']})")
+        self._in_fmt: Optional[str] = None
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        if src.media not in (MediaType.VIDEO, MediaType.ANY):
+            raise ElementError(
+                f"{self.name}: needs video/x-raw input, got {src.media}")
+        fields = dict(src.dict)
+        fields.pop("spec", None)
+        self._in_fmt = str(fields.get("format", "RGB"))
+        if self.format:
+            fields["format"] = self.format
+        caps = Caps.new(MediaType.VIDEO, **fields)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf: Buffer):
+        if not self.format or self.format == self._in_fmt:
+            return [(SRC, buf)]
+        frame = np.asarray(buf.tensors[0])
+        if frame.ndim == 2:  # GRAY8 without channel dim
+            frame = frame[..., None]
+        rgba = _to_rgba(frame, self._in_fmt or "RGB")
+        out = _from_rgba(rgba, self.format)
+        return [(SRC, buf.with_tensors([out], spec=None))]
+
+
+@register_element("videoscale")
+class VideoScale(Element):
+    """Resize ``video/x-raw`` frames to ``width=`` x ``height=``.
+
+    ``method=nearest`` (default, GStreamer's 0) or ``method=bilinear``.
+    Without width/height props, frames pass through (the reference
+    negotiates the size from downstream caps; set them explicitly here).
+    """
+
+    kind = "videoscale"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.width = int(self.props.get("width", 0))
+        self.height = int(self.props.get("height", 0))
+        self.method = str(self.props.get("method", "nearest")).lower()
+        if self.method not in ("nearest", "bilinear", "0", "1"):
+            raise ElementError(
+                f"{self.name}: method must be nearest|bilinear")
+        if self.method in ("0",):
+            self.method = "nearest"
+        if self.method in ("1",):
+            self.method = "bilinear"
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        src = next(iter(in_caps.values()), Caps.any())
+        if src.media not in (MediaType.VIDEO, MediaType.ANY):
+            raise ElementError(
+                f"{self.name}: needs video/x-raw input, got {src.media}")
+        fields = dict(src.dict)
+        fields.pop("spec", None)
+        if self.width:
+            fields["width"] = self.width
+        if self.height:
+            fields["height"] = self.height
+        caps = Caps.new(MediaType.VIDEO, **fields)
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def process(self, pad, buf: Buffer):
+        if not (self.width or self.height):
+            return [(SRC, buf)]
+        frame = np.asarray(buf.tensors[0])
+        chan_added = frame.ndim == 2
+        if chan_added:  # 2-d gray frame: give it a channel dim for the math
+            frame = frame[..., None]
+        h, w = frame.shape[:2]
+        oh = self.height or h
+        ow = self.width or w
+        if (oh, ow) == (h, w):
+            return [(SRC, buf)]
+        if self.method == "nearest":
+            yi = (np.arange(oh) * (h / oh)).astype(int).clip(0, h - 1)
+            xi = (np.arange(ow) * (w / ow)).astype(int).clip(0, w - 1)
+            out = frame[yi[:, None], xi[None, :]]
+        else:  # bilinear
+            yf = (np.arange(oh) + 0.5) * (h / oh) - 0.5
+            xf = (np.arange(ow) + 0.5) * (w / ow) - 0.5
+            y0 = np.clip(np.floor(yf).astype(int), 0, h - 1)
+            x0 = np.clip(np.floor(xf).astype(int), 0, w - 1)
+            y1 = np.clip(y0 + 1, 0, h - 1)
+            x1 = np.clip(x0 + 1, 0, w - 1)
+            wy = np.clip(yf - y0, 0.0, 1.0)[:, None, None]
+            wx = np.clip(xf - x0, 0.0, 1.0)[None, :, None]
+            f = frame.astype(np.float32)
+            top = f[y0[:, None], x0[None, :]] * (1 - wx) + \
+                f[y0[:, None], x1[None, :]] * wx
+            bot = f[y1[:, None], x0[None, :]] * (1 - wx) + \
+                f[y1[:, None], x1[None, :]] * wx
+            out = top * (1 - wy) + bot * wy
+            if np.issubdtype(frame.dtype, np.integer):
+                info = np.iinfo(frame.dtype)
+                out = np.clip(np.round(out), info.min, info.max)
+            out = out.astype(frame.dtype)
+        if chan_added:
+            out = out[..., 0]
+        return [(SRC, buf.with_tensors([out], spec=None))]

@@ -1,0 +1,132 @@
+"""queue / videoconvert / videoscale compatibility elements (GStreamer base
+elements every reference example pipeline assumes)."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.elements.video import VideoConvert, VideoScale
+
+
+class TestVideoConvert:
+    def _frame(self):
+        rng = np.random.default_rng(0)
+        return rng.integers(0, 256, (4, 6, 3), np.uint8)
+
+    def test_rgb_to_bgr_roundtrip(self):
+        f = self._frame()
+        c = VideoConvert({"format": "BGR"})
+        c.configure({"sink": nt.Caps.new("video/x-raw", format="RGB")}, ["src"])
+        out = c.process("sink", Buffer([f]))[0][1].tensors[0]
+        np.testing.assert_array_equal(out, f[..., ::-1])
+        back = VideoConvert({"format": "RGB"})
+        back.configure({"sink": nt.Caps.new("video/x-raw", format="BGR")}, ["src"])
+        np.testing.assert_array_equal(
+            back.process("sink", Buffer([out]))[0][1].tensors[0], f)
+
+    def test_rgb_to_rgba_alpha_opaque(self):
+        f = self._frame()
+        c = VideoConvert({"format": "RGBA"})
+        c.configure({"sink": nt.Caps.new("video/x-raw", format="RGB")}, ["src"])
+        out = c.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert out.shape == (4, 6, 4)
+        np.testing.assert_array_equal(out[..., :3], f)
+        assert (out[..., 3] == 255).all()
+
+    def test_gray8_bt601(self):
+        f = np.zeros((2, 2, 3), np.uint8)
+        f[0, 0] = [255, 0, 0]
+        c = VideoConvert({"format": "GRAY8"})
+        c.configure({"sink": nt.Caps.new("video/x-raw", format="RGB")}, ["src"])
+        out = c.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert out.shape == (2, 2, 1)
+        assert out[0, 0, 0] == 76  # round(0.299*255)
+
+    def test_passthrough_without_format(self):
+        f = self._frame()
+        c = VideoConvert({})
+        c.configure({"sink": nt.Caps.new("video/x-raw", format="RGB")}, ["src"])
+        out = c.process("sink", Buffer([f]))[0][1]
+        np.testing.assert_array_equal(out.tensors[0], f)
+
+    def test_bad_format_rejected(self):
+        with pytest.raises(Exception):
+            VideoConvert({"format": "YUY2"})
+
+
+class TestVideoScale:
+    def test_nearest_downscale(self):
+        f = np.arange(16, dtype=np.uint8).reshape(4, 4, 1)
+        s = VideoScale({"width": 2, "height": 2})
+        s.configure({"sink": nt.Caps.new("video/x-raw", format="GRAY8",
+                                         width=4, height=4)}, ["src"])
+        out = s.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert out.shape == (2, 2, 1)
+        np.testing.assert_array_equal(out[..., 0], [[0, 2], [8, 10]])
+
+    def test_bilinear_constant_preserved(self):
+        f = np.full((5, 7, 3), 111, np.uint8)
+        s = VideoScale({"width": 13, "height": 9, "method": "bilinear"})
+        s.configure({"sink": nt.Caps.new("video/x-raw", format="RGB",
+                                         width=7, height=5)}, ["src"])
+        out = s.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert out.shape == (9, 13, 3)
+        assert (out == 111).all()
+
+    def test_caps_carry_new_size(self):
+        s = VideoScale({"width": 8, "height": 6})
+        caps = s.configure({"sink": nt.Caps.new("video/x-raw", format="RGB",
+                                                width=4, height=4)}, ["src"])
+        assert caps["src"].get("width") == 8
+        assert caps["src"].get("height") == 6
+
+
+def test_reference_style_pipeline_runs_verbatim():
+    """The stock reference topology (videoconvert ! videoscale ! queue)
+    runs as written, feeding the classification slice."""
+    p = nt.Pipeline(
+        "videotestsrc num-buffers=2 width=12 height=10 pattern=random ! "
+        "videoconvert format=RGB ! videoscale width=8 height=8 ! "
+        "queue max-size-buffers=4 ! "
+        "tensor_converter ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_sink name=out",
+        fuse=True,
+    )
+    with p:
+        bufs = [p.pull("out", timeout=15) for _ in range(2)]
+        p.wait(timeout=15)
+    for b in bufs:
+        assert b.tensors[0].shape == (1, 8, 8, 3)
+        assert b.tensors[0].dtype == np.float32
+
+
+class TestReviewRegressions:
+    def test_alpha_preserved_rgba_to_bgra(self):
+        f = np.zeros((2, 2, 4), np.uint8)
+        f[..., 0] = 10  # R
+        f[..., 2] = 30  # B
+        f[..., 3] = 128  # alpha must survive
+        c = VideoConvert({"format": "BGRA"})
+        c.configure({"sink": nt.Caps.new("video/x-raw", format="RGBA")},
+                    ["src"])
+        out = c.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert (out[..., 3] == 128).all()
+        assert (out[..., 0] == 30).all() and (out[..., 2] == 10).all()
+
+    def test_bilinear_2d_gray_frame(self):
+        f = np.arange(12, dtype=np.uint8).reshape(3, 4)  # no channel dim
+        s = VideoScale({"width": 8, "height": 6, "method": "bilinear"})
+        s.configure({"sink": nt.Caps.new("video/x-raw", format="GRAY8")},
+                    ["src"])
+        out = s.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert out.shape == (6, 8)  # stays 2-d
+
+    def test_bilinear_16bit_range(self):
+        f = np.full((2, 2, 1), 1000, np.uint16)
+        s = VideoScale({"width": 4, "height": 4, "method": "bilinear"})
+        s.configure({"sink": nt.Caps.new("video/x-raw", format="GRAY16_LE")},
+                    ["src"])
+        out = s.process("sink", Buffer([f]))[0][1].tensors[0]
+        assert (out == 1000).all()  # not clamped to 255
