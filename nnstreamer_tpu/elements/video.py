@@ -103,6 +103,89 @@ def _from_rgba(rgba: np.ndarray, fmt: str) -> np.ndarray:
     return out
 
 
+def _infer_fmt(caps: Caps, frame: np.ndarray) -> str:
+    """Negotiated ``format`` field, else infer from channel count."""
+    fmt = caps.get("format") if caps is not None else None
+    if not fmt:
+        c = 1 if frame.ndim == 2 else frame.shape[-1]
+        fmt = {1: "GRAY8", 3: "RGB", 4: "RGBA"}.get(c, "RGB")
+    fmt = str(fmt)
+    if fmt not in _CHANNEL_ORDER and fmt != "GRAY8":
+        raise ElementError(
+            f"compositor: unsupported frame format {fmt!r} "
+            "(8-bit RGB family / GRAY8)")
+    return fmt
+
+
+@register_element("compositor")
+class Compositor(Element):
+    """Alpha-blend overlay streams onto a base video stream.
+
+    Reference usage: the stock detection/pose examples composite the
+    ``tensor_decoder`` RGBA overlay onto the camera frames.  ``sink_0``
+    is the base frame; every other sink pad is an overlay blended in
+    numeric pad order with per-pixel source-over alpha, scaled by the
+    GStreamer per-pad property ``sink_N::alpha=<0..1>`` when given.
+    Frames are converted through RGBA using each pad's NEGOTIATED format
+    (channel-count inference when caps carry no format field), so BGR
+    bases and ARGB overlays blend correctly; output format follows the
+    base frame.  Sync is slowest-pad, matching the mux machinery.
+    """
+
+    kind = "compositor"
+    sync_policy = "all"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.props.get("background")  # accepted for compatibility
+        self._pad_alpha = {}
+
+    def configure(self, in_caps, out_pads):
+        self.in_caps = dict(in_caps)
+        for pad in in_caps:  # read per-pad alphas while props are checked
+            self._pad_alpha[pad] = float(
+                self.props.get(f"{pad}::alpha", 1.0))
+        base = in_caps.get("sink_0") or next(iter(in_caps.values()), Caps.any())
+        self.out_caps = {p: base for p in out_pads}
+        return self.out_caps
+
+    def process_group(self, bufs):
+        from .routing import _pad_index
+
+        pads = sorted(bufs, key=_pad_index)  # numeric: sink_10 > sink_2
+        base_buf = bufs[pads[0]]
+        base = np.asarray(base_buf.tensors[0])
+        base_fmt = _infer_fmt(self.in_caps.get(pads[0]), base)
+        squeeze = base.ndim == 2
+        if squeeze:
+            base = base[..., None]
+        out = _to_rgba(base, base_fmt).astype(np.float32)
+        meta = dict(base_buf.meta)
+        for pad in pads[1:]:
+            ov_buf = bufs[pad]
+            meta.update(ov_buf.meta)
+            ov = np.asarray(ov_buf.tensors[0])
+            ov_fmt = _infer_fmt(self.in_caps.get(pad), ov)
+            if ov.ndim == 2:
+                ov = ov[..., None]
+            if ov.shape[:2] != base.shape[:2]:
+                raise ElementError(
+                    f"{self.name}: overlay {ov.shape[:2]} != base "
+                    f"{base.shape[:2]} (use videoscale)")
+            rgba = _to_rgba(ov, ov_fmt).astype(np.float32)
+            a = (rgba[..., 3:4] / 255.0) * self._pad_alpha.get(pad, 1.0)
+            out[..., :3] = rgba[..., :3] * a + out[..., :3] * (1.0 - a)
+        res = np.clip(np.round(out), 0, 255).astype(np.uint8)
+        res = _from_rgba(res, base_fmt)
+        if squeeze:
+            res = res[..., 0]
+        new = base_buf.with_tensors([res], spec=None)
+        new.meta.update(meta)
+        pts = [b.pts for b in bufs.values() if b.pts is not None]
+        new.pts = max(pts) if pts else None
+        return [(SRC, new)]
+
+
 @register_element("videoconvert")
 class VideoConvert(Element):
     """Convert ``video/x-raw`` frames between the RGB family and GRAY8.

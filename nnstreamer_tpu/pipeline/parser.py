@@ -33,6 +33,8 @@ class ParseError(ValueError):
 
 _NAME_RE = re.compile(r"^[A-Za-z_][\w\-]*$")
 _PROP_RE = re.compile(r"^([A-Za-z_][\w\-]*)=(.*)$", re.S)
+# GStreamer per-pad property syntax: sink_1::alpha=0.5
+_PAD_PROP_RE = re.compile(r"^([A-Za-z_][\w\-]*::[A-Za-z_][\w\-]*)=(.*)$", re.S)
 _REF_RE = re.compile(r"^([A-Za-z_][\w\-]*)\.([\w\-]*)$")
 _CAPS_RE = re.compile(r"^[a-z]+/[\w\-\.\+]+")
 
@@ -151,10 +153,19 @@ def parse(text: str) -> PipelineGraph:
             props: Dict[str, object] = {}
             i += 1
             while i < n:
-                m = _PROP_RE.match(toks[i])
-                if not m or toks[i] == "!":
+                if toks[i] == "!":
                     break
-                props[m.group(1).replace("-", "_")] = _coerce(m.group(2))
+                pm = _PAD_PROP_RE.match(toks[i])
+                m = pm or _PROP_RE.match(toks[i])
+                if not m:
+                    break
+                key = m.group(1)
+                if pm is None:
+                    key = key.replace("-", "_")
+                else:  # pad props keep the pad name verbatim: sink_1::alpha
+                    pad, _, prop = key.partition("::")
+                    key = f"{pad}::{prop.replace('-', '_')}"
+                props[key] = _coerce(m.group(2))
                 i += 1
             node = g.add(kind, props)
             if want_link:
@@ -203,7 +214,8 @@ def _next_src_pad(g: PipelineGraph, node: Node) -> str:
 def _assign_request_pads(g: PipelineGraph) -> None:
     """Give multi-input elements (mux/merge/join) numbered sink pads and
     multi-output elements numbered src pads when linked via default pads."""
-    multi_sink = {"tensor_mux", "tensor_merge", "join", "tensor_trainer"}
+    multi_sink = {"tensor_mux", "tensor_merge", "join", "tensor_trainer",
+                  "compositor"}
     multi_src = {"tee"}
     for node in g.nodes.values():
         if node.kind in multi_sink:

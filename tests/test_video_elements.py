@@ -130,3 +130,85 @@ class TestReviewRegressions:
                     ["src"])
         out = s.process("sink", Buffer([f]))[0][1].tensors[0]
         assert (out == 1000).all()  # not clamped to 255
+
+
+class TestCompositor:
+    def test_source_over_blend(self):
+        from nnstreamer_tpu.elements.video import Compositor
+
+        base = np.full((2, 2, 3), 100, np.uint8)
+        ov = np.zeros((2, 2, 4), np.uint8)
+        ov[0, 0] = [200, 0, 0, 255]   # opaque red: replaces
+        ov[0, 1] = [200, 0, 0, 127]   # half: blends
+        c = Compositor({})
+        c.configure({"sink_0": nt.Caps.any(), "sink_1": nt.Caps.any()},
+                    ["src"])
+        out = c.process_group({
+            "sink_0": Buffer([base], pts=5),
+            "sink_1": Buffer([ov], pts=9),
+        })[0][1]
+        o = out.tensors[0]
+        np.testing.assert_array_equal(o[0, 0], [200, 0, 0])
+        assert abs(int(o[0, 1, 0]) - 150) <= 1  # 200*0.498 + 100*0.502
+        np.testing.assert_array_equal(o[1, 1], [100, 100, 100])
+        assert out.pts == 9
+
+    def test_size_mismatch_rejected(self):
+        from nnstreamer_tpu.elements.base import ElementError
+        from nnstreamer_tpu.elements.video import Compositor
+
+        c = Compositor({})
+        c.configure({"sink_0": nt.Caps.any(), "sink_1": nt.Caps.any()},
+                    ["src"])
+        with pytest.raises(ElementError, match="videoscale"):
+            c.process_group({
+                "sink_0": Buffer([np.zeros((4, 4, 3), np.uint8)]),
+                "sink_1": Buffer([np.zeros((2, 2, 4), np.uint8)]),
+            })
+
+    def test_stock_overlay_pipeline(self):
+        """tee'd video + detection overlay composited — the stock example
+        shape (camera branch + decoder branch reunited)."""
+        desc = (
+            "videotestsrc num-buffers=2 width=32 height=32 pattern=ball "
+            "name=cam ! tee name=t "
+            "t. ! queue ! comp.sink_0 "
+            "t. ! queue ! tensor_converter ! "
+            "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+            "tensor_filter framework=jax model=ssd_mobilenet "
+            "custom=size:32,classes:4,batch:1 ! "
+            "tensor_decoder mode=bounding_boxes option3=0.3 option4=32:32 ! "
+            "comp.sink_1 "
+            "compositor name=comp ! tensor_sink name=out"
+        )
+        p = nt.Pipeline(desc, fuse=False)
+        with p:
+            bufs = [p.pull("out", timeout=60) for _ in range(2)]
+            p.wait(timeout=30)
+        for b in bufs:
+            assert b.tensors[0].shape == (32, 32, 3)
+            assert "detections" in b.meta
+
+    def test_bare_refs_and_pad_alpha_and_bgr_base(self):
+        """GStreamer spellings work: bare `comp.` branch refs, per-pad
+        sink_1::alpha, and a BGR base blends in its own channel order."""
+        desc = (
+            "videotestsrc num-buffers=1 width=8 height=8 pattern=black ! "
+            "videoconvert format=BGR ! comp. "
+            "appsrc name=ov ! comp. "
+            "compositor name=comp sink_1::alpha=0.5 ! tensor_sink name=out"
+        )
+        p = nt.Pipeline(desc, fuse=False)
+        ov = np.zeros((8, 8, 4), np.uint8)
+        ov[..., 0] = 200  # pure RED overlay, fully opaque...
+        ov[..., 3] = 255  # ...then scaled by pad alpha 0.5
+        with p:
+            p.push("ov", ov)
+            b = p.pull("out", timeout=15)
+            p.eos("ov")
+            p.wait(timeout=15)
+        out = b.tensors[0]
+        # base black BGR; red at half alpha lands in the B-G-R layout's
+        # channel 2 at ~100
+        assert abs(int(out[0, 0, 2]) - 100) <= 1
+        assert out[0, 0, 0] == 0  # blue channel untouched
