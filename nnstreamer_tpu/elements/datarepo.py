@@ -86,29 +86,34 @@ class DataRepoSrc(SourceElement):
     def generate(self) -> Iterator[Union[Buffer, Event]]:
         self._load_meta()
         sample_size = sum(s.nbytes for s in self.spec)
-        with open(self.location, "rb") as f:
-            data = f.read()
-        total = int(self._meta.get("total_samples", len(data) // sample_size))
+        # Memory-map the dataset: samples are zero-copy views into the OS
+        # page cache (the reference's C reader streams from the file; a
+        # Python read() would materialize the WHOLE set in process RAM and
+        # copy every sample).  Views stay valid while the mapping is held.
+        fsize = os.path.getsize(self.location)
+        total = int(self._meta.get("total_samples", fsize // sample_size))
         stop = total - 1 if self.stop_idx < 0 else min(self.stop_idx, total - 1)
+        # Size check BEFORE the empty-file return: a truncated/zero file
+        # whose meta still claims samples must error, not yield nothing.
+        if (stop + 1) * sample_size > fsize:
+            raise ElementError(
+                f"datarepo file holds {fsize} bytes; meta claims "
+                f"{total} samples of {sample_size}")
         indices = list(range(self.start_idx, stop + 1))
-        if not indices:
-            return
+        if not indices or fsize == 0:
+            return  # empty dataset (mmap of an empty file is an error)
+        data = np.memmap(self.location, dtype=np.uint8, mode="r")
         for epoch in range(self.epochs):
             order = list(indices)
             if self.shuffle:
                 np.random.default_rng(epoch).shuffle(order)
             for i in order:
                 off = i * sample_size
-                raw = data[off : off + sample_size]
-                if len(raw) < sample_size:
-                    raise ElementError(f"datarepo sample {i} truncated")
                 tensors: List[np.ndarray] = []
-                pos = 0
+                pos = off
                 for s in self.spec:
                     n = s.nbytes
-                    arr = np.frombuffer(raw[pos : pos + n], dtype=s.dtype).reshape(
-                        s.shape
-                    )
+                    arr = data[pos : pos + n].view(s.dtype).reshape(s.shape)
                     tensors.append(arr)
                     pos += n
                 yield Buffer(tensors, spec=self.spec, meta={"sample_index": i, "epoch": epoch})

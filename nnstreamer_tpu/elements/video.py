@@ -62,13 +62,17 @@ class Queue(Element):
         return [(SRC, buf)]
 
 
-#: channel index order of each RGB-family format (None = alpha slot)
+#: channel index order of each RGB-family format (None = alpha/pad slot)
 _CHANNEL_ORDER = {
     "RGB": (0, 1, 2), "BGR": (2, 1, 0),
     "RGBA": (0, 1, 2, None), "BGRA": (2, 1, 0, None),
     "ARGB": (None, 0, 1, 2), "ABGR": (None, 2, 1, 0),
     "RGBx": (0, 1, 2, None), "BGRx": (2, 1, 0, None),
 }
+
+#: 4-channel formats whose 4th slot is PADDING, not alpha: semantically
+#: opaque (the compositor must not read the undefined pad byte as alpha)
+_PADDED_FMTS = frozenset({"RGBx", "BGRx"})
 
 #: ITU-R BT.601 luma weights (the GStreamer videoconvert default)
 _LUMA = np.array([0.299, 0.587, 0.114], np.float32)
@@ -87,6 +91,8 @@ def _to_rgba(frame: np.ndarray, fmt: str) -> np.ndarray:
     rgba = np.full(frame.shape[:2] + (4,), 255, frame.dtype)
     for i, tgt in enumerate(order):
         rgba[..., 3 if tgt is None else tgt] = frame[..., i]
+    if fmt in _PADDED_FMTS:  # x slot is padding, not alpha: opaque
+        rgba[..., 3] = 255
     return rgba
 
 
@@ -149,6 +155,11 @@ class Compositor(Element):
         self.out_caps = {p: base for p in out_pads}
         return self.out_caps
 
+    def process(self, pad, buf):
+        # Single-input compositor is legal in GStreamer: passthrough (the
+        # runtime only collates groups when >1 sink pad is linked).
+        return [(SRC, buf)]
+
     def process_group(self, bufs):
         from .routing import _pad_index
 
@@ -160,6 +171,9 @@ class Compositor(Element):
         if squeeze:
             base = base[..., None]
         out = _to_rgba(base, base_fmt).astype(np.float32)
+        a0 = self._pad_alpha.get(pads[0], 1.0)
+        if a0 != 1.0:  # GStreamer fades the base toward the background
+            out[..., :3] *= a0
         meta = dict(base_buf.meta)
         for pad in pads[1:]:
             ov_buf = bufs[pad]

@@ -187,3 +187,30 @@ def test_trainer_resume_restores_opt_state(tmp_path):
     assert any(np.any(np.asarray(l) != 0) for l in leaves2)
     for a, b in zip(leaves1, leaves2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_datareposrc_zero_copy_and_truncation(tmp_path):
+    """Samples are views into the file mapping (no per-sample copies), and
+    a meta/file size mismatch errors instead of yielding garbage."""
+    from nnstreamer_tpu.elements.datarepo import DataRepoSrc
+
+    data = np.arange(4 * 5, dtype=np.float32)  # 5 samples of [4] f32
+    loc = tmp_path / "d.bin"
+    loc.write_bytes(data.tobytes())
+    meta = tmp_path / "d.json"
+    meta.write_text('{"dims": "4", "types": "float32", "total_samples": 5, '
+                    '"sample_size": 16}')
+    src = DataRepoSrc({"location": str(loc), "json": str(meta)})
+    src.configure({}, ["src"])
+    bufs = list(src.generate())
+    assert len(bufs) == 5
+    np.testing.assert_array_equal(bufs[2].tensors[0], data[8:12])
+    assert not bufs[2].tensors[0].flags["OWNDATA"]  # view, not a copy
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"dims": "4", "types": "float32", "total_samples": 50, '
+                   '"sample_size": 16}')
+    src2 = DataRepoSrc({"location": str(loc), "json": str(bad)})
+    src2.configure({}, ["src"])
+    with pytest.raises(Exception, match="holds"):
+        list(src2.generate())
