@@ -31,7 +31,9 @@ import threading
 import time
 
 
-_SOURCE_QUEUE_CAPACITY = 4
+# 8-deep in-flight window: measured +29% classification fps over 4 (RTT
+# and host post-processing hide behind more batches); 16 adds only +2%.
+_SOURCE_QUEUE_CAPACITY = 8
 
 #: Peak dense-matmul throughput per chip by device kind (bf16 FLOP/s) —
 #: public spec-sheet numbers, used only for the MFU report field.
