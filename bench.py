@@ -277,15 +277,22 @@ def _source_driven_bench(desc: str, batch: int, batches: int, warmup: int,
     return r
 
 
-def bench_detection(batch: int, batches: int, size: int, warmup: int) -> dict:
+def bench_detection(batch: int, batches: int, size: int, warmup: int,
+                    model: str = "ssd_mobilenet") -> dict:
+    """Config #2 names both SSD-MobileNet AND YOLOv5; ``model`` selects
+    (both drive the same bounding_boxes decode, yolov5 via option1)."""
     total = _source_total_frames(batch, batches, warmup)
+    fmt = "yolov5" if model == "yolov5" else "ssd"
+    # input convention per family: SSD-mobilenet [-1,1]; YOLO [0,1]
+    norm = ("typecast:float32,div:255.0" if model == "yolov5"
+            else "typecast:float32,add:-127.5,div:127.5")
     desc = (
         f"videotestsrc device=true batch={batch} num-buffers={total} "
         f"width={size} height={size} pattern=ball name=src ! "
-        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
-        f"tensor_filter framework=jax model=ssd_mobilenet custom=size:{size},classes:91,batch:{batch} name=f ! "
-        f"tensor_decoder mode=bounding_boxes option3=0.5 option4={size}:{size} "
-        "option7=device ! "
+        f"tensor_transform mode=arithmetic option={norm} ! "
+        f"tensor_filter framework=jax model={model} custom=size:{size},classes:91,batch:{batch} name=f ! "
+        f"tensor_decoder mode=bounding_boxes option1={fmt} option3=0.5 "
+        f"option4={size}:{size} option7=device ! "
         f"tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY}"
     )
     # The decoder fuses into the XLA program with option7=device: threshold
@@ -294,7 +301,7 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int) -> dict:
     # (~2.8x over host NMS on one chip).
     return _source_driven_bench(
         desc, batch, batches, warmup,
-        "ssd_mobilenet_detection_fps_per_chip", 250.0, "videotestsrc",
+        f"{model}_detection_fps_per_chip", 250.0, "videotestsrc",
     )
 
 
@@ -434,13 +441,16 @@ def main() -> int:
                          "or host-fed appsrc windows")
     ap.add_argument("--audio-model", default="speech_commands",
                     choices=["speech_commands", "wav2vec2"])
+    ap.add_argument("--detection-model", default="ssd_mobilenet",
+                    choices=["ssd_mobilenet", "yolov5"])
     args = ap.parse_args()
 
     runners = {
         "classification": lambda: bench_classification(
             args.batch, args.batches, args.size, args.warmup, args.source),
         "detection": lambda: bench_detection(
-            args.batch, args.batches, args.size, args.warmup),
+            args.batch, args.batches, args.size, args.warmup,
+            args.detection_model),
         "pose": lambda: bench_pose(
             args.batch, args.batches, args.size, args.warmup),
         "audio": lambda: bench_audio(args.batch, args.batches, args.warmup,

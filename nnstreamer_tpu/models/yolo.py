@@ -1,0 +1,159 @@
+"""YOLOv5-style single-shot detector — the second half of benchmark
+config #2 ("SSD-MobileNet / YOLOv5 object detection", BASELINE.json).
+
+Reference analog: the reference decodes YOLOv5/YOLOv8 raw output in
+``tensordec-boundingbox.c``'s yolo modes (SURVEY §2.5 [UNVERIFIED]); the
+model itself comes from a .tflite/.onnx file.  Zero-egress here, so the
+zoo provides a compact YOLOv5-shaped network built from the shared
+depthwise-separable blocks: a strided backbone with three detection
+scales (strides 8/16/32), ``anchors_per_cell`` predictors per cell, and
+the YOLOv5 head convention — sigmoid box/objectness/class activations
+with per-cell offset decode — emitting ONE ``[B, N, 5+C]`` tensor in the
+exact layout ``tensor_decoder mode=bounding_boxes option1=yolov5``
+consumes (cx, cy, w, h normalized, objectness, class scores).
+
+TPU-first: the whole predict-and-decode is one jitted program; the grid
+offset/anchor math is folded into the fused pipeline program next to the
+convs, and the decoder's device-NMS path (option7=device) keeps the
+full decode on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .backbone import fm_size, he_conv, make_ops, rounded, sep_block_params, \
+    sep_block_pspecs, stem_params, stem_pspecs
+from .zoo import ModelBundle, register_model
+
+#: (stride-2 steps between scales are built from these widths)
+_BACKBONE = [64, 128, 256]   # strides 8, 16, 32 scale widths (pre width-mult)
+_ANCHORS_PER_CELL = 3
+#: YOLOv5-ish anchor sizes per scale, normalized to input size
+_ANCHOR_SIZES = {
+    8: [(0.04, 0.06), (0.08, 0.12), (0.12, 0.09)],
+    16: [(0.14, 0.22), (0.26, 0.17), (0.24, 0.38)],
+    32: [(0.45, 0.35), (0.38, 0.64), (0.75, 0.70)],
+}
+
+
+def _keygen(seed: int):
+    import jax
+
+    key = jax.random.PRNGKey(seed)
+    while True:
+        key, sub = jax.random.split(key)
+        yield sub
+
+
+def init_params(classes: int, width: float = 1.0, seed: int = 0) -> Dict:
+    keys = _keygen(seed)
+    params: Dict = {"stem": stem_params(keys, 3, rounded(32, width))}
+    cin = rounded(32, width)
+    # stem is stride 2; three stride-2 stages land strides 8/16/32 with one
+    # refining block per scale
+    for i, ch in enumerate(_BACKBONE):
+        cout = rounded(ch, width)
+        params[f"down{i}"] = sep_block_params(keys, cin, cout)   # stride 2
+        params[f"block{i}"] = sep_block_params(keys, cout, cout)  # stride 1
+        cin = cout
+        nout = _ANCHORS_PER_CELL * (5 + classes)
+        params[f"head{i}"] = {
+            "w": he_conv(next(keys), 1, 1, cout, nout),
+            # objectness prior: like the SSD low-prior cls bias, random
+            # weights should predict "no object" almost everywhere
+            "b": np.full((nout,), -4.0, np.float32),
+        }
+    return params
+
+
+def param_pspecs() -> Dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict = {"stem": stem_pspecs()}
+    for i in range(len(_BACKBONE)):
+        specs[f"down{i}"] = sep_block_pspecs()
+        specs[f"block{i}"] = sep_block_pspecs()
+        specs[f"head{i}"] = {"w": P(), "b": P()}
+    return specs
+
+
+def num_predictions(size: int) -> int:
+    return sum(
+        fm_size(size, s) ** 2 * _ANCHORS_PER_CELL for s in (8, 16, 32))
+
+
+def apply(params, x, *, classes: int, size: int, compute_dtype="bfloat16"):
+    """[B, size, size, 3] float32 in [0,1] -> [B, N, 5+C] float32
+    (yolov5 layout).  ``size`` pins the traced input so N matches the
+    bundle's negotiated out_spec."""
+    import jax
+    import jax.numpy as jnp
+
+    assert x.shape[1] == x.shape[2] == size, (
+        f"yolov5 input must be {size}x{size}, got {x.shape}")
+    conv2d, sbr, sep = make_ops(compute_dtype)
+    cdt = jnp.dtype(compute_dtype)
+
+    h = conv2d(x.astype(cdt), params["stem"]["w"], 2)
+    h = sbr(h, params["stem"]["scale"], params["stem"]["bias"])
+    # extra stride-2 maxpool after the stem puts the three down/block
+    # stages at strides 8/16/32 — each head consumes its own stage's
+    # feature map (channel counts match init_params' loop exactly)
+    h = jax.lax.reduce_window(
+        h, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "SAME")
+    feats = []
+    for i, stride in enumerate((8, 16, 32)):
+        h = sep(h, params[f"down{i}"], 2)
+        h = sep(h, params[f"block{i}"], 1)
+        feats.append((stride, h, params[f"head{i}"]))
+    outs = []
+
+    B = x.shape[0]
+    for stride, fm, hp in feats:
+        g = fm.shape[1]
+        raw = conv2d(fm, hp["w"], 1) + hp["b"].astype(cdt)
+        raw = raw.reshape(B, g, g, _ANCHORS_PER_CELL, 5 + classes)
+        raw = raw.astype(jnp.float32)
+        s = jax.nn.sigmoid(raw)
+        # yolov5 decode: cell offset + sigmoid box, anchor-scaled w/h
+        gy, gx = jnp.meshgrid(jnp.arange(g), jnp.arange(g), indexing="ij")
+        cx = (s[..., 0] * 2.0 - 0.5 + gx[None, :, :, None]) / g
+        cy = (s[..., 1] * 2.0 - 0.5 + gy[None, :, :, None]) / g
+        anch = jnp.asarray(_ANCHOR_SIZES[stride], jnp.float32)  # [A, 2]
+        w = (s[..., 2] * 2.0) ** 2 * anch[None, None, None, :, 0]
+        hh = (s[..., 3] * 2.0) ** 2 * anch[None, None, None, :, 1]
+        pred = jnp.concatenate(
+            [jnp.stack([cx, cy, w, hh], axis=-1), s[..., 4:]], axis=-1)
+        outs.append(pred.reshape(B, -1, 5 + classes))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_model("yolov5")
+def _yolo(opts: Dict[str, str]) -> ModelBundle:
+    classes = int(opts.get("classes", 80))
+    width = float(opts.get("width", 1.0))
+    seed = int(opts.get("seed", 0))
+    size = int(opts.get("size", 224))
+    batch = int(opts.get("batch", 1))
+    dtype = opts.get("dtype", "bfloat16")
+    if size % 32:
+        raise ValueError(f"yolov5 size must be a multiple of 32, got {size}")
+
+    params = init_params(classes=classes, width=width, seed=seed)
+    apply_fn = functools.partial(
+        apply, classes=classes, size=size, compute_dtype=dtype)
+    n = num_predictions(size)
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(
+            f"{5 + classes}:{n}:{batch}", "float32"),
+        param_pspecs=param_pspecs(),
+        name="yolov5",
+    )

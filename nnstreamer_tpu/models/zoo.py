@@ -71,6 +71,7 @@ def _ensure_builtin():
         "nnstreamer_tpu.models.testmodels",
         "nnstreamer_tpu.models.mobilenet",
         "nnstreamer_tpu.models.ssd",
+        "nnstreamer_tpu.models.yolo",
         "nnstreamer_tpu.models.posenet",
         "nnstreamer_tpu.models.audio",
         "nnstreamer_tpu.models.llama",

@@ -147,3 +147,57 @@ def test_posenet_odd_size_fm():
     heat, off = b.apply_fn(b.params, x)
     assert heat.shape[1:3] == (17, 17)
     assert tuple(b.out_spec[0].shape[1:3]) == (17, 17)
+
+
+class TestYolo:
+    """YOLOv5-shaped zoo model (the second half of config #2)."""
+
+    def test_output_layout_and_ranges(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import yolo
+        from nnstreamer_tpu.models.zoo import build
+
+        b = build("yolov5", {"size": "96", "classes": "7", "batch": "2",
+                             "dtype": "float32"})
+        x = jnp.zeros((2, 96, 96, 3), jnp.float32)
+        out = np.asarray(b.apply_fn(b.params, x))
+        n = yolo.num_predictions(96)
+        assert out.shape == (2, n, 12)  # cx cy w h obj + 7 classes
+        # decoded centers normalized; obj/cls are sigmoids
+        assert (out[..., 4:] >= 0).all() and (out[..., 4:] <= 1).all()
+        # yolov5's (2*sig-0.5+grid)/g decode reaches +-0.5/g past [0,1]
+        assert out[..., 0].min() > -0.2 and out[..., 0].max() < 1.2
+        # objectness prior: random weights mostly predict background
+        assert float(np.median(out[..., 4])) < 0.1
+
+    def test_bundle_spec_matches_output(self):
+        from nnstreamer_tpu.models.zoo import build
+
+        b = build("yolov5", {"size": "64", "classes": "3", "batch": "1"})
+        assert b.out_spec[0].shape[1] == b.apply_fn(
+            b.params, np.zeros((1, 64, 64, 3), np.float32)).shape[1]
+
+    def test_size_must_be_multiple_of_32(self):
+        from nnstreamer_tpu.models.zoo import build
+
+        with pytest.raises(ValueError, match="multiple of 32"):
+            build("yolov5", {"size": "100"})
+
+    def test_fused_yolo_detection_pipeline(self):
+        import nnstreamer_tpu as nt
+
+        p = nt.Pipeline(
+            "videotestsrc device=true batch=2 num-buffers=4 width=64 "
+            "height=64 pattern=ball name=src ! "
+            "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+            "tensor_filter framework=jax model=yolov5 "
+            "custom=size:64,classes:5,batch:2 ! "
+            "tensor_decoder mode=bounding_boxes option1=yolov5 option3=0.3 "
+            "option4=64:64 option7=device ! tensor_sink name=out")
+        fused = [s for s in p.stages if len(s.node_ids) > 1]
+        assert fused and len(fused[0].node_ids) == 3
+        with p:
+            b = p.pull("out", timeout=120)
+            p.wait(timeout=60)
+        assert b.tensors[0].shape == (2, 64, 64, 4)
