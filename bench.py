@@ -30,6 +30,12 @@ import sys
 import threading
 import time
 
+# `JAX_PLATFORMS=cpu python bench.py` must not touch (and hang on) an
+# unreachable device tunnel when a site hook pre-imported jax.
+from nnstreamer_tpu.core.platform import honor_jax_platforms
+
+honor_jax_platforms()
+
 
 # 8-deep in-flight window: measured +29% classification fps over 4 (RTT
 # and host post-processing hide behind more batches); 16 adds only +2%.

@@ -1,0 +1,26 @@
+"""Backend/platform selection helpers.
+
+One quirk of environments with a site hook that pre-imports jax (the dev
+TPU tunnel does): ``JAX_PLATFORMS`` read from the environment lands too
+late for a pre-imported jax, so a user's ``JAX_PLATFORMS=cpu`` would be
+ignored and the process could touch — and hang on — an unreachable
+device tunnel.  :func:`honor_jax_platforms` makes the env var behave as
+documented; importing THIS module does not import jax, so entry scripts
+can call it before any backend init.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def honor_jax_platforms() -> None:
+    """Re-assert ``JAX_PLATFORMS`` through the live config when jax was
+    pre-imported (site hook); no-op — and no jax import — otherwise, since
+    a fresh import honors the env var natively."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -19,6 +19,12 @@ import traceback
 # Runnable as `python tools/smoke_tpu.py` without an installed package.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Lets `JAX_PLATFORMS=cpu` run this smoke on CPU even when a site hook
+# pre-imported jax (see core/platform.py).
+from nnstreamer_tpu.core.platform import honor_jax_platforms
+
+honor_jax_platforms()
+
 
 def _check(name, fn):
     try:
