@@ -18,9 +18,28 @@ import sys
 def honor_jax_platforms() -> None:
     """Re-assert ``JAX_PLATFORMS`` through the live config when jax was
     pre-imported (site hook); no-op — and no jax import — otherwise, since
-    a fresh import honors the env var natively."""
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat and "jax" in sys.modules:
-        import jax
+    a fresh import honors the env var natively.
 
-        jax.config.update("jax_platforms", plat)
+    For SCRIPT entry points (bench.py, tools/smoke_tpu.py) that own their
+    process — the library itself never mutates global jax config on
+    import, so a user's deliberate programmatic pin survives
+    ``import nnstreamer_tpu``.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not (plat and "jax" in sys.modules):
+        return
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    try:  # best-effort: warn when the update can no longer take effect
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            import warnings
+
+            warnings.warn(
+                "JAX backend already initialized before JAX_PLATFORMS "
+                "could be honored; the requested platform may be ignored",
+                RuntimeWarning, stacklevel=2)
+    except Exception:  # noqa: BLE001 - private API probe only
+        pass
