@@ -425,6 +425,32 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     }
 
 
+def _backend_reachable(timeout_s: float = 180.0) -> bool:
+    """Bounded probe of the jax backend.  A dead device tunnel makes
+    jax.devices() block forever; a bench run should fail FAST with a
+    clear reason (observed during a tunnel outage) rather than hang
+    until the caller's timeout with no diagnostics."""
+    from nnstreamer_tpu.utils.watchdog import call_with_watchdog
+
+    def probe():
+        import jax
+
+        return jax.devices()
+
+    try:
+        call_with_watchdog(probe, timeout_s, "jax.devices()")
+    except TimeoutError:
+        print(
+            f"bench: device backend unreachable (jax.devices() did not "
+            f"return within {timeout_s:.0f}s) — tunnel down?",
+            file=sys.stderr)
+        return False
+    except Exception as e:  # noqa: BLE001 - reported to the caller
+        print(f"bench: backend init failed: {e}", file=sys.stderr)
+        return False
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="classification",
@@ -450,6 +476,8 @@ def main() -> int:
     ap.add_argument("--detection-model", default="ssd_mobilenet",
                     choices=["ssd_mobilenet", "yolov5"])
     args = ap.parse_args()
+    if not _backend_reachable():
+        return 3  # distinct from argparse's usage-error exit code 2
 
     runners = {
         "classification": lambda: bench_classification(
