@@ -384,7 +384,18 @@ class TensorQueryClient(Element):
                     self._rx_error = e
                     self._cv.notify_all()
                 return
-            self._handle_response(buf)
+            try:
+                self._handle_response(buf)
+            except Exception as e:  # noqa: BLE001 - any escape kills the reader
+                # e.g. emit attempted while not attached to a pipeline: an
+                # exception escaping here would silently kill the reader
+                # thread and outstanding requests would only surface via
+                # timeout — record it so _wait_outstanding reports promptly.
+                with self._cv:
+                    if self._rx_error is None:
+                        self._rx_error = e
+                    self._cv.notify_all()
+                return
 
     def _handle_response(self, buf: Buffer) -> None:
         """Pair one received response with its request and deliver it.

@@ -68,9 +68,12 @@ class _SyncModes:
                 "(slowest|basepad|refresh)")
         opt = str(self.props.get("sync_option", "") or "0")
         self._base_idx = int(opt.split(":")[0] or 0)
+        # Unconditional: a single-sink-pad mux in slowest mode skips the
+        # runtime's group collation and reaches process() directly, where
+        # latest-buffer collation degenerates to pass-through.
+        self._latest: Dict[str, Buffer] = {}
         if self.sync_mode != "slowest":
             self.sync_policy = "any"  # instance overrides the class attr
-            self._latest: Dict[str, Buffer] = {}
 
     def _base_pad(self) -> str:
         pads = sorted(self.in_caps, key=_pad_index)  # numeric: sink_10 > sink_2

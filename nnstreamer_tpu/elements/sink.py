@@ -52,8 +52,9 @@ class TensorSink(SinkElement):
 
     def process(self, pad, buf: Buffer):
         metrics.count(f"{self.name}.frames")
+        prefetch_cap = min(16, self._q.maxsize or 16)
         if (self.to_host and not self._callbacks and not self.drop
-                and self._q.qsize() < 16):
+                and self._q.qsize() < prefetch_cap):
             # The app will pop host arrays: start the D2H now so the copy
             # overlaps the queue dwell time instead of being paid inside
             # pop() — over a remote/tunneled device this is a full RTT per

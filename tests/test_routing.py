@@ -256,6 +256,17 @@ class TestMuxSyncModes:
         outs = self._push(m, "sink_0", 3.0, 30)
         assert outs[0][1].tensors[1][0] == 11.0  # latest wins
 
+    def test_single_pad_slowest_process_passthrough(self):
+        # A single-sink-pad mux in default slowest mode bypasses the
+        # runtime's group collation and hits process() directly — must
+        # pass through, not crash (advisor r2 finding).
+        m = TensorMux()
+        m.configure({"sink_0": nt.Caps.any()}, ["src"])
+        outs = self._push(m, "sink_0", 5.0, 7)
+        assert len(outs) == 1
+        assert outs[0][1].pts == 7
+        assert outs[0][1].tensors[0][0] == 5.0
+
     def test_refresh_any_pad_triggers(self):
         m = TensorMux({"sync_mode": "refresh"})
         m.configure({"sink_0": nt.Caps.any(), "sink_1": nt.Caps.any()}, ["src"])
