@@ -113,6 +113,179 @@ def init_params(cfg: LlamaConfig, seed: int = 0, dtype="float32") -> Dict:
     }
 
 
+def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
+                    dtype="bfloat16") -> Tuple[Dict, LlamaConfig]:
+    """Fill the documented pytree layout from a REAL checkpoint file.
+
+    ``path``: a ``.safetensors`` file, a HF sharded checkpoint directory /
+    ``*.safetensors.index.json``, or an ``.npz`` (models/checkpoint.py).
+    Accepts HF ``model.layers.N.self_attn.q_proj.weight`` naming (weights
+    transposed from [out,in] linear layout to this module's [in,out]
+    matmul layout — no RoPE re-permutation is needed because :func:`_rope`
+    uses the same rotate-half convention HF checkpoints are stored for) or
+    this module's own stacked naming (``layers.wq`` etc., the npz
+    round-trip).  Per-layer tensors are stacked on the leading layer axis
+    for the ``lax.scan`` block.
+
+    ``cfg=None`` reads a HF ``config.json`` next to the checkpoint; without
+    one, dims are inferred from tensor shapes with head_dim assumed 128
+    (the Llama convention) — pass an explicit cfg when that's wrong.
+    Returns ``(params, cfg)``; weights cast to ``dtype`` (norms stay f32,
+    matching :func:`init_params`).
+    """
+    import os
+
+    from . import checkpoint as ckpt
+
+    tensors = ckpt.load_tensors(path)
+    dt = np.dtype("float32") if dtype == "float32" else _np_bf16()
+    if dtype not in ("float32", "bfloat16"):
+        dt = np.dtype(dtype)
+
+    if "embed" in tensors and "layers.wq" in tensors:  # native stacked npz
+        if cfg is None:
+            cfg = _infer_config_native(tensors)
+        params = {
+            "embed": np.asarray(tensors["embed"]).astype(dt),
+            "layers": {k.split(".", 1)[1]:
+                       np.asarray(tensors[k]).astype(
+                           np.float32 if k.startswith("layers.ln") else dt)
+                       for k in tensors if k.startswith("layers.")},
+            "ln_out": np.asarray(tensors["ln_out"]).astype(np.float32),
+            "lm_head": np.asarray(tensors["lm_head"]).astype(dt),
+        }
+        return params, cfg
+
+    if cfg is None:
+        cfg = _infer_config_hf(path, tensors)
+
+    def get(name):
+        if name not in tensors:
+            raise ckpt.CheckpointError(
+                f"{path}: missing tensor {name!r} "
+                f"(have {len(tensors)} tensors, e.g. "
+                f"{sorted(tensors)[:3]})")
+        return np.asarray(tensors[name])
+
+    def stack_T(fmt):
+        return np.stack([get(fmt.format(i)).T.astype(dt)
+                         for i in range(cfg.n_layers)])
+
+    def stack_f32(fmt):
+        return np.stack([get(fmt.format(i)).astype(np.float32)
+                         for i in range(cfg.n_layers)])
+
+    p = "model.layers.{}."
+    layers = {
+        "wq": stack_T(p + "self_attn.q_proj.weight"),
+        "wk": stack_T(p + "self_attn.k_proj.weight"),
+        "wv": stack_T(p + "self_attn.v_proj.weight"),
+        "wo": stack_T(p + "self_attn.o_proj.weight"),
+        "w_gate": stack_T(p + "mlp.gate_proj.weight"),
+        "w_up": stack_T(p + "mlp.up_proj.weight"),
+        "w_down": stack_T(p + "mlp.down_proj.weight"),
+        "ln_attn": stack_f32(p + "input_layernorm.weight"),
+        "ln_mlp": stack_f32(p + "post_attention_layernorm.weight"),
+    }
+    embed = get("model.embed_tokens.weight").astype(dt)
+    if "lm_head.weight" in tensors:
+        lm_head = get("lm_head.weight").T.astype(dt)
+    else:  # tied embeddings
+        lm_head = np.ascontiguousarray(embed.T)
+    params = {
+        "embed": embed,
+        "layers": layers,
+        "ln_out": get("model.norm.weight").astype(np.float32),
+        "lm_head": lm_head,
+    }
+    _check_shapes(params, cfg, path)
+    return params, cfg
+
+
+def _np_bf16():
+    from ..core.types import bfloat16
+
+    return bfloat16
+
+
+def _infer_config_hf(path: str, tensors: Dict) -> LlamaConfig:
+    import json
+    import os
+
+    base = path if os.path.isdir(path) else os.path.dirname(path)
+    cfg_path = os.path.join(base, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            c = json.load(f)
+        return LlamaConfig(
+            vocab=c["vocab_size"], dim=c["hidden_size"],
+            n_layers=c["num_hidden_layers"],
+            n_heads=c["num_attention_heads"],
+            n_kv_heads=c.get("num_key_value_heads",
+                             c["num_attention_heads"]),
+            ffn_hidden=c["intermediate_size"],
+            max_seq=min(c.get("max_position_embeddings", 4096), 8192),
+            rope_theta=float(c.get("rope_theta", 10000.0)),
+            norm_eps=float(c.get("rms_norm_eps", 1e-5)),
+        )
+    # shape inference: head_dim is 128 by Llama convention
+    from . import checkpoint as ckpt
+
+    try:
+        vocab, dim = tensors["model.embed_tokens.weight"].shape
+        layer_ids = [int(k.split(".")[2]) for k in tensors
+                     if k.startswith("model.layers.")]
+        n_layers = 1 + max(layer_ids)
+        ffn = tensors["model.layers.0.mlp.gate_proj.weight"].shape[0]
+        kv_out = tensors["model.layers.0.self_attn.k_proj.weight"].shape[0]
+    except (KeyError, ValueError) as e:
+        raise ckpt.CheckpointError(
+            f"{path}: not a Llama-family checkpoint (no config.json and "
+            f"HF tensor names absent: {e}; have e.g. "
+            f"{sorted(tensors)[:3]})") from e
+    hd = 128 if dim % 128 == 0 and dim >= 128 else 64
+    return LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                       n_heads=dim // hd, n_kv_heads=kv_out // hd,
+                       ffn_hidden=ffn)
+
+
+def _infer_config_native(tensors: Dict) -> LlamaConfig:
+    L, D, qout = tensors["layers.wq"].shape
+    vocab = tensors["embed"].shape[0]
+    F = tensors["layers.w_gate"].shape[2]
+    kvout = tensors["layers.wk"].shape[2]
+    hd = 128 if D % 128 == 0 and D >= 128 else 64
+    if qout % hd:
+        hd = qout  # degenerate tiny models: one head
+    return LlamaConfig(vocab=vocab, dim=D, n_layers=L, n_heads=qout // hd,
+                       n_kv_heads=kvout // hd, ffn_hidden=F)
+
+
+def _check_shapes(params: Dict, cfg: LlamaConfig, path: str) -> None:
+    L, D, H, Hkv, F = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                       cfg.ffn_hidden)
+    hd = cfg.head_dim
+    want = {
+        ("embed",): (cfg.vocab, D),
+        ("layers", "wq"): (L, D, H * hd),
+        ("layers", "wk"): (L, D, Hkv * hd),
+        ("layers", "wv"): (L, D, Hkv * hd),
+        ("layers", "wo"): (L, H * hd, D),
+        ("layers", "w_gate"): (L, D, F),
+        ("layers", "w_up"): (L, D, F),
+        ("layers", "w_down"): (L, F, D),
+        ("lm_head",): (D, cfg.vocab),
+    }
+    for keys, shape in want.items():
+        node = params
+        for k in keys:
+            node = node[k]
+        if tuple(node.shape) != shape:
+            raise ValueError(
+                f"{path}: {'.'.join(keys)} has shape {tuple(node.shape)}, "
+                f"config wants {shape} — wrong config for this checkpoint?")
+
+
 def param_pspecs() -> Dict:
     """TP shardings over the ``model`` mesh axis: split heads / FFN hidden
     on the contraction-free dim, so each matmul is local and XLA all-reduces
@@ -426,6 +599,33 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
         param_pspecs=param_pspecs(), name=preset,
     )
     bundle.config = cfg  # used by the llm framework for the decode loop
+    return bundle
+
+
+def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
+    """Zoo entry for REAL weights: ``model=/path/llama.safetensors``.
+
+    Same bundle contract as :func:`_build` but params come from
+    :func:`load_checkpoint`; ``custom=param_dtype:...,max_seq:N`` apply.
+    """
+    params, cfg = load_checkpoint(
+        path, dtype=opts.get("param_dtype", "bfloat16"))
+    if "max_seq" in opts:
+        cfg = dataclasses.replace(cfg, max_seq=int(opts["max_seq"]))
+    dtype = opts.get("dtype", "bfloat16")
+
+    def apply_fn(params, tokens):
+        return forward(params, tokens, cfg, compute_dtype=dtype)
+
+    in_spec = TensorsSpec.from_string("1:1", "int32").replace(
+        format=TensorFormat.FLEXIBLE)
+    out_spec = TensorsSpec.from_string(f"{cfg.vocab}:1:1", "float32").replace(
+        format=TensorFormat.FLEXIBLE)
+    bundle = ModelBundle(
+        apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
+        param_pspecs=param_pspecs(), name=path,
+    )
+    bundle.config = cfg
     return bundle
 
 

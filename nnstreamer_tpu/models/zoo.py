@@ -97,6 +97,20 @@ def build(name: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle:
         b = _builders.get(key)
     if b is not None:
         return b(opts)
+    # Model FILES (the reference's default tensor_filter path: model=<file>).
+    if key.endswith((".tflite", ".safetensors", ".npz",
+                     ".safetensors.index.json")):
+        import os
+
+        if not os.path.exists(key):
+            raise KeyError(f"model file not found: {key}")
+        if key.endswith(".tflite"):
+            from . import tflite
+
+            return tflite.load_bundle(key, opts)
+        from . import llama
+
+        return llama.build_from_checkpoint(key, opts)
     if ":" in key:
         mod_name, attr = key.split(":", 1)
         mod = importlib.import_module(mod_name)

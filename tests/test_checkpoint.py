@@ -1,0 +1,199 @@
+"""Checkpoint ingestion tests (VERDICT r2 missing #3, second half): real
+weights enter models/llama.py's documented pytree via safetensors/npz.
+
+Strategy: start from a native ``init_params`` pytree, EXPORT it to
+HF-format tensors (the inverse transpose/unstack of the importer), write a
+real .safetensors file + config.json, import it back, and require exact
+pytree equality plus identical forward logits — proving the name mapping,
+transposes, and stacking, not just "it loads".
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import checkpoint as ckpt
+from nnstreamer_tpu.models import llama, zoo
+
+
+CFG = llama.LlamaConfig(vocab=96, dim=32, n_layers=2, n_heads=2,
+                        n_kv_heads=1, ffn_hidden=48, max_seq=64)
+
+
+def _to_hf(params, cfg):
+    """Invert load_checkpoint's mapping: stacked native -> HF names."""
+    out = {"model.embed_tokens.weight": np.asarray(params["embed"]),
+           "model.norm.weight": np.asarray(params["ln_out"]),
+           "lm_head.weight": np.ascontiguousarray(
+               np.asarray(params["lm_head"]).T)}
+    lay = params["layers"]
+    hf = {"wq": "self_attn.q_proj", "wk": "self_attn.k_proj",
+          "wv": "self_attn.v_proj", "wo": "self_attn.o_proj",
+          "w_gate": "mlp.gate_proj", "w_up": "mlp.up_proj",
+          "w_down": "mlp.down_proj"}
+    for i in range(cfg.n_layers):
+        for k, name in hf.items():
+            out[f"model.layers.{i}.{name}.weight"] = np.ascontiguousarray(
+                np.asarray(lay[k])[i].T)
+        out[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
+            lay["ln_attn"])[i]
+        out[f"model.layers.{i}.post_attention_layernorm.weight"] = \
+            np.asarray(lay["ln_mlp"])[i]
+    return out
+
+
+def _write_config(dirpath, cfg):
+    (dirpath / "config.json").write_text(json.dumps({
+        "vocab_size": cfg.vocab, "hidden_size": cfg.dim,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "intermediate_size": cfg.ffn_hidden,
+        "max_position_embeddings": cfg.max_seq,
+        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.norm_eps,
+    }))
+
+
+def _assert_tree_equal(got, want):
+    import jax
+
+    flat_g = jax.tree_util.tree_leaves_with_path(got)
+    flat_w = dict(jax.tree_util.tree_leaves_with_path(want))
+    assert len(flat_g) == len(flat_w)
+    for path, g in flat_g:
+        w = flat_w[path]
+        np.testing.assert_array_equal(
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            err_msg=str(path))
+
+
+class TestSafetensors:
+    def test_roundtrip_dtypes(self, tmp_path):
+        from nnstreamer_tpu.core.types import bfloat16
+
+        rng = np.random.default_rng(0)
+        tensors = {
+            "a": rng.standard_normal((3, 4)).astype(np.float32),
+            "b": (rng.standard_normal((8,)) * 10).astype(np.float16),
+            "c": rng.integers(0, 100, (2, 2)).astype(np.int64),
+            "d": rng.standard_normal((4, 2)).astype(np.float32).astype(bfloat16),
+        }
+        p = str(tmp_path / "t.safetensors")
+        ckpt.write_safetensors(p, tensors)
+        back = ckpt.read_safetensors(p)
+        assert set(back) == set(tensors)
+        for k in tensors:
+            assert back[k].dtype == tensors[k].dtype
+            np.testing.assert_array_equal(np.asarray(back[k], np.float32),
+                                          np.asarray(tensors[k], np.float32))
+
+    def test_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.safetensors"
+        p.write_bytes(b"\xff" * 64)
+        with pytest.raises(ckpt.CheckpointError):
+            ckpt.read_safetensors(str(p))
+
+    def test_sharded_index(self, tmp_path):
+        a = {"x": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        b = {"y": np.ones((4,), np.float32)}
+        ckpt.write_safetensors(str(tmp_path / "s1.safetensors"), a)
+        ckpt.write_safetensors(str(tmp_path / "s2.safetensors"), b)
+        idx = tmp_path / "model.safetensors.index.json"
+        idx.write_text(json.dumps({"weight_map": {
+            "x": "s1.safetensors", "y": "s2.safetensors"}}))
+        out = ckpt.load_tensors(str(idx))
+        np.testing.assert_array_equal(out["x"], a["x"])
+        np.testing.assert_array_equal(out["y"], b["y"])
+        # directory form resolves to the same index
+        out2 = ckpt.load_tensors(str(tmp_path))
+        assert set(out2) == {"x", "y"}
+
+
+class TestLlamaImport:
+    def test_hf_roundtrip_exact(self, tmp_path):
+        params = llama.init_params(CFG, seed=3)
+        ckpt.write_safetensors(str(tmp_path / "model.safetensors"),
+                               _to_hf(params, CFG))
+        _write_config(tmp_path, CFG)
+        got, cfg = llama.load_checkpoint(
+            str(tmp_path / "model.safetensors"), dtype="float32")
+        assert cfg == CFG  # config.json read back verbatim
+        _assert_tree_equal(got, params)
+
+    def test_forward_logits_match(self, tmp_path):
+        params = llama.init_params(CFG, seed=3)
+        ckpt.write_safetensors(str(tmp_path / "model.safetensors"),
+                               _to_hf(params, CFG))
+        _write_config(tmp_path, CFG)
+        got, cfg = llama.load_checkpoint(
+            str(tmp_path / "model.safetensors"), dtype="float32")
+        toks = np.array([[1, 5, 9, 2]], np.int32)
+        a = np.asarray(llama.forward(params, toks, CFG,
+                                     compute_dtype="float32"))
+        b = np.asarray(llama.forward(got, toks, cfg,
+                                     compute_dtype="float32"))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_tied_embeddings_fallback(self, tmp_path):
+        params = llama.init_params(CFG, seed=1)
+        hf = _to_hf(params, CFG)
+        del hf["lm_head.weight"]
+        ckpt.write_safetensors(str(tmp_path / "model.safetensors"), hf)
+        _write_config(tmp_path, CFG)
+        got, _ = llama.load_checkpoint(
+            str(tmp_path / "model.safetensors"), dtype="float32")
+        np.testing.assert_array_equal(got["lm_head"],
+                                      np.asarray(got["embed"]).T)
+
+    def test_missing_tensor_clear_error(self, tmp_path):
+        hf = _to_hf(llama.init_params(CFG, seed=0), CFG)
+        del hf["model.layers.1.mlp.up_proj.weight"]
+        ckpt.write_safetensors(str(tmp_path / "model.safetensors"), hf)
+        _write_config(tmp_path, CFG)
+        with pytest.raises(ckpt.CheckpointError, match="up_proj"):
+            llama.load_checkpoint(str(tmp_path / "model.safetensors"))
+
+    def test_wrong_config_shape_error(self, tmp_path):
+        hf = _to_hf(llama.init_params(CFG, seed=0), CFG)
+        ckpt.write_safetensors(str(tmp_path / "model.safetensors"), hf)
+        bad = llama.LlamaConfig(vocab=96, dim=32, n_layers=2, n_heads=2,
+                                n_kv_heads=1, ffn_hidden=64)  # wrong F
+        with pytest.raises(ValueError, match="w_gate"):
+            llama.load_checkpoint(str(tmp_path / "model.safetensors"),
+                                  cfg=bad)
+
+    def test_non_llama_checkpoint_clear_error(self, tmp_path):
+        # a BERT-ish file with neither naming scheme nor config.json must
+        # fail with a CheckpointError naming the file, not a bare KeyError
+        p = str(tmp_path / "bert.safetensors")
+        ckpt.write_safetensors(p, {
+            "bert.encoder.layer.0.attention.self.query.weight":
+                np.zeros((4, 4), np.float32)})
+        with pytest.raises(ckpt.CheckpointError, match="bert.safetensors"):
+            llama.load_checkpoint(p)
+
+    def test_native_npz_roundtrip(self, tmp_path):
+        params = llama.init_params(CFG, seed=2)
+        flat = {"embed": params["embed"], "ln_out": params["ln_out"],
+                "lm_head": params["lm_head"]}
+        for k, v in params["layers"].items():
+            flat[f"layers.{k}"] = v
+        p = str(tmp_path / "native.npz")
+        np.savez(p, **{k: np.asarray(v) for k, v in flat.items()})
+        got, cfg = llama.load_checkpoint(p, cfg=CFG, dtype="float32")
+        _assert_tree_equal(got, params)
+
+    def test_zoo_builds_bundle_from_safetensors(self, tmp_path):
+        params = llama.init_params(CFG, seed=3)
+        path = tmp_path / "model.safetensors"
+        ckpt.write_safetensors(str(path), _to_hf(params, CFG))
+        _write_config(tmp_path, CFG)
+        bundle = zoo.build(str(path), {"param_dtype": "float32",
+                                       "dtype": "float32"})
+        assert bundle.config.vocab == CFG.vocab
+        toks = np.array([[1, 2, 3]], np.int32)
+        logits = np.asarray(bundle.apply_fn(bundle.params, toks))
+        want = np.asarray(llama.forward(params, toks, CFG,
+                                        compute_dtype="float32"))
+        np.testing.assert_allclose(logits, want, rtol=1e-6)

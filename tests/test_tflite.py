@@ -1,0 +1,218 @@
+"""`.tflite` ingestion tests (VERDICT r2 missing #3 / SURVEY §2.4 tflite row,
+§7 "model ingestion" hard part).
+
+The fixture files are emitted by models/tflite_build.py (flatbuffer writer)
+and parsed back by models/tflite.py (flatbuffer reader) — two independent
+codings of the public format.  Numerics are cross-checked against torch
+(an independent conv/pool implementation present in the environment), so a
+matching bug in writer+reader would still fail the golden comparison.
+"""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.models import tflite, tflite_build, zoo
+
+
+def _build_cnn_file(tmp_path, seed=0):
+    """conv(SAME,s2,relu6) -> dwconv(SAME) -> avgpool -> reshape -> fc ->
+    softmax: the MobileNet op vocabulary in miniature, with real weights."""
+    rng = np.random.default_rng(seed)
+    mw = tflite_build.ModelWriter()
+    x = mw.add_input([1, 8, 8, 3])
+    w1 = mw.add_const(rng.standard_normal((4, 3, 3, 3)).astype(np.float32) * 0.3,
+                      "conv_w")
+    b1 = mw.add_const(rng.standard_normal((4,)).astype(np.float32) * 0.1,
+                      "conv_b")
+    y = mw.add_op("CONV_2D", [x, w1, b1], [1, 4, 4, 4],
+                  options={"padding": "SAME", "stride": (2, 2),
+                           "act": "relu6"})
+    wd = mw.add_const(rng.standard_normal((1, 3, 3, 4)).astype(np.float32) * 0.3,
+                      "dw_w")
+    bd = mw.add_const(np.zeros((4,), np.float32), "dw_b")
+    y = mw.add_op("DEPTHWISE_CONV_2D", [y, wd, bd], [1, 4, 4, 4],
+                  options={"padding": "SAME", "stride": (1, 1)})
+    y = mw.add_op("AVERAGE_POOL_2D", [y], [1, 2, 2, 4],
+                  options={"padding": "VALID", "stride": (2, 2),
+                           "filter": (2, 2)})
+    y = mw.add_op("RESHAPE", [y], [1, 16],
+                  options={"new_shape": [1, 16]})
+    wf = mw.add_const(rng.standard_normal((5, 16)).astype(np.float32) * 0.2,
+                      "fc_w")
+    bf = mw.add_const(rng.standard_normal((5,)).astype(np.float32) * 0.1,
+                      "fc_b")
+    y = mw.add_op("FULLY_CONNECTED", [y, wf, bf], [1, 5])
+    y = mw.add_op("SOFTMAX", [y], [1, 5])
+    blob = mw.finish(outputs=[y])
+    path = tmp_path / "tiny_cnn.tflite"
+    path.write_bytes(blob)
+    return str(path), rng
+
+
+def _torch_golden(path, x):
+    """Independent execution of the fixture graph with torch."""
+    import torch
+    import torch.nn.functional as F
+
+    g = tflite.TFLiteGraph(open(path, "rb").read())
+    c = {i: torch.from_numpy(np.array(a)) for i, a in g.constants.items()}
+    names = {g.tensor_names[i]: i for i in g.constants}
+    t = torch.from_numpy(x).permute(0, 3, 1, 2)  # NHWC -> NCHW
+
+    def same_pad(t, k, s):
+        ih, iw = t.shape[2], t.shape[3]
+        ph = max((-(ih // -s) - 1) * s + k - ih, 0)
+        pw = max((-(iw // -s) - 1) * s + k - iw, 0)
+        return F.pad(t, (pw // 2, pw - pw // 2, ph // 2, ph - ph // 2))
+
+    w1 = c[names["conv_w"]]  # OHWI
+    t = F.conv2d(same_pad(t, 3, 2), w1.permute(0, 3, 1, 2),
+                 c[names["conv_b"]], stride=2)
+    t = torch.clamp(t, 0, 6)
+    wd = c[names["dw_w"]]  # [1, kh, kw, C]
+    t = F.conv2d(same_pad(t, 3, 1), wd.permute(3, 0, 1, 2),
+                 c[names["dw_b"]], groups=4)
+    t = F.avg_pool2d(t, 2, 2)
+    flat = t.permute(0, 2, 3, 1).reshape(1, 16)  # back to NHWC order
+    logits = flat @ c[names["fc_w"]].T + c[names["fc_b"]]
+    return torch.softmax(logits, dim=-1).numpy()
+
+
+class TestParser:
+    def test_graph_structure(self, tmp_path):
+        path, _ = _build_cnn_file(tmp_path)
+        g = tflite.TFLiteGraph(open(path, "rb").read())
+        assert [op.kind for op in g.ops] == [
+            "CONV_2D", "DEPTHWISE_CONV_2D", "AVERAGE_POOL_2D", "RESHAPE",
+            "FULLY_CONNECTED", "SOFTMAX"]
+        assert len(g.inputs) == 1 and len(g.outputs) == 1
+        assert g.shapes[g.inputs[0]] == [1, 8, 8, 3]
+        assert g.shapes[g.outputs[0]] == [1, 5]
+        conv = g.ops[0]
+        assert conv.attrs["padding"] == "SAME"
+        assert conv.attrs["strides"] == (2, 2)
+        # real weights made it out of the buffers
+        assert any(a.shape == (4, 3, 3, 3) for a in g.constants.values())
+
+    def test_rejects_non_tflite(self):
+        with pytest.raises(tflite.TFLiteError, match="TFL3"):
+            tflite.TFLiteGraph(b"\x00" * 64)
+
+    def test_matches_torch_golden(self, tmp_path):
+        path, rng = _build_cnn_file(tmp_path)
+        bundle = tflite.load_bundle(path)
+        x = rng.standard_normal((1, 8, 8, 3)).astype(np.float32)
+        got = np.asarray(bundle.apply_fn(bundle.params, x))
+        want = _torch_golden(path, x)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+        assert got.shape == (1, 5)
+        np.testing.assert_allclose(got.sum(), 1.0, rtol=1e-5)
+
+    def test_jittable(self, tmp_path):
+        import jax
+
+        path, rng = _build_cnn_file(tmp_path)
+        bundle = tflite.load_bundle(path)
+        x = rng.standard_normal((1, 8, 8, 3)).astype(np.float32)
+        eager = np.asarray(bundle.apply_fn(bundle.params, x))
+        jitted = np.asarray(jax.jit(bundle.apply_fn)(bundle.params, x))
+        np.testing.assert_allclose(eager, jitted, rtol=1e-6)
+
+    def test_specs_reflect_graph_io(self, tmp_path):
+        path, _ = _build_cnn_file(tmp_path)
+        bundle = tflite.load_bundle(path)
+        assert bundle.in_spec.specs[0].shape == (1, 8, 8, 3)
+        assert bundle.out_spec.specs[0].shape == (1, 5)
+        assert bundle.in_spec.specs[0].dtype == np.float32
+
+
+class TestElementwiseOps:
+    def test_add_mul_concat_mean(self, tmp_path):
+        rng = np.random.default_rng(1)
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4, 4, 2])
+        c = mw.add_const(rng.standard_normal((1, 4, 4, 2)).astype(np.float32))
+        s = mw.add_op("ADD", [x, c], [1, 4, 4, 2], options={"act": "relu"})
+        m = mw.add_op("MUL", [s, c], [1, 4, 4, 2])
+        cc = mw.add_op("CONCATENATION", [s, m], [1, 4, 4, 4],
+                       options={"axis": 3})
+        axes = mw.add_const(np.array([1, 2], np.int32), "axes")
+        out = mw.add_op("MEAN", [cc, axes], [1, 4],
+                        options={"keep_dims": False})
+        path = tmp_path / "ew.tflite"
+        path.write_bytes(mw.finish(outputs=[out]))
+
+        bundle = tflite.load_bundle(str(path))
+        xv = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+        got = np.asarray(bundle.apply_fn(bundle.params, xv))
+        cv = next(a for a in bundle.params.values() if a.shape == (1, 4, 4, 2))
+        sv = np.maximum(xv + cv, 0)
+        want = np.concatenate([sv, sv * cv], axis=3).mean(axis=(1, 2))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestPipelineIntegration:
+    def test_tensor_filter_loads_tflite_file(self, tmp_path):
+        """The reference's default usage, verbatim: tensor_filter
+        framework=jax model=<path.tflite> (SURVEY §2.3)."""
+        path, rng = _build_cnn_file(tmp_path)
+        p = nt.Pipeline(
+            f"appsrc name=src caps=other/tensors,dimensions=3:8:8:1,"
+            f"types=float32 ! "
+            f"tensor_filter framework=jax model={path} ! "
+            f"tensor_sink name=out")
+        x = rng.standard_normal((1, 8, 8, 3)).astype(np.float32)
+        with p:
+            p.push("src", x)
+            buf = p.pull("out", timeout=60)
+            p.eos()
+            p.wait(timeout=30)
+        got = np.asarray(buf.tensors[0])
+        want = np.asarray(
+            tflite.load_bundle(path).apply_fn(
+                tflite.load_bundle(path).params, x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_zoo_build_missing_file(self):
+        with pytest.raises(KeyError, match="not found"):
+            zoo.build("/nonexistent/model.tflite")
+
+    def test_quantized_rejected(self):
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4], dtype=np.uint8)
+        w = mw.add_const(np.zeros((4, 4), np.uint8), "qw",
+                         quant_scale=[0.5])
+        out = mw.add_op("FULLY_CONNECTED", [x, w], [1, 4],
+                        out_dtype=np.uint8)
+        blob = mw.finish(outputs=[out])
+        with pytest.raises(tflite.TFLiteError, match="quantized"):
+            tflite.TFLiteGraph(blob)
+
+    def test_static_operands_jit_clean(self, tmp_path):
+        """MEAN axes / PAD widths / shape-tensor RESHAPE resolve as trace-
+        time constants — a graph using them must survive jax.jit (the
+        jax_fw filter jits apply_fn unconditionally)."""
+        import jax
+
+        rng = np.random.default_rng(2)
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4, 4, 2])
+        pads = mw.add_const(
+            np.array([[0, 0], [1, 1], [1, 1], [0, 0]], np.int32), "pads")
+        y = mw.add_op("PAD", [x, pads], [1, 6, 6, 2])
+        axes = mw.add_const(np.array([1, 2], np.int32), "axes")
+        y = mw.add_op("MEAN", [y, axes], [1, 2])
+        shp = mw.add_const(np.array([2, 1], np.int32), "shape")
+        y = mw.add_op("RESHAPE", [y, shp], [2, 1])
+        path = tmp_path / "static.tflite"
+        path.write_bytes(mw.finish(outputs=[y]))
+
+        bundle = tflite.load_bundle(str(path))
+        # static operands are excluded from the device params pytree
+        assert all(a.dtype != np.int32 for a in bundle.params.values())
+        xv = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+        got = np.asarray(jax.jit(bundle.apply_fn)(bundle.params, xv))
+        want = np.pad(xv, [(0, 0), (1, 1), (1, 1), (0, 0)]).mean(
+            axis=(1, 2)).reshape(2, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
