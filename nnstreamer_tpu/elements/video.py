@@ -77,6 +77,82 @@ _PADDED_FMTS = frozenset({"RGBx", "BGRx"})
 #: ITU-R BT.601 luma weights (the GStreamer videoconvert default)
 _LUMA = np.array([0.299, 0.587, 0.114], np.float32)
 
+#: planar (I420) / semi-planar (NV12) YUV 4:2:0 — the camera-native
+#: formats every upstream v4l2src example negotiates before videoconvert.
+#: Frames are the flat GStreamer byte layout viewed as [H*3/2, W] uint8
+#: (or any shape totalling H*W*3/2 bytes); conversion is BT.601 limited
+#: range (Y 16-235, chroma biased at 128), like GStreamer's default.
+_YUV_FMTS = frozenset({"I420", "NV12"})
+
+
+def _yuv_frame_hw(frame: np.ndarray, caps: Optional[Caps]) -> tuple:
+    """(height, width) of a YUV frame: caps fields when negotiated, else
+    derived from the canonical [H*3/2, W] shape."""
+    if caps is not None:
+        w = caps.get("width")
+        h = caps.get("height")
+        if w and h:
+            return int(h), int(w)
+    if frame.ndim == 2 and (frame.shape[0] * 2) % 3 == 0:
+        return frame.shape[0] * 2 // 3, frame.shape[1]
+    raise ElementError(
+        f"YUV frame of shape {frame.shape} needs width=/height= caps "
+        "(cannot derive the plane split)")
+
+
+def _split_yuv(frame: np.ndarray, h: int, w: int, fmt: str):
+    flat = np.asarray(frame, np.uint8).ravel()
+    need = h * w * 3 // 2
+    if flat.size != need:
+        raise ElementError(
+            f"{fmt} frame has {flat.size} bytes, {h}x{w} needs {need}")
+    if h % 2 or w % 2:
+        raise ElementError(f"{fmt} needs even dimensions, got {h}x{w}")
+    y = flat[:h * w].reshape(h, w)
+    if fmt == "I420":
+        q = h * w // 4
+        u = flat[h * w:h * w + q].reshape(h // 2, w // 2)
+        v = flat[h * w + q:].reshape(h // 2, w // 2)
+    else:  # NV12: interleaved UV plane
+        uv = flat[h * w:].reshape(h // 2, w // 2, 2)
+        u, v = uv[..., 0], uv[..., 1]
+    return y, u, v
+
+
+def _yuv_to_rgb(frame: np.ndarray, h: int, w: int, fmt: str) -> np.ndarray:
+    """[flat YUV420] -> [H, W, 3] RGB uint8 (BT.601 limited range)."""
+    y, u, v = _split_yuv(frame, h, w, fmt)
+    yy = 1.164 * (y.astype(np.float32) - 16.0)
+    # chroma upsample: nearest 2x2 (GStreamer's fast path)
+    uu = np.repeat(np.repeat(u, 2, 0), 2, 1).astype(np.float32) - 128.0
+    vv = np.repeat(np.repeat(v, 2, 0), 2, 1).astype(np.float32) - 128.0
+    r = yy + 1.596 * vv
+    g = yy - 0.813 * vv - 0.391 * uu
+    b = yy + 2.018 * uu
+    rgb = np.stack([r, g, b], axis=-1)
+    return np.clip(np.round(rgb), 0, 255).astype(np.uint8)
+
+
+def _rgb_to_yuv(rgb: np.ndarray, fmt: str) -> np.ndarray:
+    """[H, W, 3] RGB uint8 -> [H*3/2, W] flat YUV420 (BT.601 limited)."""
+    h, w = rgb.shape[:2]
+    if h % 2 or w % 2:
+        raise ElementError(f"{fmt} needs even dimensions, got {h}x{w}")
+    f = rgb.astype(np.float32)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    y = 16.0 + 0.257 * r + 0.504 * g + 0.098 * b
+    uf = 128.0 - 0.148 * r - 0.291 * g + 0.439 * b
+    vf = 128.0 + 0.439 * r - 0.368 * g - 0.071 * b
+    # chroma subsample: 2x2 box average
+    u = uf.reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+    v = vf.reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+    if fmt == "I420":
+        flat = np.concatenate([y.ravel(), u.ravel(), v.ravel()])
+    else:  # NV12
+        flat = np.concatenate([y.ravel(), np.stack([u, v], -1).ravel()])
+    return np.clip(np.round(flat), 0, 255).astype(np.uint8).reshape(
+        h * 3 // 2, w)
+
 
 def _to_rgba(frame: np.ndarray, fmt: str) -> np.ndarray:
     """[H, W, C] in ``fmt`` -> [H, W, 4] RGBA (alpha preserved; opaque for
@@ -116,10 +192,10 @@ def _infer_fmt(caps: Caps, frame: np.ndarray) -> str:
         c = 1 if frame.ndim == 2 else frame.shape[-1]
         fmt = {1: "GRAY8", 3: "RGB", 4: "RGBA"}.get(c, "RGB")
     fmt = str(fmt)
-    if fmt not in _CHANNEL_ORDER and fmt != "GRAY8":
+    if fmt not in _CHANNEL_ORDER and fmt != "GRAY8" and fmt not in _YUV_FMTS:
         raise ElementError(
             f"compositor: unsupported frame format {fmt!r} "
-            "(8-bit RGB family / GRAY8)")
+            "(8-bit RGB family / GRAY8 / I420 / NV12)")
     return fmt
 
 
@@ -167,10 +243,16 @@ class Compositor(Element):
         base_buf = bufs[pads[0]]
         base = np.asarray(base_buf.tensors[0])
         base_fmt = _infer_fmt(self.in_caps.get(pads[0]), base)
-        squeeze = base.ndim == 2
-        if squeeze:
-            base = base[..., None]
-        out = _to_rgba(base, base_fmt).astype(np.float32)
+        squeeze = False
+        if base_fmt in _YUV_FMTS:  # camera-native base: blend in RGB space
+            h, w = _yuv_frame_hw(base, self.in_caps.get(pads[0]))
+            base = _yuv_to_rgb(base, h, w, base_fmt)
+            out = _to_rgba(base, "RGB").astype(np.float32)
+        else:
+            squeeze = base.ndim == 2
+            if squeeze:
+                base = base[..., None]
+            out = _to_rgba(base, base_fmt).astype(np.float32)
         a0 = self._pad_alpha.get(pads[0], 1.0)
         if a0 != 1.0:  # GStreamer fades the base toward the background
             out[..., :3] *= a0
@@ -180,6 +262,10 @@ class Compositor(Element):
             meta.update(ov_buf.meta)
             ov = np.asarray(ov_buf.tensors[0])
             ov_fmt = _infer_fmt(self.in_caps.get(pad), ov)
+            if ov_fmt in _YUV_FMTS:
+                oh, ow = _yuv_frame_hw(ov, self.in_caps.get(pad))
+                ov = _yuv_to_rgb(ov, oh, ow, ov_fmt)
+                ov_fmt = "RGB"
             if ov.ndim == 2:
                 ov = ov[..., None]
             if ov.shape[:2] != base.shape[:2]:
@@ -190,7 +276,10 @@ class Compositor(Element):
             a = (rgba[..., 3:4] / 255.0) * self._pad_alpha.get(pad, 1.0)
             out[..., :3] = rgba[..., :3] * a + out[..., :3] * (1.0 - a)
         res = np.clip(np.round(out), 0, 255).astype(np.uint8)
-        res = _from_rgba(res, base_fmt)
+        if base_fmt in _YUV_FMTS:  # output format follows the base frame
+            res = _rgb_to_yuv(res[..., :3], base_fmt)
+        else:
+            res = _from_rgba(res, base_fmt)
         if squeeze:
             res = res[..., 0]
         new = base_buf.with_tensors([res], spec=None)
@@ -202,10 +291,13 @@ class Compositor(Element):
 
 @register_element("videoconvert")
 class VideoConvert(Element):
-    """Convert ``video/x-raw`` frames between the RGB family and GRAY8.
+    """Convert ``video/x-raw`` frames between the RGB family, GRAY8, and
+    the camera-native YUV 4:2:0 formats (I420 / NV12, BT.601).
 
     ``format=`` names the output format; without it frames pass through
     (the reference negotiates; this runtime's negotiation is explicit).
+    The stock upstream camera pipeline runs verbatim:
+    ``v4l2src/appsrc (I420) ! videoconvert format=RGB ! tensor_converter``.
     """
 
     kind = "videoconvert"
@@ -213,11 +305,11 @@ class VideoConvert(Element):
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
         self.format = str(self.props.get("format", "") or "")
-        if self.format and self.format not in _CHANNEL_ORDER and \
-                self.format != "GRAY8":
+        known = set(_CHANNEL_ORDER) | {"GRAY8"} | _YUV_FMTS
+        if self.format and self.format not in known:
             raise ElementError(
                 f"{self.name}: unsupported format {self.format!r} "
-                f"(one of {sorted(_CHANNEL_ORDER) + ['GRAY8']})")
+                f"(one of {sorted(known)})")
         self._in_fmt: Optional[str] = None
 
     def configure(self, in_caps, out_pads):
@@ -239,10 +331,25 @@ class VideoConvert(Element):
         if not self.format or self.format == self._in_fmt:
             return [(SRC, buf)]
         frame = np.asarray(buf.tensors[0])
+        in_fmt = self._in_fmt or "RGB"
+        in_caps = next(iter(self.in_caps.values()), None)
+        if in_fmt in _YUV_FMTS:
+            h, w = _yuv_frame_hw(frame, in_caps)
+            rgb = _yuv_to_rgb(frame, h, w, in_fmt)
+            if self.format in _YUV_FMTS:
+                out = _rgb_to_yuv(rgb, self.format)
+            elif self.format == "RGB":
+                out = rgb
+            else:
+                out = _from_rgba(_to_rgba(rgb, "RGB"), self.format)
+            return [(SRC, buf.with_tensors([out], spec=None))]
         if frame.ndim == 2:  # GRAY8 without channel dim
             frame = frame[..., None]
-        rgba = _to_rgba(frame, self._in_fmt or "RGB")
-        out = _from_rgba(rgba, self.format)
+        rgba = _to_rgba(frame, in_fmt)
+        if self.format in _YUV_FMTS:
+            out = _rgb_to_yuv(rgba[..., :3], self.format)
+        else:
+            out = _from_rgba(rgba, self.format)
         return [(SRC, buf.with_tensors([out], spec=None))]
 
 
@@ -276,6 +383,10 @@ class VideoScale(Element):
         if src.media not in (MediaType.VIDEO, MediaType.ANY):
             raise ElementError(
                 f"{self.name}: needs video/x-raw input, got {src.media}")
+        if str(src.get("format") or "") in _YUV_FMTS:
+            raise ElementError(
+                f"{self.name}: cannot scale subsampled YUV directly — "
+                "insert 'videoconvert format=RGB' upstream")
         fields = dict(src.dict)
         fields.pop("spec", None)
         if self.width:

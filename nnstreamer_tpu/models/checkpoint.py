@@ -106,8 +106,8 @@ def load_tensors(path: str) -> Dict[str, np.ndarray]:
     if path.endswith(".safetensors"):
         return read_safetensors(path)
     if path.endswith(".npz"):
-        z = np.load(path)
-        return {k: z[k] for k in z.files}
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
     raise CheckpointError(
         f"{path}: unsupported checkpoint format (want .safetensors, "
         ".safetensors.index.json, or .npz)")

@@ -433,20 +433,38 @@ def load_bundle(path: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle
     The file's weight tensors become the bundle's params pytree (so they
     ride HBM and donation/sharding machinery like any zoo model); the graph
     walk happens at trace time, producing one fused XLA program.
+
+    ``custom=param_dtype:bfloat16`` casts the float weights (e.g. to feed
+    the MXU at 2 bytes/param); other option keys are rejected so a typo'd
+    pipeline string fails loudly instead of being silently ignored.
     """
+    opts = dict(opts or {})
+    param_dtype = opts.pop("param_dtype", None)
+    if opts:
+        raise TFLiteError(
+            f"{path}: unsupported options {sorted(opts)} "
+            "(tflite ingestion supports: param_dtype)")
     with open(path, "rb") as f:
         data = f.read()
     g = TFLiteGraph(data, name=path)
     # Static-metadata operands (reshape shapes, pad widths, mean axes) stay
     # OUT of params: they must be concrete at trace time, and shipping them
-    # to device would be pointless anyway.
+    # to device would be pointless anyway.  A constant ALSO consumed as
+    # data by some other op keeps its params slot.
     static_ids = set()
+    data_ids = set()
     for op in g.ops:
-        for pos in _STATIC_OPERANDS.get(op.kind, ()):
-            if pos < len(op.inputs):
-                static_ids.add(op.inputs[pos])
+        static_pos = _STATIC_OPERANDS.get(op.kind, ())
+        for pos, idx in enumerate(op.inputs):
+            (static_ids if pos in static_pos else data_ids).add(idx)
     params = {f"t{i}": np.asarray(v) for i, v in g.constants.items()
-              if i not in static_ids}
+              if i not in (static_ids - data_ids)}
+    if param_dtype:
+        from ..core.types import dtype_from_name
+
+        dt = dtype_from_name(str(param_dtype))
+        params = {k: v.astype(dt) if np.issubdtype(v.dtype, np.floating)
+                  else v for k, v in params.items()}
 
     def apply_fn(p, *inputs):
         if len(inputs) != len(g.inputs):

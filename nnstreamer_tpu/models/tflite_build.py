@@ -203,15 +203,28 @@ class ModelWriter:
         if kind == "RESHAPE":
             if "new_shape" in o:
                 vec = w.vector_scalar("<i", o["new_shape"])
-                return 13, w.table(offsets={0: vec})
-            return 13, None
+                return 17, w.table(offsets={0: vec})
+            return 17, None
         if kind == "ADD":
             return 11, w.table(scalars={0: ("<b", act)})
+        if kind == "MUL":
+            return 21, w.table(scalars={0: ("<b", act)})
+        if kind == "SUB":
+            return 28, w.table(scalars={0: ("<b", act)})
         if kind == "CONCATENATION":
             return 10, w.table(scalars={0: ("<i", o.get("axis", 0)),
                                         1: ("<b", act)})
         if kind == "MEAN":
             return 27, w.table(scalars={0: ("<b", 1 if o.get("keep_dims") else 0)})
+        if kind == "SQUEEZE":
+            if "squeeze_dims" in o:
+                vec = w.vector_scalar("<i", o["squeeze_dims"])
+                return 30, w.table(offsets={0: vec})
+            return 30, None
+        if o:
+            raise ValueError(
+                f"{kind}: options {sorted(o)} given but this writer emits "
+                "no options table for the op — they would be silently lost")
         return 0, None
 
     def finish(self, outputs: List[int]) -> bytes:

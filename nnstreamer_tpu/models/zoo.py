@@ -98,10 +98,13 @@ def build(name: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle:
     if b is not None:
         return b(opts)
     # Model FILES (the reference's default tensor_filter path: model=<file>).
-    if key.endswith((".tflite", ".safetensors", ".npz",
-                     ".safetensors.index.json")):
-        import os
+    import os
 
+    is_ckpt_dir = os.path.isdir(key) and (
+        os.path.exists(os.path.join(key, "model.safetensors.index.json"))
+        or os.path.exists(os.path.join(key, "model.safetensors")))
+    if key.endswith((".tflite", ".safetensors", ".npz",
+                     ".safetensors.index.json")) or is_ckpt_dir:
         if not os.path.exists(key):
             raise KeyError(f"model file not found: {key}")
         if key.endswith(".tflite"):

@@ -96,8 +96,8 @@ def local_device_count() -> int:
 def global_mesh(**axes: int):
     """Mesh over ALL processes' devices (== :func:`make_mesh` over
     ``jax.devices()``, which is global after :func:`initialize`).  Axis
-    sizes multiply to the global device count; one axis may be -1 to
-    absorb the rest (make_mesh semantics)."""
+    sizes multiply to the global device count; ``data=0`` (the default)
+    absorbs the rest (make_mesh semantics)."""
     from .mesh import make_mesh
 
     return make_mesh(**axes)

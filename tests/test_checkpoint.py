@@ -184,6 +184,29 @@ class TestLlamaImport:
         got, cfg = llama.load_checkpoint(p, cfg=CFG, dtype="float32")
         _assert_tree_equal(got, params)
 
+    def test_zoo_builds_from_checkpoint_directory(self, tmp_path):
+        # HF sharded layout as a DIRECTORY path (review r3 finding)
+        params = llama.init_params(CFG, seed=4)
+        hf = _to_hf(params, CFG)
+        keys = sorted(hf)
+        half = len(keys) // 2
+        ckpt.write_safetensors(str(tmp_path / "s1.safetensors"),
+                               {k: hf[k] for k in keys[:half]})
+        ckpt.write_safetensors(str(tmp_path / "s2.safetensors"),
+                               {k: hf[k] for k in keys[half:]})
+        (tmp_path / "model.safetensors.index.json").write_text(json.dumps({
+            "weight_map": {k: ("s1.safetensors" if k in keys[:half]
+                               else "s2.safetensors") for k in keys}}))
+        _write_config(tmp_path, CFG)
+        bundle = zoo.build(str(tmp_path), {"param_dtype": "float32",
+                                           "dtype": "float32"})
+        assert bundle.config == CFG
+        toks = np.array([[3, 1]], np.int32)
+        np.testing.assert_allclose(
+            np.asarray(bundle.apply_fn(bundle.params, toks)),
+            np.asarray(llama.forward(params, toks, CFG,
+                                     compute_dtype="float32")), rtol=1e-6)
+
     def test_zoo_builds_bundle_from_safetensors(self, tmp_path):
         params = llama.init_params(CFG, seed=3)
         path = tmp_path / "model.safetensors"

@@ -1,0 +1,160 @@
+"""Two-PROCESS distribution witnesses (VERDICT r2 missing #5 / SURVEY
+§5.8): the DCN half of the comm backend, previously code without a test.
+
+* Collective path: two real OS processes join via
+  ``parallel.distributed.initialize`` (jax coordinator + gloo CPU
+  collectives), build a global mesh spanning both processes' devices, and
+  reduce process-local shards — ordered across batches.
+* Stream-feed path: a query server pipeline in a second process; the
+  parent feeds batches over the real TCP wire and asserts ordered
+  reassembly (the "DCN/gRPC host-level stream feed" role).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env(devices_per_proc: int) -> dict:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # children pin cpu via jax.config
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+_COLLECTIVE_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nnstreamer_tpu.parallel import distributed as dist
+    from nnstreamer_tpu.parallel import make_mesh
+
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    ok = dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=2, process_id=pid)
+    assert ok and dist.is_initialized()
+    assert dist.local_device_count() == 2, jax.local_devices()
+    assert dist.global_device_count() == 4, jax.devices()
+
+    mesh = dist.global_mesh()  # data axis absorbs all four global devices
+    assert mesh.devices.size == 4
+    # feed sharded batches; reductions must come back in batch order
+    for k in range(3):
+        local = np.arange(2, dtype=np.float32) + 10 * pid + 100 * k
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), local)
+        total = jax.jit(lambda a: a.sum(),
+                        out_shardings=NamedSharding(mesh, P()))(arr)
+        val = float(np.asarray(jax.device_get(total)))
+        expect = float(sum((np.arange(2) + 10 * p + 100 * k).sum()
+                           for p in range(2)))
+        assert val == expect, (k, val, expect)
+        print(f"BATCH {k} {val}", flush=True)
+    print("DCN OK", pid, flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_collectives_ordered(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(_COLLECTIVE_CHILD)
+    port = _free_port()
+    env = _child_env(devices_per_proc=2)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process collective child hung")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {pid} failed:\n{out}"
+        assert f"DCN OK {pid}" in out
+        # batches arrived in order on both processes
+        lines = [l for l in out.splitlines() if l.startswith("BATCH")]
+        assert [l.split()[1] for l in lines] == ["0", "1", "2"]
+
+
+_SERVER_CHILD = textwrap.dedent("""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import sys
+    import numpy as np
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.types import TensorsSpec
+    from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+    spec = TensorsSpec.from_string("4", "float32")
+    register_custom_easy("dcn-double", lambda ins: [ins[0] * 2],
+                         in_spec=spec, out_spec=spec)
+    p = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=9 ! "
+        "tensor_filter framework=custom-easy model=dcn-double ! "
+        "tensor_query_serversink id=9")
+    p.start()
+    print("PORT", p.element("ssrc").bound_port, flush=True)
+    sys.stdin.read()  # parent closes stdin to stop the server
+    p.stop()
+""")
+
+
+@pytest.mark.slow
+def test_query_feed_across_processes(tmp_path):
+    """Host-level stream feed over the real wire to another PROCESS:
+    ordered round-trip of a batch stream through a remote pipeline."""
+    import nnstreamer_tpu as nt
+
+    script = tmp_path / "server.py"
+    script.write_text(_SERVER_CHILD)
+    env = _child_env(devices_per_proc=2)
+    srv = subprocess.Popen([sys.executable, str(script)], env=env,
+                           stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                           stderr=subprocess.STDOUT, text=True)
+    try:
+        line = srv.stdout.readline()
+        assert line.startswith("PORT"), f"server did not start: {line}"
+        port = int(line.split()[1])
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "timeout=30 ! tensor_sink name=out")
+        with cli:
+            for i in range(8):
+                cli.push("src", np.full((4,), float(i), np.float32))
+            for i in range(8):
+                out = cli.pull("out", timeout=30)
+                np.testing.assert_allclose(
+                    np.asarray(out.tensors[0]), np.full((4,), 2.0 * i))
+            cli.eos("src")
+            cli.wait(timeout=30)
+    finally:
+        try:
+            srv.stdin.close()
+            srv.wait(timeout=20)
+        except Exception:
+            srv.kill()

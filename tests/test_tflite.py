@@ -189,6 +189,53 @@ class TestPipelineIntegration:
         with pytest.raises(tflite.TFLiteError, match="quantized"):
             tflite.TFLiteGraph(blob)
 
+    def test_mul_fused_activation_roundtrips(self, tmp_path):
+        # writer emits MulOptions (review r3 finding): relu must clamp
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4])
+        c = mw.add_const(np.array([[-1, 1, -1, 1]], np.float32))
+        y = mw.add_op("MUL", [x, c], [1, 4], options={"act": "relu"})
+        path = tmp_path / "mul.tflite"
+        path.write_bytes(mw.finish(outputs=[y]))
+        g = tflite.TFLiteGraph(path.read_bytes())
+        assert g.ops[0].attrs["act"] == 1  # RELU
+        bundle = tflite.load_bundle(str(path))
+        got = np.asarray(bundle.apply_fn(
+            bundle.params, np.array([[2, 2, -3, -3]], np.float32)))
+        np.testing.assert_array_equal(got, [[0, 2, 3, 0]])
+
+    def test_shared_static_and_data_constant(self, tmp_path):
+        # ONE constant consumed both as RESHAPE's static shape operand and
+        # as ADD's data operand must keep its params slot AND resolve as a
+        # trace-time constant (review r3 finding)
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([2, 2], dtype=np.int32)
+        c = mw.add_const(np.array([4], np.int32), "four")
+        flat = mw.add_op("RESHAPE", [x, c], [4], out_dtype=np.int32)
+        y = mw.add_op("ADD", [flat, c], [4], out_dtype=np.int32)
+        path = tmp_path / "shared.tflite"
+        path.write_bytes(mw.finish(outputs=[y]))
+        bundle = tflite.load_bundle(str(path))
+        import jax
+
+        got = np.asarray(jax.jit(bundle.apply_fn)(
+            bundle.params, np.ones((2, 2), np.int32)))
+        np.testing.assert_array_equal(got, [5, 5, 5, 5])
+
+    def test_unknown_option_rejected(self, tmp_path):
+        path, _ = _build_cnn_file(tmp_path)
+        with pytest.raises(tflite.TFLiteError, match="param_dtype"):
+            tflite.load_bundle(path, {"nope": "1"})
+
+    def test_param_dtype_option(self, tmp_path):
+        from nnstreamer_tpu.core.types import bfloat16
+
+        path, _ = _build_cnn_file(tmp_path)
+        bundle = tflite.load_bundle(path, {"param_dtype": "bfloat16"})
+        floats = [a for a in bundle.params.values()
+                  if a.dtype in (np.float32, bfloat16)]
+        assert floats and all(a.dtype == bfloat16 for a in floats)
+
     def test_static_operands_jit_clean(self, tmp_path):
         """MEAN axes / PAD widths / shape-tensor RESHAPE resolve as trace-
         time constants — a graph using them must survive jax.jit (the

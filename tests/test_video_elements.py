@@ -102,6 +102,118 @@ def test_reference_style_pipeline_runs_verbatim():
         assert b.tensors[0].dtype == np.float32
 
 
+class TestYUV:
+    """I420/NV12 camera-native formats (VERDICT r2 missing #4): BT.601
+    limited-range goldens and the verbatim upstream camera topology."""
+
+    def _solid_i420(self, h, w, y, u, v):
+        flat = np.concatenate([
+            np.full(h * w, y, np.uint8),
+            np.full(h * w // 4, u, np.uint8),
+            np.full(h * w // 4, v, np.uint8)])
+        return flat.reshape(h * 3 // 2, w)
+
+    def test_i420_red_golden(self):
+        # BT.601: pure red is (Y,U,V) = (82, 90, 240)
+        from nnstreamer_tpu.elements.video import _yuv_to_rgb
+
+        rgb = _yuv_to_rgb(self._solid_i420(4, 4, 82, 90, 240), 4, 4, "I420")
+        r, g, b = (int(c) for c in rgb[0, 0])
+        assert r == 255 and g <= 2 and b <= 2
+
+    def test_rgb_i420_roundtrip(self):
+        from nnstreamer_tpu.elements.video import _rgb_to_yuv, _yuv_to_rgb
+
+        rng = np.random.default_rng(0)
+        # block-uniform image: chroma subsampling is lossless on it
+        small = rng.integers(0, 256, (4, 4, 3), np.uint8)
+        rgb = np.repeat(np.repeat(small, 2, 0), 2, 1)
+        back = _yuv_to_rgb(_rgb_to_yuv(rgb, "I420"), 8, 8, "I420")
+        # limited-range quantization costs a few codes, not more
+        assert np.abs(back.astype(int) - rgb.astype(int)).max() <= 6
+
+    def test_nv12_matches_i420(self):
+        from nnstreamer_tpu.elements.video import _rgb_to_yuv, _yuv_to_rgb
+
+        rng = np.random.default_rng(1)
+        rgb = rng.integers(0, 256, (8, 6, 3), np.uint8)
+        a = _yuv_to_rgb(_rgb_to_yuv(rgb, "I420"), 8, 6, "I420")
+        b = _yuv_to_rgb(_rgb_to_yuv(rgb, "NV12"), 8, 6, "NV12")
+        np.testing.assert_array_equal(a, b)
+
+    def test_camera_pipeline_verbatim_i420(self):
+        """The stock upstream camera topology with I420 caps, as written:
+        appsrc (I420) ! videoconvert ! tensor_converter ! ..."""
+        p = nt.Pipeline(
+            "appsrc name=cam caps=video/x-raw,format=I420,width=16,height=8 ! "
+            "videoconvert format=RGB ! tensor_converter ! "
+            "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+            "tensor_sink name=out")
+        frame = self._solid_i420(8, 16, 82, 90, 240)  # pure red
+        with p:
+            p.push("cam", frame)
+            b = p.pull("out", timeout=15)
+            p.eos()
+            p.wait(timeout=15)
+        out = np.asarray(b.tensors[0])
+        assert out.shape[-3:] == (8, 16, 3)
+        assert out.reshape(-1, 3)[0, 0] == 1.0  # red channel saturated
+        assert out.reshape(-1, 3)[0, 1] <= 0.01
+
+    def test_convert_rgb_to_nv12_and_back_pipeline(self):
+        p = nt.Pipeline(
+            "appsrc name=src caps=video/x-raw,format=RGB,width=8,height=8 ! "
+            "videoconvert format=NV12 ! tensor_sink name=out", fuse=False)
+        rgb = np.repeat(np.repeat(
+            np.random.default_rng(2).integers(0, 256, (4, 4, 3), np.uint8),
+            2, 0), 2, 1)
+        with p:
+            p.push("src", rgb)
+            b = p.pull("out", timeout=15)
+            p.eos()
+            p.wait(timeout=15)
+        yuv = np.asarray(b.tensors[0])
+        assert yuv.shape == (12, 8)  # H*3/2 x W byte layout
+
+    def test_compositor_i420_base(self):
+        desc = (
+            "appsrc name=cam caps=video/x-raw,format=I420,width=8,height=8 ! comp. "
+            "appsrc name=ov caps=video/x-raw,format=RGBA,width=8,height=8 ! comp. "
+            "compositor name=comp ! tensor_sink name=out")
+        p = nt.Pipeline(desc, fuse=False)
+        base = self._solid_i420(8, 8, 16, 128, 128)  # black
+        ov = np.zeros((8, 8, 4), np.uint8)
+        ov[..., 1] = 200
+        ov[..., 3] = 255  # opaque green overlay
+        with p:
+            p.push("cam", base)
+            p.push("ov", ov)
+            b = p.pull("out", timeout=15)
+            p.eos("cam")
+            p.eos("ov")
+            p.wait(timeout=15)
+        out = np.asarray(b.tensors[0])
+        assert out.shape == (12, 8)  # output stays I420 like the base
+        from nnstreamer_tpu.elements.video import _yuv_to_rgb
+
+        rgb = _yuv_to_rgb(out, 8, 8, "I420")
+        assert abs(int(rgb[0, 0, 1]) - 200) <= 4  # green survived the trip
+        assert rgb[0, 0, 0] <= 6 and rgb[0, 0, 2] <= 6
+
+    def test_videoscale_rejects_yuv(self):
+        with pytest.raises(Exception, match="videoconvert"):
+            p = nt.Pipeline(
+                "appsrc name=src caps=video/x-raw,format=I420,width=8,height=8 ! "
+                "videoscale width=4 height=4 ! tensor_sink name=out")
+            p.start()
+
+    def test_odd_dims_rejected(self):
+        from nnstreamer_tpu.elements.video import _rgb_to_yuv
+
+        with pytest.raises(Exception, match="even"):
+            _rgb_to_yuv(np.zeros((5, 4, 3), np.uint8), "I420")
+
+
 class TestReviewRegressions:
     def test_alpha_preserved_rgba_to_bgra(self):
         f = np.zeros((2, 2, 4), np.uint8)
