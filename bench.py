@@ -352,6 +352,10 @@ def bench_audio(batch: int, batches: int, warmup: int,
     mopts = f"dtype:float32,batch:{batch}"
     if model == "wav2vec2":
         mopts += f",samples:{samples}"
+    # wav2vec2 decodes on-edge: mode=ctc fuses a device argmax into the
+    # same XLA program, so D2H is [B,T] ids, not [B,T,vocab] logits
+    # (which were the whole bottleneck on the tunneled chip: 405 win/s).
+    dec = "tensor_decoder mode=ctc ! " if model == "wav2vec2" else ""
     if source == "audiotestsrc":
         # Device-generated windows (the audio analog of the videotestsrc
         # device source): zero H2D in the loop, measures the pipeline.
@@ -360,7 +364,7 @@ def bench_audio(batch: int, batches: int, warmup: int,
             f"audiotestsrc device=true batch={batch} num-buffers={total} "
             f"samplesperbuffer={samples} rate=16000 name=src ! "
             f"tensor_filter framework=jax model={model} "
-            f"custom={mopts} name=f ! "
+            f"custom={mopts} name=f ! {dec}"
             f"tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY}"
         )
         r = _source_driven_bench(
@@ -373,7 +377,7 @@ def bench_audio(batch: int, batches: int, warmup: int,
     desc = (
         f"appsrc name=src caps=other/tensors,dimensions={samples}:{batch},types=float32 ! "
         f"tensor_filter framework=jax model={model} custom={mopts} name=f ! "
-        "tensor_sink name=out"
+        f"{dec}tensor_sink name=out"
     )
     r = _pipeline_bench(
         desc,

@@ -73,6 +73,7 @@ _BUILTIN_MODULES = [
     "nnstreamer_tpu.decoders.image_segment",
     "nnstreamer_tpu.decoders.direct_video",
     "nnstreamer_tpu.decoders.serialize",
+    "nnstreamer_tpu.decoders.ctc",
     "nnstreamer_tpu.converters.serialize",
     "nnstreamer_tpu.trainer.subplugin",
 ]

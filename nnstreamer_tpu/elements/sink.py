@@ -45,6 +45,7 @@ class TensorSink(SinkElement):
         self._q: _queue.Queue = _queue.Queue(maxsize=cap)
         self._callbacks: List[Callable[[Buffer], None]] = []
         self.to_host = bool(self.props.get("to_host", True))
+        self._resolver = None  # lazy 1-thread host_post resolver
 
     def connect_new_data(self, cb: Callable[[Buffer], None]) -> None:
         """Reference: g_signal_connect(sink, "new-data", ...)."""
@@ -65,6 +66,17 @@ class TensorSink(SinkElement):
             for t in buf.tensors:
                 if hasattr(t, "copy_to_host_async"):
                     t.copy_to_host_async()
+            if "_host_post" in buf.meta:
+                # Resolve the deferred decode on a dedicated worker, NOT
+                # the stage thread (would stall the pipeline) and NOT the
+                # pull thread (was round-2's out.proc hotspot): pop()
+                # collects a finished result.  Single worker => FIFO order.
+                if self._resolver is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._resolver = ThreadPoolExecutor(
+                        1, thread_name_prefix=f"{self.name}-resolve")
+                buf = self._resolver.submit(buf.to_host)
         if self._callbacks:
             buf = buf.resolve()
         for cb in self._callbacks:
@@ -98,14 +110,38 @@ class TensorSink(SinkElement):
                     check()
                 if _time.monotonic() > deadline:
                     raise TimeoutError(f"no buffer at sink {self.name!r} in {timeout}s")
-        return buf.to_host() if self.to_host else buf
+        # pop's timeout bounds ARRIVAL; materialization gets its own full
+        # budget (the pre-resolver to_host() here was unbounded — a slow
+        # tunneled D2H must not start failing because the queue wait ate
+        # the deadline).
+        return self._materialize(buf, timeout)
 
     def try_pop(self) -> Optional[Buffer]:
         try:
             buf = self._q.get_nowait()
         except _queue.Empty:
             return None
-        return buf.to_host() if self.to_host else buf
+        return self._materialize(buf, 30.0)
+
+    def _materialize(self, item, timeout: float) -> Buffer:
+        import concurrent.futures as _cf
+
+        if isinstance(item, _cf.Future):  # background-resolved host buffer
+            try:
+                return item.result(timeout=timeout)
+            except _cf.TimeoutError:
+                # builtin TimeoutError is pop()'s documented contract (and
+                # the two are distinct types on py3.10)
+                raise TimeoutError(
+                    f"host_post resolution at sink {self.name!r} exceeded "
+                    f"{timeout}s") from None
+        return item.to_host() if self.to_host else item
+
+    def stop(self) -> None:
+        if self._resolver is not None:
+            self._resolver.shutdown(wait=False)
+            self._resolver = None
+        super().stop()
 
     @property
     def depth(self) -> int:

@@ -23,7 +23,7 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-from ..core.types import TensorsSpec
+from ..core.types import TensorSpec, TensorsSpec
 from .zoo import ModelBundle, register_model
 
 SAMPLE_RATE = 16000
@@ -275,11 +275,20 @@ def _wav2vec2(opts: Dict[str, str]) -> ModelBundle:
                              vocab=vocab, seed=seed)
     apply_fn = functools.partial(apply_w2v, n_heads=n_heads,
                                  compute_dtype=dtype)
+    # Static [B, T, vocab] out spec via shape-only tracing (T falls out of
+    # the conv encoder strides; no compile, no FLOPs).  A static spec keeps
+    # the whole chain fusable, so a downstream ctc decoder's device argmax
+    # joins the same XLA program and only [B, T] ids cross D2H.
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.eval_shape(apply_fn, params,
+                         jax.ShapeDtypeStruct((batch, samples), jnp.float32))
     return ModelBundle(
         apply_fn=apply_fn,
         params=params,
         in_spec=TensorsSpec.from_string(f"{samples}:{batch}", "float32"),
-        out_spec=None,  # T depends on conv strides; derived per buffer
+        out_spec=TensorsSpec((TensorSpec.from_shape(out.shape, out.dtype),)),
         param_pspecs=None,
         name="wav2vec2",
     )

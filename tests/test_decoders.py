@@ -369,3 +369,85 @@ class TestFusedDecodePaths:
         fused = self._run_fused(d, [boxes, scores])
         dets = fused.meta["detections"]  # B==1 collapses to one frame's list
         assert len(dets) == 5
+
+
+class TestCTC:
+    """ctc decoder (decode-on-edge for wav2vec2-class logits): device
+    argmax + host collapse; the D2H payload shrinks by a factor of vocab."""
+
+    def _logits(self, ids, vocab=8):
+        # logits whose argmax is exactly `ids` ([B, T])
+        ids = np.asarray(ids)
+        out = np.zeros(ids.shape + (vocab,), np.float32)
+        np.put_along_axis(out, ids[..., None], 5.0, axis=-1)
+        return out
+
+    def test_collapse_semantics(self):
+        from nnstreamer_tpu.decoders.ctc import collapse_ctc
+
+        seqs = collapse_ctc(np.array([[0, 3, 3, 0, 3, 2, 2, 0]]), blank=0)
+        np.testing.assert_array_equal(seqs[0], [3, 3, 2])  # blank splits 3s
+
+    def test_host_decode(self):
+        from nnstreamer_tpu.decoders.ctc import CTC
+
+        d = CTC({})
+        logits = self._logits([[0, 5, 5, 0, 2, 0]])
+        out = d.decode([logits], Buffer([logits]))
+        np.testing.assert_array_equal(out.tensors[0], [[5, 2]])
+        np.testing.assert_array_equal(out.meta["lengths"], [2])
+
+    def test_fused_matches_host_and_shrinks_d2h(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.core.types import TensorsSpec
+        from nnstreamer_tpu.decoders.ctc import CTC
+
+        d = CTC({})
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((4, 50, 32)).astype(np.float32)
+        spec = TensorsSpec.of([logits])
+        fn, out_spec = d.device_fn(spec)
+        outs = fn((jnp.asarray(logits),))
+        # device output is ids only: vocab-factor smaller than the logits
+        assert outs[0].shape == (4, 50) and outs[0].dtype == jnp.int32
+        assert out_spec[0].shape == (4, 50)
+        fused = d.host_post([np.asarray(o) for o in outs], Buffer([logits]))
+        host = d.decode([logits], Buffer([logits]))
+        for a, b in zip(fused.meta["tokens"], host.meta["tokens"]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_charmap_text_output(self):
+        import os
+        import tempfile
+
+        from nnstreamer_tpu.decoders.ctc import CTC
+
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "chars.txt")
+            with open(path, "w") as f:
+                f.write("\n".join(["_", "a", "b", "c"]))
+            d = CTC({"option2": path})
+            logits = self._logits([[1, 1, 0, 2, 3]], vocab=4)
+            out = d.decode([logits], Buffer([logits]))
+            assert out.meta["text"] == ["abc"]
+
+    def test_wav2vec2_pipeline_fuses_ctc(self):
+        """The bench topology: wav2vec2's static out spec lets the ctc
+        decoder join the fused XLA stage, so the sink receives ids."""
+        p = nt.Pipeline(
+            "appsrc name=src caps=other/tensors,dimensions=16000:1,types=float32 ! "
+            "tensor_filter framework=jax model=wav2vec2 "
+            "custom=dtype:float32,n_layers:2 name=f ! "
+            "tensor_decoder mode=ctc ! tensor_sink name=out")
+        fused = [s for s in p.stages if "+" in s.element.name]
+        assert fused and "tensor_decoder" in fused[0].element.name
+        wav = np.sin(np.linspace(0, 440 * np.pi, 16000,
+                                 dtype=np.float32))[None, :]
+        with p:
+            p.push("src", wav)
+            b = p.pull("out", timeout=60)
+            p.eos()
+            p.wait(timeout=30)
+        assert b.tensors[0].dtype == np.int32
+        assert "tokens" in b.meta

@@ -196,7 +196,8 @@ class TestYolo:
             "tensor_decoder mode=bounding_boxes option1=yolov5 option3=0.3 "
             "option4=64:64 option7=device ! tensor_sink name=out")
         fused = [s for s in p.stages if len(s.node_ids) > 1]
-        assert fused and len(fused[0].node_ids) == 3
+        # device source folds in: src+transform+filter+decoder
+        assert fused and len(fused[0].node_ids) == 4
         with p:
             b = p.pull("out", timeout=120)
             p.wait(timeout=60)

@@ -226,7 +226,8 @@ def test_detection_decoder_fuses_and_defers():
     )
     p = nt.Pipeline(desc, fuse=True)
     fused = [s for s in p.stages if len(s.node_ids) > 1]
-    assert fused and len(fused[0].node_ids) == 3  # transform+filter+decoder
+    # device source folds in too: src+transform+filter+decoder, one stage
+    assert fused and len(fused[0].node_ids) == 4
     with p:
         bufs = [p.pull("out", timeout=120) for _ in range(2)]
         p.wait(timeout=60)
@@ -391,3 +392,73 @@ def test_unknown_property_rejected_at_startup():
     with p2:
         p2.pull("out", timeout=10)
         p2.wait(timeout=10)
+
+
+def test_device_source_folds_into_fused_stage():
+    """VERDICT r2 weak #1 (host overhead): a device-resident source joins
+    the fused stage — the pipeline front is ONE schedulable unit, and
+    results still match the unfused run exactly."""
+    desc = (
+        "videotestsrc device=true batch=2 num-buffers=6 width=16 height=16 "
+        "pattern=smpte name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_filter framework=jax model=average custom=dims:3:16:16:2 ! "
+        "tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, fuse=True)
+    from nnstreamer_tpu.pipeline.plan import FusedSourceElement
+
+    srcs = [s for s in p.stages if isinstance(s.element, FusedSourceElement)]
+    assert len(srcs) == 1 and len(srcs[0].node_ids) == 3
+    assert len(p.stages) == 2  # fused front + sink
+    fused_out = []
+    with p:
+        for _ in range(3):
+            fused_out.append(np.asarray(p.pull("out", timeout=30).tensors[0]))
+        p.wait(timeout=30)
+    q = nt.Pipeline(desc, fuse=False)
+    with q:
+        for i in range(3):
+            want = np.asarray(q.pull("out", timeout=30).tensors[0])
+            np.testing.assert_allclose(fused_out[i], want, rtol=1e-6)
+        q.wait(timeout=30)
+
+
+def test_device_source_fold_truncates_tail_batch():
+    # num-buffers=5 with batch=2: fused source must still emit 2+2+1 frames
+    p = nt.Pipeline(
+        "videotestsrc device=true batch=2 num-buffers=5 width=8 height=8 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32 ! "
+        "tensor_sink name=out")
+    sizes = []
+    with p:
+        for _ in range(3):
+            sizes.append(np.asarray(p.pull("out", timeout=30).tensors[0]).shape[0])
+        p.wait(timeout=30)
+    assert sizes == [2, 2, 1]
+
+
+def test_sink_background_resolver_orders_and_labels():
+    """host_post resolution happens off the pull thread but stays FIFO and
+    produces identical labels/meta."""
+    desc = (
+        "videotestsrc device=true batch=2 num-buffers=12 width=16 height=16 "
+        "pattern=ball name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_filter framework=jax model=average custom=dims:3:16:16:2 ! "
+        "tensor_decoder mode=image_labeling ! tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, fuse=True)
+    metas = []
+    with p:
+        for _ in range(6):
+            b = p.pull("out", timeout=30)
+            assert "_host_post" not in b.meta  # resolved before delivery
+            metas.append(list(b.meta["label_index"]))
+        p.wait(timeout=30)
+    q = nt.Pipeline(desc, fuse=False)
+    with q:
+        for i in range(6):
+            want = q.pull("out", timeout=30).meta["label_index"]
+            assert metas[i] == list(np.atleast_1d(want))
+        q.wait(timeout=30)
