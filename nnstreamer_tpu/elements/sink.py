@@ -46,6 +46,7 @@ class TensorSink(SinkElement):
         self._callbacks: List[Callable[[Buffer], None]] = []
         self.to_host = bool(self.props.get("to_host", True))
         self._resolver = None  # lazy 1-thread host_post resolver
+        self._parked = None  # not-yet-done Future seen by try_pop
 
     def connect_new_data(self, cb: Callable[[Buffer], None]) -> None:
         """Reference: g_signal_connect(sink, "new-data", ...)."""
@@ -53,8 +54,12 @@ class TensorSink(SinkElement):
 
     def process(self, pad, buf: Buffer):
         metrics.count(f"{self.name}.frames")
+        # Snapshot once: a callback registered mid-stream must not observe
+        # half of this method's gating (connect_new_data is a public API
+        # with no start-only restriction) — it takes effect next buffer.
+        callbacks = list(self._callbacks)
         prefetch_cap = min(16, self._q.maxsize or 16)
-        if (self.to_host and not self._callbacks and not self.drop
+        if (self.to_host and not callbacks and not self.drop
                 and self._q.qsize() < prefetch_cap):
             # The app will pop host arrays: start the D2H now so the copy
             # overlaps the queue dwell time instead of being paid inside
@@ -77,9 +82,9 @@ class TensorSink(SinkElement):
                     self._resolver = ThreadPoolExecutor(
                         1, thread_name_prefix=f"{self.name}-resolve")
                 buf = self._resolver.submit(buf.to_host)
-        if self._callbacks:
+        if callbacks:
             buf = buf.resolve()
-        for cb in self._callbacks:
+        for cb in callbacks:
             cb(buf)
         stop = getattr(self, "_stop_event", None)
         while True:
@@ -101,7 +106,9 @@ class TensorSink(SinkElement):
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        while True:
+        buf = self._parked  # a Future try_pop saw mid-flight goes first
+        self._parked = None
+        while buf is None:
             try:
                 buf = self._q.get(timeout=0.1)
                 break
@@ -117,11 +124,22 @@ class TensorSink(SinkElement):
         return self._materialize(buf, timeout)
 
     def try_pop(self) -> Optional[Buffer]:
-        try:
-            buf = self._q.get_nowait()
-        except _queue.Empty:
+        """Non-blocking poll: None when no FINISHED buffer is ready.  A
+        still-resolving background buffer is parked (single-consumer pull
+        API) and returned by the next pop/try_pop once done."""
+        import concurrent.futures as _cf
+
+        item = self._parked
+        if item is None:
+            try:
+                item = self._q.get_nowait()
+            except _queue.Empty:
+                return None
+        if isinstance(item, _cf.Future) and not item.done():
+            self._parked = item
             return None
-        return self._materialize(buf, 30.0)
+        self._parked = None
+        return self._materialize(item, 30.0)
 
     def _materialize(self, item, timeout: float) -> Buffer:
         import concurrent.futures as _cf

@@ -15,9 +15,11 @@ into the surrounding pipeline's XLA program like any zoo model.
 Supported operator set (the MobileNet/SSD-era CNN vocabulary the
 reference's examples actually use): CONV_2D, DEPTHWISE_CONV_2D,
 FULLY_CONNECTED, AVERAGE/MAX_POOL_2D, RESHAPE, SOFTMAX, ADD, SUB, MUL,
-CONCATENATION, PAD, MEAN, RELU, RELU6, LOGISTIC, TANH.  Float32 graphs
-only; quantized graphs raise a clear error naming the tensor (dequantize
-offline, or extend ``_constant``).
+DIV, CONCATENATION, PAD, MEAN, SQUEEZE, TRANSPOSE, RESIZE_BILINEAR,
+SPACE_TO_DEPTH, RELU, RELU6, LOGISTIC, TANH.  Float and HYBRID quantized
+models load (integer weights dequantize at parse time, per-tensor or
+per-axis, and run float on the MXU); fully-quantized graphs (integer
+activations) raise a clear error naming the tensor.
 """
 
 from __future__ import annotations
@@ -166,7 +168,8 @@ _OP_NAMES = {
     0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
     4: "DEPTHWISE_CONV_2D", 9: "FULLY_CONNECTED", 14: "LOGISTIC",
     17: "MAX_POOL_2D", 18: "MUL", 19: "RELU", 21: "RELU6", 22: "RESHAPE",
-    25: "SOFTMAX", 28: "TANH", 34: "PAD", 40: "MEAN", 41: "SUB",
+    23: "RESIZE_BILINEAR", 25: "SOFTMAX", 26: "SPACE_TO_DEPTH", 28: "TANH",
+    34: "PAD", 39: "TRANSPOSE", 40: "MEAN", 41: "SUB", 42: "DIV",
     43: "SQUEEZE",
 }
 
@@ -249,16 +252,28 @@ class TFLiteGraph:
             self.dtypes.append(dt)
             self.tensor_names.append(tname)
             q = fb.f_tab(t, 4)
-            if q is not None and fb.f_vec_f32(q, 2):
-                raise TFLiteError(
-                    f"tensor {idx} ({tname!r}) is quantized "
-                    "(scale present) — only float32 graphs are supported; "
-                    "dequantize offline")
+            scale = fb.f_vec_f32(q, 2) if q is not None else None
             bufidx = fb.f_u32(t, 2, 0)
             raw = buffers[bufidx] if bufidx < len(buffers) else None
+            if scale and not raw:
+                # Quantized ACTIVATIONS mean a fully-quantized graph —
+                # integer compute paths are not reproduced here.  Quantized
+                # WEIGHTS (below) are fine: hybrid models dequantize at
+                # load and run float on the MXU.
+                raise TFLiteError(
+                    f"tensor {idx} ({tname!r}) is a quantized activation — "
+                    "fully-quantized graphs are unsupported (hybrid "
+                    "quantized-weight models load fine)")
             if raw:
                 arr = np.frombuffer(raw, dtype=dt)
-                self.constants[idx] = arr.reshape(shape) if shape else arr
+                arr = arr.reshape(shape) if shape else arr
+                # Only INTEGER weights dequantize; some converters leave a
+                # stale scale on already-float tensors (schema-legal), and
+                # re-scaling those would silently corrupt them.
+                if scale and np.issubdtype(dt, np.integer):
+                    arr = self._dequantize(fb, q, arr, scale, tname)
+                    self.dtypes[idx] = np.dtype(np.float32)
+                self.constants[idx] = arr
 
         self.inputs = fb.f_vec_i32(sg, 1) or []
         self.outputs = fb.f_vec_i32(sg, 2) or []
@@ -275,6 +290,25 @@ class TFLiteGraph:
             outs = fb.f_vec_i32(op, 2) or []
             bo = fb.f_tab(op, 4)
             self.ops.append(_Op(kind, ins, outs, self._attrs(fb, kind, bo)))
+
+    @staticmethod
+    def _dequantize(fb: _FB, q: int, arr: np.ndarray, scale, tname: str):
+        """int8/uint8 weights -> float32 via (q - zero_point) * scale,
+        per-tensor or per-axis (quantized_dimension)."""
+        zp = fb.f_vec_i64(q, 3) or [0] * len(scale)
+        axis = fb.f_i32(q, 6, 0)
+        s = np.asarray(scale, np.float32)
+        z = np.asarray(zp, np.float32)
+        if s.size == 1:
+            return (arr.astype(np.float32) - z[0]) * s[0]
+        if arr.ndim == 0 or arr.shape[axis] != s.size:
+            raise TFLiteError(
+                f"tensor {tname!r}: per-axis scale count {s.size} does not "
+                f"match dim {axis} of shape {arr.shape}")
+        bshape = [1] * arr.ndim
+        bshape[axis] = s.size
+        return ((arr.astype(np.float32) - z.reshape(bshape))
+                * s.reshape(bshape))
 
     @staticmethod
     def _attrs(fb: _FB, kind: str, bo: Optional[int]) -> Dict:
@@ -303,8 +337,13 @@ class TFLiteGraph:
             a["beta"] = fb.f_f32(bo, 0, 1.0) if bo else 1.0
         elif kind == "RESHAPE":
             a["new_shape"] = fb.f_vec_i32(bo, 0) if bo else None
-        elif kind in ("ADD", "SUB", "MUL"):
+        elif kind in ("ADD", "SUB", "MUL", "DIV"):
             a["act"] = fb.f_i8(bo, 0, 0) if bo else 0
+        elif kind == "RESIZE_BILINEAR":
+            a["align_corners"] = fb.f_bool(bo, 2, False) if bo else False
+            a["half_pixel"] = fb.f_bool(bo, 3, False) if bo else False
+        elif kind == "SPACE_TO_DEPTH":
+            a["block"] = fb.f_i32(bo, 0, 1) if bo else 1
         elif kind == "CONCATENATION":
             a["axis"] = fb.f_i32(bo, 0, 0) if bo else 0
             a["act"] = fb.f_i8(bo, 1, 0) if bo else 0
@@ -322,7 +361,37 @@ class TFLiteGraph:
 #: per-op input positions that are STATIC metadata (shapes/axes/paddings),
 #: not data: they must resolve to concrete graph constants at trace time —
 #: reading them through the traced params pytree would crash under jit.
-_STATIC_OPERANDS = {"RESHAPE": (1,), "PAD": (1,), "MEAN": (1,)}
+_STATIC_OPERANDS = {"RESHAPE": (1,), "PAD": (1,), "MEAN": (1,),
+                    "TRANSPOSE": (1,), "RESIZE_BILINEAR": (1,)}
+
+
+def _resize_bilinear(x, oh: int, ow: int, align_corners: bool,
+                     half_pixel: bool):
+    """tflite ResizeBilinear semantics (all three coordinate mappings)."""
+    import jax.numpy as jnp
+
+    h, w = x.shape[1], x.shape[2]
+
+    def coords(o, n):
+        i = jnp.arange(o, dtype=jnp.float32)
+        if align_corners and o > 1:
+            return i * (n - 1) / (o - 1)
+        if half_pixel:
+            return jnp.maximum((i + 0.5) * n / o - 0.5, 0.0)
+        return i * n / o
+
+    yf = coords(oh, h)
+    xf = coords(ow, w)
+    y0 = jnp.clip(jnp.floor(yf).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xf).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (yf - y0)[None, :, None, None]
+    wx = (xf - x0)[None, None, :, None]
+    f = x.astype(jnp.float32)
+    top = f[:, y0][:, :, x0] * (1 - wx) + f[:, y0][:, :, x1] * wx
+    bot = f[:, y1][:, :, x0] * (1 - wx) + f[:, y1][:, :, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(x.dtype)
 
 
 def _run_op(op: _Op, get, const, attrs_name: str):
@@ -393,10 +462,25 @@ def _run_op(op: _Op, get, const, attrs_name: str):
         import jax
 
         return jax.nn.softmax(get(op.inputs[0]) * a["beta"], axis=-1)
-    if k in ("ADD", "SUB", "MUL"):
+    if k in ("ADD", "SUB", "MUL", "DIV"):
         x, y = get(op.inputs[0]), get(op.inputs[1])
-        z = {"ADD": x + y, "SUB": x - y, "MUL": x * y}[k]
+        z = {"ADD": x + y, "SUB": x - y, "MUL": x * y, "DIV": x / y}[k]
         return _act_fn(a["act"], attrs_name)(z)
+    if k == "TRANSPOSE":
+        perm = [int(v) for v in const(op.inputs[1]).ravel()]
+        return jnp.transpose(get(op.inputs[0]), perm)
+    if k == "SPACE_TO_DEPTH":
+        x = get(op.inputs[0])
+        b = a["block"]
+        B, H, W, C = x.shape
+        x = x.reshape(B, H // b, b, W // b, b, C)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            B, H // b, W // b, C * b * b)
+    if k == "RESIZE_BILINEAR":
+        x = get(op.inputs[0])
+        oh, ow = (int(v) for v in const(op.inputs[1]).ravel())
+        return _resize_bilinear(x, oh, ow, a["align_corners"],
+                                a["half_pixel"])
     if k == "CONCATENATION":
         parts = [get(i) for i in op.inputs]
         z = jnp.concatenate(parts, axis=a["axis"])

@@ -119,15 +119,18 @@ class Writer:
 # ---------------------------------------------------------------------------
 
 _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 2,
-                np.dtype(np.uint8): 3, np.dtype(np.int64): 4}
+                np.dtype(np.uint8): 3, np.dtype(np.int64): 4,
+                np.dtype(np.int8): 9}
 
 _PAD_CODES = {"SAME": 0, "VALID": 1}
 _ACT_CODES = {None: 0, "relu": 1, "relu6": 3, "tanh": 4}
 _OP_CODES = {"ADD": 0, "AVERAGE_POOL_2D": 1, "CONCATENATION": 2,
              "CONV_2D": 3, "DEPTHWISE_CONV_2D": 4, "FULLY_CONNECTED": 9,
              "LOGISTIC": 14, "MAX_POOL_2D": 17, "MUL": 18, "RELU": 19,
-             "RELU6": 21, "RESHAPE": 22, "SOFTMAX": 25, "TANH": 28,
-             "PAD": 34, "MEAN": 40, "SUB": 41, "SQUEEZE": 43}
+             "RELU6": 21, "RESHAPE": 22, "RESIZE_BILINEAR": 23,
+             "SOFTMAX": 25, "SPACE_TO_DEPTH": 26, "TANH": 28, "PAD": 34,
+             "TRANSPOSE": 39, "MEAN": 40, "SUB": 41, "DIV": 42,
+             "SQUEEZE": 43}
 
 
 class ModelWriter:
@@ -148,27 +151,36 @@ class ModelWriter:
         self.ops: List[Tuple[str, List[int], List[int], Dict]] = []
 
     def _tensor(self, shape, dtype, name, data: Optional[np.ndarray],
-                quant_scale: Optional[Sequence[float]] = None) -> int:
+                quant: Optional[Dict] = None) -> int:
         if data is not None:
             self.buffers.append(np.ascontiguousarray(data).tobytes())
             bufidx = len(self.buffers) - 1
         else:
             bufidx = 0
         self.tensors.append(
-            (list(shape), np.dtype(dtype), name, bufidx, quant_scale))
+            (list(shape), np.dtype(dtype), name, bufidx, quant))
         return len(self.tensors) - 1
 
-    def add_input(self, shape, dtype=np.float32, name="input") -> int:
-        idx = self._tensor(shape, dtype, name, None)
+    def add_input(self, shape, dtype=np.float32, name="input",
+                  quant_scale: Optional[Sequence[float]] = None) -> int:
+        quant = {"scale": list(quant_scale)} if quant_scale else None
+        idx = self._tensor(shape, dtype, name, None, quant)
         self.inputs.append(idx)
         return idx
 
     def add_const(self, array: np.ndarray, name="const",
-                  quant_scale: Optional[Sequence[float]] = None) -> int:
-        """``quant_scale`` writes a QuantizationParameters table — used to
-        exercise the reader's quantized-graph rejection."""
-        return self._tensor(array.shape, array.dtype, name, array,
-                            quant_scale)
+                  quant_scale: Optional[Sequence[float]] = None,
+                  quant_zero_point: Optional[Sequence[int]] = None,
+                  quant_axis: int = 0) -> int:
+        """``quant_scale``/``quant_zero_point``/``quant_axis`` write a
+        QuantizationParameters table (per-tensor or per-axis) — exercised
+        by the reader's weight dequantization and activation rejection."""
+        quant = None
+        if quant_scale:
+            quant = {"scale": list(quant_scale), "axis": int(quant_axis)}
+            if quant_zero_point:
+                quant["zero_point"] = [int(z) for z in quant_zero_point]
+        return self._tensor(array.shape, array.dtype, name, array, quant)
 
     def add_op(self, kind: str, inputs: List[int], out_shape,
                out_dtype=np.float32, options: Optional[Dict] = None) -> int:
@@ -211,6 +223,16 @@ class ModelWriter:
             return 21, w.table(scalars={0: ("<b", act)})
         if kind == "SUB":
             return 28, w.table(scalars={0: ("<b", act)})
+        if kind == "DIV":
+            return 29, w.table(scalars={0: ("<b", act)})
+        if kind == "TRANSPOSE":
+            return 26, w.table()
+        if kind == "SPACE_TO_DEPTH":
+            return 19, w.table(scalars={0: ("<i", o["block"])})
+        if kind == "RESIZE_BILINEAR":
+            return 15, w.table(scalars={
+                2: ("<B", 1 if o.get("align_corners") else 0),
+                3: ("<B", 1 if o.get("half_pixel") else 0)})
         if kind == "CONCATENATION":
             return 10, w.table(scalars={0: ("<i", o.get("axis", 0)),
                                         1: ("<b", act)})
@@ -252,13 +274,18 @@ class ModelWriter:
         buffers_vec = w.vector_offsets(buffer_tabs)
 
         tensor_tabs = []
-        for shape, dtype, name, bufidx, quant_scale in self.tensors:
+        for shape, dtype, name, bufidx, quant in self.tensors:
             shape_vec = w.vector_scalar("<i", shape)
             name_off = w.string(name)
             offs = {0: shape_vec, 3: name_off}
-            if quant_scale is not None:
-                scale_vec = w.vector_scalar("<f", list(quant_scale))
-                offs[4] = w.table(offsets={2: scale_vec})
+            if quant is not None:
+                q_offs = {2: w.vector_scalar("<f", quant["scale"])}
+                if quant.get("zero_point"):
+                    q_offs[3] = w.vector_scalar("<q", quant["zero_point"])
+                q_scal = {}
+                if quant.get("axis"):
+                    q_scal[6] = ("<i", quant["axis"])
+                offs[4] = w.table(scalars=q_scal, offsets=q_offs)
             tensor_tabs.append(w.table(
                 scalars={1: ("<b", _DTYPE_CODES[dtype]),
                          2: ("<I", bufidx)},

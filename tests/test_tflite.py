@@ -8,6 +8,8 @@ codings of the public format.  Numerics are cross-checked against torch
 matching bug in writer+reader would still fail the golden comparison.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -178,16 +180,85 @@ class TestPipelineIntegration:
         with pytest.raises(KeyError, match="not found"):
             zoo.build("/nonexistent/model.tflite")
 
-    def test_quantized_rejected(self):
+    def test_quantized_activation_rejected(self):
+        # fully-quantized graph: the INPUT activation carries a scale
         mw = tflite_build.ModelWriter()
-        x = mw.add_input([1, 4], dtype=np.uint8)
+        x = mw.add_input([1, 4], dtype=np.uint8, quant_scale=[0.5])
         w = mw.add_const(np.zeros((4, 4), np.uint8), "qw",
                          quant_scale=[0.5])
         out = mw.add_op("FULLY_CONNECTED", [x, w], [1, 4],
                         out_dtype=np.uint8)
         blob = mw.finish(outputs=[out])
-        with pytest.raises(tflite.TFLiteError, match="quantized"):
+        with pytest.raises(tflite.TFLiteError, match="quantized activation"):
             tflite.TFLiteGraph(blob)
+
+    def test_quantized_weights_dequantize(self):
+        # hybrid model: int8 weights with per-axis scale + zero_point run
+        # as float (the common published-model format)
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 3])
+        q = np.array([[10, -10, 0], [20, 0, -20]], np.int8)  # [out=2, in=3]
+        w = mw.add_const(q, "qw", quant_scale=[0.1, 0.5],
+                         quant_zero_point=[0, 4], quant_axis=0)
+        y = mw.add_op("FULLY_CONNECTED", [x, w], [1, 2])
+        blob = mw.finish(outputs=[y])
+        g = tflite.TFLiteGraph(blob)
+        wq = g.constants[w]
+        assert wq.dtype == np.float32
+        want = np.array([[1.0, -1.0, 0.0], [8.0, -2.0, -12.0]], np.float32)
+        np.testing.assert_allclose(wq, want)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "q.tflite")
+            open(p, "wb").write(blob)
+            b = tflite.load_bundle(p)
+            got = np.asarray(b.apply_fn(b.params,
+                                        np.ones((1, 3), np.float32)))
+            np.testing.assert_allclose(got, want.sum(axis=1)[None, :])
+
+    def test_new_ops_transpose_s2d_div_resize(self, tmp_path):
+        import jax
+
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4, 4, 2])
+        perm = mw.add_const(np.array([0, 2, 1, 3], np.int32), "perm")
+        y = mw.add_op("TRANSPOSE", [x, perm], [1, 4, 4, 2])
+        y = mw.add_op("SPACE_TO_DEPTH", [y], [1, 2, 2, 8],
+                      options={"block": 2})
+        two = mw.add_const(np.full((1,), 2.0, np.float32), "two")
+        y = mw.add_op("DIV", [y, two], [1, 2, 2, 8])
+        path = tmp_path / "ops.tflite"
+        path.write_bytes(mw.finish(outputs=[y]))
+        b = tflite.load_bundle(str(path))
+        xv = np.arange(32, dtype=np.float32).reshape(1, 4, 4, 2)
+        got = np.asarray(jax.jit(b.apply_fn)(b.params, xv))
+        t = xv.transpose(0, 2, 1, 3)
+        s2d = t.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 3, 2, 4, 5).reshape(
+            1, 2, 2, 8)
+        np.testing.assert_allclose(got, s2d / 2.0)
+
+    def test_resize_bilinear_matches_torch(self, tmp_path):
+        import jax
+        import torch
+        import torch.nn.functional as F
+
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4, 4, 3])
+        size = mw.add_const(np.array([8, 8], np.int32), "size")
+        y = mw.add_op("RESIZE_BILINEAR", [x, size], [1, 8, 8, 3],
+                      options={"half_pixel": True})
+        path = tmp_path / "resize.tflite"
+        path.write_bytes(mw.finish(outputs=[y]))
+        b = tflite.load_bundle(str(path))
+        xv = np.random.default_rng(0).standard_normal(
+            (1, 4, 4, 3)).astype(np.float32)
+        got = np.asarray(jax.jit(b.apply_fn)(b.params, xv))
+        # torch align_corners=False == tflite half_pixel_centers=True
+        want = F.interpolate(torch.from_numpy(xv).permute(0, 3, 1, 2),
+                             size=(8, 8), mode="bilinear",
+                             align_corners=False).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
     def test_mul_fused_activation_roundtrips(self, tmp_path):
         # writer emits MulOptions (review r3 finding): relu must clamp
@@ -263,3 +334,17 @@ class TestPipelineIntegration:
         want = np.pad(xv, [(0, 0), (1, 1), (1, 1), (0, 0)]).mean(
             axis=(1, 2)).reshape(2, 1)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestQuantEdgeCases:
+    def test_stale_scale_on_float_weight_untouched(self, tmp_path):
+        # schema-legal: converter leaves scale metadata on a FLOAT weight;
+        # values must pass through unchanged (review r3 finding)
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 2])
+        wv = np.array([[1.5, -2.5], [0.5, 3.0]], np.float32)
+        w = mw.add_const(wv, "fw", quant_scale=[0.1])
+        y = mw.add_op("FULLY_CONNECTED", [x, w], [1, 2])
+        blob = mw.finish(outputs=[y])
+        g = tflite.TFLiteGraph(blob)
+        np.testing.assert_array_equal(g.constants[w], wv)
