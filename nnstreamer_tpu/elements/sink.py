@@ -107,7 +107,6 @@ class TensorSink(SinkElement):
 
         deadline = _time.monotonic() + timeout
         buf = self._parked  # a Future try_pop saw mid-flight goes first
-        self._parked = None
         while buf is None:
             try:
                 buf = self._q.get(timeout=0.1)
@@ -120,8 +119,15 @@ class TensorSink(SinkElement):
         # pop's timeout bounds ARRIVAL; materialization gets its own full
         # budget (the pre-resolver to_host() here was unbounded — a slow
         # tunneled D2H must not start failing because the queue wait ate
-        # the deadline).
-        return self._materialize(buf, timeout)
+        # the deadline).  A materialization timeout PARKS the item so the
+        # frame is retried by the next pop/try_pop, never dropped.
+        try:
+            out = self._materialize(buf, timeout)
+        except TimeoutError:
+            self._parked = buf
+            raise
+        self._parked = None
+        return out
 
     def try_pop(self) -> Optional[Buffer]:
         """Non-blocking poll: None when no FINISHED buffer is ready.  A

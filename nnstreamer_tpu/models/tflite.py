@@ -463,8 +463,11 @@ def _run_op(op: _Op, get, const, attrs_name: str):
 
         return jax.nn.softmax(get(op.inputs[0]) * a["beta"], axis=-1)
     if k in ("ADD", "SUB", "MUL", "DIV"):
-        x, y = get(op.inputs[0]), get(op.inputs[1])
-        z = {"ADD": x + y, "SUB": x - y, "MUL": x * y, "DIV": x / y}[k]
+        import operator
+
+        fn = {"ADD": operator.add, "SUB": operator.sub,
+              "MUL": operator.mul, "DIV": operator.truediv}[k]
+        z = fn(get(op.inputs[0]), get(op.inputs[1]))
         return _act_fn(a["act"], attrs_name)(z)
     if k == "TRANSPOSE":
         perm = [int(v) for v in const(op.inputs[1]).ravel()]

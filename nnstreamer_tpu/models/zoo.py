@@ -103,7 +103,7 @@ def build(name: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle:
     is_ckpt_dir = os.path.isdir(key) and (
         os.path.exists(os.path.join(key, "model.safetensors.index.json"))
         or os.path.exists(os.path.join(key, "model.safetensors")))
-    if key.endswith((".tflite", ".safetensors", ".npz",
+    if key.endswith((".tflite", ".onnx", ".safetensors", ".npz",
                      ".safetensors.index.json")) or is_ckpt_dir:
         if not os.path.exists(key):
             raise KeyError(f"model file not found: {key}")
@@ -111,6 +111,10 @@ def build(name: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle:
             from . import tflite
 
             return tflite.load_bundle(key, opts)
+        if key.endswith(".onnx"):
+            from . import onnx
+
+            return onnx.load_bundle(key, opts)
         from . import llama
 
         return llama.build_from_checkpoint(key, opts)

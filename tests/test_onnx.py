@@ -1,0 +1,206 @@
+"""`.onnx` ingestion tests (SURVEY §2.4 onnxruntime row).
+
+The fixtures are exported by TORCH'S OWN ONNX exporter — a fully
+independent protobuf serializer — so these tests check real third-party
+interop, not a round-trip of our own writer.  Numerics are compared
+against the torch module that produced each file.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.models import onnx as nx
+from nnstreamer_tpu.models import zoo
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _patch_exporter():
+    # torch's legacy exporter serializes its own protobuf but insists on
+    # the `onnx` package for a final (optional) onnxscript post-step —
+    # skip it; the serialized ModelProto is already complete.
+    from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, custom: model_bytes
+    yield
+    onnx_proto_utils._add_onnxscript_fn = orig
+
+
+def _export(tmp_path, module, x, name="m.onnx", opset=13):
+    path = str(tmp_path / name)
+    module.eval()
+    with torch.no_grad():
+        torch.onnx.export(module, x, path, opset_version=opset,
+                          dynamo=False)
+    return path
+
+
+def _compare(path, module, x, rtol=1e-4, atol=1e-5):
+    import jax
+
+    bundle = nx.load_bundle(path)
+    got = np.asarray(jax.jit(bundle.apply_fn)(bundle.params, x.numpy()))
+    with torch.no_grad():
+        want = module(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    return bundle
+
+
+class TestTorchExportedModels:
+    def test_small_cnn(self, tmp_path):
+        torch.manual_seed(0)
+        m = nn.Sequential(
+            nn.Conv2d(3, 8, 3, stride=2, padding=1), nn.ReLU(),
+            nn.Conv2d(8, 8, 3, padding=1, groups=8), nn.ReLU6(),
+            nn.MaxPool2d(2),
+            nn.Flatten(), nn.Linear(8 * 2 * 2, 5), nn.Softmax(dim=1))
+        x = torch.randn(2, 3, 8, 8)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_batchnorm_and_avgpool(self, tmp_path):
+        torch.manual_seed(1)
+        m = nn.Sequential(
+            nn.Conv2d(3, 4, 3, padding=1), nn.BatchNorm2d(4), nn.ReLU(),
+            nn.AvgPool2d(2), nn.Conv2d(4, 6, 1), nn.Sigmoid())
+        m.eval()
+        # non-trivial running stats (export uses them in eval mode)
+        m[1].running_mean.uniform_(-1, 1)
+        m[1].running_var.uniform_(0.5, 2.0)
+        x = torch.randn(1, 3, 8, 8)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_global_pool_residual(self, tmp_path):
+        torch.manual_seed(2)
+
+        class Block(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2d(4, 4, 3, padding=1)
+                self.c2 = nn.Conv2d(4, 4, 3, padding=1)
+                self.head = nn.Linear(4, 3)
+
+            def forward(self, x):
+                h = torch.relu(self.c1(x))
+                h = self.c2(h) + x  # residual Add
+                h = torch.nn.functional.adaptive_avg_pool2d(h, 1)
+                return self.head(h.flatten(1))
+
+        m = Block()
+        x = torch.randn(2, 4, 6, 6)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_transpose_pad_mean(self, tmp_path):
+        torch.manual_seed(3)
+
+        class M(nn.Module):
+            def forward(self, x):
+                h = x.permute(0, 2, 1)
+                h = torch.nn.functional.pad(h, (1, 1), value=0.5)
+                return h.mean(dim=-1)
+
+        m = M()
+        x = torch.randn(2, 3, 5)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_reflect_pad_and_ceil_pool(self, tmp_path):
+        torch.manual_seed(6)
+        m = nn.Sequential(
+            nn.ReflectionPad2d(1),
+            nn.Conv2d(2, 3, 3),
+            nn.MaxPool2d(2, ceil_mode=True))  # 5x5 -> 3x3 under ceil
+        x = torch.randn(1, 2, 5, 5)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_avgpool_ceil_mode(self, tmp_path):
+        m = nn.Sequential(nn.AvgPool2d(2, ceil_mode=True))
+        x = torch.randn(1, 2, 5, 5)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_weight_transpose_under_jit(self, tmp_path):
+        # a hostable op (Transpose) applied to a WEIGHT initializer must
+        # run traced, not through the numpy fast path (review r3 finding)
+        torch.manual_seed(7)
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.w = nn.Parameter(torch.randn(3, 5))
+
+            def forward(self, x):
+                return x @ self.w.t()
+
+        m = M()
+        x = torch.randn(2, 5)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_mlp_gemm(self, tmp_path):
+        torch.manual_seed(4)
+        m = nn.Sequential(nn.Linear(10, 16), nn.Tanh(), nn.Linear(16, 4))
+        x = torch.randn(3, 10)
+        bundle = _compare(_export(tmp_path, m, x), m, x)
+        # weights really came from the file
+        assert any(v.shape == (16, 10) for v in bundle.params.values())
+
+
+class TestErrorsAndOptions:
+    def test_not_onnx(self, tmp_path):
+        p = tmp_path / "junk.onnx"
+        p.write_bytes(b"\x00\x01\x02\x03" * 8)
+        with pytest.raises(nx.ONNXError):
+            nx.load_bundle(str(p))
+
+    def test_unsupported_op_listed(self, tmp_path):
+        class M(nn.Module):
+            def forward(self, x):
+                return torch.fft.fft(x).real
+
+        x = torch.randn(4)
+        try:
+            path = _export(tmp_path, M(), x)
+        except Exception:
+            pytest.skip("torch cannot export fft to onnx")
+        with pytest.raises(nx.ONNXError, match="unsupported op"):
+            nx.load_bundle(path)
+
+    def test_unknown_option_rejected(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 2))
+        x = torch.randn(1, 4)
+        path = _export(tmp_path, m, x)
+        with pytest.raises(nx.ONNXError, match="param_dtype"):
+            nx.load_bundle(path, {"bogus": "1"})
+
+
+class TestPipelineIntegration:
+    def test_tensor_filter_loads_onnx_file(self, tmp_path):
+        torch.manual_seed(5)
+        m = nn.Sequential(
+            nn.Conv2d(3, 4, 3, stride=2, padding=1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(4 * 4 * 4, 5), nn.Softmax(dim=1))
+        x = torch.randn(1, 3, 8, 8)
+        path = _export(tmp_path, m, x)
+        p = nt.Pipeline(
+            f"appsrc name=src caps=other/tensors,dimensions=8:8:3:1,"
+            f"types=float32 ! "
+            f"tensor_filter framework=jax model={path} ! "
+            f"tensor_sink name=out")
+        with p:
+            p.push("src", x.numpy())
+            buf = p.pull("out", timeout=60)
+            p.eos()
+            p.wait(timeout=30)
+        with torch.no_grad():
+            want = m(x).numpy()
+        np.testing.assert_allclose(np.asarray(buf.tensors[0]), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_zoo_routes_onnx(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 2))
+        x = torch.randn(1, 4)
+        path = _export(tmp_path, m, x)
+        bundle = zoo.build(path)
+        assert bundle.in_spec.specs[0].shape == (1, 4)
