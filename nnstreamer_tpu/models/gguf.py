@@ -1,0 +1,197 @@
+"""GGUF model-file reader: llama.cpp's checkpoint format -> numpy dict.
+
+Reference analog: the llama.cpp sub-plugin
+(``ext/nnstreamer/tensor_filter/tensor_filter_llamacpp.cc``, SURVEY §2.4
+[UNVERIFIED]) consumes GGUF files.  The container is public and simple:
+little-endian header (magic "GGUF", version, tensor count, kv count),
+typed metadata key-values, tensor descriptors (name, dims in ggml
+fastest-first order, ggml type, data offset), then an aligned data blob.
+A pure-Python reader covers the UNQUANTIZED types (F32/F16/BF16) with
+numpy memmaps; k-quant block formats raise a clear error naming the
+tensor and type (dequantize offline with llama.cpp's tools).
+
+``llama.load_checkpoint`` routes ``.gguf`` through here: tensor names map
+from llama.cpp's ``blk.N.attn_q.weight`` convention, the model config is
+read from the ``llama.*`` metadata keys, and q/k weights are re-laid from
+ggml's interleaved-pair RoPE convention into the rotate-half layout
+models/llama.py computes with (the same permutation HF applies when it
+converts Meta checkpoints).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.types import bfloat16
+
+
+class GGUFError(ValueError):
+    pass
+
+
+_MAGIC = 0x46554747  # "GGUF"
+
+#: ggml type id -> numpy dtype for the UNQUANTIZED types.  BF16 (30) is
+#: included only when the real ml_dtypes extension dtype is present: the
+#: core.types fallback aliases bfloat16 to float32, which would silently
+#: reinterpret 2-byte BF16 payloads as 4-byte floats.
+_GGML_DTYPES = {0: np.dtype(np.float32), 1: np.dtype(np.float16),
+                24: np.dtype(np.int8), 25: np.dtype(np.int16),
+                26: np.dtype(np.int32), 27: np.dtype(np.int64),
+                28: np.dtype(np.float64)}
+if np.dtype(bfloat16).itemsize == 2:
+    _GGML_DTYPES[30] = np.dtype(bfloat16)
+
+_GGML_QUANT_NAMES = {2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
+                     8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
+                     12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 15: "Q8_K"}
+
+
+class _Reader:
+    def __init__(self, f, size: int):
+        self.f = f
+        self.size = size
+
+    def _read(self, n: int) -> bytes:
+        data = self.f.read(n)
+        if len(data) != n:
+            raise GGUFError(
+                f"{self.f.name}: truncated GGUF (wanted {n} bytes at "
+                f"offset {self.f.tell() - len(data)}, file is "
+                f"{self.size} bytes)")
+        return data
+
+    def u32(self):
+        return struct.unpack("<I", self._read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self._read(8))[0]
+
+    def s(self):
+        n = self.u64()
+        if n > self.size:
+            raise GGUFError(
+                f"{self.f.name}: corrupt GGUF (string length {n} exceeds "
+                f"file size {self.size})")
+        return self._read(n).decode("utf-8", "replace")
+
+    _SCALARS = {0: ("<B", 1), 1: ("<b", 1), 2: ("<H", 2), 3: ("<h", 2),
+                4: ("<I", 4), 5: ("<i", 4), 6: ("<f", 4), 7: ("<B", 1),
+                10: ("<Q", 8), 11: ("<q", 8), 12: ("<d", 8)}
+
+    def value(self, vtype: int):
+        if vtype in self._SCALARS:
+            fmt, size = self._SCALARS[vtype]
+            v = struct.unpack(fmt, self._read(size))[0]
+            return bool(v) if vtype == 7 else v
+        if vtype == 8:
+            return self.s()
+        if vtype == 9:  # array
+            et = self.u32()
+            n = self.u64()
+            return [self.value(et) for _ in range(n)]
+        raise GGUFError(f"unknown metadata value type {vtype}")
+
+
+def read(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
+    """Returns (metadata, tensors).  Tensor arrays are memmap-backed and
+    shaped in numpy (outermost-first) order — ggml dims are stored
+    fastest-first, so they are reversed here."""
+    import os
+
+    with open(path, "rb") as f:
+        r = _Reader(f, os.path.getsize(path))
+        if r.u32() != _MAGIC:
+            raise GGUFError(f"{path}: not a GGUF file (bad magic)")
+        version = r.u32()
+        if version not in (2, 3):
+            raise GGUFError(f"{path}: unsupported GGUF version {version}")
+        n_tensors = r.u64()
+        n_kv = r.u64()
+        meta: Dict = {}
+        for _ in range(n_kv):
+            key = r.s()
+            vtype = r.u32()
+            meta[key] = r.value(vtype)
+        infos = []
+        for _ in range(n_tensors):
+            name = r.s()
+            n_dims = r.u32()
+            dims = [r.u64() for _ in range(n_dims)]
+            ggml_type = r.u32()
+            offset = r.u64()
+            infos.append((name, dims, ggml_type, offset))
+        align = int(meta.get("general.alignment", 32))
+        pos = f.tell()
+        data_start = (pos + align - 1) // align * align
+
+    tensors: Dict[str, np.ndarray] = {}
+    for name, dims, ggml_type, offset in infos:
+        if ggml_type not in _GGML_DTYPES:
+            qname = _GGML_QUANT_NAMES.get(ggml_type, str(ggml_type))
+            raise GGUFError(
+                f"{path}: tensor {name!r} uses quantized ggml type "
+                f"{qname} — only F32/F16/BF16 GGUF loads here; dequantize "
+                "offline (llama.cpp: llama-quantize --allow-requantize, "
+                "or convert with outtype f16)")
+        dt = _GGML_DTYPES[ggml_type]
+        count = int(np.prod(dims)) if dims else 1
+        mm = np.memmap(path, dtype=np.uint8, mode="r",
+                       offset=data_start + offset,
+                       shape=(count * dt.itemsize,))
+        # ggml dims are fastest-first; numpy wants outermost-first
+        tensors[name] = mm.view(dt).reshape(list(reversed(dims)))
+    return meta, tensors
+
+
+def write(path: str, meta: Dict, tensors: Dict[str, np.ndarray],
+          align: int = 32) -> None:
+    """Emit a GGUF v3 file (tests / converting weights for reuse)."""
+    inv = {v: k for k, v in _GGML_DTYPES.items()}
+
+    def pack_s(s: str) -> bytes:
+        raw = s.encode("utf-8")
+        return struct.pack("<Q", len(raw)) + raw
+
+    def pack_value(v) -> bytes:
+        if isinstance(v, bool):
+            return struct.pack("<IB", 7, int(v))
+        if isinstance(v, int):
+            return struct.pack("<Iq", 11, v)
+        if isinstance(v, float):
+            return struct.pack("<If", 6, v)
+        if isinstance(v, str):
+            return struct.pack("<I", 8) + pack_s(v)
+        raise GGUFError(f"unsupported metadata value {v!r}")
+
+    out = bytearray()
+    out += struct.pack("<IIQQ", _MAGIC, 3, len(tensors), len(meta))
+    for k, v in meta.items():
+        out += pack_s(k)
+        out += pack_value(v)
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = np.dtype(arr.dtype)
+        if dt not in inv:
+            raise GGUFError(f"unsupported dtype {dt} for {name}")
+        dims = list(reversed(arr.shape))  # ggml fastest-first
+        out += pack_s(name)
+        out += struct.pack("<I", len(dims))
+        for d in dims:
+            out += struct.pack("<Q", d)
+        out += struct.pack("<IQ", inv[dt], offset)
+        blob = arr.tobytes()
+        blobs.append(blob)
+        offset += (len(blob) + align - 1) // align * align
+    pad = (-len(out)) % align
+    out += b"\x00" * pad
+    for blob in blobs:
+        out += blob
+        out += b"\x00" * ((-len(blob)) % align)
+    with open(path, "wb") as f:
+        f.write(out)

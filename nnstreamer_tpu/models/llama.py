@@ -118,7 +118,9 @@ def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
     """Fill the documented pytree layout from a REAL checkpoint file.
 
     ``path``: a ``.safetensors`` file, a HF sharded checkpoint directory /
-    ``*.safetensors.index.json``, or an ``.npz`` (models/checkpoint.py).
+    ``*.safetensors.index.json``, an ``.npz`` (models/checkpoint.py), or a
+    llama.cpp ``.gguf`` (models/gguf.py — F32/F16/BF16, config from the
+    ``llama.*`` metadata keys, RoPE layout converted).
     Accepts HF ``model.layers.N.self_attn.q_proj.weight`` naming (weights
     transposed from [out,in] linear layout to this module's [in,out]
     matmul layout — no RoPE re-permutation is needed because :func:`_rope`
@@ -137,10 +139,12 @@ def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
 
     from . import checkpoint as ckpt
 
-    tensors = ckpt.load_tensors(path)
     dt = np.dtype("float32") if dtype == "float32" else _np_bf16()
     if dtype not in ("float32", "bfloat16"):
         dt = np.dtype(dtype)
+    if path.endswith(".gguf"):
+        return _load_gguf(path, cfg, dt)
+    tensors = ckpt.load_tensors(path)
 
     if "embed" in tensors and "layers.wq" in tensors:  # native stacked npz
         if cfg is None:
@@ -206,6 +210,97 @@ def _np_bf16():
     from ..core.types import bfloat16
 
     return bfloat16
+
+
+def _rope_permute(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """ggml/Meta interleaved-pair RoPE layout -> rotate-half layout (the
+    permutation HF applies converting Meta checkpoints; models/llama.py's
+    _rope is rotate-half).  ``w``: [n_heads*head_dim, in_features]."""
+    out, dim2 = w.shape
+    hd = out // n_heads
+    return np.ascontiguousarray(
+        w.reshape(n_heads, hd // 2, 2, dim2).swapaxes(1, 2).reshape(
+            out, dim2))
+
+
+def _load_gguf(path: str, cfg: Optional[LlamaConfig],
+               dt) -> Tuple[Dict, LlamaConfig]:
+    """llama.cpp GGUF -> the stacked pytree (reference: the llamacpp
+    sub-plugin's model format, SURVEY §2.4)."""
+    from . import gguf
+
+    meta, tensors = gguf.read(path)
+
+    def get(name):
+        if name not in tensors:
+            raise gguf.GGUFError(
+                f"{path}: missing tensor {name!r} (have e.g. "
+                f"{sorted(tensors)[:3]})")
+        return np.asarray(tensors[name])
+
+    if cfg is None:
+        arch = str(meta.get("general.architecture", "llama"))
+
+        def m(key, default=None):
+            v = meta.get(f"{arch}.{key}", default)
+            if v is None:
+                raise gguf.GGUFError(
+                    f"{path}: metadata {arch}.{key} missing and no cfg "
+                    "given")
+            return v
+
+        vocab = get("token_embd.weight").shape[0]
+        n_heads = int(m("attention.head_count"))
+        cfg = LlamaConfig(
+            vocab=vocab,
+            dim=int(m("embedding_length")),
+            n_layers=int(m("block_count")),
+            n_heads=n_heads,
+            n_kv_heads=int(m("attention.head_count_kv", n_heads)),
+            ffn_hidden=int(m("feed_forward_length")),
+            max_seq=min(int(m("context_length", 4096)), 8192),
+            rope_theta=float(m("rope.freq_base", 10000.0)),
+            norm_eps=float(m("attention.layer_norm_rms_epsilon", 1e-5)),
+        )
+
+    def stack(fmt, heads=None):
+        mats = []
+        for i in range(cfg.n_layers):
+            w = get(fmt.format(i))
+            if heads is not None:
+                w = _rope_permute(w, heads)
+            mats.append(w.T.astype(dt))
+        return np.stack(mats)
+
+    def stack_norm(fmt):
+        return np.stack([get(fmt.format(i)).astype(np.float32)
+                         for i in range(cfg.n_layers)])
+
+    p = "blk.{}."
+    layers = {
+        "wq": stack(p + "attn_q.weight", heads=cfg.n_heads),
+        "wk": stack(p + "attn_k.weight", heads=cfg.n_kv_heads),
+        "wv": stack(p + "attn_v.weight"),
+        "wo": stack(p + "attn_output.weight"),
+        "w_gate": stack(p + "ffn_gate.weight"),
+        "w_up": stack(p + "ffn_up.weight"),
+        "w_down": stack(p + "ffn_down.weight"),
+        "ln_attn": stack_norm(p + "attn_norm.weight"),
+        "ln_mlp": stack_norm(p + "ffn_norm.weight"),
+    }
+    embed = get("token_embd.weight").astype(dt)
+    if "output.weight" in tensors:
+        lm_head = get("output.weight").T.astype(dt)
+    else:  # tied embeddings
+        lm_head = np.ascontiguousarray(embed.T)
+    params = {
+        "embed": embed,
+        "layers": layers,
+        "ln_out": get("output_norm.weight").astype(np.float32),
+        "lm_head": lm_head,
+    }
+    _check_shapes(params, cfg, path)
+    return params, cfg
 
 
 def _infer_config_hf(path: str, tensors: Dict) -> LlamaConfig:

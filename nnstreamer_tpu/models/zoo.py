@@ -103,7 +103,7 @@ def build(name: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle:
     is_ckpt_dir = os.path.isdir(key) and (
         os.path.exists(os.path.join(key, "model.safetensors.index.json"))
         or os.path.exists(os.path.join(key, "model.safetensors")))
-    if key.endswith((".tflite", ".onnx", ".safetensors", ".npz",
+    if key.endswith((".tflite", ".onnx", ".safetensors", ".npz", ".gguf",
                      ".safetensors.index.json")) or is_ckpt_dir:
         if not os.path.exists(key):
             raise KeyError(f"model file not found: {key}")

@@ -1,0 +1,168 @@
+"""GGUF ingestion tests — the reference's llama.cpp sub-plugin model
+format (SURVEY §2.4).  Strategy mirrors test_checkpoint.py: export native
+params to GGUF (including the INVERSE RoPE permutation, so the file is in
+ggml's interleaved layout like a real llama.cpp checkpoint), import, and
+require exact pytree equality + identical forward logits.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.models import gguf, llama, zoo
+
+CFG = llama.LlamaConfig(vocab=96, dim=32, n_layers=2, n_heads=2,
+                        n_kv_heads=1, ffn_hidden=48, max_seq=64)
+
+
+def _inv_rope_permute(w, n_heads):
+    """rotate-half -> ggml interleaved (inverse of llama._rope_permute)."""
+    out, dim2 = w.shape
+    hd = out // n_heads
+    return np.ascontiguousarray(
+        w.reshape(n_heads, 2, hd // 2, dim2).swapaxes(1, 2).reshape(
+            out, dim2))
+
+
+def _to_gguf_tensors(params, cfg):
+    lay = params["layers"]
+    out = {"token_embd.weight": np.asarray(params["embed"]),
+           "output_norm.weight": np.asarray(params["ln_out"]),
+           "output.weight": np.ascontiguousarray(
+               np.asarray(params["lm_head"]).T)}
+    for i in range(cfg.n_layers):
+        wq = np.ascontiguousarray(np.asarray(lay["wq"])[i].T)
+        wk = np.ascontiguousarray(np.asarray(lay["wk"])[i].T)
+        out[f"blk.{i}.attn_q.weight"] = _inv_rope_permute(wq, cfg.n_heads)
+        out[f"blk.{i}.attn_k.weight"] = _inv_rope_permute(wk, cfg.n_kv_heads)
+        out[f"blk.{i}.attn_v.weight"] = np.ascontiguousarray(
+            np.asarray(lay["wv"])[i].T)
+        out[f"blk.{i}.attn_output.weight"] = np.ascontiguousarray(
+            np.asarray(lay["wo"])[i].T)
+        out[f"blk.{i}.ffn_gate.weight"] = np.ascontiguousarray(
+            np.asarray(lay["w_gate"])[i].T)
+        out[f"blk.{i}.ffn_up.weight"] = np.ascontiguousarray(
+            np.asarray(lay["w_up"])[i].T)
+        out[f"blk.{i}.ffn_down.weight"] = np.ascontiguousarray(
+            np.asarray(lay["w_down"])[i].T)
+        out[f"blk.{i}.attn_norm.weight"] = np.asarray(lay["ln_attn"])[i]
+        out[f"blk.{i}.ffn_norm.weight"] = np.asarray(lay["ln_mlp"])[i]
+    return out
+
+
+def _meta(cfg):
+    return {
+        "general.architecture": "llama",
+        "llama.block_count": cfg.n_layers,
+        "llama.embedding_length": cfg.dim,
+        "llama.attention.head_count": cfg.n_heads,
+        "llama.attention.head_count_kv": cfg.n_kv_heads,
+        "llama.feed_forward_length": cfg.ffn_hidden,
+        "llama.context_length": cfg.max_seq,
+        "llama.rope.freq_base": cfg.rope_theta,
+        "llama.attention.layer_norm_rms_epsilon": cfg.norm_eps,
+    }
+
+
+class TestContainer:
+    def test_roundtrip(self, tmp_path):
+        from nnstreamer_tpu.core.types import bfloat16
+
+        rng = np.random.default_rng(0)
+        tensors = {
+            "a": rng.standard_normal((3, 4)).astype(np.float32),
+            "b": rng.standard_normal((8,)).astype(np.float16),
+            "c": rng.standard_normal((2, 5)).astype(np.float32).astype(
+                bfloat16),
+        }
+        meta = {"general.architecture": "llama", "x.count": 7,
+                "x.flag": True, "x.rate": 0.5}
+        p = str(tmp_path / "t.gguf")
+        gguf.write(p, meta, tensors)
+        m2, t2 = gguf.read(p)
+        assert m2["general.architecture"] == "llama"
+        assert m2["x.count"] == 7 and m2["x.flag"] is True
+        assert abs(m2["x.rate"] - 0.5) < 1e-7
+        for k in tensors:
+            assert t2[k].dtype == tensors[k].dtype
+            np.testing.assert_array_equal(
+                np.asarray(t2[k], np.float32),
+                np.asarray(tensors[k], np.float32))
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "bad.gguf"
+        p.write_bytes(b"NOPE" + b"\x00" * 32)
+        with pytest.raises(gguf.GGUFError, match="magic"):
+            gguf.read(str(p))
+
+    def test_quantized_type_named_in_error(self, tmp_path):
+        # hand-build a one-tensor GGUF using ggml type Q4_K (=12)
+        name = b"blk.0.ffn_up.weight"
+        blob = struct.pack("<IIQQ", 0x46554747, 3, 1, 0)
+        blob += struct.pack("<Q", len(name)) + name
+        blob += struct.pack("<I", 2)  # n_dims
+        blob += struct.pack("<QQ", 4, 4)
+        blob += struct.pack("<IQ", 12, 0)  # Q4_K, offset 0
+        blob += b"\x00" * 64
+        p = tmp_path / "q.gguf"
+        p.write_bytes(blob)
+        with pytest.raises(gguf.GGUFError, match="Q4_K"):
+            gguf.read(str(p))
+
+
+class TestLlamaImport:
+    def test_roundtrip_exact_and_logits(self, tmp_path):
+        params = llama.init_params(CFG, seed=5)
+        p = str(tmp_path / "model.gguf")
+        gguf.write(p, _meta(CFG), _to_gguf_tensors(params, CFG))
+        got, cfg = llama.load_checkpoint(p, dtype="float32")
+        # config from GGUF metadata (floats ride as f32 in the container)
+        import dataclasses
+
+        for f in dataclasses.fields(CFG):
+            a, b = getattr(cfg, f.name), getattr(CFG, f.name)
+            if isinstance(b, float):
+                assert abs(a - b) <= 1e-7 * max(1.0, abs(b)), f.name
+            else:
+                assert a == b, f.name
+        cfg = CFG  # exact eps for the numeric comparison below
+        toks = np.array([[1, 9, 4, 2]], np.int32)
+        a = np.asarray(llama.forward(params, toks, CFG,
+                                     compute_dtype="float32"))
+        b = np.asarray(llama.forward(got, toks, cfg,
+                                     compute_dtype="float32"))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_tied_embeddings(self, tmp_path):
+        params = llama.init_params(CFG, seed=6)
+        tensors = _to_gguf_tensors(params, CFG)
+        del tensors["output.weight"]
+        p = str(tmp_path / "tied.gguf")
+        gguf.write(p, _meta(CFG), tensors)
+        got, _ = llama.load_checkpoint(p, dtype="float32")
+        np.testing.assert_array_equal(got["lm_head"],
+                                      np.asarray(got["embed"]).T)
+
+    def test_llm_filter_streams_from_gguf(self, tmp_path):
+        """The reference's usage end-to-end: the llm streaming filter fed
+        by a GGUF model file."""
+        params = llama.init_params(CFG, seed=7)
+        p = str(tmp_path / "model.gguf")
+        gguf.write(p, _meta(CFG), _to_gguf_tensors(params, CFG))
+        pl = nt.Pipeline(
+            "appsrc name=src caps=other/tensors,dimensions=1:1,"
+            "types=int32,format=flexible ! "
+            f"tensor_filter framework=llm model={p} "
+            "custom=max_new:4,param_dtype:float32,dtype:float32 ! "
+            "tensor_sink name=out")
+        with pl:
+            pl.push("src", np.array([[1, 5]], np.int32))
+            toks = [int(np.asarray(pl.pull("out", timeout=120)
+                                   .tensors[0]).ravel()[0])
+                    for _ in range(4)]
+            pl.eos()
+            pl.wait(timeout=30)
+        assert len(toks) == 4
+        assert all(0 <= t < CFG.vocab for t in toks)
