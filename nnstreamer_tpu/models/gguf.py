@@ -124,9 +124,13 @@ def read(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
             ggml_type = r.u32()
             offset = r.u64()
             infos.append((name, dims, ggml_type, offset))
-        align = int(meta.get("general.alignment", 32))
+        align = meta.get("general.alignment", 32)
+        if not isinstance(align, int) or align <= 0:
+            raise GGUFError(
+                f"{path}: invalid general.alignment {align!r}")
         pos = f.tell()
         data_start = (pos + align - 1) // align * align
+        file_size = r.size
 
     tensors: Dict[str, np.ndarray] = {}
     for name, dims, ggml_type, offset in infos:
@@ -139,9 +143,15 @@ def read(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
                 "or convert with outtype f16)")
         dt = _GGML_DTYPES[ggml_type]
         count = int(np.prod(dims)) if dims else 1
+        nbytes = count * dt.itemsize
+        if data_start + offset + nbytes > file_size:
+            raise GGUFError(
+                f"{path}: truncated GGUF — tensor {name!r} needs bytes "
+                f"[{data_start + offset}, {data_start + offset + nbytes}) "
+                f"but the file is {file_size} bytes")
         mm = np.memmap(path, dtype=np.uint8, mode="r",
                        offset=data_start + offset,
-                       shape=(count * dt.itemsize,))
+                       shape=(nbytes,))
         # ggml dims are fastest-first; numpy wants outermost-first
         tensors[name] = mm.view(dt).reshape(list(reversed(dims)))
     return meta, tensors
@@ -167,31 +177,28 @@ def write(path: str, meta: Dict, tensors: Dict[str, np.ndarray],
             return struct.pack("<I", 8) + pack_s(v)
         raise GGUFError(f"unsupported metadata value {v!r}")
 
-    out = bytearray()
-    out += struct.pack("<IIQQ", _MAGIC, 3, len(tensors), len(meta))
+    header = bytearray()
+    header += struct.pack("<IIQQ", _MAGIC, 3, len(tensors), len(meta))
     for k, v in meta.items():
-        out += pack_s(k)
-        out += pack_value(v)
-    blobs = []
+        header += pack_s(k)
+        header += pack_value(v)
     offset = 0
     for name, arr in tensors.items():
-        arr = np.ascontiguousarray(arr)
         dt = np.dtype(arr.dtype)
         if dt not in inv:
             raise GGUFError(f"unsupported dtype {dt} for {name}")
         dims = list(reversed(arr.shape))  # ggml fastest-first
-        out += pack_s(name)
-        out += struct.pack("<I", len(dims))
+        header += pack_s(name)
+        header += struct.pack("<I", len(dims))
         for d in dims:
-            out += struct.pack("<Q", d)
-        out += struct.pack("<IQ", inv[dt], offset)
-        blob = arr.tobytes()
-        blobs.append(blob)
-        offset += (len(blob) + align - 1) // align * align
-    pad = (-len(out)) % align
-    out += b"\x00" * pad
-    for blob in blobs:
-        out += blob
-        out += b"\x00" * ((-len(blob)) % align)
+            header += struct.pack("<Q", d)
+        header += struct.pack("<IQ", inv[dt], offset)
+        offset += (arr.nbytes + align - 1) // align * align
+    header += b"\x00" * ((-len(header)) % align)
+    # stream tensors to the file — a 7B export is ~14 GB; buffering
+    # tobytes() copies would double peak RAM
     with open(path, "wb") as f:
-        f.write(out)
+        f.write(header)
+        for name, arr in tensors.items():
+            np.ascontiguousarray(arr).tofile(f)
+            f.write(b"\x00" * ((-arr.nbytes) % align))

@@ -251,6 +251,14 @@ def _load_gguf(path: str, cfg: Optional[LlamaConfig],
 
         vocab = get("token_embd.weight").shape[0]
         n_heads = int(m("attention.head_count"))
+        ctx = int(m("context_length", 4096))
+        if ctx > 8192:
+            from ..core.log import logger
+
+            logger(__name__).warning(
+                "%s: clamping context_length %d to 8192 (KV-cache HBM "
+                "budget); pass custom=max_seq:%d to tensor_filter to "
+                "raise it", path, ctx, ctx)
         cfg = LlamaConfig(
             vocab=vocab,
             dim=int(m("embedding_length")),
@@ -258,7 +266,7 @@ def _load_gguf(path: str, cfg: Optional[LlamaConfig],
             n_heads=n_heads,
             n_kv_heads=int(m("attention.head_count_kv", n_heads)),
             ffn_hidden=int(m("feed_forward_length")),
-            max_seq=min(int(m("context_length", 4096)), 8192),
+            max_seq=min(ctx, 8192),
             rope_theta=float(m("rope.freq_base", 10000.0)),
             norm_eps=float(m("attention.layer_norm_rms_epsilon", 1e-5)),
         )

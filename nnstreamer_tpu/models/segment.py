@@ -1,0 +1,117 @@
+"""DeepLab-style semantic segmentation — pairs with the image_segment
+decoder (SURVEY §2.5 ``tensordec-imagesegment.c``; the reference's stock
+segmentation example runs deeplabv3_257_mv_gpu.tflite through it).
+
+TPU-first shape: MobileNet separable backbone at output-stride 16 (shared
+blocks from models/backbone.py), an ASPP-lite context head (1x1 + global
+pooling branch — the deeplab recipe minus the dilated pyramid, which XLA
+fuses poorly at tiny feature maps), and a bilinear upsample back to input
+resolution INSIDE the jitted program, so the fused pipeline hands the
+decoder a full-resolution [B, H, W, classes] score map and the decoder's
+device argmax shrinks D2H to one byte-ish id per pixel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.types import TensorsSpec
+from .backbone import (he_conv, make_ops, rounded, sep_block_params,
+                       sep_block_pspecs, stem_params, stem_pspecs)
+from .zoo import ModelBundle, register_model
+
+_BACKBONE: Tuple[Tuple[int, int], ...] = (
+    (1, 64), (2, 128), (1, 128), (2, 256), (1, 256), (2, 512),
+    (1, 512), (1, 512),
+)
+CLASSES = 21  # PASCAL-VOC, the reference example's label set
+
+
+def init_params(width: float = 1.0, classes: int = CLASSES,
+                seed: int = 0) -> Dict:
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
+    params: Dict = {"stem": stem_params(keys, 3, rounded(32, width))}
+    cin = rounded(32, width)
+    for i, (_s, ch) in enumerate(_BACKBONE):
+        cout = rounded(ch, width)
+        params[f"block{i}"] = sep_block_params(keys, cin, cout)
+        cin = cout
+    mid = rounded(256, width)
+    params["aspp_conv"] = {"w": he_conv(next(keys), 1, 1, cin, mid),
+                           "bias": np.zeros((mid,), np.float32)}
+    params["aspp_pool"] = {"w": he_conv(next(keys), 1, 1, cin, mid),
+                           "bias": np.zeros((mid,), np.float32)}
+    params["head"] = {"w": he_conv(next(keys), 1, 1, 2 * mid, classes),
+                      "bias": np.zeros((classes,), np.float32)}
+    return params
+
+
+def param_pspecs() -> Dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict = {"stem": stem_pspecs()}
+    for i in range(len(_BACKBONE)):
+        specs[f"block{i}"] = sep_block_pspecs()
+    for head in ("aspp_conv", "aspp_pool", "head"):
+        specs[head] = {"w": P(), "bias": P()}
+    return specs
+
+
+def apply(params, x, *, compute_dtype="bfloat16"):
+    """[B, H, W, 3] -> [B, H, W, classes] float32 score map."""
+    import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(compute_dtype)
+    B, H, W = x.shape[0], x.shape[1], x.shape[2]
+    x = x.astype(cdt)
+    conv2d, sbr, sep = make_ops(cdt)
+
+    p = params["stem"]
+    x = sbr(conv2d(x, p["w"], 2), p["scale"], p["bias"])
+    for i, (stride, _ch) in enumerate(_BACKBONE):
+        x = sep(x, params[f"block{i}"], stride)
+
+    # ASPP-lite: local 1x1 branch + image-level pooling branch
+    a = params["aspp_conv"]
+    local = jax.nn.relu(conv2d(x, a["w"], 1) + a["bias"].astype(cdt))
+    g = params["aspp_pool"]
+    pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+    pooled = jax.nn.relu(conv2d(pooled, g["w"], 1) + g["bias"].astype(cdt))
+    pooled = jnp.broadcast_to(pooled, local.shape)
+    feat = jnp.concatenate([local, pooled], axis=-1)
+
+    h = params["head"]
+    logits = conv2d(feat, h["w"], 1) + h["bias"].astype(cdt)
+    # full-resolution upsample inside the program (XLA lowers
+    # jax.image.resize to gathers that fuse with the head conv)
+    logits = jax.image.resize(
+        logits.astype(jnp.float32), (B, H, W, logits.shape[-1]), "bilinear")
+    return logits
+
+
+@register_model("deeplab_mobilenet")
+def _deeplab(opts: Dict[str, str]) -> ModelBundle:
+    width = float(opts.get("width", 1.0))
+    classes = int(opts.get("classes", CLASSES))
+    seed = int(opts.get("seed", 0))
+    size = int(opts.get("size", 257))  # the reference example's 257x257
+    batch = int(opts.get("batch", 1))
+    dtype = opts.get("dtype", "bfloat16")
+
+    params = init_params(width=width, classes=classes, seed=seed)
+    apply_fn = functools.partial(apply, compute_dtype=dtype)
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(
+            f"{classes}:{size}:{size}:{batch}", "float32"),
+        param_pspecs=param_pspecs(),
+        name="deeplab_mobilenet",
+    )

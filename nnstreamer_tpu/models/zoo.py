@@ -73,6 +73,7 @@ def _ensure_builtin():
         "nnstreamer_tpu.models.ssd",
         "nnstreamer_tpu.models.yolo",
         "nnstreamer_tpu.models.posenet",
+        "nnstreamer_tpu.models.segment",
         "nnstreamer_tpu.models.audio",
         "nnstreamer_tpu.models.llama",
     ):

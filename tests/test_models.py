@@ -12,7 +12,7 @@ def test_zoo_lists_benchmark_models():
     names = zoo.model_names()
     for required in ("mobilenet_v1", "ssd_mobilenet", "posenet",
                      "speech_commands", "wav2vec2", "llama_tiny",
-                     "llama2_7b"):
+                     "llama2_7b", "deeplab_mobilenet"):
         assert required in names, f"{required} missing from zoo {names}"
 
 
@@ -202,3 +202,35 @@ class TestYolo:
             b = p.pull("out", timeout=120)
             p.wait(timeout=60)
         assert b.tensors[0].shape == (2, 64, 64, 4)
+
+
+def test_deeplab_segmentation_pipeline_fused():
+    """Segmentation family (SURVEY §2.5 image_segment example): deeplab
+    zoo model -> fused device argmax decode -> RGBA overlay."""
+    p = nt.Pipeline(
+        "videotestsrc device=true batch=2 num-buffers=4 width=64 height=64 "
+        "pattern=smpte name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_filter framework=jax model=deeplab_mobilenet "
+        "custom=size:64,classes:6,batch:2,width:0.25,dtype:float32 ! "
+        "tensor_decoder mode=image_segment ! tensor_sink name=out")
+    fused = [s for s in p.stages if len(s.node_ids) > 1]
+    assert fused and len(fused[0].node_ids) == 4  # src+transform+filter+dec
+    with p:
+        b = p.pull("out", timeout=120)
+        p.wait(timeout=60)
+    overlay = np.asarray(b.tensors[0])
+    assert overlay.shape == (2, 64, 64, 4)  # full-res RGBA, batched
+    assert overlay.dtype == np.uint8
+
+
+def test_deeplab_output_is_full_resolution_scores():
+    from nnstreamer_tpu.models import zoo as _zoo
+
+    b = _zoo.build("deeplab_mobilenet",
+                   {"size": "32", "classes": "5", "batch": "1",
+                    "width": "0.25", "dtype": "float32"})
+    x = np.random.default_rng(0).random((1, 32, 32, 3), np.float32)
+    out = np.asarray(b.apply_fn(b.params, x))
+    assert out.shape == (1, 32, 32, 5)
+    assert np.isfinite(out).all()
