@@ -6,8 +6,9 @@ CPU suite cannot (tests/conftest.py forces the virtual CPU mesh).
 
 Checks: Pallas flash-attention numerics against plain XLA on the real
 backend, the fused classification pipeline, device-NMS detection, LLM
-token streaming, and a query offload roundtrip.  Prints one PASS/FAIL
-line each and exits nonzero on any failure.
+token streaming, wav2vec2 + ctc decode-on-edge, .tflite file ingestion,
+and a query offload roundtrip.  Prints one PASS/FAIL line each and exits
+nonzero on any failure.
 """
 
 from __future__ import annotations
@@ -114,6 +115,67 @@ def llm_stream():
         p.wait(timeout=60)
 
 
+def wav2vec2_ctc_decode_on_edge():
+    """Round-3 path: the ctc decoder's device argmax fuses with wav2vec2,
+    so only [B, T] ids cross the tunnel instead of [B, T, vocab] logits."""
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+
+    p = nt.Pipeline(
+        "audiotestsrc device=true batch=16 num-buffers=64 "
+        "samplesperbuffer=16000 rate=16000 name=src ! "
+        "tensor_filter framework=jax model=wav2vec2 "
+        "custom=dtype:float32,batch:16,samples:16000 ! "
+        "tensor_decoder mode=ctc ! tensor_sink name=out max-buffers=4")
+    fused = [s for s in p.stages if "+" in s.element.name]
+    assert fused and "tensor_decoder" in fused[0].element.name
+    with p:
+        b = p.pull("out", timeout=600)
+        assert np.asarray(b.tensors[0]).dtype == np.int32
+        assert "tokens" in b.meta and len(b.meta["tokens"]) == 16
+        p.wait(timeout=120)
+
+
+def tflite_file_ingestion():
+    """Round-3 path: a real .tflite file parsed into the fused program."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.models import tflite_build
+
+    rng = np.random.default_rng(0)
+    mw = tflite_build.ModelWriter()
+    x = mw.add_input([8, 32, 32, 3])
+    w = mw.add_const(rng.standard_normal((16, 3, 3, 3)).astype(
+        np.float32) * 0.2)
+    b = mw.add_const(np.zeros((16,), np.float32))
+    y = mw.add_op("CONV_2D", [x, w, b], [8, 16, 16, 16],
+                  options={"padding": "SAME", "stride": (2, 2),
+                           "act": "relu"})
+    y = mw.add_op("MEAN", [y, mw.add_const(np.array([1, 2], np.int32))],
+                  [8, 16])
+    y = mw.add_op("SOFTMAX", [y], [8, 16])
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m.tflite")
+        open(path, "wb").write(mw.finish(outputs=[y]))
+        p = nt.Pipeline(
+            f"appsrc name=src caps=other/tensors,dimensions=3:32:32:8,"
+            f"types=float32 ! tensor_filter framework=jax model={path} ! "
+            "tensor_sink name=out")
+        with p:
+            p.push("src", rng.standard_normal((8, 32, 32, 3)).astype(
+                np.float32))
+            out = np.asarray(p.pull("out", timeout=600).tensors[0])
+            assert out.shape == (8, 16)
+            np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+            p.eos()
+            p.wait(timeout=60)
+
+
 def query_roundtrip():
     import numpy as np
 
@@ -150,6 +212,8 @@ def main() -> int:
         ("fused classification pipeline", classification_pipeline),
         ("device-NMS detection pipeline", detection_device_nms),
         ("LLM token streaming", llm_stream),
+        ("wav2vec2 + ctc decode-on-edge", wav2vec2_ctc_decode_on_edge),
+        (".tflite file ingestion", tflite_file_ingestion),
         ("tensor_query offload roundtrip", query_roundtrip),
     ]
     ok = all([_check(n, f) for n, f in checks])
