@@ -1,0 +1,101 @@
+"""Embedding bridge for the native C API (native/src/nnstpu_capi.cpp).
+
+Reference analog: the external ML C-API's single-shot surface —
+``ml_single_open`` / ``ml_single_invoke`` / ``ml_single_close`` — which
+wraps ``gsttensor_filter_single.c`` (SURVEY §3.5).  Here the C library
+embeds CPython and calls THIS module; tensors cross the boundary as raw
+little-endian bytes and are shaped/typed from the model's negotiated
+specs, exactly like the reference's ``ml_tensors_data`` payloads.
+
+The functions use integer handles (not PyObject pointers) so the C side
+never manages Python object lifetimes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from .core.types import TensorsSpec, dtype_name, dims_to_string
+
+_handles: Dict[int, object] = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+
+def _on_fresh_embed() -> None:
+    """Called by the C library ONLY when it created the interpreter: the
+    process env is the sole configuration channel there, so JAX_PLATFORMS
+    is honored.  When loaded into an existing Python process this never
+    runs — a host app's programmatic jax.config pin wins (the library
+    invariant from core/platform.py)."""
+    from .core.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+
+def _spec_str(spec: TensorsSpec) -> str:
+    """``dims,dtype`` per tensor, ';'-joined: "3:8:8:1,float32;..." """
+    if spec is None:
+        return ""
+    return ";".join(t.to_string() for t in spec.specs)
+
+
+def single_open(model: str, framework: str = "auto",
+                custom: str = "") -> int:
+    """Returns a handle id; raises with a clear message on failure."""
+    from .elements.filter import SingleShot
+
+    props = {}
+    if custom:
+        props["custom"] = custom
+    s = SingleShot(framework=framework or "auto", model=model, **props)
+    with _lock:
+        hid = _next_id[0]
+        _next_id[0] += 1
+        _handles[hid] = s
+    return hid
+
+
+def _get(hid: int):
+    s = _handles.get(int(hid))
+    if s is None:
+        raise KeyError(f"invalid single-shot handle {hid}")
+    return s
+
+
+def single_info(hid: int) -> Tuple[str, str]:
+    s = _get(hid)
+    return _spec_str(s.in_spec), _spec_str(s.out_spec)
+
+
+def single_invoke_bytes(hid: int, blobs: List[bytes]) -> List[bytes]:
+    s = _get(hid)
+    specs = s.in_spec.specs if s.in_spec is not None else None
+    if specs is None:
+        raise ValueError(
+            "model has no static input spec; the C API needs one to type "
+            "raw byte payloads")
+    if len(blobs) != len(specs):
+        raise ValueError(
+            f"model takes {len(specs)} input tensor(s), got {len(blobs)}")
+    arrays = []
+    for i, (blob, spec) in enumerate(zip(blobs, specs)):
+        if len(blob) != spec.nbytes:
+            raise ValueError(
+                f"input {i}: {len(blob)} bytes, spec "
+                f"{dims_to_string(spec.dims)},{dtype_name(spec.dtype)} "
+                f"needs {spec.nbytes}")
+        arrays.append(
+            np.frombuffer(blob, dtype=spec.dtype).reshape(spec.shape))
+    outs = s.invoke(arrays)
+    return [np.ascontiguousarray(o).tobytes() for o in outs]
+
+
+def single_close(hid: int) -> None:
+    with _lock:
+        s = _handles.pop(int(hid), None)
+    if s is not None:
+        s.close()

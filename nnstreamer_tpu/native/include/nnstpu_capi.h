@@ -1,0 +1,73 @@
+/* nnstpu C API — single-shot model invoke from C/C++ programs.
+ *
+ * Reference analog: the ML C-API's ml_single_open / ml_single_invoke /
+ * ml_single_close surface over gsttensor_filter_single.c (SURVEY §3.5).
+ * The library embeds CPython: link against libnnstpu_capi.so (built from
+ * ../src/nnstpu_capi.cpp with `python3-config --includes --embed`) and
+ * make sure PYTHONPATH reaches the nnstreamer_tpu package.
+ *
+ * Thread-safety: all entry points acquire the embedded interpreter's GIL;
+ * handles may be used from any thread, one invoke at a time per handle.
+ *
+ * Minimal use:
+ *
+ *   nnstpu_single_h h = nnstpu_single_open("mobilenet_v1", "jax",
+ *                                          "size:224,batch:1",
+ *                                          err, sizeof err);
+ *   const void *in[1] = {frame};  size_t in_sz[1] = {frame_bytes};
+ *   void *out[4]; size_t out_sz[4];
+ *   int n = nnstpu_single_invoke(h, in, in_sz, 1, out, out_sz, 4,
+ *                                err, sizeof err);
+ *   ... use out[0..n-1] ...
+ *   for (int i = 0; i < n; i++) nnstpu_free(out[i]);
+ *   nnstpu_single_close(h);
+ */
+#ifndef NNSTPU_CAPI_H
+#define NNSTPU_CAPI_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef long long nnstpu_single_h; /* < 0 means error */
+
+/* Initialize the embedded interpreter and import the bridge module.
+ * Idempotent; called automatically by nnstpu_single_open.  Returns 0 on
+ * success, -1 on failure (diagnostics on stderr). */
+int nnstpu_init(void);
+
+/* Open a model for single-shot invoke.  `framework` may be NULL/"auto";
+ * `custom` may be NULL/"" (same syntax as the pipeline `custom=` prop);
+ * `model` is a zoo name or a model FILE path (.tflite/.onnx/.gguf/
+ * .safetensors...).  On failure returns < 0 and writes a message into
+ * `err` (errlen bytes, always NUL-terminated). */
+nnstpu_single_h nnstpu_single_open(const char *model, const char *framework,
+                                   const char *custom,
+                                   char *err, size_t errlen);
+
+/* Input/output tensor descriptions as "dims,dtype;dims,dtype" strings
+ * (dims innermost-first, e.g. "3:224:224:1,float32").  Returns 0/-1. */
+int nnstpu_single_info(nnstpu_single_h h, char *in_desc, size_t in_len,
+                       char *out_desc, size_t out_len,
+                       char *err, size_t errlen);
+
+/* Invoke with n_in raw little-endian tensor payloads (sizes must match
+ * the input spec exactly).  On success returns the number of output
+ * tensors (<= max_out) and fills out_data/out_sizes with malloc'd
+ * buffers the caller releases via nnstpu_free.  Returns -1 on error. */
+int nnstpu_single_invoke(nnstpu_single_h h,
+                         const void *const *in_data, const size_t *in_sizes,
+                         int n_in, void **out_data, size_t *out_sizes,
+                         int max_out, char *err, size_t errlen);
+
+void nnstpu_single_close(nnstpu_single_h h);
+
+void nnstpu_free(void *p);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NNSTPU_CAPI_H */

@@ -1,0 +1,166 @@
+"""Native C API tests: build libnnstpu_capi.so, compile a REAL C driver
+program against nnstpu_capi.h, and run it — proving the framework is
+callable from plain C the way the reference's ML C-API is (SURVEY §3.5).
+"""
+
+import os
+import subprocess
+import sys
+import sysconfig
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "nnstreamer_tpu", "native")
+
+C_DRIVER = textwrap.dedent("""
+    #include <stdio.h>
+    #include <string.h>
+    #include "nnstpu_capi.h"
+
+    int main(void) {
+        char err[512] = "";
+        char in_desc[256], out_desc[256];
+        nnstpu_single_h h = nnstpu_single_open(
+            "average", "jax", "dims:4:1", err, sizeof err);
+        if (h < 0) { fprintf(stderr, "open: %s\\n", err); return 1; }
+        if (nnstpu_single_info(h, in_desc, sizeof in_desc,
+                               out_desc, sizeof out_desc,
+                               err, sizeof err) != 0) {
+            fprintf(stderr, "info: %s\\n", err); return 1;
+        }
+        printf("IN %s OUT %s\\n", in_desc, out_desc);
+
+        float in[4] = {1.0f, 2.0f, 3.0f, 4.0f};
+        const void *ins[1] = {in};
+        size_t in_sz[1] = {sizeof in};
+        void *outs[4];
+        size_t out_sz[4];
+        int n = nnstpu_single_invoke(h, ins, in_sz, 1, outs, out_sz, 4,
+                                     err, sizeof err);
+        if (n < 0) { fprintf(stderr, "invoke: %s\\n", err); return 1; }
+        float *o = (float *)outs[0];
+        printf("N %d BYTES %zu VAL %.3f\\n", n, out_sz[0], o[0]);
+        if (n != 1 || o[0] != 2.5f) return 2;
+
+        /* error path: wrong payload size must fail with a message */
+        size_t bad_sz[1] = {7};
+        if (nnstpu_single_invoke(h, ins, bad_sz, 1, outs, out_sz, 4,
+                                 err, sizeof err) != -1 ||
+            strstr(err, "bytes") == NULL) {
+            fprintf(stderr, "bad-size accepted? err=%s\\n", err); return 3;
+        }
+
+        nnstpu_free(outs[0]);
+        nnstpu_single_close(h);
+        printf("CAPI OK\\n");
+        return 0;
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def capi_binary(tmp_path_factory):
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    td = tmp_path_factory.mktemp("capi")
+    lib = str(td / "libnnstpu_capi.so")
+    # Derive embed flags from THE RUNNING interpreter (a PATH
+    # python3-config may describe a different Python whose site-packages
+    # lack jax/numpy)
+    includes = [f"-I{sysconfig.get_paths()['include']}"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldver = sysconfig.get_config_var("LDVERSION") or \
+        f"{sys.version_info.major}.{sys.version_info.minor}"
+    ldflags = [f"-L{libdir}", f"-lpython{ldver}", "-ldl", "-lm"]
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         os.path.join(NATIVE, "src", "nnstpu_capi.cpp"), "-o", lib]
+        + includes + ldflags, check=True, timeout=180)
+    exe = str(td / "capi_demo")
+    src = td / "capi_demo.c"
+    src.write_text(C_DRIVER)
+    subprocess.run(
+        ["g++", "-O2", "-o", exe, str(src),
+         f"-I{os.path.join(NATIVE, 'include')}", lib]
+        + ldflags + [f"-Wl,-rpath,{td}"],
+        check=True, timeout=120)
+    return exe
+
+
+@pytest.mark.slow
+def test_c_program_single_shot(capi_binary):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    env["LD_LIBRARY_PATH"] = libdir + os.pathsep + env.get(
+        "LD_LIBRARY_PATH", "")
+    proc = subprocess.run([capi_binary], env=env, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "CAPI OK" in proc.stdout
+    assert "IN 4:1,float32" in proc.stdout
+    assert "VAL 2.500" in proc.stdout
+
+
+class TestBridgeModule:
+    """The Python half, exercised directly (fast, no compiler)."""
+
+    def test_open_invoke_close(self):
+        from nnstreamer_tpu import capi
+
+        h = capi.single_open("average", "jax", "dims:8:2")
+        try:
+            ins, outs = capi.single_info(h)
+            assert ins == "8:2,float32"
+            x = np.arange(16, dtype=np.float32)
+            res = capi.single_invoke_bytes(h, [x.tobytes()])
+            got = np.frombuffer(res[0], np.float32)
+            np.testing.assert_allclose(
+                got, x.reshape(2, 8).mean(axis=1))
+        finally:
+            capi.single_close(h)
+
+    def test_wrong_size_and_count_rejected(self):
+        from nnstreamer_tpu import capi
+
+        h = capi.single_open("average", "jax", "dims:4:1")
+        try:
+            with pytest.raises(ValueError, match="bytes"):
+                capi.single_invoke_bytes(h, [b"\x00" * 7])
+            with pytest.raises(ValueError, match="input tensor"):
+                capi.single_invoke_bytes(h, [b"\x00" * 16, b"\x00" * 16])
+        finally:
+            capi.single_close(h)
+
+    def test_invalid_handle(self):
+        from nnstreamer_tpu import capi
+
+        with pytest.raises(KeyError):
+            capi.single_info(999999)
+
+    def test_model_file_through_capi(self, tmp_path):
+        # the C API loads model FILES too (the reference's default shape)
+        from nnstreamer_tpu import capi
+        from nnstreamer_tpu.models import tflite_build
+
+        mw = tflite_build.ModelWriter()
+        x = mw.add_input([1, 4])
+        w = mw.add_const(np.eye(4, dtype=np.float32) * 3, "w")
+        y = mw.add_op("FULLY_CONNECTED", [x, w], [1, 4])
+        path = tmp_path / "m.tflite"
+        path.write_bytes(mw.finish(outputs=[y]))
+        h = capi.single_open(str(path), "jax", "")
+        try:
+            res = capi.single_invoke_bytes(
+                h, [np.ones(4, np.float32).tobytes()])
+            np.testing.assert_allclose(
+                np.frombuffer(res[0], np.float32), 3.0)
+        finally:
+            capi.single_close(h)

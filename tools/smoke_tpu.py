@@ -161,7 +161,8 @@ def tflite_file_ingestion():
     y = mw.add_op("SOFTMAX", [y], [8, 16])
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "m.tflite")
-        open(path, "wb").write(mw.finish(outputs=[y]))
+        with open(path, "wb") as f:
+            f.write(mw.finish(outputs=[y]))
         p = nt.Pipeline(
             f"appsrc name=src caps=other/tensors,dimensions=3:32:32:8,"
             f"types=float32 ! tensor_filter framework=jax model={path} ! "
