@@ -16,7 +16,8 @@ into one jitted XLA program, so normalization rides the MXU with the convs
 and only argmax indices come home.
 
 Other configs (--config): detection (#2 SSD + bounding boxes), pose (#3),
-audio (#4 speech commands), llm (#5 token streaming, tokens/sec).
+segmentation (deeplab + fused image_segment decode), audio (#4 speech
+commands / wav2vec2+ctc), llm (#5 token streaming, tokens/sec).
 
 Prints ONE JSON line per config run:
 {"metric", "value", "unit", "vs_baseline", ...extras}.
@@ -325,6 +326,26 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int,
     )
 
 
+def bench_segmentation(batch: int, batches: int, size: int,
+                       warmup: int) -> dict:
+    """Segmentation family: deeplab + fused image_segment decode (device
+    argmax; only the RGBA overlay-sized payload crosses D2H)."""
+    total = _source_total_frames(batch, batches, warmup)
+    desc = (
+        f"videotestsrc device=true batch={batch} num-buffers={total} "
+        f"width={size} height={size} pattern=smpte name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        f"tensor_filter framework=jax model=deeplab_mobilenet "
+        f"custom=size:{size},batch:{batch} name=f ! "
+        f"tensor_decoder mode=image_segment ! "
+        f"tensor_sink name=out max-buffers={_SOURCE_QUEUE_CAPACITY}"
+    )
+    return _source_driven_bench(
+        desc, batch, batches, warmup,
+        "deeplab_segmentation_fps_per_chip", 250.0, "videotestsrc",
+    )
+
+
 def bench_pose(batch: int, batches: int, size: int, warmup: int) -> dict:
     total = _source_total_frames(batch, batches, warmup)
     desc = (
@@ -491,8 +512,9 @@ def _backend_reachable(attempt_timeout_s: float = 60.0,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="classification",
-                    choices=["classification", "detection", "pose", "audio",
-                             "llm", "llm7b", "all"])
+                    choices=["classification", "detection", "pose",
+                             "segmentation", "audio", "llm", "llm7b",
+                             "all"])
     ap.add_argument("--batch", type=int, default=64)
     # 128 batches ≈ 1.2s measured window: short runs (32) showed ±30%
     # run-to-run variance from scheduling spikes; 128 is ±2%.
@@ -524,13 +546,16 @@ def main() -> int:
             "detection": (f"{args.detection_model}_detection_fps_per_chip",
                           "frames/sec"),
             "pose": ("posenet_pipeline_fps_per_chip", "frames/sec"),
+            "segmentation": ("deeplab_segmentation_fps_per_chip",
+                             "frames/sec"),
             "audio": (f"{args.audio_model}_windows_per_sec_per_chip",
                       "windows/sec"),
             "llm": (f"{args.llm_model}_tokens_per_sec_per_chip",
                     "tokens/sec"),
             "llm7b": ("llama2_7b_tokens_per_sec_per_chip", "tokens/sec"),
         }
-        todo = (["classification", "detection", "pose", "audio", "llm"]
+        todo = (["classification", "detection", "pose", "segmentation",
+                 "audio", "llm"]
                 if args.config == "all" else [args.config])
         for name in todo:
             metric, unit = fail_metrics[name]
@@ -552,6 +577,9 @@ def main() -> int:
             args.detection_model),
         "pose": lambda: bench_pose(
             args.batch, args.batches, args.size, args.warmup),
+        "segmentation": lambda: bench_segmentation(
+            max(8, args.batch // 4), args.batches, min(args.size, 224),
+            args.warmup),
         "audio": lambda: bench_audio(args.batch, args.batches, args.warmup,
                                      args.audio_source, args.audio_model),
         "llm": lambda: bench_llm(max(1, args.batches // 8), 1,

@@ -9,6 +9,7 @@
  */
 #include <Python.h>
 
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -16,9 +17,16 @@
 
 #include "../include/nnstpu_capi.h"
 
-static PyObject *g_mod = NULL;
-static int g_inited = 0;
+/* g_mod is published with release ordering after a successful init and
+ * read with acquire in every entry point: the any-thread promise in the
+ * header must not rest on a data race. */
+static std::atomic<PyObject *> g_mod{NULL};
+static std::atomic<int> g_inited{0};
 static std::mutex g_init_mu;
+
+static PyObject *mod_acquire(void) {
+    return g_mod.load(std::memory_order_acquire);
+}
 
 static void set_err(char *err, size_t errlen, const char *msg) {
     if (err && errlen) {
@@ -26,7 +34,9 @@ static void set_err(char *err, size_t errlen, const char *msg) {
     }
 }
 
-/* Capture the pending Python exception into err (GIL held). */
+/* Capture the pending Python exception into err (GIL held).  Always
+ * leaves NO exception pending — a secondary failure in str()/utf-8 must
+ * not leak into the caller's next Python call. */
 static void fetch_py_err(char *err, size_t errlen) {
     PyObject *type = NULL, *value = NULL, *tb = NULL;
     PyErr_Fetch(&type, &value, &tb);
@@ -35,7 +45,7 @@ static void fetch_py_err(char *err, size_t errlen) {
         PyObject *s = PyObject_Str(value);
         if (s) {
             const char *msg = PyUnicode_AsUTF8(s);
-            set_err(err, errlen, msg);
+            set_err(err, errlen, msg ? msg : "python error (undecodable)");
             Py_DECREF(s);
         } else {
             set_err(err, errlen, "python error (unprintable)");
@@ -46,13 +56,14 @@ static void fetch_py_err(char *err, size_t errlen) {
     Py_XDECREF(type);
     Py_XDECREF(value);
     Py_XDECREF(tb);
+    PyErr_Clear();
 }
 
 extern "C" int nnstpu_init(void) {
     /* Serialized: concurrent first calls must not race Py_InitializeEx or
      * observe a half-published g_mod (header promises any-thread use). */
     std::lock_guard<std::mutex> lk(g_init_mu);
-    if (g_inited) {
+    if (g_inited.load(std::memory_order_acquire)) {
         return 0;
     }
     if (!Py_IsInitialized()) {
@@ -70,8 +81,8 @@ extern "C" int nnstpu_init(void) {
                 PyErr_Clear();
             }
             Py_XDECREF(r);
-            g_mod = mod;
-            g_inited = 1;
+            g_mod.store(mod, std::memory_order_release);
+            g_inited.store(1, std::memory_order_release);
         } else {
             PyErr_Print();
         }
@@ -79,7 +90,7 @@ extern "C" int nnstpu_init(void) {
          * threads can PyGILState_Ensure, and on FAILURE so they don't
          * deadlock behind a dead init. */
         PyEval_SaveThread();
-        return g_inited ? 0 : -1;
+        return g_inited.load(std::memory_order_acquire) ? 0 : -1;
     }
     /* Already-initialized interpreter (e.g. loaded from a Python
      * process): just import the bridge under the GIL. */
@@ -87,8 +98,8 @@ extern "C" int nnstpu_init(void) {
     PyObject *mod = PyImport_ImportModule("nnstreamer_tpu.capi");
     int rc = -1;
     if (mod) {
-        g_mod = mod;
-        g_inited = 1;
+        g_mod.store(mod, std::memory_order_release);
+        g_inited.store(1, std::memory_order_release);
         rc = 0;
     } else {
         PyErr_Print();
@@ -105,12 +116,12 @@ extern "C" nnstpu_single_h nnstpu_single_open(const char *model,
         set_err(err, errlen, "model must be non-empty");
         return -1;
     }
-    if (!g_inited && nnstpu_init() != 0) {
+    if (!g_inited.load(std::memory_order_acquire) && nnstpu_init() != 0) {
         set_err(err, errlen, "nnstpu_init failed (see stderr)");
         return -1;
     }
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *r = PyObject_CallMethod(g_mod, "single_open", "sss", model,
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "single_open", "sss", model,
                                       framework && *framework ? framework
                                                               : "auto",
                                       custom ? custom : "");
@@ -128,12 +139,12 @@ extern "C" nnstpu_single_h nnstpu_single_open(const char *model,
 extern "C" int nnstpu_single_info(nnstpu_single_h h, char *in_desc,
                                   size_t in_len, char *out_desc,
                                   size_t out_len, char *err, size_t errlen) {
-    if (!g_inited) {
+    if (!g_inited.load(std::memory_order_acquire)) {
         set_err(err, errlen, "not initialized");
         return -1;
     }
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *r = PyObject_CallMethod(g_mod, "single_info", "L", h);
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "single_info", "L", h);
     int rc = -1;
     if (r && PyTuple_Check(r) && PyTuple_Size(r) == 2) {
         const char *a = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
@@ -161,7 +172,7 @@ extern "C" int nnstpu_single_invoke(nnstpu_single_h h,
                                     const size_t *in_sizes, int n_in,
                                     void **out_data, size_t *out_sizes,
                                     int max_out, char *err, size_t errlen) {
-    if (!g_inited) {
+    if (!g_inited.load(std::memory_order_acquire)) {
         set_err(err, errlen, "not initialized");
         return -1;
     }
@@ -190,7 +201,7 @@ extern "C" int nnstpu_single_invoke(nnstpu_single_h h,
     int n_out = -1;
     PyObject *r = NULL;
     if (!failed) {
-        r = PyObject_CallMethod(g_mod, "single_invoke_bytes", "LO", h,
+        r = PyObject_CallMethod(mod_acquire(), "single_invoke_bytes", "LO", h,
                                 blobs);
     }
     Py_DECREF(blobs);
@@ -241,11 +252,11 @@ extern "C" int nnstpu_single_invoke(nnstpu_single_h h,
 }
 
 extern "C" void nnstpu_single_close(nnstpu_single_h h) {
-    if (!g_inited) {
+    if (!g_inited.load(std::memory_order_acquire)) {
         return;
     }
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *r = PyObject_CallMethod(g_mod, "single_close", "L", h);
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "single_close", "L", h);
     if (!r) {
         PyErr_Clear();
     }

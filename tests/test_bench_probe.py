@@ -89,6 +89,7 @@ def test_main_emits_one_failure_record_per_config_for_all(monkeypatch,
         "mobilenet_v1_pipeline_fps_per_chip": "frames/sec",
         "ssd_mobilenet_detection_fps_per_chip": "frames/sec",
         "posenet_pipeline_fps_per_chip": "frames/sec",
+        "deeplab_segmentation_fps_per_chip": "frames/sec",
         "speech_commands_windows_per_sec_per_chip": "windows/sec",
         "llama_small_tokens_per_sec_per_chip": "tokens/sec",
     }
