@@ -10,8 +10,10 @@ the plan is the *physical* one, with host boundaries only where unavoidable
 Fusion rule: a maximal linear chain of nodes where every element exposes
 ``device_fn`` for its negotiated input spec, with single in/out edges on the
 default pads, collapses into a :class:`FusedElement`.  The composed function
-is jitted once with donated inputs, so intermediate tensors never leave HBM
-and XLA fuses elementwise stages into the matmul kernels around them.
+is jitted once, so intermediate tensors never leave HBM and XLA fuses
+elementwise stages into the matmul kernels around them; the folded-source
+path additionally donates its input buffers (sole ownership is guaranteed
+there), letting XLA reuse the generated frame's HBM for outputs.
 """
 
 from __future__ import annotations
@@ -50,7 +52,8 @@ class FusedElement(Element):
 
     kind = "fused"
 
-    def __init__(self, elements: List[Element], specs: List[TensorsSpec]):
+    def __init__(self, elements: List[Element], specs: List[TensorsSpec],
+                 donate: bool = False):
         super().__init__({}, name="+".join(e.name for e in elements))
         self.chain = elements
         self._fn = None
@@ -62,9 +65,9 @@ class FusedElement(Element):
         # flight; the sink resolves `_host_post` in the app thread, so the
         # tunnel's D2H roundtrip adds pipeline depth, not throughput.
         self._host_post = getattr(elements[-1], "host_post", None)
-        self._build(specs[0])
+        self._build(specs[0], donate)
 
-    def _build(self, in_spec: TensorsSpec) -> None:
+    def _build(self, in_spec: TensorsSpec, donate: bool) -> None:
         import jax
 
         fns: List[Callable] = []
@@ -82,7 +85,15 @@ class FusedElement(Element):
                 arrays = f(arrays)
             return arrays
 
-        self._fn = jax.jit(composed)
+        # Donation is only legal when the caller guarantees sole ownership
+        # of the input buffers (the folded-source path: the source mints a
+        # fresh device array per batch and this program is its only
+        # consumer) — XLA then reuses the input HBM for outputs.  CPU
+        # backends can't donate and would warn per compile, so gate it.
+        if donate and jax.default_backend() not in ("cpu",):
+            self._fn = jax.jit(composed, donate_argnums=(0,))
+        else:
+            self._fn = jax.jit(composed)
 
     @property
     def out_spec(self) -> TensorsSpec:
@@ -255,7 +266,8 @@ def plan_stages(
                 grown = grow(outs[0].dst)
                 if grown is not None:
                     chain, specs = grown
-                    fe = FusedElement([elements[i] for i in chain], specs)
+                    fe = FusedElement([elements[i] for i in chain], specs,
+                                      donate=True)
                     fs = FusedSourceElement(el, fe)
                     log.info("fused device source into XLA stage: %s",
                              fs.name)
