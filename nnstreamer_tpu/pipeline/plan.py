@@ -68,8 +68,6 @@ class FusedElement(Element):
         self._build(specs[0], donate)
 
     def _build(self, in_spec: TensorsSpec, donate: bool) -> None:
-        import jax
-
         fns: List[Callable] = []
         spec = in_spec
         for el in self.chain:
@@ -85,15 +83,29 @@ class FusedElement(Element):
                 arrays = f(arrays)
             return arrays
 
-        # Donation is only legal when the caller guarantees sole ownership
-        # of the input buffers (the folded-source path: the source mints a
-        # fresh device array per batch and this program is its only
-        # consumer) — XLA then reuses the input HBM for outputs.  CPU
-        # backends can't donate and would warn per compile, so gate it.
-        if donate and jax.default_backend() not in ("cpu",):
-            self._fn = jax.jit(composed, donate_argnums=(0,))
-        else:
-            self._fn = jax.jit(composed)
+        self._composed = composed
+        self._donate = donate
+
+    def _jitted(self):
+        """Build the jitted program on FIRST use, not at plan time: the
+        donation gate reads jax.default_backend(), which initializes the
+        backend — with a dead device tunnel that call blocks forever, and
+        pipeline CONSTRUCTION must stay backend-free (the round-3 outage
+        is exactly this failure mode)."""
+        if self._fn is None:
+            import jax
+
+            # Donation is only legal when the caller guarantees sole
+            # ownership of the input buffers (the folded-source path: the
+            # source mints a fresh device array per batch and this program
+            # is its only consumer) — XLA then reuses the input HBM for
+            # outputs.  CPU backends can't donate and would warn per
+            # compile, so gate it.
+            if self._donate and jax.default_backend() not in ("cpu",):
+                self._fn = jax.jit(self._composed, donate_argnums=(0,))
+            else:
+                self._fn = jax.jit(self._composed)
+        return self._fn
 
     @property
     def out_spec(self) -> TensorsSpec:
@@ -111,7 +123,7 @@ class FusedElement(Element):
         import jax.numpy as jnp
 
         arrays = tuple(jnp.asarray(t) for t in buf.tensors)
-        out = self._fn(arrays)
+        out = self._jitted()(arrays)
         # A truncated tail batch (device sources with non-aligned
         # num-buffers) has a different leading dim than the negotiated
         # spec: let the buffer derive its spec from the actual arrays so
