@@ -483,6 +483,77 @@ def _softmax(env, const, n):
     return jax.nn.softmax(env[n.inputs[0]], axis=axis)
 
 
+def _resize(env, const, n: _Node):
+    """ONNX Resize (opset 11+), 4D NCHW over the spatial dims: nearest
+    (asymmetric/floor — torch's interpolate export) and linear
+    (half_pixel / align_corners / asymmetric)."""
+    import jax.numpy as jnp
+
+    x = env[n.inputs[0]]
+    if x.ndim != 4:
+        raise ONNXError(f"Resize: only 4D NCHW supported, got {x.ndim}D")
+    mode = n.attrs["mode"].s if "mode" in n.attrs else "nearest"
+    coord = (n.attrs["coordinate_transformation_mode"].s
+             if "coordinate_transformation_mode" in n.attrs else "half_pixel")
+    h, w = x.shape[2], x.shape[3]
+    oh = ow = None
+    if len(n.inputs) > 3 and n.inputs[3]:  # sizes
+        sizes = [int(v) for v in const(n.inputs[3]).ravel()]
+        oh, ow = sizes[2], sizes[3]
+    elif len(n.inputs) > 2 and n.inputs[2]:  # scales
+        scales = [float(v) for v in const(n.inputs[2]).ravel()]
+        if len(scales) != 4 or scales[0] != 1 or scales[1] != 1:
+            raise ONNXError(f"Resize: unsupported scales {scales}")
+        oh, ow = int(h * scales[2]), int(w * scales[3])
+    if oh is None:
+        raise ONNXError("Resize: neither scales nor sizes given")
+
+    def src_idx(o, nsrc):
+        i = jnp.arange(o, dtype=jnp.float32)
+        if coord == "align_corners":
+            return i * (nsrc - 1) / (o - 1) if o > 1 else i * 0.0
+        if coord == "asymmetric":
+            return i * nsrc / o
+        if coord == "pytorch_half_pixel":
+            # like half_pixel, but a length-1 output maps to source 0
+            return (i + 0.5) * nsrc / o - 0.5 if o > 1 else i * 0.0
+        if coord == "half_pixel":
+            return (i + 0.5) * nsrc / o - 0.5
+        raise ONNXError(
+            f"Resize coordinate_transformation_mode {coord!r} unsupported")
+
+    yf, xf = src_idx(oh, h), src_idx(ow, w)
+    if mode == "nearest":
+        near = (n.attrs["nearest_mode"].s if "nearest_mode" in n.attrs
+                else "round_prefer_floor")  # the opset-11+ default
+        rounders = {
+            "round_prefer_floor": lambda v: jnp.ceil(v - 0.5),
+            "round_prefer_ceil": lambda v: jnp.floor(v + 0.5),
+            "floor": jnp.floor,
+            "ceil": jnp.ceil,
+        }
+        if near not in rounders:
+            raise ONNXError(f"Resize nearest_mode {near!r} unsupported")
+        rnd = rounders[near]
+        yi = jnp.clip(rnd(yf).astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(rnd(xf).astype(jnp.int32), 0, w - 1)
+        return x[:, :, yi][:, :, :, xi]
+    if mode == "linear":
+        y0 = jnp.clip(jnp.floor(yf).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xf).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = jnp.clip(yf - y0, 0.0, 1.0)[None, None, :, None]
+        wx = jnp.clip(xf - x0, 0.0, 1.0)[None, None, None, :]
+        f = x.astype(jnp.float32)
+        top = f[:, :, y0][:, :, :, x0] * (1 - wx) + \
+            f[:, :, y0][:, :, :, x1] * wx
+        bot = f[:, :, y1][:, :, :, x0] * (1 - wx) + \
+            f[:, :, y1][:, :, :, x1] * wx
+        return (top * (1 - wy) + bot * wy).astype(x.dtype)
+    raise ONNXError(f"Resize mode {mode!r} unsupported")
+
+
 def _run_node(env, const, n: _Node):
     import jax
     import jax.numpy as jnp
@@ -554,6 +625,49 @@ def _run_node(env, const, n: _Node):
         raise ONNXError(f"Constant node {n.name!r} without value")
     if op == "Identity":
         return env[n.inputs[0]]
+    if op == "Erf":
+        return jax.lax.erf(env[n.inputs[0]])
+    if op == "Sqrt":
+        return jnp.sqrt(env[n.inputs[0]])
+    if op == "Exp":
+        return jnp.exp(env[n.inputs[0]])
+    if op == "Neg":
+        return -env[n.inputs[0]]
+    if op == "Pow":
+        return jnp.power(env[n.inputs[0]], env[n.inputs[1]])
+    if op == "LeakyRelu":
+        alpha = n.attrs["alpha"].f if "alpha" in n.attrs else 0.01
+        x = env[n.inputs[0]]
+        return jnp.where(x >= 0, x, alpha * x)
+    if op in ("Max", "Min"):
+        import functools
+
+        fn = jnp.maximum if op == "Max" else jnp.minimum
+        return functools.reduce(fn, (env[i] for i in n.inputs))
+    if op == "Shape":
+        # static under XLA: emit concrete numpy so downstream shape math
+        # (Gather/Slice/Concat chains) stays trace-time constant.
+        # opset-15 start/end attributes slice the dims vector.
+        shape = np.asarray(np.shape(env[n.inputs[0]]), np.int64)
+        start = n.attrs["start"].i if "start" in n.attrs else 0
+        end = n.attrs["end"].i if "end" in n.attrs else len(shape)
+        return shape[start:end]
+    if op == "Gather":
+        axis = n.attrs["axis"].i if "axis" in n.attrs else 0
+        return jnp.take(env[n.inputs[0]], env[n.inputs[1]], axis=axis)
+    if op == "Split":
+        x = env[n.inputs[0]]
+        axis = n.attrs["axis"].i if "axis" in n.attrs else 0
+        if "split" in n.attrs and n.attrs["split"].ints:
+            sizes = list(n.attrs["split"].ints)
+        elif len(n.inputs) > 1 and n.inputs[1]:
+            sizes = [int(v) for v in const(n.inputs[1]).ravel()]
+        else:
+            sizes = [x.shape[axis] // len(n.outputs)] * len(n.outputs)
+        bounds = np.cumsum(sizes)[:-1].tolist()
+        return tuple(jnp.split(x, bounds, axis=axis))
+    if op == "Resize":
+        return _resize(env, const, n)
     if op == "Cast":
         to = n.attrs["to"].i
         if to not in _TENSOR_DTYPES:
@@ -591,20 +705,23 @@ _OPS = {"Conv", "Gemm", "MatMul", "Relu", "Sigmoid", "Tanh", "Clip",
         "BatchNormalization", "Add", "Sub", "Mul", "Div", "Concat",
         "Reshape", "Flatten", "Transpose", "Pad", "ReduceMean", "Squeeze",
         "Unsqueeze", "Constant", "Identity", "Cast", "ConstantOfShape",
-        "Slice"}
+        "Slice", "Erf", "Sqrt", "Exp", "Neg", "Pow", "LeakyRelu", "Max",
+        "Min", "Shape", "Gather", "Split", "Resize"}
 
 #: per-op input positions that are static metadata (resolved from
 #: initializers at trace time, kept OUT of the traced params pytree)
 _STATIC_OPERANDS = {"Reshape": (1,), "Pad": (1, 2), "Clip": (1, 2),
                     "ReduceMean": (1,), "Squeeze": (1,), "Unsqueeze": (1,),
-                    "ConstantOfShape": (0,), "Slice": (1, 2, 3, 4)}
+                    "ConstantOfShape": (0,), "Slice": (1, 2, 3, 4),
+                    "Resize": (1, 2, 3), "Split": (1,)}
 
 #: shape-computation ops that run in NUMPY when all inputs are concrete:
 #: under jit, even constant-fed jnp ops stage to tracers, which would make
 #: the torch exporter's pads/shape subgraphs (Cast/Slice/Concat chains)
 #: unresolvable as trace-time statics downstream.
 _HOSTABLE = {"Cast", "Slice", "Concat", "ConstantOfShape", "Unsqueeze",
-             "Squeeze", "Reshape", "Transpose", "Identity", "Constant"}
+             "Squeeze", "Reshape", "Transpose", "Identity", "Constant",
+             "Gather", "Add", "Sub", "Mul", "Div", "Max", "Min"}
 
 
 def _host_run(env, const, n: _Node):
@@ -639,6 +756,24 @@ def _host_run(env, const, n: _Node):
         perm = (tuple(n.attrs["perm"].ints) if "perm" in n.attrs
                 else tuple(reversed(range(x.ndim))))
         return np.transpose(x, perm)
+    if op == "Gather":
+        axis = n.attrs["axis"].i if "axis" in n.attrs else 0
+        return np.take(np.asarray(env[n.inputs[0]]),
+                       np.asarray(env[n.inputs[1]]), axis=axis)
+    if op in ("Add", "Sub", "Mul", "Div", "Max", "Min"):
+        import operator
+
+        fn = {"Add": operator.add, "Sub": operator.sub,
+              "Mul": operator.mul, "Div": operator.truediv,
+              "Max": np.maximum, "Min": np.minimum}[op]
+        out = np.asarray(env[n.inputs[0]])
+        for i in n.inputs[1:]:
+            out = fn(out, np.asarray(env[i]))
+        if op == "Div" and all(
+                np.issubdtype(np.asarray(env[i]).dtype, np.integer)
+                for i in n.inputs):
+            out = out.astype(np.int64)  # ONNX integer Div truncates
+        return out
     raise ONNXError(f"not hostable: {op}")  # pragma: no cover
 
 
@@ -735,7 +870,11 @@ def load_bundle(path: str, opts: Optional[Dict[str, str]] = None) -> ModelBundle
                 out = _host_run(eview, const, n)
             else:
                 out = _run_node(eview, const, n)
-            env[n.outputs[0]] = out
+            if isinstance(out, tuple):  # multi-output ops (Split)
+                for name, o in zip(n.outputs, out):
+                    env[name] = o
+            else:
+                env[n.outputs[0]] = out
         results = tuple(lookup(nm) for nm, _d, _s in g.outputs)
         return results if len(results) > 1 else results[0]
 

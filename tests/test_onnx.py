@@ -147,6 +147,126 @@ class TestTorchExportedModels:
         assert any(v.shape == (16, 10) for v in bundle.params.values())
 
 
+class TestTransformerAndYolo:
+    """Ops real-world exports need beyond the CNN basics."""
+
+    def test_attention_block(self, tmp_path):
+        """A full pre-norm transformer block (LayerNorm decomposition,
+        chunked qkv, softmax attention, GELU-via-Erf) exported by torch."""
+        torch.manual_seed(8)
+
+        class Attn(nn.Module):
+            def __init__(self, d=32, h=4):
+                super().__init__()
+                self.h, self.hd = h, d // h
+                self.qkv = nn.Linear(d, 3 * d)
+                self.o = nn.Linear(d, d)
+                self.ln1 = nn.LayerNorm(d)
+                self.ln2 = nn.LayerNorm(d)
+                self.ff1 = nn.Linear(d, 64)
+                self.ff2 = nn.Linear(64, d)
+
+            def forward(self, x):
+                B, T, D = x.shape
+                q, k, v = self.qkv(self.ln1(x)).chunk(3, dim=-1)
+                q = q.view(B, T, self.h, self.hd).transpose(1, 2)
+                k = k.view(B, T, self.h, self.hd).transpose(1, 2)
+                v = v.view(B, T, self.h, self.hd).transpose(1, 2)
+                a = torch.softmax(
+                    q @ k.transpose(-1, -2) / (self.hd ** 0.5), dim=-1)
+                y = (a @ v).transpose(1, 2).reshape(B, T, D)
+                x = x + self.o(y)
+                return x + self.ff2(
+                    torch.nn.functional.gelu(self.ff1(self.ln2(x))))
+
+        m = Attn()
+        x = torch.randn(2, 6, 32)
+        _compare(_export(tmp_path, m, x, opset=14), m, x, rtol=2e-4,
+                 atol=2e-5)
+
+    def test_yolo_block_leaky_resize_split_max(self, tmp_path):
+        torch.manual_seed(9)
+
+        class Y(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.c = nn.Conv2d(8, 16, 3, padding=1)
+
+            def forward(self, x):
+                h = torch.nn.functional.leaky_relu(self.c(x), 0.1)
+                h = torch.nn.functional.interpolate(
+                    h, scale_factor=2, mode="nearest")
+                a, b = torch.split(h, 8, dim=1)
+                return torch.maximum(a, b)
+
+        m = Y()
+        x = torch.randn(1, 8, 8, 8)
+        _compare(_export(tmp_path, m, x), m, x)
+
+    def test_bilinear_upsample(self, tmp_path):
+        class U(nn.Module):
+            def forward(self, x):
+                return torch.nn.functional.interpolate(
+                    x, scale_factor=2, mode="bilinear",
+                    align_corners=False)
+
+        x = torch.randn(1, 3, 5, 5)
+        _compare(_export(tmp_path, U(), x), U(), x)
+
+    def test_resize_spec_default_round_prefer_floor(self):
+        # ONNX defaults (coord=half_pixel, nearest_mode=round_prefer_floor)
+        # differ from torch's floor/asymmetric export — check directly
+        n = nx._Node()
+        n.op, n.name = "Resize", "r"
+        n.inputs, n.outputs = ["x", "", "scales"], ["y"]
+        n.attrs = {}
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 4, 1)
+        env = {"x": x}
+        out = np.asarray(nx._resize(
+            env, lambda name: np.array([1, 1, 2, 1], np.float32), n))
+        # spec: source rows [0,0,1,1,2,2,3,3]
+        np.testing.assert_array_equal(out.ravel(),
+                                      [0, 0, 1, 1, 2, 2, 3, 3])
+
+    def test_resize_unknown_coord_mode_rejected(self):
+        n = nx._Node()
+        n.op, n.name = "Resize", "r"
+        n.inputs, n.outputs = ["x", "", "scales"], ["y"]
+        a = nx._Attr()
+        a.f = a.i = a.t = None
+        a.s = "tf_crop_and_resize"
+        a.floats, a.ints = [], []
+        n.attrs = {"coordinate_transformation_mode": a}
+        with pytest.raises(nx.ONNXError, match="tf_crop_and_resize"):
+            nx._resize({"x": np.zeros((1, 1, 4, 4), np.float32)},
+                       lambda name: np.array([1, 1, 2, 2], np.float32), n)
+
+    def test_embedding_gather_traced_indices(self, tmp_path):
+        # Gather with DATA indices (token ids), not shape math
+        torch.manual_seed(10)
+
+        class E(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(50, 16)
+                self.head = nn.Linear(16, 4)
+
+            def forward(self, ids):
+                return self.head(self.emb(ids).mean(dim=1))
+
+        m = E()
+        ids = torch.randint(0, 50, (3, 7))
+        path = _export(tmp_path, m, ids)
+        import jax
+
+        bundle = nx.load_bundle(path)
+        got = np.asarray(jax.jit(bundle.apply_fn)(bundle.params,
+                                                  ids.numpy()))
+        with torch.no_grad():
+            want = m(ids).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 class TestErrorsAndOptions:
     def test_not_onnx(self, tmp_path):
         p = tmp_path / "junk.onnx"
