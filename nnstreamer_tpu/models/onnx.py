@@ -492,6 +492,13 @@ def _resize(env, const, n: _Node):
     x = env[n.inputs[0]]
     if x.ndim != 4:
         raise ONNXError(f"Resize: only 4D NCHW supported, got {x.ndim}D")
+    for unsup in ("antialias", "exclude_outside"):
+        if unsup in n.attrs and n.attrs[unsup].i:
+            raise ONNXError(f"Resize: attribute {unsup}=1 unsupported")
+    if "axes" in n.attrs and n.attrs["axes"].ints:
+        raise ONNXError(
+            "Resize: the opset-18 axes attribute is unsupported "
+            "(full-rank scales/sizes only)")
     mode = n.attrs["mode"].s if "mode" in n.attrs else "nearest"
     coord = (n.attrs["coordinate_transformation_mode"].s
              if "coordinate_transformation_mode" in n.attrs else "half_pixel")
@@ -587,9 +594,16 @@ def _run_node(env, const, n: _Node):
     if op in ("Add", "Sub", "Mul", "Div"):
         import operator
 
-        fn = {"Add": operator.add, "Sub": operator.sub,
-              "Mul": operator.mul, "Div": operator.truediv}[op]
-        return fn(env[n.inputs[0]], env[n.inputs[1]])
+        x, y = env[n.inputs[0]], env[n.inputs[1]]
+        if op == "Div":
+            if (np.issubdtype(np.dtype(x.dtype), np.integer)
+                    and np.issubdtype(np.dtype(y.dtype), np.integer)):
+                # ONNX integer Div truncates toward zero
+                dt = np.promote_types(x.dtype, y.dtype)
+                return jnp.trunc(jnp.divide(x, y)).astype(dt)
+            return x / y
+        return {"Add": operator.add, "Sub": operator.sub,
+                "Mul": operator.mul}[op](x, y)
     if op == "Concat":
         return jnp.concatenate([env[i] for i in n.inputs],
                                axis=n.attrs["axis"].i)
@@ -663,7 +677,10 @@ def _run_node(env, const, n: _Node):
         elif len(n.inputs) > 1 and n.inputs[1]:
             sizes = [int(v) for v in const(n.inputs[1]).ravel()]
         else:
-            sizes = [x.shape[axis] // len(n.outputs)] * len(n.outputs)
+            # opset-18 equal split: ceil-sized chunks, LAST one smaller
+            k = len(n.outputs)
+            chunk = -(x.shape[axis] // -k)
+            sizes = [chunk] * (k - 1) + [x.shape[axis] - chunk * (k - 1)]
         bounds = np.cumsum(sizes)[:-1].tolist()
         return tuple(jnp.split(x, bounds, axis=axis))
     if op == "Resize":
@@ -772,7 +789,11 @@ def _host_run(env, const, n: _Node):
         if op == "Div" and all(
                 np.issubdtype(np.asarray(env[i]).dtype, np.integer)
                 for i in n.inputs):
-            out = out.astype(np.int64)  # ONNX integer Div truncates
+            # ONNX integer Div truncates toward zero, result keeps the
+            # promoted INPUT dtype (matching the traced path)
+            dt = np.promote_types(*(np.asarray(env[i]).dtype
+                                    for i in n.inputs[:2]))
+            out = np.trunc(out).astype(dt)
         return out
     raise ONNXError(f"not hostable: {op}")  # pragma: no cover
 
