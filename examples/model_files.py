@@ -63,10 +63,8 @@ def gguf_demo(td: str) -> None:
     params = llama.init_params(cfg, seed=1)
     # export in llama.cpp's own layout (names, fastest-first dims,
     # interleaved RoPE) — what a real .gguf from the wild looks like
-    from tests.test_gguf import _meta, _to_gguf_tensors  # reuse the mapping
-
     path = os.path.join(td, "model.gguf")
-    gguf.write(path, _meta(cfg), _to_gguf_tensors(params, cfg))
+    gguf.export_llama(path, params, cfg)
 
     p = nt.Pipeline(
         "appsrc name=src caps=other/tensors,dimensions=1:1,types=int32,"

@@ -157,6 +157,68 @@ def read(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
     return meta, tensors
 
 
+def llama_metadata(cfg) -> Dict:
+    """The ``llama.*`` metadata keys llama.cpp reads for a model config."""
+    return {
+        "general.architecture": "llama",
+        "llama.block_count": cfg.n_layers,
+        "llama.embedding_length": cfg.dim,
+        "llama.attention.head_count": cfg.n_heads,
+        "llama.attention.head_count_kv": cfg.n_kv_heads,
+        "llama.feed_forward_length": cfg.ffn_hidden,
+        "llama.context_length": cfg.max_seq,
+        "llama.rope.freq_base": cfg.rope_theta,
+        "llama.attention.layer_norm_rms_epsilon": cfg.norm_eps,
+    }
+
+
+def _inv_rope_permute(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """rotate-half layout -> ggml interleaved-pair layout (inverse of
+    llama._rope_permute; composing with it is identity, proven by the
+    exact-logits round-trip test)."""
+    out, dim2 = w.shape
+    hd = out // n_heads
+    return np.ascontiguousarray(
+        w.reshape(n_heads, 2, hd // 2, dim2).swapaxes(1, 2).reshape(
+            out, dim2))
+
+
+def llama_to_tensors(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """models/llama.py stacked pytree -> llama.cpp tensor naming/layout
+    (2D mats transposed back to [out, in], q/k re-interleaved for ggml's
+    RoPE convention) — what :func:`write` needs to emit a real-looking
+    .gguf from this framework's weights."""
+    lay = params["layers"]
+    out = {"token_embd.weight": np.asarray(params["embed"]),
+           "output_norm.weight": np.asarray(params["ln_out"]),
+           "output.weight": np.ascontiguousarray(
+               np.asarray(params["lm_head"]).T)}
+    for i in range(cfg.n_layers):
+        wq = np.ascontiguousarray(np.asarray(lay["wq"])[i].T)
+        wk = np.ascontiguousarray(np.asarray(lay["wk"])[i].T)
+        out[f"blk.{i}.attn_q.weight"] = _inv_rope_permute(wq, cfg.n_heads)
+        out[f"blk.{i}.attn_k.weight"] = _inv_rope_permute(wk,
+                                                          cfg.n_kv_heads)
+        out[f"blk.{i}.attn_v.weight"] = np.ascontiguousarray(
+            np.asarray(lay["wv"])[i].T)
+        out[f"blk.{i}.attn_output.weight"] = np.ascontiguousarray(
+            np.asarray(lay["wo"])[i].T)
+        out[f"blk.{i}.ffn_gate.weight"] = np.ascontiguousarray(
+            np.asarray(lay["w_gate"])[i].T)
+        out[f"blk.{i}.ffn_up.weight"] = np.ascontiguousarray(
+            np.asarray(lay["w_up"])[i].T)
+        out[f"blk.{i}.ffn_down.weight"] = np.ascontiguousarray(
+            np.asarray(lay["w_down"])[i].T)
+        out[f"blk.{i}.attn_norm.weight"] = np.asarray(lay["ln_attn"])[i]
+        out[f"blk.{i}.ffn_norm.weight"] = np.asarray(lay["ln_mlp"])[i]
+    return out
+
+
+def export_llama(path: str, params: Dict, cfg) -> None:
+    """Write a llama-family pytree as a .gguf llama.cpp can identify."""
+    write(path, llama_metadata(cfg), llama_to_tensors(params, cfg))
+
+
 def write(path: str, meta: Dict, tensors: Dict[str, np.ndarray],
           align: int = 32) -> None:
     """Emit a GGUF v3 file (tests / converting weights for reuse)."""

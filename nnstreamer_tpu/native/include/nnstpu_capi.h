@@ -64,6 +64,38 @@ int nnstpu_single_invoke(nnstpu_single_h h,
 
 void nnstpu_single_close(nnstpu_single_h h);
 
+/* ---- pipeline surface (ml_pipeline_* analog) -------------------------
+ * Construct + start a pipeline from the gst-launch-style description,
+ * feed named appsrc elements, pull named tensor_sink elements. */
+
+typedef long long nnstpu_pipeline_h; /* < 0 means error */
+
+nnstpu_pipeline_h nnstpu_pipeline_open(const char *description,
+                                       char *err, size_t errlen);
+
+/* Push one buffer (n_in raw tensor payloads) into appsrc `name`.  Sizes
+ * must match the source's negotiated caps spec when it carries one. */
+int nnstpu_pipeline_push(nnstpu_pipeline_h h, const char *name,
+                         const void *const *in_data, const size_t *in_sizes,
+                         int n_in, char *err, size_t errlen);
+
+/* Pull one buffer from tensor_sink `name` (blocks up to timeout_ms).
+ * Returns the number of tensors (<= max_out); fills out_data/out_sizes
+ * with malloc'd buffers (caller frees via nnstpu_free) and writes the
+ * per-tensor "dims,dtype;..." description into desc. */
+int nnstpu_pipeline_pull(nnstpu_pipeline_h h, const char *name,
+                         long timeout_ms, void **out_data,
+                         size_t *out_sizes, int max_out,
+                         char *desc, size_t desc_len,
+                         char *err, size_t errlen);
+
+/* Signal end-of-stream on appsrc `name`, or on every app source when
+ * name is NULL/"". */
+int nnstpu_pipeline_eos(nnstpu_pipeline_h h, const char *name,
+                        char *err, size_t errlen);
+
+void nnstpu_pipeline_close(nnstpu_pipeline_h h);
+
 void nnstpu_free(void *p);
 
 #ifdef __cplusplus

@@ -28,6 +28,69 @@ static PyObject *mod_acquire(void) {
     return g_mod.load(std::memory_order_acquire);
 }
 
+static void set_err(char *err, size_t errlen, const char *msg);
+
+/* Build a Python list of bytes from C payloads; NULL on failure (GIL
+ * held).  Shared by single_invoke and pipeline_push. */
+static PyObject *make_blob_list(const void *const *in_data,
+                                const size_t *in_sizes, int n_in) {
+    PyObject *blobs = PyList_New(n_in);
+    if (!blobs) {
+        return NULL;
+    }
+    for (int i = 0; i < n_in; i++) {
+        PyObject *b = PyBytes_FromStringAndSize(
+            (const char *)in_data[i], (Py_ssize_t)in_sizes[i]);
+        if (!b) {
+            Py_DECREF(blobs);
+            return NULL;
+        }
+        PyList_SET_ITEM(blobs, i, b); /* steals */
+    }
+    return blobs;
+}
+
+/* Copy a Python list of bytes into malloc'd C buffers.  Returns the
+ * count, or -1 (err set, any partially-written buffers freed).  GIL
+ * held.  Shared by single_invoke and pipeline_pull. */
+static int copy_out_blobs(PyObject *list, void **out_data,
+                          size_t *out_sizes, int max_out, char *err,
+                          size_t errlen) {
+    Py_ssize_t n = PyList_Size(list);
+    if ((int)n > max_out) {
+        set_err(err, errlen, "max_out too small for outputs");
+        return -1;
+    }
+    int written = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        char *p = NULL;
+        Py_ssize_t len = 0;
+        if (PyBytes_AsStringAndSize(PyList_GET_ITEM(list, i), &p, &len) !=
+            0) {
+            break;
+        }
+        void *buf = malloc((size_t)len ? (size_t)len : 1);
+        if (!buf) {
+            set_err(err, errlen, "out of memory");
+            break;
+        }
+        memcpy(buf, p, (size_t)len);
+        out_data[i] = buf;
+        out_sizes[i] = (size_t)len;
+        written++;
+    }
+    if (written == (int)n) {
+        return (int)n;
+    }
+    /* free exactly the buffers handed out before the failure (later
+     * slots are caller-owned uninitialized memory) */
+    for (int i = 0; i < written; i++) {
+        free(out_data[i]);
+        out_data[i] = NULL;
+    }
+    return -1;
+}
+
 static void set_err(char *err, size_t errlen, const char *msg) {
     if (err && errlen) {
         snprintf(err, errlen, "%s", msg ? msg : "unknown error");
@@ -181,66 +244,103 @@ extern "C" int nnstpu_single_invoke(nnstpu_single_h h,
         return -1;
     }
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *blobs = PyList_New(n_in);
-    if (!blobs) {
-        set_err(err, errlen, "out of memory");
-        PyErr_Clear();
-        PyGILState_Release(st);
-        return -1;
-    }
-    int failed = 0;
-    for (int i = 0; i < n_in && !failed; i++) {
-        PyObject *b = PyBytes_FromStringAndSize(
-            (const char *)in_data[i], (Py_ssize_t)in_sizes[i]);
-        if (!b) {
-            failed = 1;
-        } else {
-            PyList_SET_ITEM(blobs, i, b); /* steals */
-        }
-    }
     int n_out = -1;
     PyObject *r = NULL;
-    if (!failed) {
-        r = PyObject_CallMethod(mod_acquire(), "single_invoke_bytes", "LO", h,
-                                blobs);
+    PyObject *blobs = make_blob_list(in_data, in_sizes, n_in);
+    if (blobs) {
+        r = PyObject_CallMethod(mod_acquire(), "single_invoke_bytes", "LO",
+                                h, blobs);
+        Py_DECREF(blobs);
     }
-    Py_DECREF(blobs);
     if (r && PyList_Check(r)) {
-        Py_ssize_t n = PyList_Size(r);
-        if ((int)n > max_out) {
-            set_err(err, errlen, "max_out too small for model outputs");
-        } else {
-            int written = 0;
-            int ok = 1;
-            for (Py_ssize_t i = 0; i < n && ok; i++) {
-                char *p = NULL;
-                Py_ssize_t len = 0;
-                if (PyBytes_AsStringAndSize(PyList_GET_ITEM(r, i), &p,
-                                            &len) != 0) {
-                    ok = 0;
-                    break;
-                }
-                void *buf = malloc((size_t)len ? (size_t)len : 1);
-                if (!buf) {
-                    set_err(err, errlen, "out of memory");
-                    ok = 0;
-                    break;
-                }
-                memcpy(buf, p, (size_t)len);
-                out_data[i] = buf;
-                out_sizes[i] = (size_t)len;
-                written++;
+        n_out = copy_out_blobs(r, out_data, out_sizes, max_out, err,
+                               errlen);
+    }
+    if (n_out < 0 && PyErr_Occurred()) {
+        fetch_py_err(err, errlen);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+    return n_out;
+}
+
+extern "C" nnstpu_pipeline_h nnstpu_pipeline_open(const char *description,
+                                                  char *err, size_t errlen) {
+    if (!description || !*description) {
+        set_err(err, errlen, "description must be non-empty");
+        return -1;
+    }
+    if (!g_inited.load(std::memory_order_acquire) && nnstpu_init() != 0) {
+        set_err(err, errlen, "nnstpu_init failed (see stderr)");
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "pipeline_open", "s",
+                                      description);
+    long long h = -1;
+    if (r) {
+        h = PyLong_AsLongLong(r);
+        Py_DECREF(r);
+    } else {
+        fetch_py_err(err, errlen);
+    }
+    PyGILState_Release(st);
+    return h;
+}
+
+extern "C" int nnstpu_pipeline_push(nnstpu_pipeline_h h, const char *name,
+                                    const void *const *in_data,
+                                    const size_t *in_sizes, int n_in,
+                                    char *err, size_t errlen) {
+    if (!g_inited.load(std::memory_order_acquire)) {
+        set_err(err, errlen, "not initialized");
+        return -1;
+    }
+    if (!name || n_in <= 0 || !in_data || !in_sizes) {
+        set_err(err, errlen, "bad input arguments");
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    int rc = -1;
+    PyObject *blobs = make_blob_list(in_data, in_sizes, n_in);
+    if (blobs) {
+        PyObject *r = PyObject_CallMethod(
+            mod_acquire(), "pipeline_push", "LsO", h, name, blobs);
+        if (r) {
+            rc = 0;
+            Py_DECREF(r);
+        }
+        Py_DECREF(blobs);
+    }
+    if (rc != 0) {
+        fetch_py_err(err, errlen);
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+extern "C" int nnstpu_pipeline_pull(nnstpu_pipeline_h h, const char *name,
+                                    long timeout_ms, void **out_data,
+                                    size_t *out_sizes, int max_out,
+                                    char *desc, size_t desc_len,
+                                    char *err, size_t errlen) {
+    if (!g_inited.load(std::memory_order_acquire)) {
+        set_err(err, errlen, "not initialized");
+        return -1;
+    }
+    int n_out = -1;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "pipeline_pull", "Lsd",
+                                      h, name, timeout_ms / 1000.0);
+    if (r && PyTuple_Check(r) && PyTuple_Size(r) == 2) {
+        PyObject *blobs = PyTuple_GET_ITEM(r, 0);
+        const char *d = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+        if (PyList_Check(blobs) && d) {
+            if (desc && desc_len) {
+                snprintf(desc, desc_len, "%s", d);
             }
-            if (ok) {
-                n_out = (int)n;
-            } else {
-                /* free exactly the buffers handed out before the failure
-                 * (later slots are caller-owned uninitialized memory) */
-                for (int i = 0; i < written; i++) {
-                    free(out_data[i]);
-                    out_data[i] = NULL;
-                }
-            }
+            n_out = copy_out_blobs(blobs, out_data, out_sizes, max_out,
+                                   err, errlen);
         }
     }
     if (n_out < 0 && PyErr_Occurred()) {
@@ -249,6 +349,39 @@ extern "C" int nnstpu_single_invoke(nnstpu_single_h h,
     Py_XDECREF(r);
     PyGILState_Release(st);
     return n_out;
+}
+
+extern "C" int nnstpu_pipeline_eos(nnstpu_pipeline_h h, const char *name,
+                                   char *err, size_t errlen) {
+    if (!g_inited.load(std::memory_order_acquire)) {
+        set_err(err, errlen, "not initialized");
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "pipeline_eos", "Ls",
+                                      h, name ? name : "");
+    int rc = 0;
+    if (!r) {
+        fetch_py_err(err, errlen);
+        rc = -1;
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+    return rc;
+}
+
+extern "C" void nnstpu_pipeline_close(nnstpu_pipeline_h h) {
+    if (!g_inited.load(std::memory_order_acquire)) {
+        return;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *r = PyObject_CallMethod(mod_acquire(), "pipeline_close", "L",
+                                      h);
+    if (!r) {
+        PyErr_Clear();
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
 }
 
 extern "C" void nnstpu_single_close(nnstpu_single_h h) {

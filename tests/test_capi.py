@@ -55,6 +55,33 @@ C_DRIVER = textwrap.dedent("""
 
         nnstpu_free(outs[0]);
         nnstpu_single_close(h);
+
+        /* pipeline surface: construct from the DSL, push, pull, eos */
+        nnstpu_pipeline_h p = nnstpu_pipeline_open(
+            "appsrc name=src caps=other/tensors,dimensions=4:1,"
+            "types=float32 ! "
+            "tensor_transform mode=arithmetic option=add:1.0 ! "
+            "tensor_sink name=out", err, sizeof err);
+        if (p < 0) { fprintf(stderr, "popen: %s\\n", err); return 4; }
+        float pin[4] = {1.0f, 2.0f, 3.0f, 4.0f};
+        const void *pins[1] = {pin};
+        size_t pin_sz[1] = {sizeof pin};
+        if (nnstpu_pipeline_push(p, "src", pins, pin_sz, 1,
+                                 err, sizeof err) != 0) {
+            fprintf(stderr, "push: %s\\n", err); return 4;
+        }
+        char pdesc[128];
+        n = nnstpu_pipeline_pull(p, "out", 30000, outs, out_sz, 4,
+                                 pdesc, sizeof pdesc, err, sizeof err);
+        if (n != 1) { fprintf(stderr, "pull: %s\\n", err); return 4; }
+        float *po = (float *)outs[0];
+        printf("PIPE %s %.1f %.1f %.1f %.1f\\n", pdesc,
+               po[0], po[1], po[2], po[3]);
+        if (po[0] != 2.0f || po[3] != 5.0f) return 5;
+        nnstpu_free(outs[0]);
+        if (nnstpu_pipeline_eos(p, "src", err, sizeof err) != 0) return 6;
+        nnstpu_pipeline_close(p);
+
         printf("CAPI OK\\n");
         return 0;
     }
@@ -107,6 +134,7 @@ def test_c_program_single_shot(capi_binary):
     assert "CAPI OK" in proc.stdout
     assert "IN 4:1,float32" in proc.stdout
     assert "VAL 2.500" in proc.stdout
+    assert "PIPE 4:1,float32 2.0 3.0 4.0 5.0" in proc.stdout
 
 
 class TestBridgeModule:
@@ -144,6 +172,37 @@ class TestBridgeModule:
 
         with pytest.raises(KeyError):
             capi.single_info(999999)
+
+    def test_pipeline_bridge(self):
+        from nnstreamer_tpu import capi
+
+        h = capi.pipeline_open(
+            "appsrc name=src caps=other/tensors,dimensions=4:2,"
+            "types=float32 ! "
+            "tensor_transform mode=arithmetic option=mul:2.0 ! "
+            "tensor_sink name=out")
+        try:
+            x = np.arange(8, dtype=np.float32)
+            capi.pipeline_push(h, "src", [x.tobytes()])
+            blobs, desc = capi.pipeline_pull(h, "out", timeout=15.0)
+            assert desc == "4:2,float32"
+            np.testing.assert_allclose(
+                np.frombuffer(blobs[0], np.float32), x * 2)
+            capi.pipeline_eos(h, "src")
+        finally:
+            capi.pipeline_close(h)
+
+    def test_pipeline_push_size_validated(self):
+        from nnstreamer_tpu import capi
+
+        h = capi.pipeline_open(
+            "appsrc name=src caps=other/tensors,dimensions=4:1,"
+            "types=float32 ! tensor_sink name=out")
+        try:
+            with pytest.raises(ValueError, match="bytes"):
+                capi.pipeline_push(h, "src", [b"\x00" * 5])
+        finally:
+            capi.pipeline_close(h)
 
     def test_model_file_through_capi(self, tmp_path):
         # the C API loads model FILES too (the reference's default shape)

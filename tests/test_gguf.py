@@ -17,53 +17,10 @@ CFG = llama.LlamaConfig(vocab=96, dim=32, n_layers=2, n_heads=2,
                         n_kv_heads=1, ffn_hidden=48, max_seq=64)
 
 
-def _inv_rope_permute(w, n_heads):
-    """rotate-half -> ggml interleaved (inverse of llama._rope_permute)."""
-    out, dim2 = w.shape
-    hd = out // n_heads
-    return np.ascontiguousarray(
-        w.reshape(n_heads, 2, hd // 2, dim2).swapaxes(1, 2).reshape(
-            out, dim2))
-
-
-def _to_gguf_tensors(params, cfg):
-    lay = params["layers"]
-    out = {"token_embd.weight": np.asarray(params["embed"]),
-           "output_norm.weight": np.asarray(params["ln_out"]),
-           "output.weight": np.ascontiguousarray(
-               np.asarray(params["lm_head"]).T)}
-    for i in range(cfg.n_layers):
-        wq = np.ascontiguousarray(np.asarray(lay["wq"])[i].T)
-        wk = np.ascontiguousarray(np.asarray(lay["wk"])[i].T)
-        out[f"blk.{i}.attn_q.weight"] = _inv_rope_permute(wq, cfg.n_heads)
-        out[f"blk.{i}.attn_k.weight"] = _inv_rope_permute(wk, cfg.n_kv_heads)
-        out[f"blk.{i}.attn_v.weight"] = np.ascontiguousarray(
-            np.asarray(lay["wv"])[i].T)
-        out[f"blk.{i}.attn_output.weight"] = np.ascontiguousarray(
-            np.asarray(lay["wo"])[i].T)
-        out[f"blk.{i}.ffn_gate.weight"] = np.ascontiguousarray(
-            np.asarray(lay["w_gate"])[i].T)
-        out[f"blk.{i}.ffn_up.weight"] = np.ascontiguousarray(
-            np.asarray(lay["w_up"])[i].T)
-        out[f"blk.{i}.ffn_down.weight"] = np.ascontiguousarray(
-            np.asarray(lay["w_down"])[i].T)
-        out[f"blk.{i}.attn_norm.weight"] = np.asarray(lay["ln_attn"])[i]
-        out[f"blk.{i}.ffn_norm.weight"] = np.asarray(lay["ln_mlp"])[i]
-    return out
-
-
-def _meta(cfg):
-    return {
-        "general.architecture": "llama",
-        "llama.block_count": cfg.n_layers,
-        "llama.embedding_length": cfg.dim,
-        "llama.attention.head_count": cfg.n_heads,
-        "llama.attention.head_count_kv": cfg.n_kv_heads,
-        "llama.feed_forward_length": cfg.ffn_hidden,
-        "llama.context_length": cfg.max_seq,
-        "llama.rope.freq_base": cfg.rope_theta,
-        "llama.attention.layer_norm_rms_epsilon": cfg.norm_eps,
-    }
+# export mapping lives in the product now (gguf.llama_to_tensors /
+# llama_metadata); these aliases keep the test bodies readable
+_to_gguf_tensors = gguf.llama_to_tensors
+_meta = gguf.llama_metadata
 
 
 class TestContainer:
