@@ -1,0 +1,106 @@
+"""Checkpoint converter CLI: move Llama-family weights between formats.
+
+Reference analog: the model-tooling the llama.cpp ecosystem ships
+(convert_hf_to_gguf.py et al.) — here one command over the framework's
+own readers/writers, so every format the ``llm`` filter ingests can also
+be produced:
+
+    python -m nnstreamer_tpu.tools.convert model.safetensors model.gguf
+    python -m nnstreamer_tpu.tools.convert model.gguf model.npz
+    python -m nnstreamer_tpu.tools.convert hf_dir/ model.safetensors
+
+Input: anything ``llama.load_checkpoint`` reads (.safetensors / HF
+sharded dir / .npz / .gguf).  Output format from the extension:
+``.gguf`` (llama.cpp layout, f32/f16/bf16), ``.safetensors`` (HF
+naming), ``.npz`` (this framework's stacked naming).  ``--dtype``
+selects the stored weight dtype (norms stay f32).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict
+
+import numpy as np
+
+
+def _to_hf(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """Stacked native pytree -> HF Llama tensor naming (the inverse of
+    load_checkpoint's HF branch: transpose back to [out, in], unstack)."""
+    out = {"model.embed_tokens.weight": np.asarray(params["embed"]),
+           "model.norm.weight": np.asarray(params["ln_out"]),
+           "lm_head.weight": np.ascontiguousarray(
+               np.asarray(params["lm_head"]).T)}
+    names = {"wq": "self_attn.q_proj", "wk": "self_attn.k_proj",
+             "wv": "self_attn.v_proj", "wo": "self_attn.o_proj",
+             "w_gate": "mlp.gate_proj", "w_up": "mlp.up_proj",
+             "w_down": "mlp.down_proj"}
+    lay = params["layers"]
+    for i in range(cfg.n_layers):
+        for k, n in names.items():
+            out[f"model.layers.{i}.{n}.weight"] = np.ascontiguousarray(
+                np.asarray(lay[k])[i].T)
+        out[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
+            lay["ln_attn"])[i]
+        out[f"model.layers.{i}.post_attention_layernorm.weight"] = \
+            np.asarray(lay["ln_mlp"])[i]
+    return out
+
+
+def _to_npz(params: Dict) -> Dict[str, np.ndarray]:
+    flat = {"embed": params["embed"], "ln_out": params["ln_out"],
+            "lm_head": params["lm_head"]}
+    for k, v in params["layers"].items():
+        flat[f"layers.{k}"] = v
+    return {k: np.asarray(v) for k, v in flat.items()}
+
+
+def convert(src: str, dst: str, dtype: str = "float32") -> None:
+    from ..models import checkpoint as ckpt
+    from ..models import gguf, llama
+
+    params, cfg = llama.load_checkpoint(src, dtype=dtype)
+    if dst.endswith(".gguf"):
+        gguf.export_llama(dst, params, cfg)
+    elif dst.endswith(".safetensors"):
+        ckpt.write_safetensors(dst, _to_hf(params, cfg))
+    elif dst.endswith(".npz"):
+        flat = _to_npz(params)
+        # np.savez silently stores ml_dtypes bfloat16 as raw void bytes,
+        # producing an unloadable file — npz is float32/float16 only
+        bad = [k for k, v in flat.items() if v.dtype.kind == "V"
+               or v.dtype.name == "bfloat16"]
+        if bad:
+            raise ValueError(
+                f"npz cannot represent bfloat16 (tensors {bad[:3]}...); "
+                "use --dtype float32/float16 or a .gguf/.safetensors "
+                "output")
+        np.savez(dst, **flat)
+    else:
+        raise ValueError(
+            f"unsupported output format {dst!r} "
+            "(want .gguf / .safetensors / .npz)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert Llama-family checkpoints between the formats "
+                    "the llm filter ingests")
+    ap.add_argument("src", help="input: .safetensors / HF dir / .npz / .gguf")
+    ap.add_argument("dst", help="output: .gguf / .safetensors / .npz")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16", "float16"],
+                    help="stored weight dtype (norms stay float32)")
+    args = ap.parse_args(argv)
+    try:
+        convert(args.src, args.dst, args.dtype)
+    except Exception as e:  # noqa: BLE001 - CLI surface
+        print(f"convert: {e}", file=sys.stderr)
+        return 1
+    print(f"wrote {args.dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
