@@ -148,7 +148,7 @@ def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
 
     if "embed" in tensors and "layers.wq" in tensors:  # native stacked npz
         if cfg is None:
-            cfg = _infer_config_native(tensors)
+            cfg = _read_config_json(path) or _infer_config_native(tensors)
         params = {
             "embed": np.asarray(tensors["embed"]).astype(dt),
             "layers": {k.split(".", 1)[1]:
@@ -311,26 +311,34 @@ def _load_gguf(path: str, cfg: Optional[LlamaConfig],
     return params, cfg
 
 
-def _infer_config_hf(path: str, tensors: Dict) -> LlamaConfig:
+def _read_config_json(path: str) -> Optional[LlamaConfig]:
+    """HF-style config.json next to (or inside) ``path``, if present."""
     import json
     import os
 
     base = path if os.path.isdir(path) else os.path.dirname(path)
     cfg_path = os.path.join(base, "config.json")
-    if os.path.exists(cfg_path):
-        with open(cfg_path) as f:
-            c = json.load(f)
-        return LlamaConfig(
-            vocab=c["vocab_size"], dim=c["hidden_size"],
-            n_layers=c["num_hidden_layers"],
-            n_heads=c["num_attention_heads"],
-            n_kv_heads=c.get("num_key_value_heads",
-                             c["num_attention_heads"]),
-            ffn_hidden=c["intermediate_size"],
-            max_seq=min(c.get("max_position_embeddings", 4096), 8192),
-            rope_theta=float(c.get("rope_theta", 10000.0)),
-            norm_eps=float(c.get("rms_norm_eps", 1e-5)),
-        )
+    if not os.path.exists(cfg_path):
+        return None
+    with open(cfg_path) as f:
+        c = json.load(f)
+    return LlamaConfig(
+        vocab=c["vocab_size"], dim=c["hidden_size"],
+        n_layers=c["num_hidden_layers"],
+        n_heads=c["num_attention_heads"],
+        n_kv_heads=c.get("num_key_value_heads",
+                         c["num_attention_heads"]),
+        ffn_hidden=c["intermediate_size"],
+        max_seq=min(c.get("max_position_embeddings", 4096), 8192),
+        rope_theta=float(c.get("rope_theta", 10000.0)),
+        norm_eps=float(c.get("rms_norm_eps", 1e-5)),
+    )
+
+
+def _infer_config_hf(path: str, tensors: Dict) -> LlamaConfig:
+    cfg = _read_config_json(path)
+    if cfg is not None:
+        return cfg
     # shape inference: head_dim is 128 by Llama convention
     from . import checkpoint as ckpt
 

@@ -56,31 +56,52 @@ def _to_npz(params: Dict) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in flat.items()}
 
 
+def _write_config_json(dst: str, cfg) -> None:
+    """HF-style config.json next to the output, so reimport reconstructs
+    the EXACT config (rope_theta/norm_eps/head counts) instead of
+    shape-inference guesses — the .gguf path carries this in metadata."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(dst)),
+                        "config.json")
+    with open(path, "w") as f:
+        json.dump({
+            "vocab_size": cfg.vocab, "hidden_size": cfg.dim,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_kv_heads,
+            "intermediate_size": cfg.ffn_hidden,
+            "max_position_embeddings": cfg.max_seq,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.norm_eps,
+        }, f, indent=1)
+
+
 def convert(src: str, dst: str, dtype: str = "float32") -> None:
     from ..models import checkpoint as ckpt
     from ..models import gguf, llama
 
+    if not dst.endswith((".gguf", ".safetensors", ".npz")):
+        # validate BEFORE the (potentially minutes-long, 13 GB) load
+        raise ValueError(
+            f"unsupported output format {dst!r} "
+            "(want .gguf / .safetensors / .npz)")
+    if dst.endswith(".npz") and dtype == "bfloat16":
+        # np.savez silently stores ml_dtypes bfloat16 as raw void bytes,
+        # producing an unloadable file — npz is float32/float16 only
+        raise ValueError(
+            "npz cannot represent bfloat16; use --dtype float32/float16 "
+            "or a .gguf/.safetensors output")
     params, cfg = llama.load_checkpoint(src, dtype=dtype)
     if dst.endswith(".gguf"):
         gguf.export_llama(dst, params, cfg)
     elif dst.endswith(".safetensors"):
         ckpt.write_safetensors(dst, _to_hf(params, cfg))
-    elif dst.endswith(".npz"):
-        flat = _to_npz(params)
-        # np.savez silently stores ml_dtypes bfloat16 as raw void bytes,
-        # producing an unloadable file — npz is float32/float16 only
-        bad = [k for k, v in flat.items() if v.dtype.kind == "V"
-               or v.dtype.name == "bfloat16"]
-        if bad:
-            raise ValueError(
-                f"npz cannot represent bfloat16 (tensors {bad[:3]}...); "
-                "use --dtype float32/float16 or a .gguf/.safetensors "
-                "output")
-        np.savez(dst, **flat)
+        _write_config_json(dst, cfg)
     else:
-        raise ValueError(
-            f"unsupported output format {dst!r} "
-            "(want .gguf / .safetensors / .npz)")
+        np.savez(dst, **_to_npz(params))
+        _write_config_json(dst, cfg)
 
 
 def main(argv=None) -> int:

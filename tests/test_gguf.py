@@ -130,6 +130,9 @@ class TestConvertCLI:
     and require identical forward logits at each hop."""
 
     def test_gguf_to_safetensors_to_npz_chain(self, tmp_path):
+        """Every hop is self-contained: conversions write a config.json
+        alongside (.gguf carries config in its own metadata), so reimports
+        reconstruct the EXACT config — no shape-inference guessing."""
         from nnstreamer_tpu.tools import convert as cv
 
         params = llama.init_params(CFG, seed=11)
@@ -137,23 +140,12 @@ class TestConvertCLI:
         gguf.export_llama(g1, params, CFG)
         st = str(tmp_path / "b.safetensors")
         assert cv.main([g1, st]) == 0
-        # the safetensors hop needs a config.json for reimport — convert
-        # writes HF naming; infer via explicit cfg instead
-        got_st, _ = llama.load_checkpoint(st, cfg=CFG, dtype="float32")
+        got_st, cfg_st = llama.load_checkpoint(st, dtype="float32")
+        assert cfg_st.n_kv_heads == CFG.n_kv_heads  # from config.json
         nz = str(tmp_path / "c.npz")
-        assert cv.main([st, nz]) == 1  # no config.json next to st: clear error
-        # write the config and retry
-        import json
-
-        (tmp_path / "config.json").write_text(json.dumps({
-            "vocab_size": CFG.vocab, "hidden_size": CFG.dim,
-            "num_hidden_layers": CFG.n_layers,
-            "num_attention_heads": CFG.n_heads,
-            "num_key_value_heads": CFG.n_kv_heads,
-            "intermediate_size": CFG.ffn_hidden,
-            "max_position_embeddings": CFG.max_seq}))
         assert cv.main([st, nz]) == 0
-        got_nz, _ = llama.load_checkpoint(nz, cfg=CFG, dtype="float32")
+        got_nz, cfg_nz = llama.load_checkpoint(nz, dtype="float32")
+        assert cfg_nz.rope_theta == CFG.rope_theta
         toks = np.array([[3, 7, 1]], np.int32)
         want = np.asarray(llama.forward(params, toks, CFG,
                                         compute_dtype="float32"))
