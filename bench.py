@@ -412,7 +412,8 @@ def bench_audio(batch: int, batches: int, warmup: int,
 
 
 def bench_llm(batches: int, warmup: int, model: str = "llama_small",
-              max_new: int = 64, prompt_len: int = 32) -> dict:
+              max_new: int = 64, prompt_len: int = 32,
+              quant: str = "") -> dict:
     """Config #5: tokens/sec through the llm filter (jitted prefill +
     lax.scan decode).  vs_baseline compares against the reference's
     llama.cpp CPU path order of magnitude (~20 tok/s).
@@ -430,6 +431,9 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     custom = f"max_new:{max_new}"
     if model == "llama2_7b":
         custom += ",param_dtype:bfloat16,max_seq:1024,stream_chunk:32"
+    if quant:
+        # weight-only int8: halves HBM bytes/token on the decode step
+        custom += f",quant:{quant}"
     desc = (
         "appsrc name=src ! "
         f"tensor_filter framework=llm model={model} custom={custom} ! "
@@ -454,7 +458,8 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         p.wait(timeout=60)
     tps = toks / wall
     return {
-        "metric": f"{model}_tokens_per_sec_per_chip",
+        "metric": (f"{model}_int8_tokens_per_sec_per_chip" if quant
+                   else f"{model}_tokens_per_sec_per_chip"),
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tps / 20.0, 3),
@@ -522,6 +527,8 @@ def main() -> int:
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--llm-model", default="llama_small")
+    ap.add_argument("--llm-quant", default="", choices=["", "int8"],
+                    help="weight-only quantization for llm/llm7b configs")
     ap.add_argument("--source", default="videotestsrc",
                     choices=["videotestsrc", "appsrc"],
                     help="classification config: device-generated test "
@@ -583,8 +590,10 @@ def main() -> int:
         "audio": lambda: bench_audio(args.batch, args.batches, args.warmup,
                                      args.audio_source, args.audio_model),
         "llm": lambda: bench_llm(max(1, args.batches // 8), 1,
-                                 model=args.llm_model),
-        "llm7b": lambda: bench_llm(2, 1, model="llama2_7b"),
+                                 model=args.llm_model,
+                                 quant=args.llm_quant),
+        "llm7b": lambda: bench_llm(2, 1, model="llama2_7b",
+                                   quant=args.llm_quant),
     }
     todo = list(runners) if args.config == "all" else [args.config]
     if args.config == "all":

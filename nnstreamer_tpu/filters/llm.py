@@ -130,7 +130,10 @@ class LLMFramework(Framework):
                     f"tp:{tp} needs {tp} devices, have {len(jax.devices())}")
             self.mesh = make_mesh(model=tp, data=1,
                                   devices=jax.devices()[:tp])
-            params = shard_params(self.mesh, params, llama.param_pspecs())
+            # the bundle's pspecs match ITS pytree (quantized trees have
+            # different leaves than llama.param_pspecs()'s default)
+            pspecs = self.bundle.param_pspecs or llama.param_pspecs()
+            params = shard_params(self.mesh, params, pspecs)
             self.bundle.params = params
 
         def fwd(params, tokens, cache, pos):

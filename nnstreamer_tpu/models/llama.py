@@ -397,27 +397,134 @@ def _check_shapes(params: Dict, cfg: LlamaConfig, path: str) -> None:
                 f"config wants {shape} — wrong config for this checkpoint?")
 
 
-def param_pspecs() -> Dict:
+_QUANT_MATS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_int8(params: Dict) -> Dict:
+    """Weight-only int8 with per-output-channel scales.
+
+    The decode step is HBM-bandwidth-bound (every generated token streams
+    the full parameter set through the MXU); storing the seven big layer
+    mats + lm_head as int8 halves bytes/token vs bf16 — XLA fuses the
+    int8->bf16 convert into the dot's operand read, so the dequant costs
+    no extra HBM traffic.  Norms and the embedding table (gather — tiny
+    per-token traffic) stay full precision.
+
+    Quantization runs ON DEVICE via jit: 7B params are materialized in
+    HBM (13.5 GB bf16) and must never round-trip to the host — a numpy
+    path would pull the full set over D2H and expand it to f32.  The
+    lax.map over the layer axis keeps the f32 transient to ONE layer's
+    mat, and input donation releases each original right as its int8
+    replacement lands.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def qmat(w):  # [L, in, out] -> int8 [L, in, out], f32 [L, 1, out]
+        def one(wl):
+            w32 = wl.astype(jnp.float32)
+            s = jnp.maximum(jnp.abs(w32).max(axis=0, keepdims=True) / 127.0,
+                            1e-8)
+            q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+            return q, s
+        return jax.lax.map(one, w)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def qmat2d(w):  # [D, vocab]
+        w32 = w.astype(jnp.float32)
+        s = jnp.maximum(jnp.abs(w32).max(axis=0, keepdims=True) / 127.0,
+                        1e-8)
+        q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+        return q, s
+
+    lay = params["layers"]
+    qlay: Dict = {"ln_attn": lay["ln_attn"], "ln_mlp": lay["ln_mlp"]}
+    for k in _QUANT_MATS:
+        q, s = qmat(jnp.asarray(lay[k]))
+        qlay[k + "_q"] = q
+        qlay[k + "_s"] = s  # [L, 1, out]
+    q, s = qmat2d(jnp.asarray(params["lm_head"]))
+    return {
+        "embed": params["embed"],
+        "layers": qlay,
+        "ln_out": params["ln_out"],
+        "lm_head_q": q,
+        "lm_head_s": s,  # [1, vocab]
+    }
+
+
+def _apply_quant(params: Dict, opts: Dict) -> Dict:
+    """Shared ``custom=quant:...`` handling for the zoo builders."""
+    quant = str(opts.get("quant", "")).lower()
+    if quant == "int8":
+        return quantize_int8(params)
+    if quant:
+        raise ValueError(f"unsupported quant {quant!r} (int8)")
+    return params
+
+
+def _maybe_dequant_layer(lp: Dict, dt) -> Dict:
+    """Scan-body hook: reconstruct the _block weight dict from int8+scale
+    leaves (identity for full-precision layers)."""
+    if "wq_q" not in lp:
+        return lp
+    out = {"ln_attn": lp["ln_attn"], "ln_mlp": lp["ln_mlp"]}
+    for k in _QUANT_MATS:
+        out[k] = lp[k + "_q"].astype(dt) * lp[k + "_s"].astype(dt)
+    return out
+
+
+def _lm_head(params: Dict, x, dt):
+    if "lm_head_q" in params:
+        w = params["lm_head_q"].astype(dt) * params["lm_head_s"].astype(dt)
+    else:
+        w = params["lm_head"].astype(dt)
+    import jax.numpy as jnp
+
+    return (x @ w).astype(jnp.float32)
+
+
+def param_pspecs(quant: bool = False) -> Dict:
     """TP shardings over the ``model`` mesh axis: split heads / FFN hidden
     on the contraction-free dim, so each matmul is local and XLA all-reduces
-    the block output once (Megatron layout, GSPMD-inserted collectives)."""
+    the block output once (Megatron layout, GSPMD-inserted collectives).
+    ``quant=True`` returns specs matching the :func:`quantize_int8` pytree
+    (scales follow their mat's OUT axis; in-sharded mats keep scales
+    replicated since scales are per-output-channel)."""
     from jax.sharding import PartitionSpec as P
 
+    if not quant:
+        return {
+            "embed": P(None, None),
+            "layers": {
+                "wq": P(None, None, "model"),
+                "wk": P(None, None, "model"),
+                "wv": P(None, None, "model"),
+                "wo": P(None, "model", None),
+                "w_gate": P(None, None, "model"),
+                "w_up": P(None, None, "model"),
+                "w_down": P(None, "model", None),
+                "ln_attn": P(None, None),
+                "ln_mlp": P(None, None),
+            },
+            "ln_out": P(None),
+            "lm_head": P(None, "model"),
+        }
+    out_sharded = {"wq": True, "wk": True, "wv": True, "wo": False,
+                   "w_gate": True, "w_up": True, "w_down": False}
+    lay = {"ln_attn": P(None, None), "ln_mlp": P(None, None)}
+    for k, on_out in out_sharded.items():
+        lay[k + "_q"] = (P(None, None, "model") if on_out
+                         else P(None, "model", None))
+        lay[k + "_s"] = (P(None, None, "model") if on_out
+                         else P(None, None, None))
     return {
         "embed": P(None, None),
-        "layers": {
-            "wq": P(None, None, "model"),
-            "wk": P(None, None, "model"),
-            "wv": P(None, None, "model"),
-            "wo": P(None, "model", None),
-            "w_gate": P(None, None, "model"),
-            "w_up": P(None, None, "model"),
-            "w_down": P(None, "model", None),
-            "ln_attn": P(None, None),
-            "ln_mlp": P(None, None),
-        },
+        "layers": lay,
         "ln_out": P(None),
-        "lm_head": P(None, "model"),
+        "lm_head_q": P(None, "model"),
+        "lm_head_s": P(None, "model"),
     }
 
 
@@ -542,16 +649,16 @@ def forward(params, tokens, cfg: LlamaConfig, compute_dtype="bfloat16"):
 
     dt = jnp.dtype(compute_dtype)
     B, T = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    x = jnp.asarray(params["embed"]).astype(dt)[tokens]
     positions = jnp.arange(T)
 
     def body(x, lp):
-        x, _ = _block(cfg, lp, x, positions)
+        x, _ = _block(cfg, _maybe_dequant_layer(lp, dt), x, positions)
         return x, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return _lm_head(params, x, dt)
 
 
 def init_cache(cfg: LlamaConfig, batch: int, dtype="bfloat16"):
@@ -578,20 +685,20 @@ def forward_cached(params, tokens, cache, pos_offset, cfg: LlamaConfig,
 
     dt = jnp.dtype(compute_dtype)
     B, T = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    x = jnp.asarray(params["embed"]).astype(dt)[tokens]
     positions = pos_offset + jnp.arange(T)[None, :]
 
     def body(x, layer):
         lp, kc, vc = layer
-        x, (kc, vc) = _block(cfg, lp, x, positions, kv=(kc, vc),
+        x, (kc, vc) = _block(cfg, _maybe_dequant_layer(lp, dt), x,
+                             positions, kv=(kc, vc),
                              pos_offset=pos_offset)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return _lm_head(params, x, dt), {"k": k_new, "v": v_new}
 
 
 def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
@@ -619,18 +726,19 @@ def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
         B, Tl = tokens.shape
         my = lax.axis_index("seq")
         positions = my * Tl + jnp.arange(Tl)
-        x = params["embed"].astype(dt)[tokens]
+        x = jnp.asarray(params["embed"]).astype(dt)[tokens]
 
         def attn_fn(q, k, v):
             return ring_attention_local(q, k, v, axis_name="seq", causal=True)
 
         def body(x, lp):
-            x, _ = _block(cfg, lp, x, positions, attn_fn=attn_fn)
+            x, _ = _block(cfg, _maybe_dequant_layer(lp, dt), x, positions,
+                          attn_fn=attn_fn)
             return x, None
 
         x, _ = lax.scan(body, x, params["layers"])
         x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
-        return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        return _lm_head(params, x, dt)
 
     fn = jax.shard_map(
         local_fwd, mesh=mesh,
@@ -696,6 +804,8 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
     # the test presets' numerics unchanged.
     params = init_params(cfg, seed=seed,
                          dtype=opts.get("param_dtype", "float32"))
+    quant = str(opts.get("quant", "")).lower()
+    params = _apply_quant(params, opts)
 
     def apply_fn(params, tokens):
         return forward(params, tokens, cfg, compute_dtype=dtype)
@@ -707,7 +817,7 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
         format=TensorFormat.FLEXIBLE)
     bundle = ModelBundle(
         apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
-        param_pspecs=param_pspecs(), name=preset,
+        param_pspecs=param_pspecs(quant=quant == "int8"), name=preset,
     )
     bundle.config = cfg  # used by the llm framework for the decode loop
     return bundle
@@ -724,6 +834,8 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
     if "max_seq" in opts:
         cfg = dataclasses.replace(cfg, max_seq=int(opts["max_seq"]))
     dtype = opts.get("dtype", "bfloat16")
+    quant = str(opts.get("quant", "")).lower()
+    params = _apply_quant(params, opts)
 
     def apply_fn(params, tokens):
         return forward(params, tokens, cfg, compute_dtype=dtype)
@@ -734,7 +846,7 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
         format=TensorFormat.FLEXIBLE)
     bundle = ModelBundle(
         apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
-        param_pspecs=param_pspecs(), name=path,
+        param_pspecs=param_pspecs(quant=quant == "int8"), name=path,
     )
     bundle.config = cfg
     return bundle
