@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-3 measurement sweep: one sequential session on the tunneled chip.
+# Raw per-config JSON lines land in bench_r3_raw.jsonl (one line each,
+# prefixed with the config tag); assemble BENCH_ALL_r3.json from it.
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_r3_raw.jsonl
+: > "$OUT"
+
+run() {
+  tag="$1"; shift
+  echo "=== $tag: $* ($(date -u +%H:%M:%S))" >&2
+  line=$(timeout 1800 python bench.py "$@" 2>bench_r3_last_stderr.log | tail -1)
+  rc=$?
+  echo "{\"tag\": \"$tag\", \"rc\": $rc, \"line\": $line}" >> "$OUT" 2>/dev/null \
+    || echo "{\"tag\": \"$tag\", \"rc\": $rc, \"line\": null}" >> "$OUT"
+  echo "    -> rc=$rc $line" >&2
+}
+
+python tools/smoke_tpu.py --json SMOKE_r3.json >&2
+echo "smoke rc=$?" >&2
+
+run classification --config classification
+run classification_b256 --config classification --batch 256
+run detection_ssd --config detection
+run detection_yolov5 --config detection --detection-model yolov5
+run pose --config pose
+run segmentation --config segmentation
+run audio --config audio
+run wav2vec2 --config audio --audio-model wav2vec2
+run classification_appsrc --config classification --source appsrc --batches 32
+run llm7b_bf16 --config llm7b
+run llm7b_int8 --config llm7b --llm-quant int8
+echo "SWEEP DONE ($(date -u +%H:%M:%S))" >&2

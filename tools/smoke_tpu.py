@@ -205,9 +205,23 @@ def query_roundtrip():
 
 
 def main() -> int:
+    import argparse
+    import json
+    import time
+
     import jax
 
-    print(f"backend: {jax.devices()}")
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write a machine-readable record of the run")
+    args = ap.parse_args()
+
+    # Claim the output path BEFORE burning minutes of device time on the
+    # checks; an unwritable path should fail here, not after the run.
+    json_file = open(args.json, "w") if args.json else None
+
+    devices = jax.devices()
+    print(f"backend: {devices}")
     checks = [
         ("flash-attention kernel numerics (real backend)", kernel_numerics),
         ("fused classification pipeline", classification_pipeline),
@@ -217,7 +231,23 @@ def main() -> int:
         (".tflite file ingestion", tflite_file_ingestion),
         ("tensor_query offload roundtrip", query_roundtrip),
     ]
-    ok = all([_check(n, f) for n, f in checks])
+    results = []
+    for name, fn in checks:
+        t0 = time.monotonic()
+        passed = _check(name, fn)
+        results.append({"check": name, "pass": passed,
+                        "seconds": round(time.monotonic() - t0, 2)})
+    ok = all(r["pass"] for r in results)
+    if json_file is not None:
+        with json_file as f:
+            json.dump({
+                "ok": ok,
+                "backend": [str(d) for d in devices],
+                "platform": devices[0].platform,
+                "unix_time": int(time.time()),
+                "checks": results,
+            }, f, indent=1)
+            f.write("\n")
     print("SMOKE", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 
