@@ -113,6 +113,62 @@ def init_params(cfg: LlamaConfig, seed: int = 0, dtype="float32") -> Dict:
     }
 
 
+def init_params_int8(cfg: LlamaConfig, seed: int = 0,
+                     gen_dtype="bfloat16") -> Dict:
+    """Generate-then-quantize one matrix at a time.
+
+    ``quantize_int8(init_params(cfg))`` needs the full-precision tree AND
+    the growing int8 tree resident together — at 7B that transient
+    (13.5 GB bf16 + int8 outputs) overflows a 16 GB v5e chip, which the
+    round-3 on-chip session hit as RESOURCE_EXHAUSTED.  Here each big mat
+    is generated, quantized (donated), and freed before the next is drawn:
+    peak HBM ~ final int8 tree + ONE bf16 mat.  Draws the identical RNG
+    stream as :func:`init_params`, so the result is exactly
+    ``quantize_int8(init_params(cfg, seed, gen_dtype))`` (asserted by
+    tests on the small presets)."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(gen_dtype)
+    k_embed, k_layers, k_out = jax.random.split(jax.random.PRNGKey(seed), 3)
+
+    def norm_init(key, shape, fan_in):
+        scale = np.sqrt(2.0 / max(1, fan_in)).astype(np.float32)
+        return jax.random.normal(key, shape, dt) * scale.astype(dt)
+
+    L, D, H, Hkv, F = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                       cfg.ffn_hidden)
+    hd = cfg.head_dim
+    ks = jax.random.split(k_layers, 7)
+    shapes = {
+        "wq": ((L, D, H * hd), D),
+        "wk": ((L, D, Hkv * hd), D),
+        "wv": ((L, D, Hkv * hd), D),
+        "wo": ((L, H * hd, D), H * hd),
+        "w_gate": ((L, D, F), D),
+        "w_up": ((L, D, F), D),
+        "w_down": ((L, F, D), F),
+    }
+    qmat = _qmat_layered()
+    qlay: Dict = {
+        "ln_attn": np.ones((L, D), np.float32),
+        "ln_mlp": np.ones((L, D), np.float32),
+    }
+    for i, name in enumerate(_QUANT_MATS):  # same key order as init_params
+        shape, fan = shapes[name]
+        q, s = qmat(norm_init(ks[i], shape, fan))
+        qlay[name + "_q"] = q
+        qlay[name + "_s"] = s
+    q, s = _qmat_2d()(norm_init(k_out, (D, cfg.vocab), D))
+    return {
+        "embed": norm_init(k_embed, (cfg.vocab, D), D) * 0.5,
+        "layers": qlay,
+        "ln_out": np.ones((D,), np.float32),
+        "lm_head_q": q,
+        "lm_head_s": s,
+    }
+
+
 def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
                     dtype="bfloat16") -> Tuple[Dict, LlamaConfig]:
     """Fill the documented pytree layout from a REAL checkpoint file.
@@ -400,6 +456,43 @@ def _check_shapes(params: Dict, cfg: LlamaConfig, path: str) -> None:
 _QUANT_MATS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+@functools.cache
+def _qmat_layered():
+    """jit: [L, in, out] weights -> (int8 [L, in, out], f32 [L, 1, out])
+    per-output-channel scales; input donated so the full-precision buffer
+    frees as soon as its int8 replacement lands."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def qmat(w):
+        def one(wl):
+            w32 = wl.astype(jnp.float32)
+            s = jnp.maximum(jnp.abs(w32).max(axis=0, keepdims=True) / 127.0,
+                            1e-8)
+            q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+            return q, s
+        return jax.lax.map(one, w)
+
+    return qmat
+
+
+@functools.cache
+def _qmat_2d():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def qmat2d(w):  # [D, vocab]
+        w32 = w.astype(jnp.float32)
+        s = jnp.maximum(jnp.abs(w32).max(axis=0, keepdims=True) / 127.0,
+                        1e-8)
+        q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+        return q, s
+
+    return qmat2d
+
+
 def quantize_int8(params: Dict) -> Dict:
     """Weight-only int8 with per-output-channel scales.
 
@@ -417,27 +510,9 @@ def quantize_int8(params: Dict) -> Dict:
     mat, and input donation releases each original right as its int8
     replacement lands.
     """
-    import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def qmat(w):  # [L, in, out] -> int8 [L, in, out], f32 [L, 1, out]
-        def one(wl):
-            w32 = wl.astype(jnp.float32)
-            s = jnp.maximum(jnp.abs(w32).max(axis=0, keepdims=True) / 127.0,
-                            1e-8)
-            q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
-            return q, s
-        return jax.lax.map(one, w)
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def qmat2d(w):  # [D, vocab]
-        w32 = w.astype(jnp.float32)
-        s = jnp.maximum(jnp.abs(w32).max(axis=0, keepdims=True) / 127.0,
-                        1e-8)
-        q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
-        return q, s
-
+    qmat, qmat2d = _qmat_layered(), _qmat_2d()
     lay = params["layers"]
     qlay: Dict = {"ln_attn": lay["ln_attn"], "ln_mlp": lay["ln_mlp"]}
     for k in _QUANT_MATS:
@@ -802,10 +877,18 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
     # param_dtype=bfloat16 generates weights directly at 2 bytes/param on
     # device (required to fit 7B in one chip's HBM); default float32 keeps
     # the test presets' numerics unchanged.
-    params = init_params(cfg, seed=seed,
-                         dtype=opts.get("param_dtype", "float32"))
     quant = str(opts.get("quant", "")).lower()
-    params = _apply_quant(params, opts)
+    if quant == "int8":
+        # per-mat generate+quantize+donate: the full-precision tree is
+        # never resident, so quantized 7B fits where generate-everything-
+        # then-quantize OOMs a 16 GB chip
+        params = init_params_int8(cfg, seed=seed,
+                                  gen_dtype=opts.get("param_dtype",
+                                                     "float32"))
+    else:
+        params = init_params(cfg, seed=seed,
+                             dtype=opts.get("param_dtype", "float32"))
+        params = _apply_quant(params, opts)
 
     def apply_fn(params, tokens):
         return forward(params, tokens, cfg, compute_dtype=dtype)

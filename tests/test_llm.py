@@ -204,6 +204,25 @@ class TestInt8WeightOnly:
             np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
         assert cos.min() > 0.999, cos.min()
 
+    def test_init_params_int8_matches_quantize_after_init(self):
+        # The memory-bounded per-mat path must be bit-identical to
+        # quantize_int8(init_params(...)) — same RNG stream, same math
+        # (this is what lets 7B int8 build without the full-precision
+        # tree ever being resident).
+        import jax
+
+        from nnstreamer_tpu.models import llama
+
+        cfg = self._cfg()
+        ref = llama.quantize_int8(
+            llama.init_params(cfg, seed=3, dtype="bfloat16"))
+        fused = llama.init_params_int8(cfg, seed=3, gen_dtype="bfloat16")
+        flat_r, tdef_r = jax.tree.flatten(ref)
+        flat_f, tdef_f = jax.tree.flatten(fused)
+        assert tdef_r == tdef_f
+        for r, f in zip(flat_r, flat_f):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(f))
+
     def test_generate_scan_runs_quantized(self):
         import jax
 
