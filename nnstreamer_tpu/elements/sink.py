@@ -58,16 +58,22 @@ class TensorSink(SinkElement):
         # half of this method's gating (connect_new_data is a public API
         # with no start-only restriction) — it takes effect next buffer.
         callbacks = list(self._callbacks)
+        # <= not <: a bounded queue holding cap buffers still prefetches
+        # the one about to block in put() — put() is the backpressure, so
+        # outstanding copies stay <= cap+1.  Gating at < cap made every
+        # buffer that arrived at a full (small) queue pay a synchronous
+        # D2H RTT at pop — a periodic ~1-RTT stall per cap pops that cut
+        # the round-3 audio bench 15x on the tunneled chip.
         prefetch_cap = min(16, self._q.maxsize or 16)
         if (self.to_host and not callbacks and not self.drop
-                and self._q.qsize() < prefetch_cap):
+                and self._q.qsize() <= prefetch_cap):
             # The app will pop host arrays: start the D2H now so the copy
             # overlaps the queue dwell time instead of being paid inside
             # pop() — over a remote/tunneled device this is a full RTT per
             # buffer off the pull path.  Gated: a drop=true sink may never
-            # pop this buffer, and a backed-up queue (>=16 deep) would turn
-            # prefetch into unbounded host copies + wasted transfer, so
-            # those cases pay the copy lazily at pop as before.
+            # pop this buffer, and a deeply backed-up unbounded queue
+            # (>16 deep) would turn prefetch into unbounded host copies +
+            # wasted transfer, so those cases pay the copy lazily at pop.
             for t in buf.tensors:
                 if hasattr(t, "copy_to_host_async"):
                     t.copy_to_host_async()
