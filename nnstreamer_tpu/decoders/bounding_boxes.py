@@ -91,6 +91,16 @@ class BoundingBoxes(Decoder):
             raise ValueError(f"option7 (nms placement) must be host|device, "
                              f"got {nms_opt!r}")
         self.nms_mode = nms_opt
+        # option8 (yolov8): model-input WIDTH[:HEIGHT] when the tensor
+        # carries pixel-coordinate boxes (ultralytics default); unset means
+        # normalized [0,1] coords.
+        o8 = self.option(8)
+        if o8:
+            wh = [int(v) for v in str(o8).split(":")]
+            mw, mh = (wh[0], wh[0]) if len(wh) == 1 else (wh[0], wh[1])
+            self.box_scale = np.asarray([mw, mh, mw, mh], np.float32)
+        else:
+            self.box_scale = np.float32(1.0)
 
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
         return Caps.new(
@@ -160,7 +170,9 @@ class BoundingBoxes(Decoder):
             tensors = frame[1] if isinstance(frame, tuple) else frame
             if self.format in ("ssd", "mobilenet-ssd", "mobilenetv2-ssd"):
                 boxes, scores, classes = self._decode_ssd(tensors)
-            elif self.format in ("yolov5", "yolov8", "yolo"):
+            elif self.format == "yolov8":
+                boxes, scores, classes = self._decode_yolov8(tensors)
+            elif self.format in ("yolov5", "yolo"):
                 boxes, scores, classes = self._decode_yolo(tensors)
             else:
                 raise ValueError(f"unknown bounding-box format {self.format!r}")
@@ -210,16 +222,29 @@ class BoundingBoxes(Decoder):
         elif fmt in ("yolov5", "yolov8", "yolo"):
             if len(in_spec) != 1 or len(in_spec[0].shape) != 3:
                 return None
-            batch, n, width = in_spec[0].shape
-            if width < 5:
-                return None
+            v8 = fmt == "yolov8"
+            if v8:
+                batch, c4, n = in_spec[0].shape  # channels-first (B,4+C,N)
+                if c4 < 5:
+                    return None
+            else:
+                batch, n, width = in_spec[0].shape
+                if width < 5:
+                    return None
             k = min(4 * self.max_detections, n)
+            box_scale = jnp.asarray(self.box_scale, jnp.float32)
 
             def fn(arrays):
                 pred = arrays[0].astype(jnp.float32)
-                xywh, obj, cls = pred[..., :4], pred[..., 4], pred[..., 5:]
-                sc_all = (obj[..., None] * cls if cls.shape[-1]
-                          else obj[..., None])
+                if v8:
+                    pred = jnp.swapaxes(pred, 1, 2)  # -> (B, N, 4+C)
+                    xywh = pred[..., :4] / box_scale
+                    sc_all = pred[..., 4:]
+                else:
+                    xywh, obj, cls = (pred[..., :4], pred[..., 4],
+                                      pred[..., 5:])
+                    sc_all = (obj[..., None] * cls if cls.shape[-1]
+                              else obj[..., None])
                 classes = jnp.argmax(sc_all, axis=-1).astype(jnp.int32)
                 sc = jnp.max(sc_all, axis=-1)
                 top_sc, idx = lax.top_k(sc, k)
@@ -323,6 +348,20 @@ class BoundingBoxes(Decoder):
         classes = scores_all.argmax(axis=1)
         scores = scores_all.max(axis=1)
         boxes = center_to_corner(xywh)
+        m = scores >= self.threshold
+        return boxes[m], scores[m], classes[m]
+
+    def _decode_yolov8(self, tensors):
+        # ultralytics export layout: (4+C, N) channels-first per frame,
+        # anchor-free — class scores ARE the confidence (no objectness).
+        pred = np.asarray(tensors[0], np.float32)
+        if pred.ndim == 3:
+            pred = pred.reshape(pred.shape[-2], pred.shape[-1])
+        pred = pred.T  # (N, 4+C)
+        xywh, cls = pred[:, :4], pred[:, 4:]
+        classes = cls.argmax(axis=1)
+        scores = cls.max(axis=1)
+        boxes = center_to_corner(xywh / self.box_scale)
         m = scores >= self.threshold
         return boxes[m], scores[m], classes[m]
 

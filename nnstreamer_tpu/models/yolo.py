@@ -50,7 +50,11 @@ def _keygen(seed: int):
         yield sub
 
 
-def init_params(classes: int, width: float = 1.0, seed: int = 0) -> Dict:
+def init_params(classes: int, width: float = 1.0, seed: int = 0,
+                anchors_per_cell: int = _ANCHORS_PER_CELL,
+                head_values: int = 5) -> Dict:
+    """``anchors_per_cell``/``head_values`` let the anchor-free v8 head
+    (1 predictor per cell, 4+C values) share the backbone with v5."""
     keys = _keygen(seed)
     params: Dict = {"stem": stem_params(keys, 3, rounded(32, width))}
     cin = rounded(32, width)
@@ -61,7 +65,7 @@ def init_params(classes: int, width: float = 1.0, seed: int = 0) -> Dict:
         params[f"down{i}"] = sep_block_params(keys, cin, cout)   # stride 2
         params[f"block{i}"] = sep_block_params(keys, cout, cout)  # stride 1
         cin = cout
-        nout = _ANCHORS_PER_CELL * (5 + classes)
+        nout = anchors_per_cell * (head_values + classes)
         params[f"head{i}"] = {
             "w": he_conv(next(keys), 1, 1, cout, nout),
             # objectness prior: like the SSD low-prior cls bias, random
@@ -87,15 +91,14 @@ def num_predictions(size: int) -> int:
         fm_size(size, s) ** 2 * _ANCHORS_PER_CELL for s in (8, 16, 32))
 
 
-def apply(params, x, *, classes: int, size: int, compute_dtype="bfloat16"):
-    """[B, size, size, 3] float32 in [0,1] -> [B, N, 5+C] float32
-    (yolov5 layout).  ``size`` pins the traced input so N matches the
-    bundle's negotiated out_spec."""
+def _backbone_feats(params, x, size: int, compute_dtype):
+    """Shared stem + three-scale backbone: [B, size, size, 3] ->
+    [(stride, feature_map, head_params)] at strides 8/16/32."""
     import jax
     import jax.numpy as jnp
 
     assert x.shape[1] == x.shape[2] == size, (
-        f"yolov5 input must be {size}x{size}, got {x.shape}")
+        f"yolo input must be {size}x{size}, got {x.shape}")
     conv2d, sbr, sep = make_ops(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
 
@@ -111,6 +114,19 @@ def apply(params, x, *, classes: int, size: int, compute_dtype="bfloat16"):
         h = sep(h, params[f"down{i}"], 2)
         h = sep(h, params[f"block{i}"], 1)
         feats.append((stride, h, params[f"head{i}"]))
+    return feats
+
+
+def apply(params, x, *, classes: int, size: int, compute_dtype="bfloat16"):
+    """[B, size, size, 3] float32 in [0,1] -> [B, N, 5+C] float32
+    (yolov5 layout).  ``size`` pins the traced input so N matches the
+    bundle's negotiated out_spec."""
+    import jax
+    import jax.numpy as jnp
+
+    conv2d, _, _ = make_ops(compute_dtype)
+    cdt = jnp.dtype(compute_dtype)
+    feats = _backbone_feats(params, x, size, compute_dtype)
     outs = []
 
     B = x.shape[0]
@@ -131,6 +147,69 @@ def apply(params, x, *, classes: int, size: int, compute_dtype="bfloat16"):
             [jnp.stack([cx, cy, w, hh], axis=-1), s[..., 4:]], axis=-1)
         outs.append(pred.reshape(B, -1, 5 + classes))
     return jnp.concatenate(outs, axis=1)
+
+
+def num_predictions_v8(size: int) -> int:
+    return sum(fm_size(size, s) ** 2 for s in (8, 16, 32))
+
+
+def apply_v8(params, x, *, classes: int, size: int,
+             compute_dtype="bfloat16"):
+    """[B, size, size, 3] float32 in [0,1] -> [B, 4+C, N] float32 — the
+    YOLOv8 (ultralytics) channels-first export layout the reference's
+    yolov8 decoder mode consumes: anchor-free (one predictor per cell, no
+    objectness column), post-sigmoid class scores, normalized cx,cy,w,h."""
+    import jax
+    import jax.numpy as jnp
+
+    conv2d, _, _ = make_ops(compute_dtype)
+    cdt = jnp.dtype(compute_dtype)
+    B = x.shape[0]
+    outs = []
+    for stride, fm, hp in _backbone_feats(params, x, size, compute_dtype):
+        g = fm.shape[1]
+        raw = conv2d(fm, hp["w"], 1) + hp["b"].astype(cdt)
+        raw = raw.reshape(B, g, g, 4 + classes).astype(jnp.float32)
+        s = jax.nn.sigmoid(raw)
+        gy, gx = jnp.meshgrid(jnp.arange(g), jnp.arange(g), indexing="ij")
+        # anchor-free decode: cell-offset centers; w/h from a per-scale
+        # prior proportional to the stride (v8's dist2bbox analog)
+        cx = (s[..., 0] * 2.0 - 0.5 + gx[None]) / g
+        cy = (s[..., 1] * 2.0 - 0.5 + gy[None]) / g
+        prior = 4.0 * stride / size
+        w = (s[..., 2] * 2.0) ** 2 * prior
+        hh = (s[..., 3] * 2.0) ** 2 * prior
+        pred = jnp.concatenate(
+            [jnp.stack([cx, cy, w, hh], axis=-1), s[..., 4:]], axis=-1)
+        outs.append(pred.reshape(B, -1, 4 + classes))
+    return jnp.swapaxes(jnp.concatenate(outs, axis=1), 1, 2)
+
+
+@register_model("yolov8")
+def _yolov8(opts: Dict[str, str]) -> ModelBundle:
+    classes = int(opts.get("classes", 80))
+    width = float(opts.get("width", 1.0))
+    seed = int(opts.get("seed", 0))
+    size = int(opts.get("size", 224))
+    batch = int(opts.get("batch", 1))
+    dtype = opts.get("dtype", "bfloat16")
+    if size % 32:
+        raise ValueError(f"yolov8 size must be a multiple of 32, got {size}")
+
+    params = init_params(classes=classes, width=width, seed=seed,
+                         anchors_per_cell=1, head_values=4)
+    apply_fn = functools.partial(
+        apply_v8, classes=classes, size=size, compute_dtype=dtype)
+    n = num_predictions_v8(size)
+    return ModelBundle(
+        apply_fn=apply_fn,
+        params=params,
+        in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
+        out_spec=TensorsSpec.from_string(
+            f"{n}:{4 + classes}:{batch}", "float32"),
+        param_pspecs=param_pspecs(),
+        name="yolov8",
+    )
 
 
 @register_model("yolov5")

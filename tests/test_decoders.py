@@ -97,6 +97,33 @@ class TestBoundingBoxes:
         assert dets[0]["class_index"] == 1
         np.testing.assert_allclose(dets[0]["box"], [0.4, 0.4, 0.6, 0.6], atol=1e-6)
 
+    def test_yolov8_decode_channels_first(self):
+        # ultralytics layout: (4+C, N), no objectness column — class scores
+        # are the confidence.
+        d = BoundingBoxes({"option1": "yolov8", "option4": "64:64"})
+        pred = np.zeros((8, 5), np.float32)  # 4 box + 4 classes, 5 anchors
+        pred[:, 0] = [0.5, 0.5, 0.2, 0.2, 0.0, 0.8, 0.0, 0.0]
+        pred[:, 1] = [0.2, 0.2, 0.1, 0.1, 0.3, 0.0, 0.0, 0.0]  # below thr
+        out = d.decode([pred], Buffer([pred]))
+        dets = out.meta["detections"]
+        assert len(dets) == 1
+        assert dets[0]["class_index"] == 1
+        np.testing.assert_allclose(dets[0]["box"], [0.4, 0.4, 0.6, 0.6],
+                                   atol=1e-6)
+
+    def test_yolov8_pixel_coords_option8(self):
+        # option8=model-input size: boxes arrive in pixels and normalize
+        # against it.
+        d = BoundingBoxes({"option1": "yolov8", "option4": "64:64",
+                           "option8": "160"})
+        pred = np.zeros((6, 3), np.float32)
+        pred[:, 0] = [80.0, 80.0, 32.0, 32.0, 0.9, 0.1]
+        out = d.decode([pred], Buffer([pred]))
+        dets = out.meta["detections"]
+        assert len(dets) == 1 and dets[0]["class_index"] == 0
+        np.testing.assert_allclose(dets[0]["box"], [0.4, 0.4, 0.6, 0.6],
+                                   atol=1e-6)
+
 
 class TestPose:
     def test_keypoints(self):
@@ -265,6 +292,18 @@ class TestFusedDecodePaths:
         pred = np.zeros((1, 4, 9), np.float32)
         pred[0, 0] = [0.5, 0.5, 0.2, 0.2, 0.9, 0, 0.8, 0, 0]
         pred[0, 1] = [0.2, 0.2, 0.1, 0.1, 0.1, 0, 0, 0, 0.3]
+        fused = self._run_fused(d, [pred])
+        dets = fused.meta["detections"]
+        assert len(dets) == 1
+        assert dets[0]["class_index"] == 1
+        np.testing.assert_allclose(dets[0]["box"], [0.4, 0.4, 0.6, 0.6],
+                                   atol=1e-6)
+
+    def test_bounding_boxes_yolov8_fused_matches_host(self):
+        d = BoundingBoxes({"option1": "yolov8", "option4": "64:64"})
+        pred = np.zeros((1, 8, 5), np.float32)  # (B, 4+C, N)
+        pred[0, :, 0] = [0.5, 0.5, 0.2, 0.2, 0.0, 0.8, 0.0, 0.0]
+        pred[0, :, 1] = [0.2, 0.2, 0.1, 0.1, 0.3, 0.0, 0.0, 0.0]
         fused = self._run_fused(d, [pred])
         dets = fused.meta["detections"]
         assert len(dets) == 1

@@ -184,6 +184,40 @@ class TestYolo:
         with pytest.raises(ValueError, match="multiple of 32"):
             build("yolov5", {"size": "100"})
 
+    def test_yolov8_output_layout(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import yolo
+        from nnstreamer_tpu.models.zoo import build
+
+        b = build("yolov8", {"size": "96", "classes": "7", "batch": "2",
+                             "dtype": "float32"})
+        x = jnp.zeros((2, 96, 96, 3), jnp.float32)
+        out = np.asarray(b.apply_fn(b.params, x))
+        n = yolo.num_predictions_v8(96)
+        assert out.shape == (2, 11, n)  # channels-first: 4 box + 7 classes
+        # class scores are sigmoids; anchor-free => no objectness column
+        assert (out[:, 4:, :] >= 0).all() and (out[:, 4:, :] <= 1).all()
+        assert float(np.median(out[:, 4:, :])) < 0.1  # background prior
+
+    def test_fused_yolov8_detection_pipeline(self):
+        import nnstreamer_tpu as nt
+
+        p = nt.Pipeline(
+            "videotestsrc device=true batch=2 num-buffers=4 width=64 "
+            "height=64 pattern=ball name=src ! "
+            "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+            "tensor_filter framework=jax model=yolov8 "
+            "custom=size:64,classes:5,batch:2 ! "
+            "tensor_decoder mode=bounding_boxes option1=yolov8 option3=0.3 "
+            "option4=64:64 option7=device ! tensor_sink name=out")
+        fused = [s for s in p.stages if len(s.node_ids) > 1]
+        assert fused and len(fused[0].node_ids) == 4
+        with p:
+            b = p.pull("out", timeout=120)
+            p.wait(timeout=60)
+        assert b.tensors[0].shape == (2, 64, 64, 4)
+
     def test_fused_yolo_detection_pipeline(self):
         import nnstreamer_tpu as nt
 
