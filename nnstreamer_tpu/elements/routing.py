@@ -47,12 +47,16 @@ class _SyncModes:
     * ``slowest`` (default): emit when EVERY sink pad has contributed; the
       runtime's group collation implements it (sync_policy "all"); output
       pts = max of inputs.
-    * ``basepad``: ``sync-option=<pad-index>[:<duration>]``; the base pad
+    * ``basepad``: ``sync-option=<pad-index>[:<duration-ns>]``; the base pad
       drives — each base-pad buffer emits one output combining it with the
       most recent buffer seen on every other pad (other pads never gate
-      beyond the first buffer).  Output pts = base pad's.  The reference's
-      ``duration`` pts-window refinement is accepted but not enforced
-      (latest-buffer semantics approximate it).
+      beyond the first buffer).  Output pts = base pad's.  With a
+      ``duration`` window the reference discards non-base buffers older
+      than ``base_pts - duration`` and waits for fresher ones; here the
+      single-latest-buffer analog holds the base buffer (bounded pending
+      queue) until every other pad's latest lands inside the window, and
+      EOS flushes whatever is pending with the last-seen buffers (the
+      reference's end-of-stream behavior).
     * ``refresh``: ANY pad's new buffer emits an output reusing the other
       pads' most recent buffers.  Output pts = the arriving buffer's.
 
@@ -67,11 +71,15 @@ class _SyncModes:
                 f"{self.name}: unknown sync-mode {self.sync_mode!r} "
                 "(slowest|basepad|refresh)")
         opt = str(self.props.get("sync_option", "") or "0")
-        self._base_idx = int(opt.split(":")[0] or 0)
+        parts = opt.split(":")
+        self._base_idx = int(parts[0] or 0)
+        self._base_window_ns = (int(parts[1])
+                                if len(parts) > 1 and parts[1] else None)
         # Unconditional: a single-sink-pad mux in slowest mode skips the
         # runtime's group collation and reaches process() directly, where
         # latest-buffer collation degenerates to pass-through.
         self._latest: Dict[str, Buffer] = {}
+        self._pending_base: List[Buffer] = []
         if self.sync_mode != "slowest":
             self.sync_policy = "any"  # instance overrides the class attr
 
@@ -87,14 +95,65 @@ class _SyncModes:
         # Only reached in basepad/refresh modes (slowest uses the runtime's
         # process_group collation).
         self._latest[pad] = buf
+        if self.sync_mode == "basepad":
+            if pad == self._base_pad():
+                self._pending_base.append(buf)
+                # Bounded like the reference's collectpad queues: a pad
+                # that never catches up must not grow memory without limit.
+                if len(self._pending_base) > 64:
+                    del self._pending_base[0]
+            if not set(self.in_caps) <= set(self._latest):
+                return []  # caps need every tensor: one-per-pad first
+            return self._drain_basepad()
         if not set(self.in_caps) <= set(self._latest):
             return []  # caps need every tensor: wait for one-per-pad first
-        if self.sync_mode == "basepad" and pad != self._base_pad():
-            return []
-        outs = self.process_group(dict(self._latest))
+        return self._emit_with(dict(self._latest), buf)
+
+    def _emit_with(self, group: Dict[str, Buffer], driving: Buffer):
+        outs = self.process_group(group)
         for _, o in outs:
-            o.pts = buf.pts  # driving buffer's timestamp, not the max
-            o.seqno = buf.seqno
+            o.pts = driving.pts  # driving buffer's timestamp, not the max
+            o.seqno = driving.seqno
+        return outs
+
+    def _in_window(self, base_buf: Buffer) -> bool:
+        """True when every non-base pad's latest buffer is no staler than
+        ``base_pts - duration`` (reference: too-old buffers are discarded
+        and the element waits for fresher data on that pad)."""
+        if self._base_window_ns is None or base_buf.pts is None:
+            return True
+        base = self._base_pad()
+        for p, lb in self._latest.items():
+            if p != base and lb.pts is not None \
+                    and lb.pts < base_buf.pts - self._base_window_ns:
+                return False
+        return True
+
+    def _drain_basepad(self):
+        base = self._base_pad()
+        outs = []
+        while self._pending_base:
+            b = self._pending_base[0]
+            if not self._in_window(b):
+                break  # hold (in order) until the stale pad catches up
+            self._pending_base.pop(0)
+            group = dict(self._latest)
+            group[base] = b
+            outs.extend(self._emit_with(group, b))
+        return outs
+
+    def finalize(self):
+        # EOS: no fresher buffers are coming — flush held base buffers
+        # with the last-seen data on the other pads.
+        outs = []
+        if self.sync_mode == "basepad" \
+                and set(self.in_caps) <= set(self._latest):
+            base = self._base_pad()
+            for b in self._pending_base:
+                group = dict(self._latest)
+                group[base] = b
+                outs.extend(self._emit_with(group, b))
+        self._pending_base = []
         return outs
 
 

@@ -240,21 +240,60 @@ class TestMuxSyncModes:
     def test_basepad_base_drives(self):
         m = TensorMux({"sync_mode": "basepad", "sync_option": "0"})
         m.configure({"sink_0": nt.Caps.any(), "sink_1": nt.Caps.any()}, ["src"])
-        # base pad arrives first: must wait for pad 1's first buffer
+        # base pad arrives first: held until pad 1's first buffer (the
+        # reference queues it in collectpads rather than dropping it)
         assert self._push(m, "sink_0", 1.0, 10) == []
-        # non-base pad never triggers emission
-        assert self._push(m, "sink_1", 9.0, 12) == []
-        # next base buffer emits, pairing with pad 1's LATEST
+        # pad 1's first buffer releases the held base buffer
+        outs = self._push(m, "sink_1", 9.0, 12)
+        assert len(outs) == 1
+        buf = outs[0][1]
+        assert buf.pts == 10  # base pad's pts, not the releasing pad's
+        assert buf.tensors[0][0] == 1.0 and buf.tensors[1][0] == 9.0
+        # next base buffer emits immediately, pairing with pad 1's LATEST
         outs = self._push(m, "sink_0", 2.0, 20)
         assert len(outs) == 1
         buf = outs[0][1]
-        assert buf.pts == 20  # base pad's pts, not max
+        assert buf.pts == 20
         assert buf.tensors[0][0] == 2.0 and buf.tensors[1][0] == 9.0
-        # fast non-base pad updates are coalesced: still no emission
+        # fast non-base pad updates are coalesced: no emission without a
+        # pending base buffer
         assert self._push(m, "sink_1", 10.0, 21) == []
         assert self._push(m, "sink_1", 11.0, 22) == []
         outs = self._push(m, "sink_0", 3.0, 30)
         assert outs[0][1].tensors[1][0] == 11.0  # latest wins
+
+    def test_basepad_duration_window_enforced(self):
+        # sync-option=<pad>:<duration-ns>: a non-base buffer staler than
+        # base_pts - duration must NOT be combined; the base buffer is held
+        # until the slow pad catches up (reference discards too-old
+        # non-base buffers and waits for fresher data).
+        m = TensorMux({"sync_mode": "basepad", "sync_option": "0:5"})
+        m.configure({"sink_0": nt.Caps.any(), "sink_1": nt.Caps.any()}, ["src"])
+        assert self._push(m, "sink_1", 7.0, 2) == []
+        # base at pts 10: pad 1's latest (pts 2) is outside [5, inf) — hold
+        assert self._push(m, "sink_0", 1.0, 10) == []
+        # still-stale update (pts 4 < 10-5): keeps holding
+        assert self._push(m, "sink_1", 8.0, 4) == []
+        # in-window update releases the held base buffer, in order
+        outs = self._push(m, "sink_1", 9.0, 6)
+        assert len(outs) == 1
+        assert outs[0][1].pts == 10
+        assert outs[0][1].tensors[1][0] == 9.0
+        # newer-than-base data is always acceptable
+        outs = self._push(m, "sink_0", 2.0, 11)
+        assert len(outs) == 1 and outs[0][1].tensors[1][0] == 9.0
+
+    def test_basepad_duration_window_eos_flush(self):
+        m = TensorMux({"sync_mode": "basepad", "sync_option": "0:5"})
+        m.configure({"sink_0": nt.Caps.any(), "sink_1": nt.Caps.any()}, ["src"])
+        assert self._push(m, "sink_1", 7.0, 0) == []
+        assert self._push(m, "sink_0", 1.0, 10) == []  # held: pad 1 stale
+        assert self._push(m, "sink_0", 2.0, 20) == []  # held behind it
+        # EOS: no fresher data is coming — flush both with last-seen data
+        outs = m.finalize()
+        assert [o[1].pts for o in outs] == [10, 20]
+        assert all(o[1].tensors[1][0] == 7.0 for o in outs)
+        assert m.finalize() == []  # idempotent
 
     def test_single_pad_slowest_process_passthrough(self):
         # A single-sink-pad mux in default slowest mode bypasses the
