@@ -11,17 +11,21 @@ run() {
   tag="$1"; shift
   echo "=== $tag: $* ($(date -u +%H:%M:%S))" >&2
   line=$(timeout 1800 python bench.py "$@" 2>bench_r3_last_stderr.log | tail -1)
-  rc=$?
-  echo "{\"tag\": \"$tag\", \"rc\": $rc, \"line\": $line}" >> "$OUT" 2>/dev/null \
-    || echo "{\"tag\": \"$tag\", \"rc\": $rc, \"line\": null}" >> "$OUT"
+  rc=${PIPESTATUS[0]}  # bench.py's status, not tail's
+  # Guard against empty/non-JSON output (e.g. killed by timeout before
+  # printing): record an explicit null instead of a malformed line.
+  if ! python -c "import json,sys; json.loads(sys.argv[1])" "$line" 2>/dev/null; then
+    line=null
+  fi
+  echo "{\"tag\": \"$tag\", \"rc\": $rc, \"line\": $line}" >> "$OUT"
   echo "    -> rc=$rc $line" >&2
 }
 
 python tools/smoke_tpu.py --json SMOKE_r3.json >&2
 echo "smoke rc=$?" >&2
 
-run classification --config classification
-run classification_b256 --config classification --batch 256
+run classification_b64 --config classification --batch 64
+run classification --config classification  # default batch (256 since r3)
 run detection_ssd --config detection
 run detection_yolov5 --config detection --detection-model yolov5
 run pose --config pose

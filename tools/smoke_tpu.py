@@ -217,8 +217,11 @@ def main() -> int:
     args = ap.parse_args()
 
     # Claim the output path BEFORE burning minutes of device time on the
-    # checks; an unwritable path should fail here, not after the run.
-    json_file = open(args.json, "w") if args.json else None
+    # checks — but via a sibling temp file renamed at the end, so an
+    # unwritable path fails here while a crash mid-run (tunnel death)
+    # can't truncate a previous good record.
+    json_tmp = args.json + ".tmp" if args.json else None
+    json_file = open(json_tmp, "w") if json_tmp else None
 
     devices = jax.devices()
     print(f"backend: {devices}")
@@ -248,6 +251,7 @@ def main() -> int:
                 "checks": results,
             }, f, indent=1)
             f.write("\n")
+        os.replace(json_tmp, args.json)
     print("SMOKE", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 
