@@ -29,6 +29,8 @@ import queue as _queue
 import socket
 import threading
 import time
+
+import numpy as np
 from typing import Dict, Iterator, List, Optional, Tuple, Union
 
 from ..core.buffer import Buffer, Event
@@ -43,6 +45,9 @@ log = logger(__name__)
 
 _META_MSG = "_query_msg"
 _META_CONN = "_query_conn"
+#: serversrc batching: list of per-request meta dicts riding one stacked
+#: buffer; serversink splits output rows back to each client.
+_META_BATCH = "_query_batch"
 
 #: Placeholder in ``_done`` for a fully-streamed request: advances the
 #: in-order cursor without emitting (its buffers already went downstream).
@@ -150,6 +155,21 @@ class TensorQueryServerSrc(SourceElement):
     Props: ``host`` (default 127.0.0.1), ``port`` (0 = OS-assigned; read the
     bound port via ``.bound_port``), ``id`` (pairs with the serversink of the
     same id), ``topic`` (optional capability filter).
+
+    **Dynamic batching** (TPU-first; no reference analog — the reference
+    serves one request per invoke): ``max-batch=N`` with
+    ``batch-window-ms=W`` collects up to N concurrent client requests
+    (first arrival opens a W-ms window), stacks them along a new leading
+    batch axis, and emits ONE buffer — the downstream filter runs a single
+    batched fused invoke instead of N sequential ones, which is how the
+    MXU wants to be fed.  ``batch-pad=true`` (default) pads partial groups
+    to N by repeating the last row so XLA sees one static shape (no
+    recompile churn); the serversink drops padded rows.  Only
+    same-shape/dtype requests share a group; a mismatch flushes the group.
+    Requires the served model to be batch-leading and the pipeline's
+    filter to accept [N, ...] inputs.  Streaming filters (``llm``) are
+    not yet supported behind ``max-batch`` — their per-token piece
+    tensors are not batch-leading; serve them unbatched (the default).
     """
 
     kind = "tensor_query_serversrc"
@@ -160,7 +180,13 @@ class TensorQueryServerSrc(SourceElement):
         self.port = int(self.props.get("port", 0))
         self.sid = int(self.props.get("id", 0))
         self.topic = str(self.props.get("topic", ""))
+        self.max_batch = int(self.props.get("max_batch", 1))
+        self.batch_window_s = float(self.props.get("batch_window_ms", 2.0)) / 1e3
+        self.batch_pad = bool(self.props.get("batch_pad", True))
+        if self.max_batch < 1:
+            raise ElementError(f"{self.name}: max-batch must be >= 1")
         self._core: Optional[_ServerCore] = None
+        self._carry: Optional[Buffer] = None  # shape-mismatch pushback
 
     def start(self) -> None:
         with _servers_lock:
@@ -191,10 +217,60 @@ class TensorQueryServerSrc(SourceElement):
     def generate(self) -> Iterator[Union[Buffer, Event]]:
         stop = getattr(self, "_stop_event", threading.Event())
         while not stop.is_set():
+            first = self._carry
+            self._carry = None
+            if first is None:
+                try:
+                    first = self._core.inbound.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+            if self.max_batch <= 1:
+                yield first
+                continue
+            yield self._collect_group(first)
+
+    @staticmethod
+    def _sig(buf: Buffer):
+        sig = []
+        for t in buf.tensors:
+            a = np.asarray(t)
+            sig.append((a.shape, a.dtype.str))
+        return tuple(sig)
+
+    def _collect_group(self, first: Buffer) -> Buffer:
+        """Stack up to max-batch same-shape requests arriving within the
+        window opened by ``first`` into one batch-leading buffer."""
+        stop = getattr(self, "_stop_event", threading.Event())
+        group = [first]
+        sig = self._sig(first)
+        deadline = time.monotonic() + self.batch_window_s
+        while len(group) < self.max_batch and not stop.is_set():
+            # 0.1s slices keep shutdown responsive inside a long window.
+            remaining = min(0.1, deadline - time.monotonic())
+            if remaining <= 0:
+                break
             try:
-                yield self._core.inbound.get(timeout=0.1)
+                nxt = self._core.inbound.get(timeout=remaining)
             except _queue.Empty:
                 continue
+            if self._sig(nxt) != sig:
+                self._carry = nxt  # different shape: flush, regroup next
+                break
+            group.append(nxt)
+        valid = len(group)
+        if valid == 1 and not self.batch_pad:
+            return first
+        rows = group
+        if self.batch_pad and valid < self.max_batch:
+            rows = group + [group[-1]] * (self.max_batch - valid)
+        tensors = [
+            np.stack([np.asarray(b.tensors[i]) for b in rows])
+            for i in range(len(first.tensors))
+        ]
+        metas = [dict(b.meta) for b in group]
+        out = Buffer(tensors, pts=first.pts, meta={_META_BATCH: metas})
+        metrics.count("query_server.batched", valid)
+        return out
 
 
 @register_element("tensor_query_serversink")
@@ -212,6 +288,8 @@ class TensorQueryServerSink(SinkElement):
         core = _get_server(self.sid)
         if core is None:
             raise ElementError(f"no query server with id={self.sid}")
+        if _META_BATCH in buf.meta:
+            return self._send_batched(core, buf)
         cid = buf.meta.get(_META_CONN)
         if cid is None:
             log.warning("%s: buffer without query connection meta; dropped", self.name)
@@ -224,6 +302,37 @@ class TensorQueryServerSink(SinkElement):
             metrics.count("query_server.out")
         else:
             metrics.count(f"{self.name}.dropped")
+        return []
+
+    def _send_batched(self, core, buf: Buffer):
+        """Split a dynamically batched result (serversrc ``max-batch``)
+        back into one response row per originating request; padded rows
+        (rows past the _META_BATCH list) are dropped.  One D2H for the whole
+        batch, not one per client."""
+        host = buf.to_host()
+        metas = host.meta[_META_BATCH]
+        tensors = [np.asarray(t) for t in host.tensors]
+        for t in tensors:
+            if t.ndim == 0 or t.shape[0] < len(metas):
+                raise ElementError(
+                    f"{self.name}: batched output leading dim "
+                    f"{t.shape[:1] or None} < {len(metas)} batched requests "
+                    "— the served model must be batch-leading for "
+                    "serversrc max-batch")
+        resp_meta = {k: v for k, v in host.meta.items()
+                     if k not in (_META_BATCH, _META_CONN)}
+        for i, m in enumerate(metas):
+            cid = m.get(_META_CONN)
+            if cid is None:
+                metrics.count(f"{self.name}.dropped")
+                continue
+            out = Buffer([t[i] for t in tensors], pts=host.pts,
+                         meta={**{k: v for k, v in m.items()
+                                  if k != _META_CONN}, **resp_meta})
+            if core.send(int(cid), wire.encode_buffer(out)):
+                metrics.count("query_server.out")
+            else:
+                metrics.count(f"{self.name}.dropped")
         return []
 
 
