@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Assemble BENCH_ALL_r3.json from bench_r3_raw.jsonl (one sweep session)."""
+import json
+import subprocess
+import sys
+
+raw = [json.loads(l) for l in open("bench_r3_raw.jsonl")]
+commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                        capture_output=True, text=True).stdout.strip()
+results = []
+failed = []
+for d in raw:
+    if d["rc"] == 0 and d["line"]:
+        results.append({"tag": d["tag"], **d["line"]})
+    else:
+        failed.append({"tag": d["tag"], "rc": d["rc"]})
+out = {
+    "note": "round-3 sweep: one sequential session on the single tunneled "
+            "v5e chip (plus SMOKE_r3.json from the same session); "
+            "cross-session chip/tunnel-state variance is ~1.5-2x on the "
+            "video configs — claims are restricted to THIS artifact",
+    "commit": commit,
+    "device": "TPU v5 lite (1 chip, axon tunnel)",
+    "parity_bar": "250 fps/chip (vs_baseline 1.0) per BASELINE.json north "
+                  "star; llm vs ~20 tok/s llama.cpp-class",
+    "results": results,
+}
+if failed:
+    out["failed"] = failed
+json.dump(out, open("BENCH_ALL_r3.json", "w"), indent=1)
+print(f"BENCH_ALL_r3.json: {len(results)} results, {len(failed)} failed")
+for r in results:
+    print(f"  {r['tag']:22s} {r['value']:>10} {r['unit']}")
