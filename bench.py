@@ -33,9 +33,11 @@ import time
 
 # `JAX_PLATFORMS=cpu python bench.py` must not touch (and hang on) an
 # unreachable device tunnel when a site hook pre-imported jax.
-from nnstreamer_tpu.core.platform import honor_jax_platforms
+from nnstreamer_tpu.core.platform import (enable_compilation_cache,
+                                           honor_jax_platforms)
 
 honor_jax_platforms()
+enable_compilation_cache()
 
 
 # 8-deep in-flight window: measured +29% classification fps over 4 (RTT

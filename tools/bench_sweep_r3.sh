@@ -28,6 +28,7 @@ run classification_b64 --config classification --batch 64
 run classification --config classification  # default batch (256 since r3)
 run detection_ssd --config detection
 run detection_yolov5 --config detection --detection-model yolov5
+run detection_yolov8 --config detection --detection-model yolov8
 run pose --config pose
 run segmentation --config segmentation
 run audio --config audio

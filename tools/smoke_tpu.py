@@ -22,9 +22,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Lets `JAX_PLATFORMS=cpu` run this smoke on CPU even when a site hook
 # pre-imported jax (see core/platform.py).
-from nnstreamer_tpu.core.platform import honor_jax_platforms
+from nnstreamer_tpu.core.platform import (enable_compilation_cache,
+                                          honor_jax_platforms)
 
 honor_jax_platforms()
+enable_compilation_cache()
 
 
 def _check(name, fn):
