@@ -167,9 +167,11 @@ class TensorQueryServerSrc(SourceElement):
     recompile churn); the serversink drops padded rows.  Only
     same-shape/dtype requests share a group; a mismatch flushes the group.
     Requires the served model to be batch-leading and the pipeline's
-    filter to accept [N, ...] inputs.  Streaming filters (``llm``) are
-    not yet supported behind ``max-batch`` — their per-token piece
-    tensors are not batch-leading; serve them unbatched (the default).
+    filter to accept [N, ...] inputs.  Streaming filters compose too:
+    an ``llm`` filter behind ``max-batch=N`` decodes N concurrent
+    same-length prompts in ONE lax.scan loop and streams each client its
+    own row of every token (ids only when batched — per-row byte pieces
+    are not batch-leading; clients detokenize ids themselves).
     """
 
     kind = "tensor_query_serversrc"

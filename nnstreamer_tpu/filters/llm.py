@@ -239,19 +239,25 @@ class LLMFramework(Framework):
     def invoke_stream(self, inputs: Sequence) -> Iterator[List[np.ndarray]]:
         """Yield one output list per generated token: [ids [B] int32,
         piece bytes uint8] — flexible tensors, the reference's streaming
-        contract."""
+        contract.  Batched prompts ([B, T], B>1 — e.g. stacked by a
+        ``tensor_query_serversrc max-batch=N``) yield [ids [B]] only: a
+        per-row variable-length piece tensor is not batch-leading, so
+        byte decoding is the consumer's job (ids are the contract; the
+        query serversink row-splits ids back to each client)."""
         prompt = self._to_tokens(inputs[0])
         for ids in self._gen_tokens(prompt):
-            metrics.count("llm.tokens")
+            metrics.count("llm.tokens", ids.shape[0])
+            if ids.shape[0] != 1:
+                yield [ids]
+                continue
             piece = np.frombuffer(
-                self.tokenizer.decode_piece(int(ids[0])), np.uint8
-            ) if ids.shape[0] == 1 else np.zeros((0,), np.uint8)
+                self.tokenizer.decode_piece(int(ids[0])), np.uint8)
             yield [ids, piece.copy()]
 
     def invoke(self, inputs: Sequence) -> List[np.ndarray]:
         """Non-streaming: all generated ids as one [B, N] tensor + the
-        decoded bytes (batch 1)."""
-        chunks = [ids for ids, _ in self.invoke_stream(inputs)]
+        decoded bytes (batch-1 only; batched yields carry ids alone)."""
+        chunks = [outs[0] for outs in self.invoke_stream(inputs)]
         ids = np.stack(chunks, axis=1)
         text = b"".join(self.tokenizer.decode_piece(int(t)) for t in ids[0])
         return [ids, np.frombuffer(text, np.uint8).copy()]
