@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 _ENV_CONF = "NNS_TPU_CONF"
 _ENV_PLUGINS = "NNS_TPU_PLUGINS"
 _ENV_FW_PRIORITY = "NNS_TPU_FILTER_PRIORITY"
+_ENV_BUCKETING = "NNS_TPU_SHAPE_BUCKETING"
 
 
 @dataclasses.dataclass
@@ -54,6 +55,9 @@ class Config:
                 cfg.filter_priority = _split(ini.get("filter", "priority"))
             if ini.has_option("common", "queue_capacity"):
                 cfg.queue_capacity = ini.getint("common", "queue_capacity")
+            if ini.has_option("common", "shape_bucketing"):
+                cfg.shape_bucketing = ini.getboolean("common",
+                                                     "shape_bucketing")
             for sec in ini.sections():
                 if sec.startswith("filter-"):
                     cfg.framework_options[sec[len("filter-"):]] = dict(ini.items(sec))
@@ -61,6 +65,9 @@ class Config:
             cfg.plugin_modules = _split(os.environ[_ENV_PLUGINS])
         if os.environ.get(_ENV_FW_PRIORITY):
             cfg.filter_priority = _split(os.environ[_ENV_FW_PRIORITY])
+        if os.environ.get(_ENV_BUCKETING):
+            cfg.shape_bucketing = os.environ[_ENV_BUCKETING].lower() in (
+                "1", "true", "yes", "on")
         return cfg
 
 

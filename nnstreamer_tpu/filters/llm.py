@@ -37,6 +37,7 @@ from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..core.config import get_config
 from ..core.log import logger, metrics
 from ..core.registry import register_filter
 from ..core.types import TensorFormat, TensorsSpec
@@ -45,6 +46,15 @@ from ..models.zoo import build as build_model
 from .base import Framework, FrameworkError, parse_custom_options
 
 log = logger(__name__)
+
+
+def _next_bucket(t: int) -> int:
+    """Smallest power-of-two >= t (min 32): bounds distinct prefill
+    compilations at log2(max_seq) programs for arbitrary prompt mixes."""
+    b = 32
+    while b < t:
+        b <<= 1
+    return b
 
 
 class ByteTokenizer:
@@ -213,13 +223,28 @@ class LLMFramework(Framework):
             from ..parallel.sharding import shard_params as _sp
             cache = _sp(self.mesh, cache, llama.cache_pspecs())
         params = self.bundle.params
+        # Prompt-length bucketing (SURVEY §7 "dynamic shapes vs XLA static
+        # shapes"): the prefill program compiles per SHAPE, so serving
+        # mixed-length prompts would compile per length.  Right-pad to the
+        # next bucket: causal attention keeps real tokens from seeing pad
+        # rows, decode overwrites cache row `pos` before any later
+        # position can attend it, and the sampled logit is read at the
+        # REAL last position — numerics are untouched (asserted by test).
+        P = T
+        if get_config().shape_bucketing:
+            P = min(_next_bucket(T), cfg.max_seq - 1)
+        if P > T:
+            prompt = np.pad(prompt, ((0, 0), (0, P - T)))
         logits, cache = self._fwd(params, jnp.asarray(prompt), cache, 0)
         key = jax.random.PRNGKey(self.seed)
-        # At least one token is always safe: prefill wrote cache[0:T] and the
-        # first sample needs no further cache write.  Subsequent decode steps
-        # feed at positions T..T+n-2, each of which must stay < max_seq.
+        # At least one token is always safe: prefill wrote cache[0:P]
+        # (real rows 0:T; rows T..P-1 hold pad-token K/V that stay hidden
+        # behind the decode mask until sequentially overwritten) and the
+        # first sample needs no further cache write.  Subsequent decode
+        # steps feed at positions T..T+n-2, each of which must stay
+        # < max_seq.
         n = max(1, min(self.max_new, cfg.max_seq - T))
-        tok = llama.sample_token(logits[:, -1], key, self.temperature)
+        tok = llama.sample_token(logits[:, T - 1], key, self.temperature)
         yield np.asarray(tok)
         done = 1
         pos = T

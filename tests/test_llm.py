@@ -292,3 +292,51 @@ class TestInt8WeightOnly:
                 p.pull("out", timeout=120)
             p.eos()
             p.wait(timeout=30)
+
+
+class TestPrefillBucketing:
+    """SURVEY §7 "dynamic shapes vs XLA static shapes": prompts right-pad
+    to power-of-two buckets so mixed-length serving compiles at most
+    log2(max_seq) prefill programs — with numerics IDENTICAL to the
+    unbucketed program (causal attention hides pad rows; decode
+    overwrites cache row `pos` before anything can attend it)."""
+
+    def _ids(self, prompt):
+        from nnstreamer_tpu.filters.llm import LLMFramework
+
+        fw = LLMFramework()
+        fw.open({"model": "llama_tiny",
+                 "custom": "max_new:6,stream_chunk:2,temperature:0.7"})
+        return [out[0].copy() for out in fw.invoke_stream([prompt])]
+
+    def test_bucketed_matches_unbucketed(self):
+        import dataclasses
+
+        from nnstreamer_tpu.core import config as config_mod
+
+        prompts = [np.arange(1, 6, dtype=np.int32),        # 5 -> bucket 32
+                   np.arange(1, 41, dtype=np.int32)]       # 40 -> bucket 64
+        for prompt in prompts:
+            cfg = config_mod.get_config()
+            try:
+                config_mod.set_config(
+                    dataclasses.replace(cfg, shape_bucketing=False))
+                plain = self._ids(prompt)
+                config_mod.set_config(
+                    dataclasses.replace(cfg, shape_bucketing=True))
+                bucketed = self._ids(prompt)
+            finally:
+                config_mod.set_config(cfg)
+            assert len(plain) == len(bucketed)
+            for a, b in zip(plain, bucketed):
+                np.testing.assert_array_equal(a, b)
+
+    def test_mixed_lengths_share_prefill_program(self):
+        from nnstreamer_tpu.filters.llm import LLMFramework
+
+        fw = LLMFramework()
+        fw.open({"model": "llama_tiny", "custom": "max_new:1"})
+        for t in (3, 9, 17, 30):  # all bucket to 32
+            list(fw.invoke_stream([np.arange(1, t + 1, dtype=np.int32)]))
+        # jit cache: one prefill entry despite four prompt lengths
+        assert fw._fwd._cache_size() == 1

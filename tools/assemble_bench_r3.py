@@ -5,8 +5,8 @@ import subprocess
 import sys
 
 raw = [json.loads(l) for l in open("bench_r3_raw.jsonl")]
-commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
-                        capture_output=True, text=True).stdout.strip()
+assembled_at = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, text=True).stdout.strip()
 results = []
 failed = []
 for d in raw:
@@ -15,11 +15,17 @@ for d in raw:
     else:
         failed.append({"tag": d["tag"], "rc": d["rc"]})
 out = {
-    "note": "round-3 sweep: one sequential session on the single tunneled "
-            "v5e chip (plus SMOKE_r3.json from the same session); "
-            "cross-session chip/tunnel-state variance is ~1.5-2x on the "
-            "video configs — claims are restricted to THIS artifact",
-    "commit": commit,
+    "note": "round-3 measurements on the single tunneled v5e chip: the "
+            "12 base configs are one sequential sweep session (plus "
+            "SMOKE_r3.json from the same session); the llm7b_int8_x8/_x16 "
+            "multi-stream rows were recorded in a follow-up session at the "
+            "commit that introduced --llm-streams.  Cross-session "
+            "chip/tunnel-state variance is ~1.5-2x — claims are "
+            "restricted to THIS artifact",
+    "assembled_at_commit": assembled_at,
+    "measured_at": "base sweep spanned d2e25c8..8328f4c (mid-sweep commits "
+                   "touched only query batching, not measured paths); "
+                   "llm7b_int8_x8/_x16 rows at 0e51944",
     "device": "TPU v5 lite (1 chip, axon tunnel)",
     "parity_bar": "250 fps/chip (vs_baseline 1.0) per BASELINE.json north "
                   "star; llm vs ~20 tok/s llama.cpp-class",
