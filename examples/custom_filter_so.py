@@ -11,12 +11,15 @@ prebuilt .so and just point ``model=`` at it.
 
 import os
 import subprocess
+import sys
 import tempfile
 
 import numpy as np
 
-import nnstreamer_tpu as nt
-from nnstreamer_tpu.filters.custom_so import include_dir
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import nnstreamer_tpu as nt  # noqa: E402
+from nnstreamer_tpu.filters.custom_so import include_dir  # noqa: E402
 
 SOURCE = r"""
 #include <cstring>

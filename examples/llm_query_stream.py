@@ -8,11 +8,14 @@ and receive the generated tokens streamed back one buffer each, tagged
     python examples/llm_query_stream.py llama2_7b  # real 7B (needs ~14 GB HBM)
 """
 
+import os
 import sys
 
 import numpy as np
 
-import nnstreamer_tpu as nt
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import nnstreamer_tpu as nt  # noqa: E402
 
 
 def main():

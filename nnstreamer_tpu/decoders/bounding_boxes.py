@@ -162,6 +162,10 @@ class BoundingBoxes(Decoder):
         return np.asarray(tb), np.asarray(ts), np.asarray(tc)
 
     def _decode_one(self, frame):
+        detections = self._decode_dets(frame)
+        return self._draw(detections), detections
+
+    def _decode_dets(self, frame):
         if isinstance(frame, tuple) and frame[0] == "triple":
             boxes, scores, classes = frame[1]
             m = scores >= self.threshold
@@ -190,7 +194,7 @@ class BoundingBoxes(Decoder):
                     "label": self.labels[ci] if ci < len(self.labels) else str(ci),
                 }
             )
-        return self._draw(detections), detections
+        return detections
 
     # -- fusion ------------------------------------------------------------
     # The whole prefilter joins the fused XLA program: per-anchor class
@@ -302,7 +306,8 @@ class BoundingBoxes(Decoder):
         tc = np.asarray(arrays[2])
         valid = np.asarray(arrays[3]).astype(bool) if len(arrays) > 3 else None
         b = tb.shape[0]
-        overlays, dets = [], []
+        canvas = np.zeros((b, self.out_h, self.out_w, 4), np.uint8)
+        dets = []
         for i in range(b):
             if valid is not None:
                 # device-NMS path: arrays ARE the final detections
@@ -317,17 +322,16 @@ class BoundingBoxes(Decoder):
                     }
                     for j in range(tb.shape[1]) if valid[i, j]
                 ]
-                overlay = self._draw(d)
+                self._draw_into(canvas[i], d)
             else:
-                overlay, d = self._decode_one(
-                    ("triple", (tb[i], ts[i], tc[i])))
-            overlays.append(overlay)
+                d = self._decode_dets(("triple", (tb[i], ts[i], tc[i])))
+                self._draw_into(canvas[i], d)
             dets.append(d)
         if b == 1:
-            new = buf.with_tensors([overlays[0]], spec=None)
+            new = buf.with_tensors([canvas[0]], spec=None)
             new.meta["detections"] = dets[0]
             return new
-        new = buf.with_tensors([np.stack(overlays)], spec=None)
+        new = buf.with_tensors([canvas], spec=None)
         new.meta["detections"] = dets
         return new
 
@@ -367,6 +371,14 @@ class BoundingBoxes(Decoder):
 
     def _draw(self, detections) -> np.ndarray:
         overlay = np.zeros((self.out_h, self.out_w, 4), np.uint8)
+        self._draw_into(overlay, detections)
+        return overlay
+
+    def _draw_into(self, overlay: np.ndarray, detections) -> np.ndarray:
+        """Draw in place — the batched host_post path allocates ONE
+        [B, H, W, 4] canvas and draws each frame into its row view
+        (per-frame zeros + a final np.stack copy were ~70% of the
+        measured host_post time at batch 64)."""
         t = 2  # line thickness (reference draws 1px rectangles + label text)
         for d in detections:
             x1, y1, x2, y2 = d["box"]
