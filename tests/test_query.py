@@ -474,3 +474,48 @@ class TestDynamicBatching:
         direct = [out[0] for out in fw.invoke_stream([np.stack(prompts)])]
         for row, ids in enumerate(streams):
             assert ids == [int(d[row]) for d in direct]
+
+    def test_client_disconnect_mid_batched_stream_isolated(self):
+        # One of two clients sharing a batched LLM stream vanishes
+        # mid-generation: its send fails and the connection drops, while
+        # the surviving client still receives its complete stream (the
+        # reference's multi-client isolation requirement, applied to the
+        # batched path).
+        import contextlib
+
+        max_new = 6
+        # A wide window costs nothing when both requests arrive (the group
+        # closes the moment it reaches max-batch) but guarantees a loaded
+        # CI host cannot split the two pushes into separate single-row
+        # batches — which would let the test pass without exercising the
+        # shared-stream scenario it documents.
+        srv = nt.Pipeline(
+            "tensor_query_serversrc name=ssrc port=0 id=43 "
+            "max-batch=2 batch-window-ms=5000 ! "
+            f"tensor_filter name=f framework=llm model=llama_tiny "
+            f"custom=max_new:{max_new},stream_chunk:1 invoke-dynamic=true ! "
+            "tensor_query_serversink id=43"
+        )
+        with srv, contextlib.ExitStack() as clients:
+            port = srv.element("ssrc").bound_port
+            doomed = clients.enter_context(nt.Pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "timeout=30 ! tensor_sink name=out"))
+            survivor = clients.enter_context(nt.Pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "timeout=30 ! tensor_sink name=out"))
+            doomed.push("src", np.array([1, 5, 9, 2], np.int32))
+            survivor.push("src", np.array([3, 3, 7, 8], np.int32))
+            # doomed reads one token then tears down mid-stream
+            doomed.pull("out", timeout=30)
+            doomed.stop()
+            toks = [survivor.pull("out", timeout=30)
+                    for _ in range(max_new)]
+            assert toks[-1].meta.get("stream_last") is True
+            assert [t.meta["stream_index"] for t in toks] == \
+                list(range(max_new))
+            # Proof the scenario actually ran batched: ONE filter invoke
+            # served both clients' streams.
+            assert srv.element("f")._n_invoked == 1
+            survivor.eos("src")
+            survivor.wait(timeout=10)
