@@ -260,6 +260,12 @@ class TensorQueryServerSrc(SourceElement):
                 break
             group.append(nxt)
         valid = len(group)
+        # occupancy = batched / (batch_groups * max_batch): how full the
+        # dynamic batches actually run (serving-capacity observability).
+        # Counted for EVERY flushed group — including batch-pad=false solo
+        # flushes, where under-occupancy is precisely the signal.
+        metrics.count("query_server.batched", valid)
+        metrics.count("query_server.batch_groups")
         if valid == 1 and not self.batch_pad:
             return first
         rows = group
@@ -270,9 +276,7 @@ class TensorQueryServerSrc(SourceElement):
             for i in range(len(first.tensors))
         ]
         metas = [dict(b.meta) for b in group]
-        out = Buffer(tensors, pts=first.pts, meta={_META_BATCH: metas})
-        metrics.count("query_server.batched", valid)
-        return out
+        return Buffer(tensors, pts=first.pts, meta={_META_BATCH: metas})
 
 
 @register_element("tensor_query_serversink")

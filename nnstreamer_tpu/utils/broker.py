@@ -136,6 +136,17 @@ class MqttLiteBroker:
             self.publish_raw(frame, default_topic)
         conn.close()
 
+    def subscriber_count(self, topic: Optional[str] = None) -> int:
+        """Live subscriptions (optionally: those whose pattern matches
+        ``topic``).  Lets publishers/tests wait for a subscriber to be
+        registered instead of racing the SUBSCRIBE against the first
+        QoS-0 publish (which is simply lost if it wins the race)."""
+        with self._lock:
+            if topic is None:
+                return len(self._subs)
+            return sum(1 for pat, _ in self._subs.values()
+                       if topic_matches(pat, topic))
+
     def publish_raw(self, frame: bytes, default_topic: str = "") -> None:
         """Route one encoded-buffer frame to matching subscribers."""
         topic = default_topic

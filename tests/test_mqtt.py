@@ -27,6 +27,20 @@ class TestTopicMatching:
         assert not topic_matches("a/b/c", "a/b")
 
 
+def _wait_sub(broker, topic, timeout=10.0):
+    """Block until a subscription matching ``topic`` is registered: QoS-0
+    publishes that win the race against SUBSCRIBE are simply lost (only
+    the retained backlog, when enabled, replays — and only the LAST
+    message), which made these tests flake under CPU load."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while broker.subscriber_count(topic) == 0:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no subscriber for {topic!r} in {timeout}s")
+        time.sleep(0.01)
+
+
 class TestBrokerPipelines:
     def test_pub_sub_roundtrip(self):
         with MqttLiteBroker() as broker:
@@ -40,6 +54,7 @@ class TestBrokerPipelines:
                     f"port={broker.port} topic=cam/0"
                 )
                 with sink_pipe:
+                    _wait_sub(broker, "cam/0")
                     for i in range(3):
                         sink_pipe.push("src", np.full((2,), i, np.int16))
                     outs = [src_pipe.pull("out", timeout=15) for _ in range(3)]
@@ -63,6 +78,7 @@ class TestBrokerPipelines:
                     f"appsrc name=src ! mqttsink port={broker.port} topic=cam/1"
                 )
                 with pub, pub2:
+                    _wait_sub(broker, "cam/1")
                     pub.push("src", np.array([1], np.uint8))
                     pub2.push("src", np.array([2], np.uint8))
                     out = src_pipe.pull("out", timeout=15)
@@ -101,6 +117,7 @@ class TestBrokerPipelines:
                     f"appsrc name=src ! mqttsink port={broker.port} topic=t"
                 )
                 with pub:
+                    _wait_sub(broker, "t")
                     pub.push("src", nt.Buffer([np.zeros(1, np.uint8)], pts=1000))
                     out = sub.pull("out", timeout=15)
                     pub.eos()
@@ -178,6 +195,7 @@ class TestReconnect:
         with sub:
             pub = nt.Pipeline(f"appsrc name=src ! mqttsink port={port} topic=t")
             with pub:
+                _wait_sub(broker, "t")
                 pub.push("src", np.array([1], np.uint8))
                 first = sub.pull("out", timeout=15)
                 pub.eos()

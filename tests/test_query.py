@@ -514,8 +514,16 @@ class TestDynamicBatching:
             assert toks[-1].meta.get("stream_last") is True
             assert [t.meta["stream_index"] for t in toks] == \
                 list(range(max_new))
-            # Proof the scenario actually ran batched: ONE filter invoke
-            # served both clients' streams.
-            assert srv.element("f")._n_invoked == 1
             survivor.eos("src")
             survivor.wait(timeout=10)
+            # Proof the scenario actually ran batched: ONE filter invoke
+            # served both clients' streams.  Polled — the counter
+            # increments when the server-side stream generator finalizes,
+            # which races the last token's delivery to the client.
+            import time as _t
+
+            deadline = _t.monotonic() + 5
+            while srv.element("f")._n_invoked < 1 \
+                    and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+            assert srv.element("f")._n_invoked == 1
