@@ -1,0 +1,61 @@
+"""Continuous batching: clients join a RUNNING decode loop.
+
+``custom=serve:continuous,slots:N`` keeps one per-row-position decode
+loop alive; each queued prompt is admitted into a free slot at a chunk
+boundary (bucketed batch-1 prefill written into the slot's KV rows), so
+a late client starts receiving tokens while earlier streams are still
+decoding — the serving shape neither the reference's per-request
+llama.cpp filter nor static group batching can express.
+
+    python examples/llm_continuous_serving.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import nnstreamer_tpu as nt  # noqa: E402
+
+MAX_NEW = 16
+
+
+def main():
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=11 ! "
+        f"tensor_filter framework=llm model=llama_tiny "
+        f"custom=max_new:{MAX_NEW},serve:continuous,slots:2,stream_chunk:2 "
+        "invoke-dynamic=true ! "
+        "tensor_query_serversink id=11")
+    with srv:
+        port = srv.element("ssrc").bound_port
+        first = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=60 "
+            "! tensor_sink name=out")
+        late = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} timeout=60 "
+            "! tensor_sink name=out")
+        with first, late:
+            first.push("src", "stream one, long-running")
+            first.pull("out", timeout=60)  # stream 1 is demonstrably live
+            t_join = time.perf_counter()
+            late.push("src", "late joiner")
+            late.pull("out", timeout=60)   # first token of the LATE stream
+            join_ms = (time.perf_counter() - t_join) * 1e3
+            # drain both streams
+            for p, n in ((first, MAX_NEW - 1), (late, MAX_NEW - 1)):
+                toks = [p.pull("out", timeout=60) for _ in range(n)]
+                assert toks[-1].meta.get("stream_last") is True
+            for p in (first, late):
+                p.eos("src")
+                p.wait(timeout=15)
+    print(f"late client's first token arrived {join_ms:.0f} ms after it "
+          f"joined — while stream one was still decoding its {MAX_NEW} "
+          "tokens (continuous admission, no group barrier)")
+
+
+if __name__ == "__main__":
+    main()

@@ -129,6 +129,11 @@ class TensorFilter(Element):
     def configure(self, in_caps, out_pads):
         self.in_caps = dict(in_caps)
         fw = self._ensure_fw()
+        if getattr(fw, "continuous", False):
+            # Continuous-serving frameworks (llm serve:continuous) emit
+            # tokens from their own serve thread, decoupled from any one
+            # input buffer — same async-emit contract as the query client.
+            self.wants_async_emit = True
         fw_in, fw_out = fw.get_model_info()
 
         # explicit props override / fill in what the fw doesn't know
@@ -216,6 +221,16 @@ class TensorFilter(Element):
     def process(self, pad, buf: Buffer):
         with self._fw_lock:  # pairs with reload_model's swap
             fw = self._ensure_fw()
+        if getattr(fw, "continuous", False):
+            # Standing serve loop: enqueue the request (its meta — query
+            # connection/msg ids — rides along) and return; the loop's
+            # thread emits one buffer per generated token via async emit.
+            import functools as _ft
+
+            fw.submit(self._select_inputs(buf.tensors), dict(buf.meta),
+                      _ft.partial(self._emit_serve_token, buf))
+            self._n_invoked += 1
+            return []
         if getattr(fw, "streaming", False):
             # Streaming frameworks (llm) emit MANY buffers per input; the
             # runner iterates this generator, so each token flows downstream
@@ -264,6 +279,30 @@ class TensorFilter(Element):
         if not self.invoke_dynamic:
             spec = self._combined_out_spec(self._out_spec)
         return [(SRC, buf.with_tensors(final, spec=spec))]
+
+    def _emit_serve_token(self, src_buf: Buffer, tensors, meta) -> None:
+        """Serve-thread callback: one generated token -> one buffer.
+        Derives from the ORIGINATING buffer so output-combination props
+        apply and pts survives, exactly like the per-request stream
+        path; the serve loop's meta (stream ids + request meta) wins."""
+        emit = self._async_emit
+        if emit is None:
+            raise ElementError(f"{self.name}: not attached to a pipeline")
+        out = src_buf.with_tensors(
+            self._compose_outputs(src_buf.tensors, list(tensors)),
+            spec=None)
+        out.meta = dict(meta)
+        emit([(SRC, out)])
+
+    def finalize(self):
+        fw = self.fw
+        if fw is not None and getattr(fw, "continuous", False):
+            # EOS reached the element: every admitted stream must finish
+            # (and emit its stream_last) before EOS propagates downstream.
+            if not fw.drain(timeout=600):
+                raise ElementError(
+                    f"{self.name}: continuous serve loop failed to drain")
+        return []
 
     # -- fusion ------------------------------------------------------------
     def device_fn(self, in_spec: TensorsSpec):

@@ -100,6 +100,8 @@ class LLMFramework(Framework):
         self.seed = 0
         self.mesh = None
         self._fwd = None
+        self.continuous = False
+        self._serve: Optional["_ContinuousLoop"] = None
 
     def open(self, props: Dict[str, object]) -> None:
         super().open(props)
@@ -112,6 +114,14 @@ class LLMFramework(Framework):
         # still stream downstream one-by-one, in bursts of this size.
         self.chunk = max(1, int(opts.pop("stream_chunk", 8)))
         tp = int(opts.pop("tp", 1))
+        # serve:continuous — a standing decode loop with ``slots:N`` rows:
+        # prompts are admitted into free slots of a RUNNING per-row-
+        # position decode (each stream at its own depth), so a late
+        # client never waits for earlier streams to finish the way a
+        # static group would make it.  Modern "continuous batching"; no
+        # reference analog.
+        self.continuous = str(opts.pop("serve", "")).lower() == "continuous"
+        self.slots = int(opts.pop("slots", 4))
         self.dtype = opts.get("dtype", "bfloat16")
         try:
             self.bundle = build_model(model, opts)
@@ -184,9 +194,26 @@ class LLMFramework(Framework):
             decode_chunk, static_argnames=("length",), donate_argnums=(2,))
 
     def close(self) -> None:
+        if self._serve is not None:
+            self._serve.shutdown()
+            self._serve = None
         self.bundle = None
         self._fwd = None
         self._decode_chunk = None
+
+    # -- continuous serving ------------------------------------------------
+    def submit(self, inputs: Sequence, meta: Dict, emit) -> None:
+        """Queue one prompt into the standing decode loop
+        (``custom=serve:continuous``).  ``emit(tensors, meta)`` is called
+        from the serve thread once per generated token, carrying the
+        request's meta plus stream_index/stream_last."""
+        if self._serve is None:
+            self._serve = _ContinuousLoop(self)
+        self._serve.submit(self._to_tokens(inputs[0]), meta, emit)
+
+    def drain(self, timeout: float = 600.0) -> bool:
+        """Block until every admitted stream has finished (EOS path)."""
+        return self._serve is None or self._serve.drain(timeout)
 
     def get_model_info(self):
         flex_in = TensorsSpec.from_string("1", "uint8").replace(
@@ -286,3 +313,219 @@ class LLMFramework(Framework):
         ids = np.stack(chunks, axis=1)
         text = b"".join(self.tokenizer.decode_piece(int(t)) for t in ids[0])
         return [ids, np.frombuffer(text, np.uint8).copy()]
+
+
+class _ContinuousLoop:
+    """Standing decode loop for ``custom=serve:continuous``.
+
+    One thread owns a ``slots``-row KV cache and a per-row position
+    vector (models/llama.py per-row ``pos_offset``).  Each iteration:
+    (1) admit queued prompts into idle slots — a bucketed batch-1 prefill
+    written into the slot's cache rows (``llama.write_cache_slot``), its
+    first token emitted immediately; (2) run ONE ``lax.scan`` decode
+    chunk advancing every live slot, each at its own depth; (3) emit each
+    live slot's tokens to its own requester and retire finished slots.
+    A stream admitted mid-flight therefore starts decoding at the next
+    chunk boundary instead of waiting for the running group to finish —
+    continuous batching, the serving shape a static group cannot express.
+    Idle slots decode garbage rows parked out of cache range (their
+    writes are dropped); their FLOPs ride along — static shapes are the
+    price of zero recompiles.
+    """
+
+    def __init__(self, fw: LLMFramework):
+        import queue as _q
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self.fw = fw
+        cfg, temperature = fw.cfg, fw.temperature
+        self._pending: "_q.Queue" = _q.Queue()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        # Guards the idle decision: without it, submit() could clear
+        # _idle and THEN enqueue while the serve loop, between those two
+        # steps, observes an empty queue and sets _idle — drain() would
+        # return with a live request pending and EOS would cut it off.
+        self._idle_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+
+        def decode_rows(params, tok, cache, key, pos, length):
+            def step(carry, _):
+                tok, cache, key, pos = carry
+                key, sub = jax.random.split(key)
+                logits, cache = llama.forward_cached(
+                    params, tok[:, None], cache, pos, cfg,
+                    compute_dtype=fw.dtype)
+                nxt = llama.sample_token(logits[:, -1], sub, temperature)
+                return (nxt, cache, key, pos + 1), nxt
+
+            (tok, cache, key, pos), toks = lax.scan(
+                step, (tok, cache, key, pos), None, length=length)
+            return jnp.moveaxis(toks, 0, 1), tok, cache, key, pos
+
+        self._decode_rows = jax.jit(
+            decode_rows, static_argnames=("length",), donate_argnums=(2,))
+        # slot index passed as a traced scalar: ONE admission program
+        self._write_slot = jax.jit(llama.write_cache_slot,
+                                   donate_argnums=(0,))
+        self._thread = threading.Thread(
+            target=self._run, name="llm-serve", daemon=True)
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+    def submit(self, prompt, meta: Dict, emit) -> None:
+        if self._error is not None:
+            raise FrameworkError(
+                f"continuous serve loop died: {self._error!r}")
+        with self._idle_lock:
+            self._idle.clear()
+            self._pending.put((prompt, meta, emit))
+        self._wake.set()
+
+    def drain(self, timeout: float) -> bool:
+        return self._idle.wait(timeout)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=30)
+
+    # -- serve thread ------------------------------------------------------
+    def _emit_token(self, emit, meta: Dict, token_id: int, index: int,
+                    last: bool) -> None:
+        out_meta = dict(meta)
+        out_meta["stream_index"] = index
+        if last:
+            out_meta["stream_last"] = True
+        piece = self.fw.tokenizer.decode_piece(token_id)
+        emit([np.asarray([token_id], np.int32),
+              np.frombuffer(piece, np.uint8).copy()], out_meta)
+        metrics.count("llm.tokens")
+
+    def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException as e:  # noqa: BLE001 - daemon thread: report
+            log.exception("continuous serve loop died")
+            self._error = e
+            # Terminate every live and queued stream so no client hangs
+            # to its timeout waiting on a dead loop.
+            import queue as _q
+
+            for slot in list(getattr(self, "_live_slots", []) or []):
+                if slot is not None:
+                    meta, emit = slot
+                    try:
+                        self._emit_token(
+                            emit, {**meta, "stream_aborted": True}, 0,
+                            1 << 30, True)
+                    except Exception:  # noqa: BLE001
+                        pass
+            while True:
+                try:
+                    _, meta, emit = self._pending.get_nowait()
+                except _q.Empty:
+                    break
+                try:
+                    self._emit_token(
+                        emit, {**meta, "stream_aborted": True}, 0, 0, True)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._idle.set()
+
+    def _run_inner(self) -> None:
+        import queue as _q
+
+        import jax
+        import jax.numpy as jnp
+
+        fw, cfg = self.fw, self.fw.cfg
+        B = fw.slots
+        params = fw.bundle.params
+        cache = llama.init_cache(cfg, B, dtype=fw.dtype)
+        pos = np.full((B,), cfg.max_seq, np.int32)  # parked = idle
+        remaining = np.zeros((B,), np.int64)
+        sidx = np.zeros((B,), np.int64)
+        slots: list = [None] * B  # (meta, emit) per live slot
+        self._live_slots = slots  # visible to the crash terminator
+        tok = np.zeros((B,), np.int32)
+        key = jax.random.PRNGKey(fw.seed)
+
+        from ..core.config import get_config as _gc
+
+        while not self._stop.is_set():
+            progressed = False
+            # 1. admit queued prompts into idle slots
+            free = np.flatnonzero(remaining == 0)
+            fi = 0
+            while fi < free.size:
+                try:
+                    prompt, meta, emit = self._pending.get_nowait()
+                except _q.Empty:
+                    break
+                slot = int(free[fi])
+                fi += 1
+                T = prompt.shape[1]
+                if T >= cfg.max_seq:
+                    # reject oversize prompts with a terminated stream
+                    self._emit_token(emit, {**meta, "stream_aborted": True},
+                                     0, 0, True)
+                    continue
+                small = llama.init_cache(cfg, 1, dtype=fw.dtype)
+                P = T
+                if _gc().shape_bucketing:
+                    P = min(_next_bucket(T), cfg.max_seq - 1)
+                if P > T:
+                    prompt = np.pad(prompt, ((0, 0), (0, P - T)))
+                logits, small = fw._fwd(params, jnp.asarray(prompt), small, 0)
+                cache = self._write_slot(cache, small, np.int32(slot))
+                key, sub = jax.random.split(key)
+                first = int(np.asarray(
+                    llama.sample_token(logits[:, T - 1], sub,
+                                       fw.temperature))[0])
+                n = max(1, min(fw.max_new, cfg.max_seq - T))
+                self._emit_token(emit, meta, first, 0, n == 1)
+                if n > 1:
+                    tok[slot] = first
+                    pos[slot] = T
+                    remaining[slot] = n - 1
+                    sidx[slot] = 1
+                    slots[slot] = (meta, emit)
+                progressed = True
+
+            # 2. one chunk of per-row decode for the live slots
+            live = remaining > 0
+            if live.any():
+                length = int(min(fw.chunk, remaining[live].min()))
+                toks, tokj, cache, key, posj = self._decode_rows(
+                    params, jnp.asarray(tok), cache, key,
+                    jnp.asarray(pos), length=length)
+                host = np.asarray(toks)  # ONE roundtrip per chunk
+                # np.array (copy): np.asarray of a jax Array is read-only,
+                # and the slot bookkeeping below mutates these in place
+                tok, pos = np.array(tokj), np.array(posj)
+                for j in range(length):
+                    for s in np.flatnonzero(live):
+                        meta, emit = slots[s]
+                        last = remaining[s] == 1
+                        self._emit_token(emit, meta, int(host[s, j]),
+                                         int(sidx[s]), bool(last))
+                        sidx[s] += 1
+                        remaining[s] -= 1
+                        if last:
+                            slots[s] = None
+                            pos[s] = cfg.max_seq  # park the slot
+                progressed = True
+
+            if not progressed:
+                with self._idle_lock:
+                    if self._pending.empty() and not (remaining > 0).any():
+                        self._idle.set()
+                self._wake.wait(0.02)
+                self._wake.clear()

@@ -663,18 +663,32 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     mask = None
     if kv is not None:
         k_cache, v_cache = kv  # [B, S_max, Hkv, hd]
-        k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
-                                           (0, pos_offset, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
-                                           (0, pos_offset, 0, 0))
+        if getattr(pos_offset, "ndim", 0) == 1:
+            # Per-row positions ([B] int32, T==1): each batch row writes
+            # its own cache slot row — the continuous-batching decode,
+            # where concurrent streams sit at different depths.  An
+            # out-of-range row position (an idle slot parked at max_seq)
+            # drops the write (jax scatter default), so idle slots decode
+            # garbage without corrupting live rows.
+            k_cache = k_cache.at[jnp.arange(B), pos_offset].set(
+                k[:, 0].astype(k_cache.dtype), mode="drop")
+            v_cache = v_cache.at[jnp.arange(B), pos_offset].set(
+                v[:, 0].astype(v_cache.dtype), mode="drop")
+            q_pos = pos_offset[:, None] + jnp.arange(T)  # [B, T]
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos_offset, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos_offset, 0, 0))
+            q_pos = (pos_offset + jnp.arange(T))[None, :]  # [1, T]
         kv = (k_cache, v_cache)
         k_all, v_all = k_cache.astype(dt), v_cache.astype(dt)
         S = k_all.shape[1]
         # Rows beyond the filled prefix are masked by key-position validity
         # (consumed only by the masked decode path below).
         k_pos = jnp.arange(S)
-        q_pos = pos_offset + jnp.arange(T)
-        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,T,S]
+        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
+        # [B or 1, 1, T, S]
     else:
         k_all, v_all = k, v
 
@@ -744,6 +758,21 @@ def init_cache(cfg: LlamaConfig, batch: int, dtype="bfloat16"):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def write_cache_slot(cache: Dict, slot_cache: Dict, slot) -> Dict:
+    """Copy a single-row cache (from a batch-1 prefill) into row ``slot``
+    of a multi-slot cache — how a new stream is admitted into a running
+    continuous-batching decode.  Shapes: cache [L, B, S, Hkv, hd],
+    slot_cache [L, 1, S, Hkv, hd]."""
+    from jax import lax
+
+    return {
+        name: lax.dynamic_update_slice(
+            cache[name], slot_cache[name].astype(cache[name].dtype),
+            (0, slot, 0, 0, 0))
+        for name in ("k", "v")
+    }
+
+
 def cache_pspecs() -> Dict:
     from jax.sharding import PartitionSpec as P
 
@@ -754,14 +783,21 @@ def cache_pspecs() -> Dict:
 def forward_cached(params, tokens, cache, pos_offset, cfg: LlamaConfig,
                    compute_dtype="bfloat16"):
     """Forward a suffix with KV cache: prefill (T=prompt) and decode (T=1)
-    are the SAME program at different T -> two XLA compilations total."""
+    are the SAME program at different T -> two XLA compilations total.
+
+    ``pos_offset`` may be a scalar (all rows at the same depth — the
+    single-stream path) or a [B] int32 vector (each row at its own depth
+    — the continuous-batching decode; requires T == 1)."""
     import jax
     import jax.numpy as jnp
 
     dt = jnp.dtype(compute_dtype)
     B, T = tokens.shape
     x = jnp.asarray(params["embed"]).astype(dt)[tokens]
-    positions = pos_offset + jnp.arange(T)[None, :]
+    if getattr(pos_offset, "ndim", 0) == 1:  # per-row positions ([B])
+        positions = pos_offset[:, None] + jnp.arange(T)[None, :]
+    else:
+        positions = pos_offset + jnp.arange(T)[None, :]
 
     def body(x, layer):
         lp, kc, vc = layer

@@ -340,3 +340,160 @@ class TestPrefillBucketing:
             list(fw.invoke_stream([np.arange(1, t + 1, dtype=np.int32)]))
         # jit cache: one prefill entry despite four prompt lengths
         assert fw._fwd._cache_size() == 1
+
+
+class TestPerRowPositionDecode:
+    """Foundation of continuous batching: a [B] position vector lets
+    concurrent streams sit at different depths in one decode program.
+    Per-row decode must match each stream decoded independently."""
+
+    def test_mixed_depth_decode_matches_independent(self):
+        import jax.numpy as jnp
+
+        cfg = llama.PRESETS["llama_tiny"]
+        params = llama.init_params(cfg, seed=0)
+        rng = np.random.default_rng(1)
+        lens = [4, 9]  # two streams at different depths
+        prompts = [rng.integers(1, cfg.vocab, (1, t), np.int32)
+                   for t in lens]
+
+        # independent reference: prefill+decode each stream alone
+        ref_logits = []
+        for p in prompts:
+            c = llama.init_cache(cfg, 1, dtype="float32")
+            _, c = llama.forward_cached(params, p, c, 0, cfg,
+                                        compute_dtype="float32")
+            nxt = np.array([[7]], np.int32)
+            lg, _ = llama.forward_cached(params, nxt, c, p.shape[1], cfg,
+                                         compute_dtype="float32")
+            ref_logits.append(np.asarray(lg[:, 0]))
+
+        # batched: admit both single-row prefills into a 2-slot cache,
+        # then ONE per-row-position decode step
+        big = llama.init_cache(cfg, 2, dtype="float32")
+        for slot, p in enumerate(prompts):
+            c = llama.init_cache(cfg, 1, dtype="float32")
+            _, c = llama.forward_cached(params, p, c, 0, cfg,
+                                        compute_dtype="float32")
+            big = llama.write_cache_slot(big, c, slot)
+        toks = np.array([[7], [7]], np.int32)
+        pos = jnp.asarray(np.array(lens, np.int32))
+        lg, big = llama.forward_cached(params, toks, big, pos, cfg,
+                                       compute_dtype="float32")
+        lg = np.asarray(lg[:, 0])
+        for row, ref in enumerate(ref_logits):
+            np.testing.assert_allclose(lg[row], ref[0], rtol=2e-4,
+                                       atol=2e-4)
+
+    def test_idle_slot_out_of_range_write_is_dropped(self):
+        import jax.numpy as jnp
+
+        cfg = llama.PRESETS["llama_tiny"]
+        params = llama.init_params(cfg, seed=0)
+        big = llama.init_cache(cfg, 2, dtype="float32")
+        before = np.asarray(big["k"]).copy()
+        toks = np.array([[3], [3]], np.int32)
+        # row 0 live at pos 0; row 1 idle, parked at max_seq (out of range)
+        pos = jnp.asarray(np.array([0, cfg.max_seq], np.int32))
+        _, big = llama.forward_cached(params, toks, big, pos, cfg,
+                                      compute_dtype="float32")
+        after = np.asarray(big["k"])
+        assert not np.array_equal(after[:, 0], before[:, 0])  # live row wrote
+        np.testing.assert_array_equal(after[:, 1], before[:, 1])  # idle didn't
+
+
+class TestContinuousServing:
+    """custom=serve:continuous — a standing decode loop with slot
+    admission (continuous batching).  Late requests join a RUNNING
+    decode at the next chunk boundary instead of waiting for the current
+    group to finish."""
+
+    def _fw(self, custom):
+        from nnstreamer_tpu.filters.llm import LLMFramework
+
+        fw = LLMFramework()
+        fw.open({"model": "llama_tiny", "custom": custom})
+        return fw
+
+    def test_greedy_matches_plain_streaming(self):
+        # temperature 0: the continuous loop must emit token-for-token
+        # what the plain per-request streaming path emits.
+        plain = self._fw("max_new:6,stream_chunk:2,temperature:0.0")
+        prompt = np.array([2, 8, 5, 1], np.int32)
+        want = [int(ids[0]) for ids, _ in plain.invoke_stream([prompt])]
+        plain.close()
+
+        fw = self._fw("max_new:6,stream_chunk:2,temperature:0.0,"
+                      "serve:continuous,slots:2")
+        got = []
+        fw.submit([prompt], {}, lambda t, m: got.append(
+            (int(t[0][0]), m["stream_index"], m.get("stream_last", False))))
+        assert fw.drain(timeout=120)
+        fw.close()
+        assert [g[0] for g in got] == want
+        assert [g[1] for g in got] == list(range(6))
+        assert got[-1][2] is True
+
+    def test_late_request_joins_running_decode(self):
+        # Stream A is long; B arrives AFTER A started.  In a static group
+        # B would wait for A to finish; continuous admission means B's
+        # tokens arrive interleaved with A's remaining tokens.
+        import threading
+        import time
+
+        fw = self._fw("max_new:24,stream_chunk:2,temperature:0.0,"
+                      "serve:continuous,slots:2")
+        events = []
+        lock = threading.Lock()
+
+        def emit_for(rid):
+            def emit(t, m):
+                with lock:
+                    events.append((rid, m["stream_index"]))
+            return emit
+
+        fw.submit([np.array([1, 5, 9, 2], np.int32)], {}, emit_for("A"))
+        # wait until A has demonstrably started streaming
+        deadline = time.monotonic() + 60
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert events, "stream A never started"
+        fw.submit([np.array([3, 3, 7, 8], np.int32)], {}, emit_for("B"))
+        assert fw.drain(timeout=120)
+        fw.close()
+        a_idx = [i for i, e in enumerate(events) if e[0] == "A"]
+        b_idx = [i for i, e in enumerate(events) if e[0] == "B"]
+        assert len(a_idx) == 24 and len(b_idx) == 24
+        # the continuous property: B started before A finished
+        assert b_idx[0] < a_idx[-1]
+        # per-stream ordering intact
+        for idxs in ([e[1] for e in events if e[0] == "A"],
+                     [e[1] for e in events if e[0] == "B"]):
+            assert idxs == list(range(24))
+
+    def test_more_requests_than_slots_queue(self):
+        fw = self._fw("max_new:4,stream_chunk:2,temperature:0.0,"
+                      "serve:continuous,slots:1")
+        done = []
+        for rid in range(3):
+            fw.submit([np.array([1 + rid, 5, 9], np.int32)], {"rid": rid},
+                      lambda t, m: done.append(m["rid"])
+                      if m.get("stream_last") else None)
+        assert fw.drain(timeout=180)
+        fw.close()
+        assert sorted(done) == [0, 1, 2]
+
+    def test_pipeline_eos_waits_for_streams(self):
+        import nnstreamer_tpu as nt
+
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_filter framework=llm model=llama_tiny "
+            "custom=max_new:5,serve:continuous,slots:2,temperature:0.0 "
+            "invoke-dynamic=true ! tensor_sink name=out")
+        with p:
+            p.push("src", np.array([1, 5, 9, 2], np.int32))
+            p.push("src", np.array([3, 3, 7, 8], np.int32))
+            p.eos("src")  # EOS while both streams are mid-flight
+            bufs = [p.pull("out", timeout=120) for _ in range(10)]
+            p.wait(timeout=120)
+        assert sum(1 for b in bufs if b.meta.get("stream_last")) == 2

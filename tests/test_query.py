@@ -527,3 +527,41 @@ class TestDynamicBatching:
                     and _t.monotonic() < deadline:
                 _t.sleep(0.02)
             assert srv.element("f")._n_invoked == 1
+
+
+def test_continuous_serving_behind_query_server():
+    """serve:continuous behind the query pair: clients arriving while
+    earlier streams are mid-decode get admitted into the running loop,
+    and each receives its own complete ordered stream (continuous
+    batching as a SERVICE — static max-batch grouping would make a late
+    client wait for the whole running group)."""
+    import contextlib
+
+    max_new = 8
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=44 ! "
+        f"tensor_filter framework=llm model=llama_tiny "
+        f"custom=max_new:{max_new},serve:continuous,slots:2,"
+        "stream_chunk:2,temperature:0.0 invoke-dynamic=true ! "
+        "tensor_query_serversink id=44"
+    )
+    with srv, contextlib.ExitStack() as stack:
+        port = srv.element("ssrc").bound_port
+        clients = [stack.enter_context(nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "timeout=60 ! tensor_sink name=out")) for _ in range(3)]
+        # stagger: client 0 starts, then 1 and 2 join mid-decode
+        clients[0].push("src", np.array([1, 5, 9, 2], np.int32))
+        clients[0].pull("out", timeout=60)  # stream 0 demonstrably live
+        clients[1].push("src", np.array([3, 3, 7, 8], np.int32))
+        clients[2].push("src", np.array([6, 1, 4, 4], np.int32))
+        for ci, c in enumerate(clients):
+            n = max_new - (1 if ci == 0 else 0)  # client 0 pulled one
+            toks = [c.pull("out", timeout=60) for _ in range(n)]
+            assert toks[-1].meta.get("stream_last") is True
+            start = 1 if ci == 0 else 0
+            assert [t.meta["stream_index"] for t in toks] == \
+                list(range(start, max_new))
+        for c in clients:
+            c.eos("src")
+            c.wait(timeout=15)
