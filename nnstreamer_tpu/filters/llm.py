@@ -499,10 +499,17 @@ class _ContinuousLoop:
                     slots[slot] = (meta, emit)
                 progressed = True
 
-            # 2. one chunk of per-row decode for the live slots
+            # 2. one chunk of per-row decode for the live slots.  The
+            # chunk length is ALWAYS fw.chunk: a variable tail length
+            # would compile a fresh 7B program per distinct value (the
+            # remote-compile cost dwarfs the tokens it saves — measured
+            # 3x throughput loss).  Streams that finish mid-chunk simply
+            # have their overshoot tokens discarded (their rows keep
+            # decoding garbage until chunk end; out-of-range cache
+            # writes drop, outputs are never emitted).
             live = remaining > 0
             if live.any():
-                length = int(min(fw.chunk, remaining[live].min()))
+                length = fw.chunk
                 toks, tokj, cache, key, posj = self._decode_rows(
                     params, jnp.asarray(tok), cache, key,
                     jnp.asarray(pos), length=length)
@@ -512,6 +519,8 @@ class _ContinuousLoop:
                 tok, pos = np.array(tokj), np.array(posj)
                 for j in range(length):
                     for s in np.flatnonzero(live):
+                        if remaining[s] == 0:
+                            continue  # finished mid-chunk: discard
                         meta, emit = slots[s]
                         last = remaining[s] == 1
                         self._emit_token(emit, meta, int(host[s, j]),
