@@ -328,6 +328,66 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int,
     )
 
 
+def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
+                          streams: int, model: str, quant: str) -> dict:
+    """Continuous batching: stagger ``streams`` prompts into the RUNNING
+    decode loop; report aggregate tokens/sec plus the late joiner's
+    first-token latency (the metric continuous batching exists for —
+    a static group would hold it until the whole running group ends).
+
+    Token accounting uses the serve loop's per-token ``emit_t`` meta, not
+    pull times: tokens queue at the sink while a pull blocks, so wall
+    clocks around pulls would count tokens generated outside the window.
+    The late joiner's first token is identified by stream identity (the
+    SECOND buffer arriving with stream_index 0), not by pull order —
+    stream 0's whole first chunk precedes the joiner's admission."""
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+
+    def tagged(base):  # distinguishes streams at the shared sink
+        b = nt.Buffer([base])
+        b.meta["bench_stream"] = tagged.n
+        tagged.n += 1
+        return b
+    tagged.n = 0
+
+    with p:
+        p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
+                                          dtype=np.int32)))
+        first = p.pull("out", timeout=900)  # stream 0 live (+compile)
+        t_join = time.monotonic()
+        p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
+                                          dtype=np.int32)))
+        for _ in range(streams - 2):
+            p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
+                                              dtype=np.int32)))
+        total = streams * max_new - 1
+        bufs = [p.pull("out", timeout=900) for _ in range(total)]
+        p.eos()
+        p.wait(timeout=120)
+    join = next(b for b in bufs
+                if b.meta["bench_stream"] == 1
+                and b.meta["stream_index"] == 0)
+    join_ms = (join.meta["emit_t"] - t_join) * 1e3
+    # generation-window throughput: emission timestamps of every token
+    # after stream 0's first (which carries compile + weight gen)
+    emits = sorted(b.meta["emit_t"] for b in bufs)
+    wall = emits[-1] - first.meta["emit_t"]
+    tps = len(emits) / wall
+    return {
+        "metric": (f"{model}_{quant or 'bf16'}_continuous_tokens_per_sec"
+                   f"_{streams}_streams"),
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / 20.0, 3),
+        "streams": streams,
+        "max_new": max_new,
+        "late_join_first_token_ms": round(join_ms, 1),
+        "wall_s": round(wall, 3),
+    }
+
+
 def bench_segmentation(batch: int, batches: int, size: int,
                        warmup: int) -> dict:
     """Segmentation family: deeplab + fused image_segment decode (device
@@ -415,7 +475,8 @@ def bench_audio(batch: int, batches: int, warmup: int,
 
 def bench_llm(batches: int, warmup: int, model: str = "llama_small",
               max_new: int = 64, prompt_len: int = 32,
-              quant: str = "", streams: int = 1) -> dict:
+              quant: str = "", streams: int = 1,
+              serve: str = "") -> dict:
     """Config #5: tokens/sec through the llm filter (jitted prefill +
     lax.scan decode).  vs_baseline compares against the reference's
     llama.cpp CPU path order of magnitude (~20 tok/s).
@@ -436,18 +497,30 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         # max_seq x B) AND XLA materializes layout-change copies of it,
         # so size it to the workload — 8 streams at max_seq:1024 blew a
         # 16 GB chip's HBM by 0.2 GB on the cache copies alone.
-        max_seq = 1024 if streams == 1 else max(
-            256, 1 << (prompt_len + max_new).bit_length())
+        max_seq = (1024 if streams == 1 and serve != "continuous"
+                   else max(256, 1 << (prompt_len + max_new).bit_length()))
         custom += f",param_dtype:bfloat16,max_seq:{max_seq},stream_chunk:32"
     if quant:
         # weight-only int8: halves HBM bytes/token on the decode step
         custom += f",quant:{quant}"
+    n_streams = max(2, streams)
+    if serve == "continuous":
+        # admission granularity = one chunk; slots sized to the stream mix
+        custom += f",serve:continuous,slots:{n_streams}"
+    # invoke-dynamic only for the continuous path: the committed static
+    # rows were measured without it, and it must stay that way so this
+    # commit reproduces the artifact's exact pipelines.
+    dyn = "invoke-dynamic=true ! " if serve == "continuous" else ""
     desc = (
         "appsrc name=src ! "
-        f"tensor_filter framework=llm model={model} custom={custom} ! "
+        f"tensor_filter framework=llm model={model} custom={custom} "
+        f"{dyn}"
         "tensor_sink name=out"
     )
     p = nt.Pipeline(desc)
+    if serve == "continuous":
+        return _bench_llm_continuous(p, rng, max_new, prompt_len,
+                                     n_streams, model, quant)
     toks = 0
     with p:
         # streams>1: N concurrent prompts decode in ONE lax.scan loop.
@@ -551,6 +624,9 @@ def main() -> int:
     ap.add_argument("--llm-streams", type=int, default=1,
                     help="concurrent prompts decoded in one batched scan "
                          "(aggregate tokens/sec reported)")
+    ap.add_argument("--llm-serve", default="", choices=["", "continuous"],
+                    help="continuous: staggered prompts join a RUNNING "
+                         "decode loop (reports late-join latency too)")
     ap.add_argument("--source", default="videotestsrc",
                     choices=["videotestsrc", "appsrc"],
                     help="classification config: device-generated test "
@@ -616,10 +692,12 @@ def main() -> int:
         "llm": lambda: bench_llm(max(1, args.batches // 8), 1,
                                  model=args.llm_model,
                                  quant=args.llm_quant,
-                                 streams=args.llm_streams),
+                                 streams=args.llm_streams,
+                                 serve=args.llm_serve),
         "llm7b": lambda: bench_llm(2, 1, model="llama2_7b",
                                    quant=args.llm_quant,
-                                   streams=args.llm_streams),
+                                   streams=args.llm_streams,
+                                   serve=args.llm_serve),
     }
     todo = list(runners) if args.config == "all" else [args.config]
     if args.config == "all":

@@ -33,6 +33,7 @@ slot.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -401,6 +402,11 @@ class _ContinuousLoop:
                     last: bool) -> None:
         out_meta = dict(meta)
         out_meta["stream_index"] = index
+        # Serving telemetry: when THIS token left the decode loop
+        # (monotonic seconds).  Lets consumers measure generation-window
+        # throughput precisely instead of inferring it from pull times,
+        # which lag emission by queue dwell.
+        out_meta["emit_t"] = time.monotonic()
         if last:
             out_meta["stream_last"] = True
         piece = self.fw.tokenizer.decode_piece(token_id)

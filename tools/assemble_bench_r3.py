@@ -15,17 +15,20 @@ for d in raw:
     else:
         failed.append({"tag": d["tag"], "rc": d["rc"]})
 out = {
-    "note": "round-3 measurements on the single tunneled v5e chip: the "
-            "12 base configs are one sequential sweep session (plus "
-            "SMOKE_r3.json from the same session); the llm7b_int8_x8/_x16 "
-            "multi-stream rows were recorded in a follow-up session at the "
-            "commit that introduced --llm-streams.  Cross-session "
-            "chip/tunnel-state variance is ~1.5-2x — claims are "
-            "restricted to THIS artifact",
+    "note": "round-3 measurements on the single tunneled v5e chip across "
+            "THREE sessions: the 12 base configs are one sequential sweep "
+            "(plus SMOKE_r3.json from the same session); the "
+            "llm7b_int8_x8/_x16 rows a follow-up session at the commit "
+            "introducing --llm-streams; llm7b_int8_continuous_x4 a third "
+            "session at the commit introducing --llm-serve (throughput "
+            "from per-token emit_t timestamps, not pull walls).  "
+            "Cross-session chip/tunnel-state variance is ~1.5-2x — "
+            "claims are restricted to THIS artifact",
     "assembled_at_commit": assembled_at,
     "measured_at": "base sweep spanned d2e25c8..8328f4c (mid-sweep commits "
                    "touched only query batching, not measured paths); "
-                   "llm7b_int8_x8/_x16 rows at 0e51944",
+                   "llm7b_int8_x8/_x16 rows at 0e51944; "
+                   "llm7b_int8_continuous_x4 at the --llm-serve commit",
     "device": "TPU v5 lite (1 chip, axon tunnel)",
     "parity_bar": "250 fps/chip (vs_baseline 1.0) per BASELINE.json north "
                   "star; llm vs ~20 tok/s llama.cpp-class",
