@@ -214,3 +214,48 @@ def test_concurrent_streaming_clients_one_server():
         direct = [int(i[0]) for i, _ in fw.invoke_stream(
             [np.array([cid + 1, 7, 3], np.int32)])]
         assert results[cid][1] == direct
+
+
+def test_continuous_serving_under_client_churn():
+    """Continuous serving survives a rolling population: three WAVES of
+    clients join the same standing decode loop (slots recycled across
+    waves), with one early-terminating client per wave; every surviving
+    client gets its full ordered stream.  (The guaranteed
+    failed-send-mid-stream race is pinned separately by
+    test_client_disconnect_mid_batched_stream_isolated.)"""
+    import contextlib
+
+    max_new = 5
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=70 ! "
+        f"tensor_filter framework=llm model=llama_tiny "
+        f"custom=max_new:{max_new},serve:continuous,slots:2,stream_chunk:2,"
+        "temperature:0.0 invoke-dynamic=true ! "
+        "tensor_query_serversink id=70"
+    )
+    rng = np.random.default_rng(0)
+    with srv:
+        port = srv.element("ssrc").bound_port
+        completed = 0
+        for wave in range(3):
+            with contextlib.ExitStack() as stack:
+                clients = [stack.enter_context(nt.Pipeline(
+                    f"appsrc name=src ! tensor_query_client port={port} "
+                    "timeout=60 ! tensor_sink name=out")) for _ in range(3)]
+                for c in clients:
+                    c.push("src", rng.integers(
+                        1, 200, (4,), dtype=np.int32))
+                # client 0 of each wave disconnects after one token
+                clients[0].pull("out", timeout=60)
+                clients[0].stop()
+                for c in clients[1:]:
+                    toks = [c.pull("out", timeout=60)
+                            for _ in range(max_new)]
+                    assert toks[-1].meta.get("stream_last") is True
+                    assert [t.meta["stream_index"] for t in toks] == \
+                        list(range(max_new))
+                    completed += 1
+                for c in clients[1:]:
+                    c.eos("src")
+                    c.wait(timeout=15)
+        assert completed == 6
