@@ -355,6 +355,7 @@ class _ContinuousLoop:
         # return with a live request pending and EOS would cut it off.
         self._idle_lock = threading.Lock()
         self._error: Optional[BaseException] = None
+        self._admitting = None  # (meta, emit) mid-admission, crash-visible
 
         def decode_rows(params, tok, cache, key, pos, length):
             def step(carry, _):
@@ -381,10 +382,15 @@ class _ContinuousLoop:
 
     # -- producer side -----------------------------------------------------
     def submit(self, prompt, meta: Dict, emit) -> None:
-        if self._error is not None:
-            raise FrameworkError(
-                f"continuous serve loop died: {self._error!r}")
+        # The error check lives INSIDE the lock: the crash handler drains
+        # _pending and sets _idle under the same lock, so a submit cannot
+        # slip a request into a dead loop's queue between its own error
+        # check and its put (that request would never be dequeued or
+        # aborted — a hung client).
         with self._idle_lock:
+            if self._error is not None:
+                raise FrameworkError(
+                    f"continuous serve loop died: {self._error!r}")
             self._idle.clear()
             self._pending.put((prompt, meta, emit))
         self._wake.set()
@@ -419,31 +425,35 @@ class _ContinuousLoop:
             self._run_inner()
         except BaseException as e:  # noqa: BLE001 - daemon thread: report
             log.exception("continuous serve loop died")
-            self._error = e
-            # Terminate every live and queued stream so no client hangs
-            # to its timeout waiting on a dead loop.
+
+            def abort(meta, emit, idx=0):
+                try:
+                    self._emit_token(
+                        emit, {**meta, "stream_aborted": True}, 0, idx,
+                        True)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            # Terminate every live, mid-admission, and queued stream so
+            # no client hangs to its timeout waiting on a dead loop.  The
+            # queue drain + idle-set run under _idle_lock, pairing with
+            # submit(): no request can enter the queue after the drain.
             import queue as _q
 
             for slot in list(getattr(self, "_live_slots", []) or []):
                 if slot is not None:
-                    meta, emit = slot
+                    abort(slot[0], slot[1], 1 << 30)
+            if self._admitting is not None:
+                abort(*self._admitting)
+            with self._idle_lock:
+                self._error = e
+                while True:
                     try:
-                        self._emit_token(
-                            emit, {**meta, "stream_aborted": True}, 0,
-                            1 << 30, True)
-                    except Exception:  # noqa: BLE001
-                        pass
-            while True:
-                try:
-                    _, meta, emit = self._pending.get_nowait()
-                except _q.Empty:
-                    break
-                try:
-                    self._emit_token(
-                        emit, {**meta, "stream_aborted": True}, 0, 0, True)
-                except Exception:  # noqa: BLE001
-                    pass
-            self._idle.set()
+                        _, meta, emit = self._pending.get_nowait()
+                    except _q.Empty:
+                        break
+                    abort(meta, emit)
+                self._idle.set()
 
     def _run_inner(self) -> None:
         import queue as _q
@@ -477,11 +487,17 @@ class _ContinuousLoop:
                     break
                 slot = int(free[fi])
                 fi += 1
+                # Crash-visibility marker: a request mid-admission is in
+                # neither _pending nor a slot — without this, a loop
+                # failure during ITS prefill would orphan it (its client
+                # would hang to timeout instead of seeing stream_aborted).
+                self._admitting = (meta, emit)
                 T = prompt.shape[1]
                 if T >= cfg.max_seq:
                     # reject oversize prompts with a terminated stream
                     self._emit_token(emit, {**meta, "stream_aborted": True},
                                      0, 0, True)
+                    self._admitting = None
                     continue
                 small = llama.init_cache(cfg, 1, dtype=fw.dtype)
                 P = T
@@ -503,6 +519,7 @@ class _ContinuousLoop:
                     remaining[slot] = n - 1
                     sidx[slot] = 1
                     slots[slot] = (meta, emit)
+                self._admitting = None
                 progressed = True
 
             # 2. one chunk of per-row decode for the live slots.  The

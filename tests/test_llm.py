@@ -497,3 +497,58 @@ class TestContinuousServing:
             bufs = [p.pull("out", timeout=120) for _ in range(10)]
             p.wait(timeout=120)
         assert sum(1 for b in bufs if b.meta.get("stream_last")) == 2
+
+    def test_continuous_with_tensor_parallel(self):
+        # serve:continuous composes with custom=tp:N — the sharded params
+        # flow through admission prefill and the per-row decode; greedy
+        # ids must match the unsharded continuous loop.
+        prompt = np.array([4, 9, 1, 7], np.int32)
+
+        def run(custom):
+            fw = self._fw(custom)
+            got = []
+            fw.submit([prompt], {}, lambda t, m: got.append(int(t[0][0])))
+            assert fw.drain(timeout=120)
+            fw.close()
+            return got
+
+        base = "max_new:5,stream_chunk:2,temperature:0.0,serve:continuous"
+        ids = run(base + ",slots:2")
+        ids_tp = run(base + ",slots:2,tp:2")
+        assert ids_tp == ids
+
+    def test_serve_loop_crash_terminates_streams(self, monkeypatch):
+        # A dying loop must terminate every live and queued stream with
+        # stream_aborted (clients never hang to their timeouts) and make
+        # subsequent submits fail loudly.
+        from nnstreamer_tpu.filters import llm as llm_mod
+        from nnstreamer_tpu.filters.llm import FrameworkError
+
+        fw = self._fw("max_new:8,stream_chunk:2,temperature:0.0,"
+                      "serve:continuous,slots:1")
+        calls = {"n": 0}
+        real = llm_mod.llama.sample_token
+
+        def dying(*a, **k):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise RuntimeError("injected serve-loop failure")
+            return real(*a, **k)
+
+        monkeypatch.setattr(llm_mod.llama, "sample_token", dying)
+        got = []
+        fw.submit([np.array([1, 5, 9], np.int32)], {},
+                  lambda t, m: got.append(dict(m)))
+        # a second request queued behind the doomed one must also be
+        # terminated, not stranded
+        fw.submit([np.array([2, 6, 8], np.int32)], {},
+                  lambda t, m: got.append(dict(m)))
+        # drain() returns only after the crash handler has emitted every
+        # stream_aborted terminator (it sets idle last), so the asserts
+        # need no further synchronization.
+        assert fw.drain(timeout=60)
+        assert any(m.get("stream_aborted") and m.get("stream_last")
+                   for m in got), got
+        with pytest.raises(FrameworkError, match="serve loop died"):
+            fw.submit([np.array([3], np.int32)], {}, lambda t, m: None)
+        fw.close()
