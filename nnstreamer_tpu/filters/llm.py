@@ -84,6 +84,10 @@ class LLMFramework(Framework):
     ``stream_chunk:N`` (tokens decoded per device roundtrip, default 8;
     1 = strict per-token streaming),
     ``tp:N`` (tensor-parallel ways over a ``model`` mesh axis),
+    ``serve:continuous`` + ``slots:N`` (continuous batching: a standing
+    per-row-position decode loop that admits queued prompts into free
+    slots at chunk boundaries — see :class:`_ContinuousLoop`),
+    ``quant:int8`` (weight-only int8),
     ``dtype:bfloat16|float32``, plus any model-builder options
     (``dim:…``, ``n_layers:…``) forwarded to the zoo.
     """
