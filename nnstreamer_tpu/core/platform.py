@@ -53,12 +53,21 @@ def enable_compilation_cache(path: str | None = None) -> None:
         return
     if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
         return  # CPU AOT cache = SIGILL hazard; see docstring
+    # The env string alone is not enough: on a host with no TPU and no
+    # JAX_PLATFORMS, jax silently resolves to CPU — ask the backend.
+    # default_backend() initializes the backend, which scripts calling
+    # this at startup are about to do anyway.
+    import jax
+
+    try:
+        if jax.default_backend() == "cpu":
+            return
+    except Exception:  # noqa: BLE001 - no backend at all: nothing to cache
+        return
     if path is None:
         path = env or os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), ".xla_cache")
-    import jax
-
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -19,9 +19,12 @@ import numpy as np
 
 from ..core.buffer import Buffer
 from ..core.caps import Caps, MediaType
+from ..core.log import logger, metrics
 from ..core.registry import register_element
 from ..core.types import TensorSpec, TensorsSpec
 from .base import Element, ElementError, SRC
+
+log = logger(__name__)
 
 
 @register_element("tee")
@@ -100,8 +103,15 @@ class _SyncModes:
                 self._pending_base.append(buf)
                 # Bounded like the reference's collectpad queues: a pad
                 # that never catches up must not grow memory without limit.
+                # Counted like every other drop path — a stalled non-base
+                # pad must be observable, not silent data loss.
                 if len(self._pending_base) > 64:
                     del self._pending_base[0]
+                    metrics.count(f"{self.name}.basepad_evicted")
+                    log.warning(
+                        "%s: basepad queue full (64); evicting oldest "
+                        "held base buffer — a non-base pad is stalled",
+                        self.name)
             if not set(self.in_caps) <= set(self._latest):
                 return []  # caps need every tensor: one-per-pad first
             return self._drain_basepad()

@@ -26,13 +26,16 @@ Pipeline usage::
 
 Input: one uint8 tensor (UTF-8 prompt bytes) or int32 token ids ``[T]`` /
 ``[B, T]``.  Output per token: ``[B]`` int32 token ids + uint8 piece bytes
-(batch 1 only), as FLEXIBLE tensors.  Tokenization is byte-level (no egress
-for real vocab files); a real tokenizer drops into :class:`ByteTokenizer`'s
-slot.
+(batch 1 only), as FLEXIBLE tensors.  Tokenization uses the checkpoint's
+own SentencePiece vocab when the model file carries one (GGUF
+``tokenizer.ggml.*`` -> models/tokenizer.py) and falls back to byte-level
+ids otherwise; with a real vocab, generation stops at the model's EOS
+token like the reference sub-plugin.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Iterator, List, Optional, Sequence
 
@@ -81,6 +84,11 @@ class LLMFramework(Framework):
     """Streaming generation.  ``custom=`` options:
 
     ``max_new:N`` (default 32), ``temperature:F`` (0 = greedy), ``seed:N``,
+    ``top_k:N`` / ``top_p:F`` (sampler truncation, compiled into the
+    decode program — llama.cpp's sampler-chain analog),
+    ``tokenizer:PATH`` (a .gguf whose ``tokenizer.ggml.*`` vocab is used
+    for text; defaults to the model file's own vocab when it has one,
+    byte-level otherwise),
     ``stream_chunk:N`` (tokens decoded per device roundtrip, default 8;
     1 = strict per-token streaming),
     ``tp:N`` (tensor-parallel ways over a ``model`` mesh axis),
@@ -102,11 +110,15 @@ class LLMFramework(Framework):
         self.tokenizer = ByteTokenizer()
         self.max_new = 32
         self.temperature = 0.0
+        self.top_k = 0
+        self.top_p = 1.0
         self.seed = 0
+        self.stop_eos = False
         self.mesh = None
         self._fwd = None
         self.continuous = False
         self._serve: Optional["_ContinuousLoop"] = None
+        self._serve_lock = threading.Lock()
 
     def open(self, props: Dict[str, object]) -> None:
         super().open(props)
@@ -114,7 +126,11 @@ class LLMFramework(Framework):
         opts = parse_custom_options(str(props.get("custom", "")))
         self.max_new = int(opts.pop("max_new", 32))
         self.temperature = float(opts.pop("temperature", 0.0))
+        self.top_k = int(opts.pop("top_k", 0))
+        self.top_p = float(opts.pop("top_p", 1.0))
         self.seed = int(opts.pop("seed", 0))
+        tok_path = opts.pop("tokenizer", None)
+        stop_opt = opts.pop("stop_eos", None)
         # Tokens decoded per device roundtrip (stream granularity): tokens
         # still stream downstream one-by-one, in bursts of this size.
         self.chunk = max(1, int(opts.pop("stream_chunk", 8)))
@@ -138,6 +154,36 @@ class LLMFramework(Framework):
                 f"model {model!r} has no LlamaConfig; the llm framework needs "
                 "a decoder-LM bundle (models/llama.py)"
             )
+        # Tokenizer priority: explicit custom=tokenizer:PATH, then the
+        # model file's own embedded vocab, then the byte-level fallback.
+        if tok_path is not None:
+            from ..models.tokenizer import load_gguf_tokenizer
+
+            tok = load_gguf_tokenizer(str(tok_path))
+            if tok is None:
+                raise FrameworkError(
+                    f"tokenizer file {tok_path!r} carries no "
+                    "tokenizer.ggml.tokens vocab")
+            self.tokenizer = tok
+        elif getattr(self.bundle, "tokenizer", None) is not None:
+            self.tokenizer = self.bundle.tokenizer
+        n_tok = getattr(self.tokenizer, "n_vocab", 0)
+        if n_tok > self.cfg.vocab:
+            # XLA CLAMPS out-of-range embedding gathers instead of
+            # raising — a vocab bigger than the model would silently
+            # generate from wrong embeddings
+            raise FrameworkError(
+                f"tokenizer vocab ({n_tok}) exceeds model vocab "
+                f"({self.cfg.vocab}); wrong tokenizer for this model")
+        # EOS terminates generation when a real vocab is in play (the
+        # llama.cpp contract); byte-level ids keep fixed-length decode so
+        # synthetic-model tests and benches stay deterministic.
+        # Override with custom=stop_eos:0/1.
+        stop = stop_opt
+        if stop is None:
+            self.stop_eos = not isinstance(self.tokenizer, ByteTokenizer)
+        else:
+            self.stop_eos = str(stop).lower() not in ("0", "false", "no")
         self._setup(tp)
 
     def _setup(self, tp: int) -> None:
@@ -174,6 +220,7 @@ class LLMFramework(Framework):
         self._fwd = jax.jit(fwd, static_argnums=(3,), donate_argnums=(2,))
 
         temperature = self.temperature
+        top_k, top_p = self.top_k, self.top_p
 
         def decode_chunk(params, tok, cache, key, pos0, length):
             """`length` decode steps as ONE program (lax.scan): the host sees
@@ -188,7 +235,8 @@ class LLMFramework(Framework):
                 logits, cache = llama.forward_cached(
                     params, tok[:, None], cache, pos0 + i, cfg,
                     compute_dtype=self.dtype)
-                nxt = llama.sample_token(logits[:, -1], sub, temperature)
+                nxt = llama.sample_token(logits[:, -1], sub, temperature,
+                                         top_k, top_p)
                 return (nxt, cache, key), nxt
 
             (tok, cache, key), toks = lax.scan(
@@ -212,8 +260,14 @@ class LLMFramework(Framework):
         (``custom=serve:continuous``).  ``emit(tensors, meta)`` is called
         from the serve thread once per generated token, carrying the
         request's meta plus stream_index/stream_last."""
+        # Lock the lazy creation: two first-submits racing from different
+        # threads must not spawn two serve loops (duplicate slot caches,
+        # split streams) — the framework API stays safe outside the
+        # single-runner pipeline assumption.
         if self._serve is None:
-            self._serve = _ContinuousLoop(self)
+            with self._serve_lock:
+                if self._serve is None:
+                    self._serve = _ContinuousLoop(self)
         self._serve.submit(self._to_tokens(inputs[0]), meta, emit)
 
     def drain(self, timeout: float = 600.0) -> bool:
@@ -276,8 +330,15 @@ class LLMFramework(Framework):
         # steps feed at positions T..T+n-2, each of which must stay
         # < max_seq.
         n = max(1, min(self.max_new, cfg.max_seq - T))
-        tok = llama.sample_token(logits[:, T - 1], key, self.temperature)
-        yield np.asarray(tok)
+        # EOS termination (batch-1 streams; batched rows finish at their
+        # own depths, so callers slice on ids themselves)
+        eos = getattr(self.tokenizer, "eos", -1) if self.stop_eos else -1
+        tok = llama.sample_token(logits[:, T - 1], key, self.temperature,
+                                 self.top_k, self.top_p)
+        first = np.asarray(tok)
+        yield first
+        if B == 1 and int(first[0]) == eos:
+            return
         done = 1
         pos = T
         while done < n:
@@ -290,6 +351,8 @@ class LLMFramework(Framework):
             host = np.asarray(toks)  # ONE roundtrip per chunk
             for j in range(length):
                 yield host[:, j]
+                if B == 1 and int(host[0, j]) == eos:
+                    return
             done += length
             pos += length
 
@@ -368,7 +431,8 @@ class _ContinuousLoop:
                 logits, cache = llama.forward_cached(
                     params, tok[:, None], cache, pos, cfg,
                     compute_dtype=fw.dtype)
-                nxt = llama.sample_token(logits[:, -1], sub, temperature)
+                nxt = llama.sample_token(logits[:, -1], sub, temperature,
+                                         fw.top_k, fw.top_p)
                 return (nxt, cache, key, pos + 1), nxt
 
             (tok, cache, key, pos), toks = lax.scan(
@@ -476,6 +540,7 @@ class _ContinuousLoop:
         self._live_slots = slots  # visible to the crash terminator
         tok = np.zeros((B,), np.int32)
         key = jax.random.PRNGKey(fw.seed)
+        eos = getattr(fw.tokenizer, "eos", -1) if fw.stop_eos else -1
 
         from ..core.config import get_config as _gc
 
@@ -514,10 +579,12 @@ class _ContinuousLoop:
                 key, sub = jax.random.split(key)
                 first = int(np.asarray(
                     llama.sample_token(logits[:, T - 1], sub,
-                                       fw.temperature))[0])
+                                       fw.temperature, fw.top_k,
+                                       fw.top_p))[0])
                 n = max(1, min(fw.max_new, cfg.max_seq - T))
-                self._emit_token(emit, meta, first, 0, n == 1)
-                if n > 1:
+                first_last = n == 1 or first == eos
+                self._emit_token(emit, meta, first, 0, first_last)
+                if not first_last:
                     tok[slot] = first
                     pos[slot] = T
                     remaining[slot] = n - 1
@@ -549,14 +616,22 @@ class _ContinuousLoop:
                         if remaining[s] == 0:
                             continue  # finished mid-chunk: discard
                         meta, emit = slots[s]
-                        last = remaining[s] == 1
-                        self._emit_token(emit, meta, int(host[s, j]),
+                        tokid = int(host[s, j])
+                        last = remaining[s] == 1 or tokid == eos
+                        self._emit_token(emit, meta, tokid,
                                          int(sidx[s]), bool(last))
                         sidx[s] += 1
                         remaining[s] -= 1
                         if last:
                             slots[s] = None
+                            remaining[s] = 0
                             pos[s] = cfg.max_seq  # park the slot
+                # Re-park EVERY idle row, not just newly-finished ones:
+                # the device advanced all rows by `length`, so a
+                # long-parked row's int32 position would otherwise creep
+                # toward wraparound (negative positions turn dropped
+                # cache writes into corrupting in-range ones).
+                pos[remaining == 0] = cfg.max_seq
                 progressed = True
 
             if not progressed:

@@ -96,6 +96,35 @@ class _Reader:
         raise GGUFError(f"unknown metadata value type {vtype}")
 
 
+def _read_header(path: str, r: "_Reader") -> Tuple[int, Dict]:
+    """magic + version + counts + key-value section, shared by
+    :func:`read` and :func:`read_metadata`.  Returns (n_tensors, meta)
+    with the reader positioned at the tensor-descriptor table."""
+    if r.u32() != _MAGIC:
+        raise GGUFError(f"{path}: not a GGUF file (bad magic)")
+    version = r.u32()
+    if version not in (2, 3):
+        raise GGUFError(f"{path}: unsupported GGUF version {version}")
+    n_tensors = r.u64()
+    n_kv = r.u64()
+    meta: Dict = {}
+    for _ in range(n_kv):
+        key = r.s()
+        vtype = r.u32()
+        meta[key] = r.value(vtype)
+    return n_tensors, meta
+
+
+def read_metadata(path: str) -> Dict:
+    """Parse only the header + key-value section (no tensor descriptors):
+    the cheap path for vocab/config sniffing (models/tokenizer.py)."""
+    import os
+
+    with open(path, "rb") as f:
+        _, meta = _read_header(path, _Reader(f, os.path.getsize(path)))
+        return meta
+
+
 def read(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
     """Returns (metadata, tensors).  Tensor arrays are memmap-backed and
     shaped in numpy (outermost-first) order — ggml dims are stored
@@ -104,18 +133,7 @@ def read(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
 
     with open(path, "rb") as f:
         r = _Reader(f, os.path.getsize(path))
-        if r.u32() != _MAGIC:
-            raise GGUFError(f"{path}: not a GGUF file (bad magic)")
-        version = r.u32()
-        if version not in (2, 3):
-            raise GGUFError(f"{path}: unsupported GGUF version {version}")
-        n_tensors = r.u64()
-        n_kv = r.u64()
-        meta: Dict = {}
-        for _ in range(n_kv):
-            key = r.s()
-            vtype = r.u32()
-            meta[key] = r.value(vtype)
+        n_tensors, meta = _read_header(path, r)
         infos = []
         for _ in range(n_tensors):
             name = r.s()
@@ -214,9 +232,15 @@ def llama_to_tensors(params: Dict, cfg) -> Dict[str, np.ndarray]:
     return out
 
 
-def export_llama(path: str, params: Dict, cfg) -> None:
-    """Write a llama-family pytree as a .gguf llama.cpp can identify."""
-    write(path, llama_metadata(cfg), llama_to_tensors(params, cfg))
+def export_llama(path: str, params: Dict, cfg, tokenizer=None) -> None:
+    """Write a llama-family pytree as a .gguf llama.cpp can identify.
+    ``tokenizer``: optional models/tokenizer.py SentencePieceTokenizer —
+    its vocab is embedded as ``tokenizer.ggml.*`` metadata so the file
+    carries its own text path, like real llama.cpp checkpoints."""
+    meta = llama_metadata(cfg)
+    if tokenizer is not None:
+        meta.update(tokenizer.to_gguf_meta())
+    write(path, meta, llama_to_tensors(params, cfg))
 
 
 def write(path: str, meta: Dict, tensors: Dict[str, np.ndarray],
@@ -237,6 +261,28 @@ def write(path: str, meta: Dict, tensors: Dict[str, np.ndarray],
             return struct.pack("<If", 6, v)
         if isinstance(v, str):
             return struct.pack("<I", 8) + pack_s(v)
+        if isinstance(v, (list, tuple)):
+            # element type from the first item (homogeneous arrays only —
+            # what the tokenizer.ggml.* vocab keys need)
+            if not v:
+                raise GGUFError("cannot write an empty metadata array")
+            e = v[0]
+            if isinstance(e, str):
+                body = b"".join(pack_s(str(x)) for x in v)
+                et = 8
+            elif isinstance(e, bool):
+                body = b"".join(struct.pack("<B", int(x)) for x in v)
+                et = 7
+            elif isinstance(e, int):
+                body = b"".join(struct.pack("<i", int(x)) for x in v)
+                et = 5
+            elif isinstance(e, float):
+                body = b"".join(struct.pack("<f", float(x)) for x in v)
+                et = 6
+            else:
+                raise GGUFError(
+                    f"unsupported metadata array element {e!r}")
+            return struct.pack("<IIQ", 9, et, len(v)) + body
         raise GGUFError(f"unsupported metadata value {v!r}")
 
     header = bytearray()

@@ -364,7 +364,21 @@ def _load_gguf(path: str, cfg: Optional[LlamaConfig],
         "lm_head": lm_head,
     }
     _check_shapes(params, cfg, path)
+    # the vocab rode along in the SAME metadata parse — build the
+    # tokenizer here instead of re-reading the file (build_from_checkpoint
+    # picks it off this cache)
+    tok = None
+    if "tokenizer.ggml.tokens" in meta:
+        from .tokenizer import SentencePieceTokenizer
+
+        tok = SentencePieceTokenizer.from_gguf_meta(meta)
+    _GGUF_TOKENIZERS[path] = tok
     return params, cfg
+
+
+#: path -> tokenizer parsed as a side effect of the last _load_gguf on
+#: that path (avoids a second metadata parse of ~32k-string vocab arrays)
+_GGUF_TOKENIZERS: Dict[str, object] = {}
 
 
 def _read_config_json(path: str) -> Optional[LlamaConfig]:
@@ -860,19 +874,49 @@ def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
     return jax.jit(fn)(params, tokens)
 
 
-def sample_token(logits, key, temperature: float):
-    """logits [B, vocab] -> token ids [B]."""
+def sample_token(logits, key, temperature: float, top_k: int = 0,
+                 top_p: float = 1.0):
+    """logits [B, vocab] -> token ids [B].
+
+    ``top_k`` (0 = off) keeps the k highest logits; ``top_p`` (1.0 = off)
+    keeps the smallest set whose probability mass reaches p (nucleus).
+    Both are STATIC (Python) values baked into the compiled program —
+    masking is where/inf over the fixed vocab axis, so the MXU shape
+    never changes and no host roundtrip happens mid-decode.  Reference
+    analog: llama.cpp's sampler chain (tensor_filter_llamacpp.cc,
+    SURVEY §2.4 [UNVERIFIED]).
+    """
     import jax
     import jax.numpy as jnp
 
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    if top_k and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        sort = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sort, axis=-1)
+        # exclusive cumulative mass before each sorted position; the first
+        # position where it already reaches p is cut (the kept set is the
+        # smallest prefix with mass >= p).  Position 0 is never cut, so
+        # the top token survives any top_p — including a degenerate
+        # top_p<=0, where exclusive mass 0 >= p would otherwise mask
+        # EVERY logit and categorical would return id 0 unconditionally.
+        cut = ((jnp.cumsum(probs, axis=-1) - probs) >= top_p) \
+            & (jnp.arange(sort.shape[-1]) > 0)
+        kept = jnp.where(cut, jnp.asarray(jnp.inf, logits.dtype), sort)
+        thresh = jnp.min(kept, axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, neg, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate_scan(params, prompt, cfg: LlamaConfig, max_new: int,
                   temperature: float = 0.0, seed: int = 0,
-                  compute_dtype="bfloat16"):
+                  compute_dtype="bfloat16", top_k: int = 0,
+                  top_p: float = 1.0):
     """Whole generation as ONE jitted program (prefill + lax.scan decode):
     the throughput path for benchmarking — no host round-trip per token."""
     import jax
@@ -882,14 +926,14 @@ def generate_scan(params, prompt, cfg: LlamaConfig, max_new: int,
     cache = init_cache(cfg, B, dtype=compute_dtype)
     logits, cache = forward_cached(params, prompt, cache, 0, cfg, compute_dtype)
     key = jax.random.PRNGKey(seed)
-    tok0 = sample_token(logits[:, -1], key, temperature)
+    tok0 = sample_token(logits[:, -1], key, temperature, top_k, top_p)
 
     def step(carry, i):
         tok, cache, key = carry
         key, sub = jax.random.split(key)
         logits, cache = forward_cached(params, tok[:, None], cache, T + i,
                                        cfg, compute_dtype)
-        nxt = sample_token(logits[:, -1], sub, temperature)
+        nxt = sample_token(logits[:, -1], sub, temperature, top_k, top_p)
         return (nxt, cache, key), tok
 
     (_, _, _), toks = jax.lax.scan(
@@ -963,9 +1007,13 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
         format=TensorFormat.FLEXIBLE)
     out_spec = TensorsSpec.from_string(f"{cfg.vocab}:1:1", "float32").replace(
         format=TensorFormat.FLEXIBLE)
+    # tokenizer parsed alongside the weights by _load_gguf (no re-read)
+    tok = _GGUF_TOKENIZERS.pop(path, None) if path.endswith(".gguf") \
+        else None
     bundle = ModelBundle(
         apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
         param_pspecs=param_pspecs(quant=quant == "int8"), name=path,
+        tokenizer=tok,
     )
     bundle.config = cfg
     return bundle

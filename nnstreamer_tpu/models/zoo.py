@@ -36,6 +36,10 @@ class ModelBundle:
     #: jax.sharding.PartitionSpec, used by the parallel runner
     param_pspecs: object = None
     name: str = "model"
+    #: optional text tokenizer carried by the checkpoint itself (GGUF
+    #: tokenizer.ggml.* vocab -> models/tokenizer.py); the llm framework
+    #: uses it in place of its byte-level fallback
+    tokenizer: object = None
 
 
 _builders: Dict[str, Callable[[Dict[str, str]], ModelBundle]] = {}
