@@ -16,10 +16,15 @@ Input contracts (option1 selects, mirroring the reference's format modes):
 
 Options (reference numbering): option1=format, option2=labels,
 option3=score threshold (default 0.5), option4=WIDTH:HEIGHT of output
-overlay (default 640:480), option5=iou threshold (default 0.5).
+overlay (default 640:480), option5=iou threshold (default 0.5),
+option6=max detections, option7=NMS placement (host|device),
+option8=model input size for pixel-coordinate boxes,
+option9=output form (overlay|tensors).
 
 Output: RGBA overlay frame (H,W,4) uint8 + ``buf.meta["detections"]`` =
-list of dicts {box, score, class_index, label}.
+list of dicts {box, score, class_index, label}; with option9=tensors,
+the detections themselves as tensors (boxes/scores/classes[/valid]) and
+no canvas — the indices-not-payloads treatment for headless serving.
 """
 
 from __future__ import annotations
@@ -101,8 +106,24 @@ class BoundingBoxes(Decoder):
             self.box_scale = np.asarray([mw, mh, mw, mh], np.float32)
         else:
             self.box_scale = np.float32(1.0)
+        # option9: output form.  "overlay" (default) = the reference's
+        # video/x-raw RGBA frame with rectangles drawn on the host.
+        # "tensors" = ship the detections THEMSELVES (boxes f32 [M,4],
+        # scores f32 [M], classes i32 [M]) and skip the canvas — the
+        # classification recipe (indices-not-payloads) applied to
+        # detection: a batch-256 overlay canvas is ~100 MB of host memset
+        # + draw per batch that a headless serving pipeline never looks
+        # at.  (The reference has no headless mode; its tensor_region
+        # decoder is the precedent for tensor-form decoder output.)
+        out_mode = (self.option(9) or "overlay").lower()
+        if out_mode not in ("overlay", "tensors"):
+            raise ValueError(f"option9 (output form) must be "
+                             f"overlay|tensors, got {out_mode!r}")
+        self.out_mode = out_mode
 
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        if self.out_mode == "tensors":
+            return Caps.tensors()
         return Caps.new(
             MediaType.VIDEO, format="RGBA", width=self.out_w, height=self.out_h
         )
@@ -120,16 +141,36 @@ class BoundingBoxes(Decoder):
         if ndim >= 3:
             outs = []
             for b, frame in enumerate(self._split_frames(tensors)):
-                overlay, dets = self._decode_one(frame)
-                o = buf.with_tensors([overlay], spec=None)
+                dets = self._decode_dets(frame)
+                if self.out_mode == "tensors":
+                    o = buf.with_tensors(self._det_tensors(dets), spec=None)
+                else:
+                    o = buf.with_tensors([self._draw(dets)], spec=None)
                 o.meta["detections"] = dets
                 o.meta["batch_index"] = b
                 outs.append(o)
             return outs
-        overlay, detections = self._decode_one(tensors)
-        out = buf.with_tensors([overlay], spec=None)
+        detections = self._decode_dets(tensors)
+        if self.out_mode == "tensors":
+            out = buf.with_tensors(self._det_tensors(detections), spec=None)
+        else:
+            out = buf.with_tensors([self._draw(detections)], spec=None)
         out.meta["detections"] = detections
         return out
+
+    @staticmethod
+    def _det_tensors(dets) -> List[np.ndarray]:
+        """detections list -> (boxes f32 [M,4], scores f32 [M],
+        classes i32 [M]) — the option9=tensors output contract."""
+        m = len(dets)
+        boxes = np.zeros((m, 4), np.float32)
+        scores = np.zeros((m,), np.float32)
+        classes = np.zeros((m,), np.int32)
+        for i, d in enumerate(dets):
+            boxes[i] = d["box"]
+            scores[i] = d["score"]
+            classes[i] = d["class_index"]
+        return [boxes, scores, classes]
 
     def _split_frames(self, tensors):
         """Per-frame inputs for a batched buffer.  SSD-format device arrays
@@ -160,10 +201,6 @@ class BoundingBoxes(Decoder):
                 lambda b, s: _ssd_topk(b, s, k))
         tb, ts, tc = fn(jnp.asarray(boxes), jnp.asarray(scores))
         return np.asarray(tb), np.asarray(ts), np.asarray(tc)
-
-    def _decode_one(self, frame):
-        detections = self._decode_dets(frame)
-        return self._draw(detections), detections
 
     def _decode_dets(self, frame):
         if isinstance(frame, tuple) and frame[0] == "triple":
@@ -301,6 +338,8 @@ class BoundingBoxes(Decoder):
         return fn, out_spec
 
     def host_post(self, arrays, buf: Buffer) -> Buffer:
+        if self.out_mode == "tensors":
+            return self._host_post_tensors(arrays, buf)
         tb = np.asarray(arrays[0], np.float32)
         ts = np.asarray(arrays[1], np.float32)
         tc = np.asarray(arrays[2])
@@ -334,6 +373,33 @@ class BoundingBoxes(Decoder):
         new = buf.with_tensors([canvas], spec=None)
         new.meta["detections"] = dets
         return new
+
+    def _host_post_tensors(self, arrays, buf: Buffer) -> Buffer:
+        """option9=tensors sink edge: NO canvas, NO per-detection Python
+        dicts — with device NMS the D2H arrays (boxes [B,M,4], scores
+        [B,M], classes [B,M], valid [B,M]) ARE the output; with host NMS
+        the greedy pass runs here and pads into the same layout.  Host
+        work per batch is O(B*M) numpy, not O(B*H*W) pixels."""
+        if len(arrays) > 3:  # device NMS emitted final detections
+            return buf.with_tensors(
+                [np.ascontiguousarray(np.asarray(a)) for a in arrays],
+                spec=None)
+        tb = np.asarray(arrays[0], np.float32)
+        ts = np.asarray(arrays[1], np.float32)
+        tc = np.asarray(arrays[2])
+        b, m = tb.shape[0], self.max_detections
+        boxes = np.zeros((b, m, 4), np.float32)
+        scores = np.zeros((b, m), np.float32)
+        classes = np.zeros((b, m), np.int32)
+        valid = np.zeros((b, m), np.uint8)
+        for i in range(b):
+            d = self._decode_dets(("triple", (tb[i], ts[i], tc[i])))
+            for j, det in enumerate(d[:m]):
+                boxes[i, j] = det["box"]
+                scores[i, j] = det["score"]
+                classes[i, j] = det["class_index"]
+                valid[i, j] = 1
+        return buf.with_tensors([boxes, scores, classes, valid], spec=None)
 
     def _decode_ssd(self, tensors):
         boxes = np.asarray(tensors[0], np.float32).reshape(-1, 4)

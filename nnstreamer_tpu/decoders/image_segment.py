@@ -33,9 +33,25 @@ _COLORS = np.array(
 
 @register_decoder("image_segment")
 class ImageSegment(Decoder):
+    """option1=output form: ``overlay`` (default, the reference's RGBA
+    palette composite) or ``classmap`` (the u8 per-pixel class ids
+    THEMSELVES, no palette gather and 1/4 the bytes — the
+    indices-not-payloads treatment; the consumer composites only the
+    frames it displays)."""
+
     mode = "image_segment"
 
+    def __init__(self, props):
+        super().__init__(props)
+        out_mode = (self.option(1) or "overlay").lower()
+        if out_mode not in ("overlay", "classmap"):
+            raise ValueError(f"option1 (output form) must be "
+                             f"overlay|classmap, got {out_mode!r}")
+        self.out_mode = out_mode
+
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        if self.out_mode == "classmap":
+            return Caps.tensors()
         return Caps.new(MediaType.VIDEO, format="RGBA")
 
     def decode(self, tensors: List[np.ndarray], buf: Buffer) -> Buffer:
@@ -47,6 +63,15 @@ class ImageSegment(Decoder):
             classes = x.astype(np.int64)
         else:
             raise ValueError(f"image_segment expects rank 2/3, got {x.shape}")
+        if self.out_mode == "classmap":
+            # match device_fn's dtype rule: u8 only when ids fit — a
+            # >256-class model must not silently wrap its ids
+            n_cls = x.shape[-1] if x.ndim == 3 else \
+                int(classes.max(initial=0)) + 1
+            dt = np.uint8 if n_cls <= 256 else np.int32
+            out = buf.with_tensors([classes.astype(dt)], spec=None)
+            out.meta["class_map"] = classes
+            return out
         overlay = _COLORS[classes % len(_COLORS)]
         out = buf.with_tensors([overlay], spec=None)
         out.meta["class_map"] = classes
@@ -78,6 +103,15 @@ class ImageSegment(Decoder):
         return fn, out_spec
 
     def host_post(self, arrays, buf: Buffer) -> Buffer:
+        if self.out_mode == "classmap":
+            # the device argmax's u8 map IS the output: no host palette
+            # gather, no int64 upcast — D2H stays 1 byte/pixel
+            classes = np.asarray(arrays[0])
+            if classes.ndim == 3 and classes.shape[0] == 1:
+                classes = classes[0]
+            out = buf.with_tensors([classes], spec=None)
+            out.meta["class_map"] = classes
+            return out
         classes = np.asarray(arrays[0]).astype(np.int64)
         if classes.ndim == 3 and classes.shape[0] == 1:
             # Collapse batch-1 like the host decode path (np.squeeze) so

@@ -9,7 +9,10 @@ dims K:W':H') — PoseNet-style.  Optional second tensor (K, 2) of short-range
 offsets is added when present.
 
 Options: option1=labels (keypoint names file), option2=WIDTH:HEIGHT of the
-overlay (default 640:480), option3=score threshold.
+overlay (default 640:480), option3=score threshold, option4=output form
+(``overlay`` default | ``tensors``: keypoint coordinates themselves as
+(x f32 [K], y f32 [K], score f32 [K]) — batched [B,K] — with no skeleton
+canvas; the indices-not-payloads treatment for headless serving).
 """
 
 from __future__ import annotations
@@ -41,8 +44,15 @@ class PoseEstimation(Decoder):
         w, h = size.split(":")
         self.out_w, self.out_h = int(w), int(h)
         self.threshold = float(self.option(3) or 0.3)
+        out_mode = (self.option(4) or "overlay").lower()
+        if out_mode not in ("overlay", "tensors"):
+            raise ValueError(f"option4 (output form) must be "
+                             f"overlay|tensors, got {out_mode!r}")
+        self.out_mode = out_mode
 
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
+        if self.out_mode == "tensors":
+            return Caps.tensors()
         return Caps.new(
             MediaType.VIDEO, format="RGBA", width=self.out_w, height=self.out_h
         )
@@ -56,15 +66,21 @@ class PoseEstimation(Decoder):
             frames = hm.reshape((n,) + hm.shape[-3:])
             if n > 1:
                 rest = [np.asarray(t) for t in tensors[1:]]
-                overlays, kps = [], []
+                per_frame, kps = [], []
                 for i in range(n):
                     sub = [frames[i]] + [
                         t[i] if t.shape[:1] == (n,) else t for t in rest
                     ]
                     o = self._decode_one(sub, buf)
-                    overlays.append(o.tensors[0])
+                    per_frame.append(o.tensors)
                     kps.append(o.meta["keypoints"])
-                out = buf.with_tensors([np.stack(overlays)], spec=None)
+                # stack EVERY output tensor across frames: overlay mode has
+                # one ([B,H,W,4]); tensors mode has three (px/py/score,
+                # each [B,K]) — dropping to tensors[0] alone would lose y
+                # and confidence in the batched host path
+                stacked = [np.stack([f[t] for f in per_frame])
+                           for t in range(len(per_frame[0]))]
+                out = buf.with_tensors(stacked, spec=None)
                 out.meta["keypoints"] = kps
                 return out
             hm = frames[0]
@@ -100,8 +116,13 @@ class PoseEstimation(Decoder):
         off = (np.asarray(tensors[1], np.float32).reshape(-1, 2)[:k]
                if len(tensors) > 1 else None)
         keypoints = self._keypoints(idx, scores, off, hh, hw)
-        overlay = self._draw(keypoints)
-        out = buf.with_tensors([overlay], spec=None)
+        if self.out_mode == "tensors":
+            px, py = self._coords(idx, off, hh, hw)
+            out = buf.with_tensors(
+                [px.astype(np.float32), py.astype(np.float32),
+                 scores.astype(np.float32)], spec=None)
+        else:
+            out = buf.with_tensors([self._draw(keypoints)], spec=None)
         out.meta["keypoints"] = keypoints
         return out
 
@@ -154,6 +175,12 @@ class PoseEstimation(Decoder):
         # batch draw replaced a per-frame python loop that dominated the
         # pull path at ~30 ms per 64-batch.
         px, py = self._coords(idx, off, hh, hw)
+        if self.out_mode == "tensors":
+            # keypoints themselves, no canvas and no per-dict Python:
+            # O(B*K) floats cross the sink edge instead of O(B*H*W) pixels
+            return buf.with_tensors(
+                [px.astype(np.float32), py.astype(np.float32),
+                 scores.astype(np.float32)], spec=None)
         kps_all = [
             [
                 {"x": float(px[i, j]), "y": float(py[i, j]),

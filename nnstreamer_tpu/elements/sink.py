@@ -54,6 +54,13 @@ class TensorSink(SinkElement):
 
     def process(self, pad, buf: Buffer):
         metrics.count(f"{self.name}.frames")
+        # appsrc max-inflight credit: arrival at the sink is delivery —
+        # release BEFORE any queue/prefetch dwell so the pusher's next
+        # batch overlaps this one's sink-side settling (the sink queue is
+        # itself bounded, so total in-flight stays capped).
+        credit = buf.meta.get("_inflight_credit")
+        if credit is not None:
+            credit.release()
         # Snapshot once: a callback registered mid-stream must not observe
         # half of this method's gating (connect_new_data is a public API
         # with no start-only restriction) — it takes effect next buffer.
@@ -194,6 +201,9 @@ class FakeSink(SinkElement):
         # Block until device work for this buffer really finished — without
         # this, "throughput" would measure XLA's async dispatch queue.
         buf.block_until_ready()
+        credit = buf.meta.get("_inflight_credit")
+        if credit is not None:
+            credit.release()
         self.count += 1
         self.last = buf
         metrics.count(f"{self.name}.frames")
@@ -223,4 +233,7 @@ class FileSink(SinkElement):
     def process(self, pad, buf):
         for t in buf.resolve().tensors:
             self._f.write(np.asarray(t).tobytes())
+        credit = buf.meta.get("_inflight_credit")
+        if credit is not None:
+            credit.release()
         return []

@@ -26,12 +26,46 @@ from ..core.types import TensorsSpec, parse_fraction
 from .base import ElementError, SourceElement, SRC
 
 
+class _InflightCredit:
+    """End-to-end admission token (``appsrc max-inflight=N``): released
+    the FIRST time this buffer — or any buffer derived from it; meta
+    copies share the token by reference — reaches a sink, and as a safety
+    net when every derived buffer is garbage-collected (drop/eviction
+    paths must never leak a credit and deadlock the pusher)."""
+
+    __slots__ = ("_sem", "_done", "_lock")
+
+    def __init__(self, sem: threading.Semaphore):
+        self._sem = sem
+        self._done = False
+        self._lock = threading.Lock()
+
+    def release(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        self._sem.release()
+
+    def __del__(self):  # drop-path safety net
+        try:
+            self.release()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
 @register_element("appsrc")
 class AppSrc(SourceElement):
     """Application-driven source: ``pipeline.push(name, array)`` feeds it.
 
     Props: ``caps`` (caps string describing what the app will push),
-    ``max-buffers`` (feed queue bound), ``block`` (push blocks when full).
+    ``max-buffers`` (feed queue bound), ``block`` (push blocks when full),
+    ``max-inflight`` (END-TO-END admission bound: at most N pushed buffers
+    anywhere between this source and a sink; push blocks past that.  The
+    per-stage queues bound memory, but on a transport-saturated pipeline
+    they still let queue-depth x batch-time of latency build up ahead of
+    every frame — the reference gets the same effect from short GStreamer
+    queues; here one credit spans the whole pipeline).
     """
 
     kind = "appsrc"
@@ -49,6 +83,9 @@ class AppSrc(SourceElement):
         self._q: _queue.Queue = _queue.Queue(
             maxsize=cap_n if self.block else 0)
         self._eos = threading.Event()
+        n_inflight = int(self.props.get("max_inflight", 0))
+        self._inflight_sem = (threading.Semaphore(n_inflight)
+                              if n_inflight > 0 else None)
 
     def configure(self, in_caps, out_pads):
         self.out_caps = {p: self._caps for p in out_pads}
@@ -68,6 +105,14 @@ class AppSrc(SourceElement):
             buf = Buffer([np.frombuffer(bytes(data), np.uint8)], pts=pts)
         else:
             buf = Buffer([np.asarray(data)], pts=pts)
+        if self._inflight_sem is not None:
+            stop = getattr(self, "_stop_event", None)
+            while not self._inflight_sem.acquire(timeout=0.1):
+                if self._eos.is_set() or (stop is not None
+                                          and stop.is_set()):
+                    raise RuntimeError("appsrc stopping; push abandoned")
+            buf.meta["_inflight_credit"] = _InflightCredit(
+                self._inflight_sem)
         self._q.put(buf)
 
     def signal_eos(self) -> None:

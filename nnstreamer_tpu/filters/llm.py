@@ -422,7 +422,9 @@ class _ContinuousLoop:
         # return with a live request pending and EOS would cut it off.
         self._idle_lock = threading.Lock()
         self._error: Optional[BaseException] = None
-        self._admitting = None  # (meta, emit) mid-admission, crash-visible
+        #: (meta, emit) entries mid-admission, crash-visible; a list —
+        #: several async admissions can be in flight per iteration
+        self._admitting: list = []
 
         def decode_rows(params, tok, cache, key, pos, length):
             def step(carry, _):
@@ -511,8 +513,8 @@ class _ContinuousLoop:
             for slot in list(getattr(self, "_live_slots", []) or []):
                 if slot is not None:
                     abort(slot[0], slot[1], 1 << 30)
-            if self._admitting is not None:
-                abort(*self._admitting)
+            for entry in list(self._admitting):
+                abort(*entry)
             with self._idle_lock:
                 self._error = e
                 while True:
@@ -533,22 +535,40 @@ class _ContinuousLoop:
         B = fw.slots
         params = fw.bundle.params
         cache = llama.init_cache(cfg, B, dtype=fw.dtype)
-        pos = np.full((B,), cfg.max_seq, np.int32)  # parked = idle
+        # tok/pos live ON DEVICE between chunks (r4): materializing them
+        # per chunk cost two tunnel roundtrips per iteration on top of
+        # the one that delivers tokens.  Host keeps only bookkeeping
+        # (remaining/sidx/slots) that never needs device values.
+        pos = jnp.full((B,), cfg.max_seq, jnp.int32)  # parked = idle
+        tok = jnp.zeros((B,), jnp.int32)
         remaining = np.zeros((B,), np.int64)
         sidx = np.zeros((B,), np.int64)
         slots: list = [None] * B  # (meta, emit) per live slot
         self._live_slots = slots  # visible to the crash terminator
-        tok = np.zeros((B,), np.int32)
         key = jax.random.PRNGKey(fw.seed)
         eos = getattr(fw.tokenizer, "eos", -1) if fw.stop_eos else -1
+
+        # tiny jitted updates keeping tok/pos device-resident
+        set_slot = jax.jit(lambda a, i, v: a.at[i].set(v),
+                           donate_argnums=(0,))
+        park_idle = jax.jit(
+            lambda p, idle: jnp.where(idle, cfg.max_seq, p),
+            donate_argnums=(0,))
 
         from ..core.config import get_config as _gc
 
         while not self._stop.is_set():
             progressed = False
-            # 1. admit queued prompts into idle slots
+            # 1. admission: dispatch EVERY pending prompt's prefill +
+            # cache write + first-token sample asynchronously — no host
+            # sync yet.  The syncs happen in step 3, AFTER the decode
+            # chunk is dispatched, so admission work overlaps the running
+            # group's compute instead of stalling it (the r3 gap: serve
+            # ran at 60% of its own decode ceiling because prefills sat
+            # on the decode critical path).
             free = np.flatnonzero(remaining == 0)
             fi = 0
+            admitted = []  # (slot, meta, emit, first_dev, n)
             while fi < free.size:
                 try:
                     prompt, meta, emit = self._pending.get_nowait()
@@ -557,16 +577,22 @@ class _ContinuousLoop:
                 slot = int(free[fi])
                 fi += 1
                 # Crash-visibility marker: a request mid-admission is in
-                # neither _pending nor a slot — without this, a loop
-                # failure during ITS prefill would orphan it (its client
-                # would hang to timeout instead of seeing stream_aborted).
-                self._admitting = (meta, emit)
+                # neither _pending nor a slot — without it, a loop
+                # failure during ITS prefill would orphan it (client
+                # hangs to timeout instead of seeing stream_aborted).
+                # A LIST: several admissions can be in flight per
+                # iteration now that prefills dispatch asynchronously.
+                # Entries removed by IDENTITY (meta dicts may hold
+                # arrays, so tuple == is not safe).
+                entry = (meta, emit)
+                self._admitting.append(entry)
                 T = prompt.shape[1]
                 if T >= cfg.max_seq:
                     # reject oversize prompts with a terminated stream
                     self._emit_token(emit, {**meta, "stream_aborted": True},
                                      0, 0, True)
-                    self._admitting = None
+                    self._admitting[:] = [
+                        e for e in self._admitting if e is not entry]
                     continue
                 small = llama.init_cache(cfg, 1, dtype=fw.dtype)
                 P = T
@@ -577,41 +603,66 @@ class _ContinuousLoop:
                 logits, small = fw._fwd(params, jnp.asarray(prompt), small, 0)
                 cache = self._write_slot(cache, small, np.int32(slot))
                 key, sub = jax.random.split(key)
-                first = int(np.asarray(
-                    llama.sample_token(logits[:, T - 1], sub,
-                                       fw.temperature, fw.top_k,
-                                       fw.top_p))[0])
+                first_dev = llama.sample_token(
+                    logits[:, T - 1], sub, fw.temperature, fw.top_k,
+                    fw.top_p)[0]
                 n = max(1, min(fw.max_new, cfg.max_seq - T))
-                first_last = n == 1 or first == eos
-                self._emit_token(emit, meta, first, 0, first_last)
-                if not first_last:
-                    tok[slot] = first
-                    pos[slot] = T
+                if n > 1:
+                    # provisional occupancy; step 3 retires it if the
+                    # materialized first token turns out to be EOS
+                    tok = set_slot(tok, np.int32(slot), first_dev)
+                    pos = set_slot(pos, np.int32(slot), np.int32(T))
                     remaining[slot] = n - 1
                     sidx[slot] = 1
                     slots[slot] = (meta, emit)
-                self._admitting = None
+                    # now covered by _live_slots: drop the _admitting
+                    # marker so a crash between here and step 3 aborts the
+                    # stream ONCE, not via both lists
+                    self._admitting[:] = [
+                        e for e in self._admitting if e is not entry]
+                    entry = None
+                admitted.append((slot, meta, emit, first_dev, n, entry))
                 progressed = True
 
-            # 2. one chunk of per-row decode for the live slots.  The
-            # chunk length is ALWAYS fw.chunk: a variable tail length
-            # would compile a fresh 7B program per distinct value (the
-            # remote-compile cost dwarfs the tokens it saves — measured
-            # 3x throughput loss).  Streams that finish mid-chunk simply
-            # have their overshoot tokens discarded (their rows keep
-            # decoding garbage until chunk end; out-of-range cache
-            # writes drop, outputs are never emitted).
+            # 2. dispatch one chunk of per-row decode for the live slots
+            # (still async).  The chunk length is ALWAYS fw.chunk: a
+            # variable tail length would compile a fresh 7B program per
+            # distinct value (the remote-compile cost dwarfs the tokens
+            # it saves — measured 3x throughput loss).  Streams that
+            # finish mid-chunk have their overshoot tokens discarded
+            # (rows keep decoding garbage until chunk end; out-of-range
+            # cache writes drop, outputs are never emitted).
             live = remaining > 0
+            toks_dev = None
             if live.any():
                 length = fw.chunk
-                toks, tokj, cache, key, posj = self._decode_rows(
-                    params, jnp.asarray(tok), cache, key,
-                    jnp.asarray(pos), length=length)
-                host = np.asarray(toks)  # ONE roundtrip per chunk
-                # np.array (copy): np.asarray of a jax Array is read-only,
-                # and the slot bookkeeping below mutates these in place
-                tok, pos = np.array(tokj), np.array(posj)
-                for j in range(length):
+                toks_dev, tok, cache, key, pos = self._decode_rows(
+                    params, tok, cache, key, pos, length=length)
+                progressed = True
+
+            # 3. materialize + emit the admitted first tokens — the
+            # device is already computing the chunk, so this sync rides
+            # under it; the late joiner's first token leaves here, one
+            # dispatch (not one drained queue) after submit.
+            for slot, meta, emit, first_dev, n, entry in admitted:
+                first = int(np.asarray(first_dev))
+                first_last = n == 1 or first == eos
+                self._emit_token(emit, meta, first, 0, first_last)
+                if first_last and n > 1:
+                    # provisional occupancy rolled back (EOS on token 0);
+                    # the in-flight chunk's row decodes garbage that
+                    # step 4 skips via remaining==0, and park_idle
+                    # re-parks its position at chunk end
+                    slots[slot] = None
+                    remaining[slot] = 0
+                if entry is not None:  # n==1: never entered _live_slots
+                    self._admitting[:] = [
+                        e for e in self._admitting if e is not entry]
+
+            # 4. deliver the chunk's tokens
+            if toks_dev is not None:
+                host = np.asarray(toks_dev)  # ONE roundtrip per chunk
+                for j in range(host.shape[1]):
                     for s in np.flatnonzero(live):
                         if remaining[s] == 0:
                             continue  # finished mid-chunk: discard
@@ -625,14 +676,12 @@ class _ContinuousLoop:
                         if last:
                             slots[s] = None
                             remaining[s] = 0
-                            pos[s] = cfg.max_seq  # park the slot
-                # Re-park EVERY idle row, not just newly-finished ones:
-                # the device advanced all rows by `length`, so a
-                # long-parked row's int32 position would otherwise creep
-                # toward wraparound (negative positions turn dropped
-                # cache writes into corrupting in-range ones).
-                pos[remaining == 0] = cfg.max_seq
-                progressed = True
+                # Re-park EVERY idle row each chunk (the device advanced
+                # all rows by `length`; a long-parked row's int32
+                # position would otherwise creep toward wraparound,
+                # where negative positions turn dropped cache writes
+                # into corrupting in-range ones).
+                pos = park_idle(pos, jnp.asarray(remaining == 0))
 
             if not progressed:
                 with self._idle_lock:

@@ -162,8 +162,13 @@ class ModelWriter:
         return len(self.tensors) - 1
 
     def add_input(self, shape, dtype=np.float32, name="input",
-                  quant_scale: Optional[Sequence[float]] = None) -> int:
-        quant = {"scale": list(quant_scale)} if quant_scale else None
+                  quant_scale: Optional[Sequence[float]] = None,
+                  quant_zero_point: Optional[Sequence[int]] = None) -> int:
+        quant = None
+        if quant_scale:
+            quant = {"scale": list(quant_scale)}
+            if quant_zero_point:
+                quant["zero_point"] = [int(z) for z in quant_zero_point]
         idx = self._tensor(shape, dtype, name, None, quant)
         self.inputs.append(idx)
         return idx
@@ -174,7 +179,8 @@ class ModelWriter:
                   quant_axis: int = 0) -> int:
         """``quant_scale``/``quant_zero_point``/``quant_axis`` write a
         QuantizationParameters table (per-tensor or per-axis) — exercised
-        by the reader's weight dequantization and activation rejection."""
+        by the reader's weight dequantization and the quantized-activation
+        IO contract."""
         quant = None
         if quant_scale:
             quant = {"scale": list(quant_scale), "axis": int(quant_axis)}
@@ -183,8 +189,19 @@ class ModelWriter:
         return self._tensor(array.shape, array.dtype, name, array, quant)
 
     def add_op(self, kind: str, inputs: List[int], out_shape,
-               out_dtype=np.float32, options: Optional[Dict] = None) -> int:
-        out = self._tensor(out_shape, out_dtype, f"{kind.lower()}_out", None)
+               out_dtype=np.float32, options: Optional[Dict] = None,
+               quant_scale: Optional[Sequence[float]] = None,
+               quant_zero_point: Optional[Sequence[int]] = None) -> int:
+        """``quant_scale``/``quant_zero_point`` annotate the op's OUTPUT
+        activation — with an integer ``out_dtype`` this is how a
+        fully-quantized graph's interior is written."""
+        quant = None
+        if quant_scale:
+            quant = {"scale": list(quant_scale)}
+            if quant_zero_point:
+                quant["zero_point"] = [int(z) for z in quant_zero_point]
+        out = self._tensor(out_shape, out_dtype, f"{kind.lower()}_out",
+                           None, quant)
         self.ops.append((kind, list(inputs), [out], dict(options or {})))
         return out
 

@@ -490,3 +490,170 @@ class TestCTC:
             p.wait(timeout=30)
         assert b.tensors[0].dtype == np.int32
         assert "tokens" in b.meta
+
+
+class TestTensorOutputModes:
+    """option9=tensors (bounding_boxes) / option4=tensors (pose) /
+    option1=classmap (image_segment): detections/keypoints/class ids ship
+    AS TENSORS with no host canvas — numerics must match the overlay
+    path's meta exactly (indices-not-payloads, the classification/wav2vec2
+    treatment applied to the remaining decoders)."""
+
+    def _run_fused(self, dec, tensors):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.core.types import TensorsSpec
+
+        spec = TensorsSpec.of(tensors)
+        fn, out_spec = dec.device_fn(spec)
+        outs = fn(tuple(jnp.asarray(t) for t in tensors))
+        host = [np.asarray(o) for o in outs]
+        return dec.host_post(host, Buffer(host))
+
+    def test_bbox_tensors_match_overlay_detections(self):
+        rng = np.random.default_rng(3)
+        boxes = np.sort(rng.random((64, 4), np.float32), axis=-1)
+        scores = rng.random((64, 5)).astype(np.float32) * 0.6
+        scores[5, 2] = 0.97
+        ov = BoundingBoxes({"option1": "ssd", "option3": "0.5",
+                            "option4": "64:64"})
+        tn = BoundingBoxes({"option1": "ssd", "option3": "0.5",
+                            "option4": "64:64", "option9": "tensors"})
+        a = ov.decode([boxes, scores], Buffer([boxes, scores]))
+        b = tn.decode([boxes, scores], Buffer([boxes, scores]))
+        dets = a.meta["detections"]
+        assert b.meta["detections"] == dets
+        tb, ts, tc = b.tensors
+        assert tb.shape == (len(dets), 4) and tb.dtype == np.float32
+        for i, d in enumerate(dets):
+            np.testing.assert_allclose(tb[i], d["box"], rtol=1e-6)
+            assert ts[i] == pytest.approx(d["score"])
+            assert tc[i] == d["class_index"]
+
+    def test_bbox_tensors_fused_device_nms_passthrough(self):
+        rng = np.random.default_rng(7)
+        n = 48
+        boxes = np.sort(rng.random((2, n, 4), np.float32), axis=-1)
+        scores = np.zeros((2, n, 3), np.float32)
+        scores[:, :, 1] = np.linspace(0.95, 0.05, n)
+        ov = BoundingBoxes({"option1": "ssd", "option3": "0.4",
+                            "option4": "64:64", "option7": "device"})
+        tn = BoundingBoxes({"option1": "ssd", "option3": "0.4",
+                            "option4": "64:64", "option7": "device",
+                            "option9": "tensors"})
+        a = self._run_fused(ov, [boxes, scores])
+        b = self._run_fused(tn, [boxes, scores])
+        tb, ts, tc, valid = b.tensors
+        assert tb.shape[0] == 2 and tb.shape[2] == 4
+        for f in range(2):
+            keep = valid[f].astype(bool)
+            dets = a.meta["detections"][f]
+            assert int(keep.sum()) == len(dets)
+            for i, d in enumerate(dets):
+                np.testing.assert_allclose(tb[f, i], d["box"], atol=1e-6)
+                assert tc[f, i] == d["class_index"]
+
+    def test_bbox_tensors_fused_host_nms_pads(self):
+        rng = np.random.default_rng(9)
+        boxes = np.sort(rng.random((2, 32, 4), np.float32), axis=-1)
+        scores = rng.random((2, 32, 6)).astype(np.float32)
+        ov = BoundingBoxes({"option1": "ssd", "option3": "0.5",
+                            "option4": "32:32"})
+        tn = BoundingBoxes({"option1": "ssd", "option3": "0.5",
+                            "option4": "32:32", "option9": "tensors"})
+        a = self._run_fused(ov, [boxes, scores])
+        b = self._run_fused(tn, [boxes, scores])
+        tb, ts, tc, valid = b.tensors
+        assert tb.shape == (2, tn.max_detections, 4)
+        for f in range(2):
+            dets = a.meta["detections"][f]
+            assert int(valid[f].sum()) == len(dets)
+            for i, d in enumerate(dets):
+                np.testing.assert_allclose(tb[f, i], d["box"], atol=1e-6)
+
+    def test_bbox_bad_option9(self):
+        with pytest.raises(ValueError, match="option9"):
+            BoundingBoxes({"option9": "pixels"})
+
+    def test_pose_tensors_match_overlay_keypoints(self):
+        k = 17
+        hm = np.zeros((8, 8, k), np.float32)
+        for i in range(k):
+            hm[i % 8, (i * 3) % 8, i] = 1.0
+        ov = PoseEstimation({"option2": "80:80"})
+        tn = PoseEstimation({"option2": "80:80", "option4": "tensors"})
+        a = ov.decode([hm], Buffer([hm]))
+        b = tn.decode([hm], Buffer([hm]))
+        px, py, sc = b.tensors
+        assert px.shape == (k,)
+        for j, kp in enumerate(a.meta["keypoints"]):
+            assert px[j] == pytest.approx(kp["x"], abs=1e-4)
+            assert py[j] == pytest.approx(kp["y"], abs=1e-4)
+            assert sc[j] == pytest.approx(kp["score"], abs=1e-6)
+
+    def test_pose_tensors_fused_batched(self):
+        k = 17
+        hm = np.zeros((3, 8, 8, k), np.float32)
+        hm[:, 2, 4, :] = 1.0
+        ov = PoseEstimation({"option2": "80:80"})
+        tn = PoseEstimation({"option2": "80:80", "option4": "tensors"})
+        a = self._run_fused(ov, [hm])
+        b = self._run_fused(tn, [hm])
+        px, py, sc = b.tensors
+        assert px.shape == (3, k)
+        for f in range(3):
+            for j, kp in enumerate(a.meta["keypoints"][f]):
+                assert px[f, j] == pytest.approx(kp["x"], abs=1e-4)
+                assert py[f, j] == pytest.approx(kp["y"], abs=1e-4)
+
+    def test_segment_classmap_matches_overlay_map(self):
+        rng = np.random.default_rng(11)
+        x = rng.random((16, 16, 7)).astype(np.float32)
+        ov = ImageSegment({})
+        cm = ImageSegment({"option1": "classmap"})
+        a = ov.decode([x], Buffer([x]))
+        b = cm.decode([x], Buffer([x]))
+        assert b.tensors[0].dtype == np.uint8
+        np.testing.assert_array_equal(b.tensors[0], a.meta["class_map"])
+
+    def test_segment_classmap_fused_stays_u8(self):
+        rng = np.random.default_rng(13)
+        x = rng.random((2, 16, 16, 7)).astype(np.float32)
+        ov = ImageSegment({})
+        cm = ImageSegment({"option1": "classmap"})
+        a = self._run_fused(ov, [x])
+        b = self._run_fused(cm, [x])
+        assert b.tensors[0].dtype == np.uint8
+        np.testing.assert_array_equal(b.tensors[0], a.meta["class_map"])
+
+    def test_detection_tensors_pipeline_e2e(self):
+        """The bench topology end-to-end: fused device NMS + tensors
+        output through a real pipeline."""
+        p = nt.Pipeline(
+            "videotestsrc device=true batch=4 num-buffers=8 width=64 "
+            "height=64 pattern=ball name=src ! "
+            "tensor_transform mode=arithmetic "
+            "option=typecast:float32,add:-127.5,div:127.5 ! "
+            "tensor_filter framework=jax model=ssd_mobilenet "
+            "custom=size:64,classes:7,batch:4 name=f ! "
+            "tensor_decoder mode=bounding_boxes option1=ssd option3=0.1 "
+            "option4=64:64 option7=device option9=tensors ! "
+            "tensor_sink name=out")
+        with p:
+            b = p.pull("out", timeout=300)
+            p.wait(timeout=120)
+        assert len(b.tensors) == 4  # boxes, scores, classes, valid
+        assert b.tensors[0].shape[0] == 4  # batch rows
+        assert b.tensors[0].shape[2] == 4
+
+    def test_pose_tensors_batched_host_path(self):
+        """Non-fused batched decode must carry all three tensors
+        (px, py, score), not just x (r4 review finding)."""
+        k = 17
+        hm = np.zeros((3, 8, 8, k), np.float32)
+        hm[:, 2, 4, :] = 1.0
+        tn = PoseEstimation({"option2": "80:80", "option4": "tensors"})
+        out = tn.decode([hm], Buffer([hm]))
+        assert len(out.tensors) == 3
+        assert out.tensors[0].shape == (3, k)
+        assert out.tensors[2].shape == (3, k)

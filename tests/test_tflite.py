@@ -180,17 +180,21 @@ class TestPipelineIntegration:
         with pytest.raises(KeyError, match="not found"):
             zoo.build("/nonexistent/model.tflite")
 
-    def test_quantized_activation_rejected(self):
-        # fully-quantized graph: the INPUT activation carries a scale
+    def test_quantized_activations_recorded_for_io(self):
+        # fully-quantized graph: integer activations parse into io_quant
+        # (dequantized-execution contract) instead of being rejected
         mw = tflite_build.ModelWriter()
-        x = mw.add_input([1, 4], dtype=np.uint8, quant_scale=[0.5])
+        x = mw.add_input([1, 4], dtype=np.uint8, quant_scale=[0.5],
+                         quant_zero_point=[128])
         w = mw.add_const(np.zeros((4, 4), np.uint8), "qw",
                          quant_scale=[0.5])
         out = mw.add_op("FULLY_CONNECTED", [x, w], [1, 4],
-                        out_dtype=np.uint8)
+                        out_dtype=np.uint8, quant_scale=[0.25],
+                        quant_zero_point=[3])
         blob = mw.finish(outputs=[out])
-        with pytest.raises(tflite.TFLiteError, match="quantized activation"):
-            tflite.TFLiteGraph(blob)
+        g = tflite.TFLiteGraph(blob)
+        assert g.io_quant[x] == (0.5, 128, np.dtype(np.uint8))
+        assert g.io_quant[out] == (0.25, 3, np.dtype(np.uint8))
 
     def test_quantized_weights_dequantize(self):
         # hybrid model: int8 weights with per-axis scale + zero_point run
@@ -348,3 +352,104 @@ class TestQuantEdgeCases:
         blob = mw.finish(outputs=[y])
         g = tflite.TFLiteGraph(blob)
         np.testing.assert_array_equal(g.constants[w], wv)
+
+
+class TestFullyQuantized:
+    """Fully-quantized (integer-activation) graphs — the reference's
+    canonical mobilenet_v1_quant class — run by DEQUANTIZED EXECUTION
+    (VERDICT r3 ask #4): integer IO contract at the boundary, float on
+    the MXU inside; numerics match the float graph within quantization
+    error."""
+
+    def _files(self, tmp_path):
+        rng = np.random.default_rng(7)
+        wf = rng.standard_normal((8, 3, 3, 3)).astype(np.float32) * 0.3
+        bf = rng.standard_normal((8,)).astype(np.float32) * 0.1
+
+        # float twin
+        mf = tflite_build.ModelWriter()
+        x = mf.add_input([1, 8, 8, 3])
+        w = mf.add_const(wf, "w")
+        b = mf.add_const(bf, "b")
+        y = mf.add_op("CONV_2D", [x, w, b], [1, 4, 4, 8],
+                      options={"stride": (2, 2), "padding": "SAME",
+                               "act": "relu6"})
+        fblob = mf.finish(outputs=[y])
+
+        # quantized twin: uint8 activations, int8 per-axis weights,
+        # int32 bias (scale = s_in * s_w, TFLite convention)
+        s_in, z_in = 1.0 / 255.0, 0
+        s_out, z_out = 6.0 / 255.0, 0  # RELU6 output range [0, 6]
+        sw = np.abs(wf).max(axis=(1, 2, 3)) / 127.0  # per-out-channel
+        wq = np.clip(np.round(wf / sw[:, None, None, None]),
+                     -127, 127).astype(np.int8)
+        bq = np.round(bf / (s_in * sw)).astype(np.int32)
+        mq = tflite_build.ModelWriter()
+        xq = mq.add_input([1, 8, 8, 3], dtype=np.uint8,
+                          quant_scale=[s_in], quant_zero_point=[z_in])
+        wqi = mq.add_const(wq, "wq", quant_scale=list(sw),
+                           quant_zero_point=[0] * 8, quant_axis=0)
+        bqi = mq.add_const(bq, "bq", quant_scale=list(s_in * sw),
+                           quant_zero_point=[0] * 8, quant_axis=0)
+        yq = mq.add_op("CONV_2D", [xq, wqi, bqi], [1, 4, 4, 8],
+                       out_dtype=np.uint8,
+                       options={"stride": (2, 2), "padding": "SAME",
+                                "act": "relu6"},
+                       quant_scale=[s_out], quant_zero_point=[z_out])
+        qblob = mq.finish(outputs=[yq])
+
+        pf = os.path.join(tmp_path, "f.tflite")
+        pq = os.path.join(tmp_path, "q.tflite")
+        open(pf, "wb").write(fblob)
+        open(pq, "wb").write(qblob)
+        return pf, pq, (s_in, z_in, s_out, z_out)
+
+    def test_quant_graph_matches_float_within_tolerance(self, tmp_path):
+        pf, pq, (s_in, z_in, s_out, z_out) = self._files(str(tmp_path))
+        bf = tflite.load_bundle(pf)
+        bq = tflite.load_bundle(pq)
+        rng = np.random.default_rng(3)
+        xf = rng.random((1, 8, 8, 3)).astype(np.float32)
+        xu = np.clip(np.round(xf / s_in) + z_in, 0, 255).astype(np.uint8)
+        yf = np.asarray(bf.apply_fn(bf.params, xf))
+        yq = np.asarray(bq.apply_fn(bq.params, xu))
+        assert yq.dtype == np.uint8
+        ydq = (yq.astype(np.float32) - z_out) * s_out
+        # error budget: input quantization (~s_in * |W|_1) + output step
+        np.testing.assert_allclose(ydq, yf, atol=4 * s_out + 0.02)
+
+    def test_integer_io_specs(self, tmp_path):
+        _, pq, _ = self._files(str(tmp_path))
+        b = tflite.load_bundle(pq)
+        assert b.in_spec[0].dtype == np.uint8
+        assert b.out_spec[0].dtype == np.uint8
+
+    def test_pipeline_feeds_uint8_directly(self, tmp_path):
+        """The reference's quant-model usage: uint8 camera frames feed the
+        filter with NO normalization transform; uint8 comes back."""
+        import nnstreamer_tpu as nt
+
+        _, pq, _ = self._files(str(tmp_path))
+        p = nt.Pipeline(
+            "appsrc name=src caps=other/tensors,"
+            "dimensions=3:8:8:1,types=uint8 ! "
+            f"tensor_filter framework=jax model={pq} name=f ! "
+            "tensor_sink name=out")
+        x = np.random.default_rng(0).integers(
+            0, 256, (1, 8, 8, 3), dtype=np.uint8)
+        with p:
+            p.push("src", x)
+            out = p.pull("out", timeout=120)
+            p.eos()
+            p.wait(timeout=30)
+        assert out.tensors[0].dtype == np.uint8
+        assert out.tensors[0].shape == (1, 4, 4, 8)
+
+    def test_jittable(self, tmp_path):
+        import jax
+
+        _, pq, _ = self._files(str(tmp_path))
+        b = tflite.load_bundle(pq)
+        x = np.zeros((1, 8, 8, 3), np.uint8)
+        got = np.asarray(jax.jit(b.apply_fn)(b.params, x))
+        assert got.dtype == np.uint8

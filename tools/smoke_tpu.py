@@ -179,6 +179,54 @@ def tflite_file_ingestion():
             p.wait(timeout=60)
 
 
+def tflite_quantized_graph():
+    """Fully-quantized (uint8-activation) .tflite on the chip: integer IO
+    contract, dequantized execution inside (VERDICT r4 ask #4)."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.models import tflite_build
+
+    rng = np.random.default_rng(5)
+    wf = rng.standard_normal((16, 3, 3, 3)).astype(np.float32) * 0.2
+    s_in, s_out = 1.0 / 255.0, 6.0 / 255.0
+    sw = np.abs(wf).max(axis=(1, 2, 3)) / 127.0
+    wq = np.clip(np.round(wf / sw[:, None, None, None]),
+                 -127, 127).astype(np.int8)
+    mw = tflite_build.ModelWriter()
+    x = mw.add_input([8, 32, 32, 3], dtype=np.uint8,
+                     quant_scale=[s_in], quant_zero_point=[0])
+    w = mw.add_const(wq, "wq", quant_scale=list(sw),
+                     quant_zero_point=[0] * 16, quant_axis=0)
+    b = mw.add_const(np.zeros((16,), np.int32), "bq",
+                     quant_scale=list(s_in * sw),
+                     quant_zero_point=[0] * 16, quant_axis=0)
+    y = mw.add_op("CONV_2D", [x, w, b], [8, 16, 16, 16],
+                  out_dtype=np.uint8,
+                  options={"padding": "SAME", "stride": (2, 2),
+                           "act": "relu6"},
+                  quant_scale=[s_out], quant_zero_point=[0])
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "q.tflite")
+        with open(path, "wb") as f:
+            f.write(mw.finish(outputs=[y]))
+        p = nt.Pipeline(
+            f"appsrc name=src caps=other/tensors,dimensions=3:32:32:8,"
+            f"types=uint8 ! tensor_filter framework=jax model={path} ! "
+            "tensor_sink name=out")
+        with p:
+            p.push("src", rng.integers(0, 256, (8, 32, 32, 3),
+                                       dtype=np.uint8))
+            out = np.asarray(p.pull("out", timeout=600).tensors[0])
+            assert out.dtype == np.uint8 and out.shape == (8, 16, 16, 16)
+            assert int(out.max()) > 0  # relu6 range actually exercised
+            p.eos()
+            p.wait(timeout=60)
+
+
 def query_roundtrip():
     import numpy as np
 
@@ -234,6 +282,7 @@ def main() -> int:
         ("LLM token streaming", llm_stream),
         ("wav2vec2 + ctc decode-on-edge", wav2vec2_ctc_decode_on_edge),
         (".tflite file ingestion", tflite_file_ingestion),
+        (".tflite fully-quantized graph", tflite_quantized_graph),
         ("tensor_query offload roundtrip", query_roundtrip),
     ]
     results = []
