@@ -145,6 +145,8 @@ class PoseEstimation(Decoder):
         self._fused_grid = (hh, hw)
         have_off = len(in_spec) > 1
 
+        pack = self.out_mode == "tensors"
+
         def fn(arrays):
             hm = arrays[0].astype(jnp.float32)
             b = hm.shape[0]
@@ -155,8 +157,21 @@ class PoseEstimation(Decoder):
             if have_off:
                 off = arrays[1].astype(jnp.float32).reshape(b, -1, 2)[:, :k]
                 outs.append(off)
+            if pack:
+                # ONE [B, K, 2(+2)] f32 payload (idx, score[, off]): a
+                # single D2H transfer instead of 2-3 — each separate
+                # tensor pays its own tunnel round trip.  idx as f32 is
+                # exact (heatmap cells << 2^24).
+                cols = [outs[0].astype(jnp.float32)[..., None],
+                        outs[1][..., None]]
+                if have_off:
+                    cols.append(outs[2])
+                return (jnp.concatenate(cols, axis=-1),)
             return tuple(outs)
 
+        if pack:
+            return fn, TensorsSpec((TensorSpec.from_shape(
+                (batch, k, 4 if have_off else 2), np.float32),))
         specs = [
             TensorSpec.from_shape((batch, k), np.int32),
             TensorSpec.from_shape((batch, k), np.float32),
@@ -167,9 +182,16 @@ class PoseEstimation(Decoder):
 
     def host_post(self, arrays, buf: Buffer) -> Buffer:
         hh, hw = self._fused_grid
-        idx = np.asarray(arrays[0])
-        scores = np.asarray(arrays[1], np.float32)
-        off = np.asarray(arrays[2], np.float32) if len(arrays) > 2 else None
+        if len(arrays) == 1:  # packed tensors-mode payload [B, K, 2(+2)]
+            p = np.asarray(arrays[0], np.float32)
+            idx = p[..., 0].astype(np.int64)
+            scores = p[..., 1]
+            off = p[..., 2:4] if p.shape[-1] >= 4 else None
+        else:
+            idx = np.asarray(arrays[0])
+            scores = np.asarray(arrays[1], np.float32)
+            off = (np.asarray(arrays[2], np.float32)
+                   if len(arrays) > 2 else None)
         b, k = idx.shape
         # Batched coordinates via the shared _coords math; the vectorized
         # batch draw replaced a per-frame python loop that dominated the

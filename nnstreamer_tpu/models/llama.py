@@ -199,7 +199,8 @@ def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
     if dtype not in ("float32", "bfloat16"):
         dt = np.dtype(dtype)
     if path.endswith(".gguf"):
-        return _load_gguf(path, cfg, dt)
+        params, cfg, _tok = _load_gguf(path, cfg, dt)
+        return params, cfg
     tensors = ckpt.load_tensors(path)
 
     if "embed" in tensors and "layers.wq" in tensors:  # native stacked npz
@@ -365,20 +366,14 @@ def _load_gguf(path: str, cfg: Optional[LlamaConfig],
     }
     _check_shapes(params, cfg, path)
     # the vocab rode along in the SAME metadata parse — build the
-    # tokenizer here instead of re-reading the file (build_from_checkpoint
-    # picks it off this cache)
+    # tokenizer here instead of re-reading the file; returned alongside
+    # the weights so build_from_checkpoint can attach it to the bundle
     tok = None
     if "tokenizer.ggml.tokens" in meta:
         from .tokenizer import SentencePieceTokenizer
 
         tok = SentencePieceTokenizer.from_gguf_meta(meta)
-    _GGUF_TOKENIZERS[path] = tok
-    return params, cfg
-
-
-#: path -> tokenizer parsed as a side effect of the last _load_gguf on
-#: that path (avoids a second metadata parse of ~32k-string vocab arrays)
-_GGUF_TOKENIZERS: Dict[str, object] = {}
+    return params, cfg, tok
 
 
 def _read_config_json(path: str) -> Optional[LlamaConfig]:
@@ -992,8 +987,16 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
     Same bundle contract as :func:`_build` but params come from
     :func:`load_checkpoint`; ``custom=param_dtype:...,max_seq:N`` apply.
     """
-    params, cfg = load_checkpoint(
-        path, dtype=opts.get("param_dtype", "bfloat16"))
+    pdt = opts.get("param_dtype", "bfloat16")
+    if path.endswith(".gguf"):
+        # gguf path: the tokenizer parses out of the SAME metadata read
+        dt = np.dtype("float32") if pdt == "float32" else _np_bf16()
+        if pdt not in ("float32", "bfloat16"):
+            dt = np.dtype(pdt)
+        params, cfg, tok = _load_gguf(path, None, dt)
+    else:
+        params, cfg = load_checkpoint(path, dtype=pdt)
+        tok = None
     if "max_seq" in opts:
         cfg = dataclasses.replace(cfg, max_seq=int(opts["max_seq"]))
     dtype = opts.get("dtype", "bfloat16")
@@ -1007,9 +1010,6 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
         format=TensorFormat.FLEXIBLE)
     out_spec = TensorsSpec.from_string(f"{cfg.vocab}:1:1", "float32").replace(
         format=TensorFormat.FLEXIBLE)
-    # tokenizer parsed alongside the weights by _load_gguf (no re-read)
-    tok = _GGUF_TOKENIZERS.pop(path, None) if path.endswith(".gguf") \
-        else None
     bundle = ModelBundle(
         apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
         param_pspecs=param_pspecs(quant=quant == "int8"), name=path,

@@ -62,8 +62,12 @@ def param_pspecs() -> Dict:
     return specs
 
 
-def apply(params, x, *, compute_dtype="bfloat16"):
-    """[B, H, W, 3] -> [B, H, W, classes] float32 score map."""
+def apply(params, x, *, compute_dtype="bfloat16", upsample: bool = True):
+    """[B, H, W, 3] -> [B, H, W, classes] float32 score map (or the
+    native-stride [B, H/16, W/16, classes] map with ``upsample=False`` —
+    the class DECISION at the model's true resolution; the full-res map
+    is only a bilinear blow-up of it, so consumers that ship maps over a
+    link can upsample after transport instead of before)."""
     import jax
     import jax.numpy as jnp
 
@@ -88,6 +92,8 @@ def apply(params, x, *, compute_dtype="bfloat16"):
 
     h = params["head"]
     logits = conv2d(feat, h["w"], 1) + h["bias"].astype(cdt)
+    if not upsample:
+        return logits.astype(jnp.float32)
     # full-resolution upsample inside the program (XLA lowers
     # jax.image.resize to gathers that fuse with the head conv)
     logits = jax.image.resize(
@@ -104,14 +110,22 @@ def _deeplab(opts: Dict[str, str]) -> ModelBundle:
     batch = int(opts.get("batch", 1))
     dtype = opts.get("dtype", "bfloat16")
 
+    # custom=upsample:0 -> emit the native output-stride-16 score map
+    # (the class decision; full res is a bilinear blow-up of it): the
+    # D2H payload shrinks 256x for link-bound serving
+    up = str(opts.get("upsample", "1")).lower() not in ("0", "false", "no")
     params = init_params(width=width, classes=classes, seed=seed)
-    apply_fn = functools.partial(apply, compute_dtype=dtype)
+    apply_fn = functools.partial(apply, compute_dtype=dtype, upsample=up)
+    out_size = size
+    if not up:
+        for _ in range(4):  # stride 16 = four SAME stride-2 stages
+            out_size = -(-out_size // 2)
     return ModelBundle(
         apply_fn=apply_fn,
         params=params,
         in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
         out_spec=TensorsSpec.from_string(
-            f"{classes}:{size}:{size}:{batch}", "float32"),
+            f"{classes}:{out_size}:{out_size}:{batch}", "float32"),
         param_pspecs=param_pspecs(),
         name="deeplab_mobilenet",
     )

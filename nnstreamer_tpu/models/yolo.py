@@ -405,7 +405,10 @@ def apply_v5s(params, x, *, classes: int, size: int,
         gy, gx = jnp.meshgrid(jnp.arange(g), jnp.arange(g), indexing="ij")
         cx = (s[..., 0] * 2.0 - 0.5 + gx[None, :, :, None]) / g
         cy = (s[..., 1] * 2.0 - 0.5 + gy[None, :, :, None]) / g
-        anch = jnp.asarray(_V5S_ANCHORS_PX[stride], jnp.float32) / 640.0
+        # anchors are pixels of the NETWORK INPUT (ultralytics
+        # convention), so normalized anchors divide by the actual input
+        # size — /640 would shrink every box at any other size
+        anch = jnp.asarray(_V5S_ANCHORS_PX[stride], jnp.float32) / size
         w = (s[..., 2] * 2.0) ** 2 * anch[None, None, None, :, 0]
         hh = (s[..., 3] * 2.0) ** 2 * anch[None, None, None, :, 1]
         pred = jnp.concatenate(
