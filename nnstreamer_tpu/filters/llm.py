@@ -557,6 +557,37 @@ class _ContinuousLoop:
 
         from ..core.config import get_config as _gc
 
+        import os as _os
+        trace = _os.environ.get("NNSTPU_SERVE_TRACE") == "1"
+
+        def _tr(tag):
+            if trace:
+                print(f"[serve {time.monotonic():.3f}] {tag}", flush=True)
+
+        # Warm EVERY program the loop uses before admitting real work:
+        # over a tunneled device, first-use costs (trace + compile +
+        # program upload) run 0.5-2 s EACH and land on the first
+        # requests' critical path otherwise (traced: park_idle's first
+        # compile alone delayed a join by 0.7 s).  llama.cpp servers
+        # warm up the same way.  The garbage this writes into slot 0's
+        # cache rows stays masked behind parked positions until a real
+        # admission overwrites it.
+        warm_T = min(32, cfg.max_seq - 1)
+        logits_w, small_w = fw._fwd(
+            params, jnp.zeros((1, warm_T), jnp.int32),
+            llama.init_cache(cfg, 1, dtype=fw.dtype), 0)
+        cache = self._write_slot(cache, small_w, np.int32(0))
+        key, sub = jax.random.split(key)
+        first_w = llama.sample_token(logits_w[:, -1], sub, fw.temperature,
+                                     fw.top_k, fw.top_p)[0]
+        tok = set_slot(tok, np.int32(0), first_w)     # device-scalar variant
+        pos = set_slot(pos, np.int32(0), np.int32(0))  # host-scalar variant
+        toks_w, tok, cache, key, pos = self._decode_rows(
+            params, tok, cache, key, pos, length=fw.chunk)
+        np.asarray(toks_w)
+        pos = park_idle(pos, jnp.asarray(np.ones((B,), bool)))
+        _tr("warmup done")
+
         while not self._stop.is_set():
             progressed = False
             # 1. admission: dispatch EVERY pending prompt's prefill +
@@ -622,6 +653,7 @@ class _ContinuousLoop:
                         e for e in self._admitting if e is not entry]
                     entry = None
                 admitted.append((slot, meta, emit, first_dev, n, entry))
+                _tr(f"admitted slot {slot} (dispatched prefill)")
                 progressed = True
 
             # 2. dispatch one chunk of per-row decode for the live slots
@@ -638,6 +670,7 @@ class _ContinuousLoop:
                 length = fw.chunk
                 toks_dev, tok, cache, key, pos = self._decode_rows(
                     params, tok, cache, key, pos, length=length)
+                _tr("chunk dispatched")
                 progressed = True
 
             # 3. materialize + emit the admitted first tokens — the
@@ -645,7 +678,9 @@ class _ContinuousLoop:
             # under it; the late joiner's first token leaves here, one
             # dispatch (not one drained queue) after submit.
             for slot, meta, emit, first_dev, n, entry in admitted:
+                _tr(f"first-token sync begins slot {slot}")
                 first = int(np.asarray(first_dev))
+                _tr(f"first-token synced slot {slot}")
                 first_last = n == 1 or first == eos
                 self._emit_token(emit, meta, first, 0, first_last)
                 if first_last and n > 1:
@@ -662,6 +697,7 @@ class _ContinuousLoop:
             # 4. deliver the chunk's tokens
             if toks_dev is not None:
                 host = np.asarray(toks_dev)  # ONE roundtrip per chunk
+                _tr("chunk materialized")
                 for j in range(host.shape[1]):
                     for s in np.flatnonzero(live):
                         if remaining[s] == 0:

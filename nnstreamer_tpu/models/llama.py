@@ -195,9 +195,7 @@ def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
 
     from . import checkpoint as ckpt
 
-    dt = np.dtype("float32") if dtype == "float32" else _np_bf16()
-    if dtype not in ("float32", "bfloat16"):
-        dt = np.dtype(dtype)
+    dt = _resolve_param_dtype(dtype)
     if path.endswith(".gguf"):
         params, cfg, _tok = _load_gguf(path, cfg, dt)
         return params, cfg
@@ -267,6 +265,17 @@ def _np_bf16():
     from ..core.types import bfloat16
 
     return bfloat16
+
+
+def _resolve_param_dtype(dtype) -> np.dtype:
+    """ONE home for the checkpoint param-dtype rule (bfloat16 through the
+    core.types alias, anything else verbatim) — load_checkpoint and the
+    gguf bundle path must never drift apart here."""
+    if dtype == "float32":
+        return np.dtype("float32")
+    if dtype == "bfloat16":
+        return _np_bf16()
+    return np.dtype(dtype)
 
 
 def _rope_permute(w: np.ndarray, n_heads: int) -> np.ndarray:
@@ -990,10 +999,7 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
     pdt = opts.get("param_dtype", "bfloat16")
     if path.endswith(".gguf"):
         # gguf path: the tokenizer parses out of the SAME metadata read
-        dt = np.dtype("float32") if pdt == "float32" else _np_bf16()
-        if pdt not in ("float32", "bfloat16"):
-            dt = np.dtype(pdt)
-        params, cfg, tok = _load_gguf(path, None, dt)
+        params, cfg, tok = _load_gguf(path, None, _resolve_param_dtype(pdt))
     else:
         params, cfg = load_checkpoint(path, dtype=pdt)
         tok = None
