@@ -573,7 +573,7 @@ def _text_vocab_file(model: str) -> str:
 
 
 def bench_llm(batches: int, warmup: int, model: str = "llama_small",
-              max_new: int = 64, prompt_len: int = 32,
+              max_new: int = None, prompt_len: int = 32,
               quant: str = "", streams: int = 1,
               serve: str = "", text: bool = False) -> dict:
     """Config #5: tokens/sec through the llm filter (jitted prefill +
@@ -590,11 +590,12 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     import nnstreamer_tpu as nt
 
     rng = np.random.default_rng(0)
-    if serve == "continuous" and max_new == 64:
-        # longer generations so the steady full-occupancy phase dominates
-        # the headline window over the stagger ramp (the ramp is the
-        # scenario's shape; full_occupancy_tokens_per_sec isolates it)
-        max_new = 128
+    if max_new is None:
+        # continuous default decodes longer so the steady full-occupancy
+        # phase dominates the stagger ramp in the headline window (the
+        # ramp is the scenario's shape; full_occupancy_tokens_per_sec
+        # isolates it); an EXPLICIT max_new is always honored
+        max_new = 128 if serve == "continuous" else 64
     custom = f"max_new:{max_new}"
     if model == "llama2_7b":
         # Multi-stream: the KV cache scales with streams (bf16 rows x

@@ -562,7 +562,11 @@ class _ContinuousLoop:
 
         def _tr(tag):
             if trace:
-                print(f"[serve {time.monotonic():.3f}] {tag}", flush=True)
+                # stderr: stdout carries bench.py's line-delimited JSON
+                import sys as _sys
+
+                print(f"[serve {time.monotonic():.3f}] {tag}",
+                      file=_sys.stderr, flush=True)
 
         # Warm EVERY program the loop uses before admitting real work:
         # over a tunneled device, first-use costs (trace + compile +
