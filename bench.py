@@ -178,8 +178,17 @@ def _pipeline_bench(desc: str, make_frame, batch: int, batches: int,
 
         def pusher():
             for i in range(batches):
+                # e2e clock starts at ADMISSION (push return): under an
+                # infinite offered load the client-side wait to be
+                # admitted is unbounded by Little's law whatever the
+                # framework does — what max-inflight bounds (and what
+                # this measures) is admission->delivery time INSIDE the
+                # pipeline.  The pre-push write keeps the reader from
+                # KeyErroring if delivery races the post-push overwrite
+                # (it would read the conservative earlier stamp).
                 push_ts[i] = time.perf_counter()
                 p.push("src", frames[i % len(frames)])
+                push_ts[i] = time.perf_counter()
 
         t = threading.Thread(target=pusher, daemon=True)
         t0 = time.perf_counter()
@@ -385,7 +394,7 @@ def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
     with p:
         p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
                                           dtype=np.int32)))
-        first = p.pull("out", timeout=900)  # stream 0 live (+compile)
+        first = p.pull("out", timeout=2100)  # stream 0 live (+compile)
         t_join = time.monotonic()
         p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
                                           dtype=np.int32)))
@@ -573,7 +582,7 @@ def _text_vocab_file(model: str) -> str:
 
 
 def bench_llm(batches: int, warmup: int, model: str = "llama_small",
-              max_new: int = None, prompt_len: int = 32,
+              max_new: int | None = None, prompt_len: int = 32,
               quant: str = "", streams: int = 1,
               serve: str = "", text: bool = False) -> dict:
     """Config #5: tokens/sec through the llm filter (jitted prefill +
@@ -658,7 +667,10 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         for _ in range(warmup):
             p.push("src", prompt)
             for _ in range(max_new):
-                p.pull("out", timeout=900)
+                # generous: the FIRST pull carries device weight gen +
+                # the scan-program compile, which a slow tunnel day can
+                # stretch past 900 s (r4 sweep measured it)
+                p.pull("out", timeout=2100)
         t0 = time.perf_counter()
         for _ in range(batches):
             p.push("src", prompt)

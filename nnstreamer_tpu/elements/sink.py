@@ -22,6 +22,16 @@ from ..core.registry import register_element
 from .base import SinkElement
 
 
+def _release_credit(buf) -> None:
+    """Free an appsrc max-inflight admission slot: called at REAL
+    delivery (pop/callback) or when a drop-mode sink discards the buffer
+    — never at mere sink arrival, which async dispatch reaches before
+    the batch's H2D/compute has actually happened."""
+    credit = getattr(buf, "meta", {}).get("_inflight_credit")
+    if credit is not None:
+        credit.release()
+
+
 @register_element("tensor_sink")
 class TensorSink(SinkElement):
     """Terminal sink with app-facing pull queue + callbacks.
@@ -54,13 +64,13 @@ class TensorSink(SinkElement):
 
     def process(self, pad, buf: Buffer):
         metrics.count(f"{self.name}.frames")
-        # appsrc max-inflight credit: arrival at the sink is delivery —
-        # release BEFORE any queue/prefetch dwell so the pusher's next
-        # batch overlaps this one's sink-side settling (the sink queue is
-        # itself bounded, so total in-flight stays capped).
-        credit = buf.meta.get("_inflight_credit")
-        if credit is not None:
-            credit.release()
+        # appsrc max-inflight credits release at POP (materialized
+        # delivery), not here: stage dispatch is async, so a buffer
+        # "arrives" as a device future milliseconds after admission
+        # while its H2D/compute still queues behind earlier batches —
+        # an arrival-time release would never bound that backlog
+        # (measured: p50 e2e 7x the bound x service product).  Dropped
+        # buffers release in the discard branch below.
         # Snapshot once: a callback registered mid-stream must not observe
         # half of this method's gating (connect_new_data is a public API
         # with no start-only restriction) — it takes effect next buffer.
@@ -97,6 +107,7 @@ class TensorSink(SinkElement):
                 buf = self._resolver.submit(buf.to_host)
         if callbacks:
             buf = buf.resolve()
+            _release_credit(buf)  # callback consumers take delivery here
         for cb in callbacks:
             cb(buf)
         stop = getattr(self, "_stop_event", None)
@@ -107,9 +118,11 @@ class TensorSink(SinkElement):
             except _queue.Full:
                 if self.drop:
                     try:
-                        self._q.get_nowait()
+                        dropped = self._q.get_nowait()
                     except _queue.Empty:
                         pass
+                    else:
+                        _release_credit(dropped)  # never popped: free now
                 elif stop is not None and stop.is_set():
                     return []  # pipeline stopping: shed instead of deadlocking
                 # else: keep blocking — backpressure to the pipeline
@@ -140,6 +153,7 @@ class TensorSink(SinkElement):
             self._parked = buf
             raise
         self._parked = None
+        _release_credit(out)  # materialized delivery: admission slot frees
         return out
 
     def try_pop(self) -> Optional[Buffer]:
@@ -158,7 +172,9 @@ class TensorSink(SinkElement):
             self._parked = item
             return None
         self._parked = None
-        return self._materialize(item, 30.0)
+        out = self._materialize(item, 30.0)
+        _release_credit(out)
+        return out
 
     def _materialize(self, item, timeout: float) -> Buffer:
         import concurrent.futures as _cf
@@ -201,9 +217,7 @@ class FakeSink(SinkElement):
         # Block until device work for this buffer really finished — without
         # this, "throughput" would measure XLA's async dispatch queue.
         buf.block_until_ready()
-        credit = buf.meta.get("_inflight_credit")
-        if credit is not None:
-            credit.release()
+        _release_credit(buf)  # ready = really delivered for a fakesink
         self.count += 1
         self.last = buf
         metrics.count(f"{self.name}.frames")
@@ -233,7 +247,5 @@ class FileSink(SinkElement):
     def process(self, pad, buf):
         for t in buf.resolve().tensors:
             self._f.write(np.asarray(t).tobytes())
-        credit = buf.meta.get("_inflight_credit")
-        if credit is not None:
-            credit.release()
+        _release_credit(buf)  # bytes on disk = delivered
         return []

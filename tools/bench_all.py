@@ -59,16 +59,22 @@ def run_row(label: str, argv, timeout: int) -> dict:
                               text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return {"row": label, "error": f"timeout after {timeout}s"}
-    line = None
+    r = None
     for ln in proc.stdout.splitlines():
         ln = ln.strip()
         if ln.startswith("{") and '"metric"' in ln:
-            line = ln  # last JSON line wins
-    if line is None:
+            try:
+                r = json.loads(ln)  # last parseable JSON line wins
+            except ValueError:
+                continue  # stray brace-lines must not kill the sweep
+    if r is None:
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
         return {"row": label, "error": f"rc={proc.returncode}",
                 "tail": tail}
-    r = json.loads(line)
+    if proc.returncode != 0:
+        # a metric line followed by a non-zero exit (teardown crash) may
+        # invalidate the number — never report it as a clean row
+        r["error"] = f"rc={proc.returncode} after metric line"
     r["row"] = label
     print(f"   {r.get('metric')}: {r.get('value')} {r.get('unit')}",
           flush=True)
@@ -78,7 +84,9 @@ def run_row(label: str, argv, timeout: int) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_ALL_r4.json")
-    ap.add_argument("--row-timeout", type=int, default=1500)
+    # must exceed bench.py's own 2100 s first-pull budget (7B weight gen
+    # + scan compile on a slow tunnel day) plus the measured window
+    ap.add_argument("--row-timeout", type=int, default=2600)
     ap.add_argument("--only", default=None,
                     help="comma-separated row labels to (re)run")
     args = ap.parse_args()
@@ -89,11 +97,33 @@ def main() -> int:
     dirty = subprocess.run(["git", "status", "--porcelain"], cwd=REPO,
                            capture_output=True, text=True).stdout.strip()
     only = set(args.only.split(",")) if args.only else None
+    if only:
+        unknown = only - {label for label, _ in ROWS}
+        if unknown:
+            ap.error(f"unknown row label(s): {sorted(unknown)}")
+    # --only MERGES into an existing artifact (rerun one failed row
+    # without destroying the sweep); rerun rows note their own commit
+    # when it differs from the original sweep's.
+    prior = {}
+    prior_doc = None
+    out_path = os.path.join(REPO, args.out)
+    if only and os.path.exists(out_path):
+        with open(out_path) as f:
+            prior_doc = json.load(f)
+        prior = {r.get("row"): r for r in prior_doc.get("results", [])}
+    orig_commit = (prior_doc or {}).get("assembled_at_commit", commit)
     results = []
     for label, argv in ROWS:
         if only and label not in only:
+            if label in prior:
+                results.append(prior[label])
             continue
-        results.append(run_row(label, argv, args.row_timeout))
+        r = run_row(label, argv, args.row_timeout)
+        if prior_doc is not None and commit != orig_commit:
+            # merged artifact keeps the ORIGINAL sweep's provenance;
+            # only rows measured elsewhere carry their own commit
+            r["rerun_at_commit"] = commit
+        results.append(r)
 
     out = {
         "note": "ONE sequential sweep, one session, one commit (each row "
@@ -101,9 +131,13 @@ def main() -> int:
                 "llm continuous throughput counts per-token emit_t "
                 "timestamps; full_occupancy_tokens_per_sec isolates the "
                 "all-slots-live window from the stagger ramp.",
-        "assembled_at_commit": commit + ("+dirty" if dirty else ""),
-        "measured_at": datetime.datetime.now(
-            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "assembled_at_commit": (orig_commit if prior_doc is not None
+                                else commit + ("+dirty" if dirty else "")),
+        "measured_at": ((prior_doc or {}).get("measured_at")
+                        if prior_doc is not None else None)
+                       or datetime.datetime.now(
+                           datetime.timezone.utc).isoformat(
+                               timespec="seconds"),
         "parity_bar": {"fps_per_chip": 250.0,
                        "source": "BASELINE.json north star / 8 chips"},
         "results": results,
@@ -114,7 +148,7 @@ def main() -> int:
         out["device"] = str(jax.devices()[0].device_kind)
     except Exception:  # noqa: BLE001 - annotation only
         pass
-    with open(os.path.join(REPO, args.out), "w") as f:
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {args.out} ({len(results)} rows)")
     return 0 if all("error" not in r for r in results) else 1
