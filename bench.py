@@ -635,13 +635,17 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         custom += f",serve:continuous,slots:{n_streams}"
     # invoke-dynamic only for the continuous path: the committed static
     # rows were measured without it, and it must stay that way so this
-    # commit reproduces the artifact's exact pipelines.
-    dyn = "invoke-dynamic=true ! " if serve == "continuous" else ""
+    # commit reproduces the artifact's exact pipelines.  The '!' before
+    # the sink stays OUTSIDE the conditional: interpolating it with the
+    # option left the static pipelines with an UNLINKED sink (the parser
+    # reads bare juxtaposition as a new gst-launch chain), which hung
+    # every static llm row's first pull in the r4 sweeps until the
+    # runtime learned to reject inputless non-sources at construction.
+    dyn = "invoke-dynamic=true " if serve == "continuous" else ""
     desc = (
         "appsrc name=src ! "
         f"tensor_filter framework=llm model={model} custom={custom} "
-        f"{dyn}"
-        "tensor_sink name=out"
+        f"{dyn}! tensor_sink name=out"
     )
     p = nt.Pipeline(desc)
     if serve == "continuous":

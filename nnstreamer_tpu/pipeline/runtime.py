@@ -280,6 +280,21 @@ class Pipeline:
                 self._by_name[node.name] = el
             self._by_name.setdefault(el.name, el)
 
+        # A non-source element with no input link can never receive a
+        # buffer — almost always a missing '!' between two elements (the
+        # parser accepts gst-launch's multi-chain juxtaposition, so this
+        # is only detectable once element classes are known).  Fail at
+        # construction instead of hanging the first pull.
+        from ..elements.base import SourceElement
+
+        for nid, el in self.elements.items():
+            if isinstance(el, SourceElement):
+                continue
+            if not self.graph.in_edges(nid):
+                raise PipelineError(
+                    f"element {el.name!r} ({self.graph.nodes[nid].kind}) "
+                    "has no input link — missing '!' before it?")
+
     # -- negotiation -------------------------------------------------------
     def _negotiate(self) -> None:
         out_caps: Dict[Tuple[int, str], Caps] = {}

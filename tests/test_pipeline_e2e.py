@@ -640,3 +640,34 @@ class TestBoundedAdmission:
                 p.push("src", x)
             p.eos()
             p.wait(timeout=30)
+
+
+class TestUnlinkedElementRejected:
+    """A missing '!' between elements parses as a new gst-launch chain,
+    leaving the second element with no input — the runtime must reject
+    it at construction instead of hanging the first pull (this exact
+    bug silently disconnected the bench's static llm sink for a round)."""
+
+    def test_missing_bang_before_sink(self):
+        from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+        with pytest.raises(PipelineError, match="no input link"):
+            nt.Pipeline(
+                "appsrc name=src ! "
+                "tensor_transform mode=typecast option=float32 "
+                "tensor_sink name=out")
+
+    def test_multi_chain_mux_still_legal(self):
+        # gst-launch juxtaposition with NAMED cross-links stays valid
+        p = nt.Pipeline(
+            "appsrc name=a caps=other/tensors,dimensions=4,types=float32 ! mux.sink_0 "
+            "appsrc name=b caps=other/tensors,dimensions=4,types=float32 ! mux.sink_1 "
+            "tensor_mux name=mux ! tensor_sink name=out")
+        x = np.ones((4,), np.float32)
+        with p:
+            p.push("a", x)
+            p.push("b", 2 * x)
+            out = p.pull("out", timeout=15)
+            p.eos()
+            p.wait(timeout=15)
+        assert len(out.tensors) == 2

@@ -85,8 +85,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_ALL_r4.json")
     # must exceed bench.py's own 2100 s first-pull budget (7B weight gen
-    # + scan compile on a slow tunnel day) plus the measured window
-    ap.add_argument("--row-timeout", type=int, default=2600)
+    # + scan compile on a slow tunnel day) PLUS the remaining warmup/
+    # measure/teardown time, or rows bench.py would finish get killed
+    ap.add_argument("--row-timeout", type=int, default=3600)
     ap.add_argument("--only", default=None,
                     help="comma-separated row labels to (re)run")
     args = ap.parse_args()
@@ -111,7 +112,8 @@ def main() -> int:
         with open(out_path) as f:
             prior_doc = json.load(f)
         prior = {r.get("row"): r for r in prior_doc.get("results", [])}
-    orig_commit = (prior_doc or {}).get("assembled_at_commit", commit)
+    cur_commit = commit + ("+dirty" if dirty else "")
+    orig_commit = (prior_doc or {}).get("assembled_at_commit", cur_commit)
     results = []
     for label, argv in ROWS:
         if only and label not in only:
@@ -119,10 +121,11 @@ def main() -> int:
                 results.append(prior[label])
             continue
         r = run_row(label, argv, args.row_timeout)
-        if prior_doc is not None and commit != orig_commit:
+        if prior_doc is not None and cur_commit != orig_commit:
             # merged artifact keeps the ORIGINAL sweep's provenance;
             # only rows measured elsewhere carry their own commit
-            r["rerun_at_commit"] = commit
+            # (dirty marker included, same as a full sweep records)
+            r["rerun_at_commit"] = cur_commit
         results.append(r)
 
     out = {
@@ -132,7 +135,7 @@ def main() -> int:
                 "timestamps; full_occupancy_tokens_per_sec isolates the "
                 "all-slots-live window from the stagger ramp.",
         "assembled_at_commit": (orig_commit if prior_doc is not None
-                                else commit + ("+dirty" if dirty else "")),
+                                else cur_commit),
         "measured_at": ((prior_doc or {}).get("measured_at")
                         if prior_doc is not None else None)
                        or datetime.datetime.now(
