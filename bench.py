@@ -616,8 +616,15 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         # continuous serving shortens the chunk: admission is quantized
         # to chunk boundaries, so 8 tokens (~150 ms at 7B int8) bounds a
         # late joiner's wait while the per-chunk roundtrip overhead stays
-        # a few percent; batch/static modes keep 32 for pure throughput
-        chunk = 8 if serve == "continuous" else 32
+        # a few percent.  Static modes (r5 policy, BENCH_ALL_r5+) cover
+        # max_new in ONE chunk — the decode is a single lax.scan
+        # roundtrip, so a slow-tunnel day's fetch RTT (measured 15-107 ms
+        # across sessions) is paid once, not per 32 tokens (the per-step
+        # device profile, PROFILE_LLM_r5.json, shows the decode at its
+        # HBM roofline — RTT is the only e2e lever left).  The r4 static
+        # rows were measured with chunk 32 at the r4 commits recorded in
+        # BENCH_ALL_r4.json; reproduce THOSE from that commit.
+        chunk = 8 if serve == "continuous" else max(32, max_new)
         custom += (f",param_dtype:bfloat16,max_seq:{max_seq},"
                    f"stream_chunk:{chunk}")
     if quant:

@@ -516,9 +516,11 @@ def quantize_int8(params: Dict) -> Dict:
 
     The decode step is HBM-bandwidth-bound (every generated token streams
     the full parameter set through the MXU); storing the seven big layer
-    mats + lm_head as int8 halves bytes/token vs bf16 — XLA fuses the
-    int8->bf16 convert into the dot's operand read, so the dequant costs
-    no extra HBM traffic.  Norms and the embedding table (gather — tiny
+    mats + lm_head as int8 halves bytes/token vs bf16.  Consumption is
+    scale-AFTER-dot (see :func:`_mm`): the int8->bf16 convert fuses into
+    the dot's operand read so dequant costs no extra HBM traffic, which
+    premultiplying the scale would break (measured 4x/mat on chip —
+    PROFILE_LLM_r5.json).  Norms and the embedding table (gather — tiny
     per-token traffic) stay full precision.
 
     Quantization runs ON DEVICE via jit: 7B params are materialized in
@@ -557,25 +559,33 @@ def _apply_quant(params: Dict, opts: Dict) -> Dict:
     return params
 
 
-def _maybe_dequant_layer(lp: Dict, dt) -> Dict:
-    """Scan-body hook: reconstruct the _block weight dict from int8+scale
-    leaves (identity for full-precision layers)."""
-    if "wq_q" not in lp:
-        return lp
-    out = {"ln_attn": lp["ln_attn"], "ln_mlp": lp["ln_mlp"]}
-    for k in _QUANT_MATS:
-        out[k] = lp[k + "_q"].astype(dt) * lp[k + "_s"].astype(dt)
-    return out
+def _mm(h, lp: Dict, key: str, dt):
+    """``h @ W`` for a layer dict that stores ``key`` either full-precision
+    or as int8+scale leaves (``key_q``/``key_s``).
+
+    Quantized mats are applied SCALE-AFTER-DOT: ``(h @ q.astype(dt)) * s``,
+    exact algebra for per-output-channel scales.  The int8->bf16 convert
+    fuses into the dot's operand read, so the weights stream through the
+    MXU at 1 byte/param; premultiplying the scale instead
+    (``h @ (q.astype(dt) * s)``) forces XLA to materialize a full bf16
+    copy of every mat in HBM — measured 4x slower per mat on v5e
+    (tools/probe_int8_dot.py).  int8 values are integers <= 127, exactly
+    representable in bf16, so postscale is also the more accurate order.
+    """
+    if key + "_q" in lp:
+        return (h @ lp[key + "_q"].astype(dt)) * lp[key + "_s"].astype(dt)
+    return h @ lp[key].astype(dt)
 
 
 def _lm_head(params: Dict, x, dt):
-    if "lm_head_q" in params:
-        w = params["lm_head_q"].astype(dt) * params["lm_head_s"].astype(dt)
-    else:
-        w = params["lm_head"].astype(dt)
     import jax.numpy as jnp
 
-    return (x @ w).astype(jnp.float32)
+    if "lm_head_q" in params:
+        # scale-after-dot (see _mm); scales are f32 so the output is
+        # promoted to f32 by the multiply itself
+        y = x @ params["lm_head_q"].astype(dt)
+        return y.astype(jnp.float32) * params["lm_head_s"]
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
 
 
 def param_pspecs(quant: bool = False) -> Dict:
@@ -672,9 +682,9 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     dt = x.dtype
 
     h = _rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(B, T, H, hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, T, Hkv, hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, T, Hkv, hd)
+    q = _mm(h, lp, "wq", dt).reshape(B, T, H, hd)
+    k = _mm(h, lp, "wk", dt).reshape(B, T, Hkv, hd)
+    v = _mm(h, lp, "wv", dt).reshape(B, T, Hkv, hd)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
@@ -737,15 +747,15 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
         p = p / jnp.sum(p, axis=-1, keepdims=True)
         attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), vr)
 
-    out = attn.reshape(B, T, H * hd) @ lp["wo"].astype(dt)
+    out = _mm(attn.reshape(B, T, H * hd), lp, "wo", dt)
     x = x + out
 
     h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
     import jax.nn as jnn
 
-    gate = jnn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    gate = jnn.silu(_mm(h, lp, "w_gate", dt))
+    up = _mm(h, lp, "w_up", dt)
+    x = x + _mm(gate * up, lp, "w_down", dt)
     return x, kv
 
 
@@ -760,7 +770,7 @@ def forward(params, tokens, cfg: LlamaConfig, compute_dtype="bfloat16"):
     positions = jnp.arange(T)
 
     def body(x, lp):
-        x, _ = _block(cfg, _maybe_dequant_layer(lp, dt), x, positions)
+        x, _ = _block(cfg, lp, x, positions)
         return x, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
@@ -819,8 +829,7 @@ def forward_cached(params, tokens, cache, pos_offset, cfg: LlamaConfig,
 
     def body(x, layer):
         lp, kc, vc = layer
-        x, (kc, vc) = _block(cfg, _maybe_dequant_layer(lp, dt), x,
-                             positions, kv=(kc, vc),
+        x, (kc, vc) = _block(cfg, lp, x, positions, kv=(kc, vc),
                              pos_offset=pos_offset)
         return x, (kc, vc)
 
@@ -861,8 +870,7 @@ def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
             return ring_attention_local(q, k, v, axis_name="seq", causal=True)
 
         def body(x, lp):
-            x, _ = _block(cfg, _maybe_dequant_layer(lp, dt), x, positions,
-                          attn_fn=attn_fn)
+            x, _ = _block(cfg, lp, x, positions, attn_fn=attn_fn)
             return x, None
 
         x, _ = lax.scan(body, x, params["layers"])

@@ -97,9 +97,14 @@ class SentencePieceTokenizer:
         """Core SPM encode, no BOS."""
         if not text:
             return []
+        # SPM prepends the dummy space UNCONDITIONALLY (before escaping),
+        # so " a" -> "▁▁a": two markers, not one.  Replacing first and
+        # skipping the prefix when the text already starts with ▁ dropped
+        # one marker on leading-space text (caught by the HF-tokenizers
+        # oracle, tests/test_tokenizer_oracle.py).
+        if self.add_prefix_space:
+            text = " " + text
         text = text.replace(" ", _SPACE)
-        if self.add_prefix_space and not text.startswith(_SPACE):
-            text = _SPACE + text
         sym = list(text)  # one symbol per unicode char to start
         n = len(sym)
         nxt = list(range(1, n)) + [-1]

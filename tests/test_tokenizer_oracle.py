@@ -1,0 +1,189 @@
+"""Cross-validate the SPM tokenizer against an INDEPENDENT oracle
+(VERDICT r4 Missing #4).
+
+Oracle: HuggingFace ``tokenizers`` (Rust) BPE with merges ranked by
+descending merged-piece score — the published conversion of a
+SentencePiece-BPE vocab (transformers' SpmConverter recipe: Prepend/
+Replace normalizer, ``byte_fallback=True``).  With UNIQUE scores the
+greedy highest-score merge (llama.cpp ``llm_tokenizer_spm``) and BPE
+lowest-rank merge orders coincide, so any id-sequence disagreement is a
+real bug in one side's merge procedure, normalization, or byte fallback.
+
+Also pins hand-derived fixtures the fuzz can't force deterministically:
+equal-score tie-breaks (leftmost pair wins), UTF-8 multibyte fallback,
+unknown-byte -> UNK, and the unconditional dummy-prefix rule the oracle
+caught (" a" -> "▁▁a", two markers).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from nnstreamer_tpu.models.tokenizer import (
+    _SPACE, TYPE_BYTE, TYPE_CONTROL, TYPE_NORMAL, TYPE_UNKNOWN,
+    SentencePieceTokenizer, toy_vocab,
+)
+
+tokenizers = pytest.importorskip("tokenizers")
+
+
+def build_vocab(rng: random.Random, alphabet: str, n_pieces: int):
+    """Random SPM vocab: specials + full byte range + single chars +
+    random multi-char merge pieces, all with UNIQUE scores."""
+    pieces = ["<unk>", "<s>", "</s>"]
+    types = [TYPE_UNKNOWN, TYPE_CONTROL, TYPE_CONTROL]
+    scores = [0.0, 0.0, 0.0]
+    for b in range(256):
+        pieces.append(f"<0x{b:02X}>")
+        types.append(TYPE_BYTE)
+        scores.append(0.0)
+    singles = [_SPACE] + list(alphabet)
+    # unique low scores for singles
+    for i, ch in enumerate(singles):
+        pieces.append(ch)
+        types.append(TYPE_NORMAL)
+        scores.append(-1e4 - i)
+    seen = set(pieces)
+    merged = []
+    while len(merged) < n_pieces:
+        ln = rng.randint(2, 5)
+        p = "".join(rng.choice(singles) for _ in range(ln))
+        if p in seen:
+            continue
+        seen.add(p)
+        merged.append(p)
+    # unique scores drawn without replacement
+    vals = rng.sample(range(1, 100000), len(merged))
+    for p, v in zip(merged, vals):
+        pieces.append(p)
+        types.append(TYPE_NORMAL)
+        scores.append(-v / 100.0)
+    return SentencePieceTokenizer(pieces, scores, types, bos=1, eos=2,
+                                  unk=0)
+
+
+def build_oracle(tok: SentencePieceTokenizer):
+    """The HF-tokenizers twin of an SPM vocab (SpmConverter recipe)."""
+    from tokenizers import Tokenizer, models, normalizers
+
+    vocab = {}
+    for i, p in enumerate(tok.pieces):
+        vocab.setdefault(p, i)  # first occurrence wins, like ours
+    merges = []
+    for p, i in vocab.items():
+        if tok.types[i] != TYPE_NORMAL or len(p) < 2:
+            continue
+        for cut in range(1, len(p)):
+            a, b = p[:cut], p[cut:]
+            if a in vocab and b in vocab and \
+                    tok.types[vocab[a]] != TYPE_BYTE and \
+                    tok.types[vocab[b]] != TYPE_BYTE:
+                merges.append((tok.scores[i], a, b))
+    merges.sort(key=lambda m: (-m[0], len(m[1] + m[2])))
+    t = Tokenizer(models.BPE(
+        vocab=vocab, merges=[(a, b) for _, a, b in merges],
+        byte_fallback=True, unk_token="<unk>", fuse_unk=True))
+    t.normalizer = normalizers.Sequence([
+        normalizers.Prepend(_SPACE),
+        normalizers.Replace(" ", _SPACE),
+    ])
+    return t
+
+
+def random_text(rng: random.Random, alphabet: str,
+                literal_block: bool = True) -> str:
+    # literal ▁ exercises the encode path but is inherently lossy on
+    # decode (SPM maps it back to space), so round-trip fuzz excludes it
+    pool = alphabet + "  " + "éß中😀" + ("▁" if literal_block else "")
+    return "".join(rng.choice(pool)
+                   for _ in range(rng.randint(1, 40))).strip() or "a"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_vs_hf_tokenizers(seed):
+    rng = random.Random(seed)
+    alphabet = "abcdefgh"
+    tok = build_vocab(rng, alphabet, n_pieces=120)
+    oracle = build_oracle(tok)
+    for case in range(200):
+        text = random_text(rng, alphabet)
+        ours = tok.encode_text(text)
+        ref = oracle.encode(text, add_special_tokens=False).ids
+        assert ours == ref, (
+            f"seed={seed} case={case} text={text!r}: "
+            f"ours={[tok.pieces[i] for i in ours]} "
+            f"oracle={[tok.pieces[i] for i in ref]}")
+
+
+def test_fuzz_decode_round_trip():
+    rng = random.Random(99)
+    tok = build_vocab(rng, "abcd", n_pieces=60)
+    for _ in range(100):
+        text = random_text(rng, "abcd", literal_block=False)
+        ids = tok.encode_text(text)
+        # SPM normalization is space -> ▁ with a dummy prefix; decode
+        # inverts both, so round-trip must reproduce the input exactly
+        assert tok.decode(ids) == text
+
+
+# -- pinned fixtures (hand-derived, no oracle needed) ---------------------
+
+def test_equal_score_tie_break_leftmost():
+    # "ab" and "bc" share a score over "abc": the LEFTMOST candidate pair
+    # merges first (llama.cpp orders its bigram queue by score then left
+    # index), so the result is [▁, ab, c], never [▁, a, bc].
+    tok = toy_vocab({"ab": -1.0, "bc": -1.0})
+    ids = tok.encode_text("abc")
+    assert [tok.pieces[i] for i in ids] == [_SPACE, "ab", "c"]
+
+
+def test_merge_order_follows_score_not_length():
+    # higher-scoring short merge beats a longer lower-scoring one
+    tok = toy_vocab({"ab": -1.0, "abc": -50.0, "bc": -2.0})
+    ids = tok.encode_text("abc")
+    assert [tok.pieces[i] for i in ids] == [_SPACE, "abc"]
+    # the path matters: ab (best) then ab+c via "abc" piece
+
+
+def test_unconditional_dummy_prefix():
+    # " a" must become ▁▁a (prefix prepended BEFORE space escaping);
+    # the pre-fix implementation produced a single ▁ here
+    tok = toy_vocab()
+    ids = tok.encode_text(" a")
+    assert [tok.pieces[i] for i in ids] == [_SPACE, _SPACE, "a"]
+    assert tok.decode(ids) == " a"
+
+
+def test_literal_block_char_keeps_prefix():
+    # text that already starts with ▁ still gets the dummy prefix
+    tok = toy_vocab()
+    ids = tok.encode_text("▁x")
+    assert [tok.pieces[i] for i in ids][:2] == [_SPACE, _SPACE]
+
+
+def test_multibyte_byte_fallback():
+    # é = C3 A9: no single-char piece, so two byte tokens
+    tok = toy_vocab()
+    ids = tok.encode_text("é")
+    assert [tok.pieces[i] for i in ids] == [_SPACE, "<0xC3>", "<0xA9>"]
+    assert tok.decode(ids) == "é"
+
+
+def test_no_byte_pieces_falls_back_to_unk():
+    pieces = ["<unk>", "<s>", "</s>", _SPACE, "a"]
+    types = [TYPE_UNKNOWN, TYPE_CONTROL, TYPE_CONTROL, TYPE_NORMAL,
+             TYPE_NORMAL]
+    tok = SentencePieceTokenizer(pieces, [0, 0, 0, -1, -2], types)
+    ids = tok.encode_text("aQ")
+    assert ids == [3, 4, 0]  # ▁, a, <unk>
+
+
+def test_merged_piece_via_either_split():
+    # "abc" reachable as ab+c or a+bc; both paths must land on the piece
+    tok_l = toy_vocab({"ab": -1.0, "abc": -0.5})
+    tok_r = toy_vocab({"bc": -1.0, "abc": -0.5})
+    for tok in (tok_l, tok_r):
+        ids = tok.encode_text("abc")
+        assert [tok.pieces[i] for i in ids] == [_SPACE, "abc"]
