@@ -95,7 +95,9 @@ class LLMFramework(Framework):
     ``serve:continuous`` + ``slots:N`` (continuous batching: a standing
     per-row-position decode loop that admits queued prompts into free
     slots at chunk boundaries — see :class:`_ContinuousLoop`),
-    ``quant:int8`` (weight-only int8),
+    ``quant:int8`` / ``quant:int4`` (weight-only quantization; int4 is
+    nibble-packed and decodes through the Pallas kernel in
+    ops/int4_matmul.py on TPU),
     ``dtype:bfloat16|float32``, plus any model-builder options
     (``dim:…``, ``n_layers:…``) forwarded to the zoo.
     """
@@ -206,6 +208,13 @@ class LLMFramework(Framework):
             pspecs = self.bundle.param_pspecs or llama.param_pspecs()
             params = shard_params(self.mesh, params, pspecs)
             self.bundle.params = params
+            # pallas_call has no GSPMD partitioning rule: int4 programs
+            # traced for this sharded mesh must take the shardable XLA
+            # reference path (process-global flag; restored in close())
+            from ..ops import int4_matmul as _i4
+
+            self._int4_kernel_was = _i4.KERNEL_ENABLED
+            _i4.KERNEL_ENABLED = False
 
         def fwd(params, tokens, cache, pos):
             return llama.forward_cached(params, tokens, cache, pos, cfg,
@@ -250,6 +259,11 @@ class LLMFramework(Framework):
         if self._serve is not None:
             self._serve.shutdown()
             self._serve = None
+        if getattr(self, "_int4_kernel_was", None) is not None:
+            from ..ops import int4_matmul as _i4
+
+            _i4.KERNEL_ENABLED = self._int4_kernel_was
+            self._int4_kernel_was = None
         self.bundle = None
         self._fwd = None
         self._decode_chunk = None

