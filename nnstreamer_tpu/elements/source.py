@@ -600,3 +600,202 @@ class TensorSrcIIO(SourceElement):
                     )
                 i += 1
             yield Buffer([np.stack(rows)])
+
+
+#: v4l2src format name -> (fourcc, bytes per pixel)
+_V4L2_FORMATS = {"RGB": ("RGB3", 3), "BGR": ("BGR3", 3),
+                 "GRAY8": ("GREY", 1), "YUY2": ("YUYV", 2)}
+
+
+@register_element("v4l2src")
+class V4L2Src(SourceElement):
+    """Camera capture — the literal ``v4l2src`` of the north-star
+    pipeline (``v4l2src ! tensor_converter ! tensor_filter ! ...``,
+    SURVEY §7 design stance).
+
+    Two backends behind one element:
+
+    * ``/dev/videoN`` (a char device): the NATIVE ioctl/mmap streaming
+      ring in native/src/nnstpu.cpp (``nns_v4l2_*``) — REQBUFS(MMAP) +
+      QBUF/DQBUF, driver-owned buffers, select()-paced.  Construction
+      fails loudly when the node is not a streaming capture device.
+    * a FIFO / regular file of raw frames (``width*height*bpp`` bytes
+      each): the hermetic-test and replay backend, same polling
+      discipline as tensor_src_iio (O_NONBLOCK + stop-event checks, so
+      a stalled producer never blocks pipeline shutdown).
+
+    Props: ``device`` (default ``/dev/video0``), ``width``/``height``/
+    ``format`` (RGB/BGR/GRAY8/YUY2) — caps are fixed at pipeline
+    construction, so a driver that substitutes another mode fails
+    loudly at start() naming what it offered (silent substitution
+    would feed skewed or never-arriving frames downstream); row-padded
+    strides (``bytesperline > width*bpp``) are repacked through the
+    native stride stripper.  ``num-buffers``, ``framerate``,
+    ``io-mode`` (``auto`` | ``native`` | ``raw``).  Emits host video
+    frames ``[H, W, bpp]`` uint8; ``tensor_converter`` downstream turns
+    them into ``other/tensors`` exactly as it does for videotestsrc.
+    """
+
+    kind = "v4l2src"
+
+    def __init__(self, props=None, name=None):
+        super().__init__(props, name)
+        self.device = str(self.props.get("device", "/dev/video0"))
+        self.width = int(self.props.get("width", 640))
+        self.height = int(self.props.get("height", 480))
+        self.format = str(self.props.get("format", "RGB")).upper()
+        if self.format not in _V4L2_FORMATS:
+            raise ElementError(
+                f"{self.name}: format must be one of "
+                f"{sorted(_V4L2_FORMATS)}, got {self.format!r}")
+        self.num_buffers = int(self.props.get("num_buffers", -1))
+        self.rate = parse_fraction(self.props.get("framerate", (30, 1)))
+        self.io_mode = str(self.props.get("io_mode", "auto")).lower()
+        if self.io_mode not in ("auto", "native", "raw"):
+            raise ElementError(
+                f"{self.name}: io-mode must be auto|native|raw, "
+                f"got {self.io_mode!r}")
+        self.n_bufs = int(self.props.get("n_bufs", 4))
+        self._cap = None   # native backend handle
+        self._fd = None    # raw backend fd
+        self._is_fifo = False
+        self._saw_data = False
+
+    def configure(self, in_caps, out_pads):
+        caps = Caps.new(
+            MediaType.VIDEO,
+            format=self.format,
+            width=self.width,
+            height=self.height,
+            framerate=self.rate,
+        )
+        self.out_caps = {p: caps for p in out_pads}
+        return self.out_caps
+
+    def _frame_bytes(self) -> int:
+        return self.width * self.height * _V4L2_FORMATS[self.format][1]
+
+    def start(self) -> None:
+        import os as _os
+        import stat as _stat
+
+        try:
+            st = _os.stat(self.device)
+        except OSError as e:
+            raise ElementError(
+                f"{self.name}: cannot stat device {self.device!r}: {e}"
+            ) from e
+        use_native = (self.io_mode == "native"
+                      or (self.io_mode == "auto"
+                          and _stat.S_ISCHR(st.st_mode)))
+        if use_native:
+            from .. import native
+
+            fourcc, _ = _V4L2_FORMATS[self.format]
+            try:
+                cap = native.V4L2Capture(self.device, self.width,
+                                         self.height, fourcc,
+                                         n_bufs=self.n_bufs)
+            except RuntimeError as e:
+                raise ElementError(f"{self.name}: {e}") from e
+            # Caps were negotiated at pipeline construction, BEFORE the
+            # device opened — a driver substituting format or geometry
+            # cannot flow downstream, so it must fail LOUDLY here (the
+            # silent alternative: every frame skipped or row-sheared).
+            # The error names what the driver offered so the pipeline
+            # string can be corrected.
+            if (cap.pixfmt != fourcc or cap.width != self.width
+                    or cap.height != self.height):
+                got = (f"{cap.pixfmt} {cap.width}x{cap.height}")
+                cap.close()
+                raise ElementError(
+                    f"{self.name}: device negotiated {got}, pipeline "
+                    f"caps want {fourcc} {self.width}x{self.height} — "
+                    "set width/height/format to a mode the device "
+                    "supports")
+            self._cap = cap
+            return
+        try:
+            self._fd = _os.open(self.device, _os.O_RDONLY | _os.O_NONBLOCK)
+            self._is_fifo = _stat.S_ISFIFO(_os.fstat(self._fd).st_mode)
+        except OSError as e:
+            raise ElementError(
+                f"{self.name}: cannot open device {self.device!r}: {e}"
+            ) from e
+
+    def stop(self) -> None:
+        if self._cap is not None:
+            self._cap.close()
+            self._cap = None
+        if self._fd is not None:
+            import os as _os
+
+            try:
+                _os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+
+    def _read_raw_frame(self, stop) -> Optional[np.ndarray]:
+        """One raw frame from the FIFO/file backend, or None at
+        EOF/stop (same polling discipline as tensor_src_iio)."""
+        import os as _os
+        import select as _select
+
+        need = self._frame_bytes()
+        parts, got = [], 0
+        while got < need:
+            if stop.is_set():
+                return None
+            r, _, _ = _select.select([self._fd], [], [], 0.2)
+            if not r:
+                continue
+            try:
+                chunk = _os.read(self._fd, need - got)
+            except BlockingIOError:
+                continue
+            except OSError:
+                return None
+            if chunk == b"":
+                if self._is_fifo and not self._saw_data:
+                    if stop.wait(0.05):
+                        return None
+                    continue
+                return None  # real EOF; a short tail frame is dropped
+            self._saw_data = True
+            parts.append(chunk)
+            got += len(chunk)
+        return np.frombuffer(b"".join(parts), np.uint8)
+
+    def generate(self):
+        stop = getattr(self, "_stop_event", threading.Event())
+        num = self.num_buffers if self.num_buffers >= 0 else 1 << 62
+        frame_ns = int(1e9 * self.rate[1] / max(1, self.rate[0]))
+        bpp = _V4L2_FORMATS[self.format][1]
+        need = self._frame_bytes()
+        for i in range(num):
+            if stop.is_set():
+                return
+            if self._cap is not None:
+                raw = None
+                while raw is None:
+                    if stop.is_set():
+                        return
+                    raw = self._cap.capture(timeout_ms=200)
+                row = self.width * bpp
+                if self._cap.stride > row:
+                    # driver pads rows (bytesperline > width*bpp):
+                    # repack through the native stride stripper
+                    from .. import native
+
+                    raw = native.strip_stride(raw, self.height, row,
+                                              self._cap.stride)
+                if raw.nbytes < need:
+                    continue  # driver hiccup: skip the short frame
+                raw = raw[:need]
+            else:
+                raw = self._read_raw_frame(stop)
+                if raw is None:
+                    return  # EOF
+            yield Buffer([raw.reshape(self.height, self.width, bpp)],
+                         pts=i * frame_ns)

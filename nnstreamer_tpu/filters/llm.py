@@ -210,11 +210,14 @@ class LLMFramework(Framework):
             self.bundle.params = params
             # pallas_call has no GSPMD partitioning rule: int4 programs
             # traced for this sharded mesh must take the shardable XLA
-            # reference path (process-global flag; restored in close())
+            # reference path.  Refcounted disable, taken LAST in the TP
+            # block (nothing after it throws) and released in close(),
+            # so a failed open can't leak a disabled kernel and two TP
+            # filters don't clobber each other.
             from ..ops import int4_matmul as _i4
 
-            self._int4_kernel_was = _i4.KERNEL_ENABLED
-            _i4.KERNEL_ENABLED = False
+            _i4.disable_kernel()
+            self._int4_disabled = True
 
         def fwd(params, tokens, cache, pos):
             return llama.forward_cached(params, tokens, cache, pos, cfg,
@@ -259,11 +262,11 @@ class LLMFramework(Framework):
         if self._serve is not None:
             self._serve.shutdown()
             self._serve = None
-        if getattr(self, "_int4_kernel_was", None) is not None:
+        if getattr(self, "_int4_disabled", False):
             from ..ops import int4_matmul as _i4
 
-            _i4.KERNEL_ENABLED = self._int4_kernel_was
-            self._int4_kernel_was = None
+            _i4.enable_kernel()
+            self._int4_disabled = False
         self.bundle = None
         self._fwd = None
         self._decode_chunk = None

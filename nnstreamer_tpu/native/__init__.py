@@ -110,6 +110,22 @@ def _load(block: bool = True) -> Optional[ctypes.CDLL]:
         lib.nns_ring_close.argtypes = [ctypes.c_void_p]
         lib.nns_ring_free.restype = None
         lib.nns_ring_free.argtypes = [ctypes.c_void_p]
+        lib.nns_v4l2_open.restype = ctypes.c_void_p
+        lib.nns_v4l2_open.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.nns_v4l2_frame_bytes.restype = ctypes.c_long
+        lib.nns_v4l2_frame_bytes.argtypes = [ctypes.c_void_p]
+        lib.nns_v4l2_stride.restype = ctypes.c_long
+        lib.nns_v4l2_stride.argtypes = [ctypes.c_void_p]
+        lib.nns_v4l2_capture.restype = ctypes.c_long
+        lib.nns_v4l2_capture.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.nns_v4l2_close.restype = None
+        lib.nns_v4l2_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
     finally:
@@ -137,6 +153,67 @@ def _to_u8(data) -> np.ndarray:
     if isinstance(data, (bytes, bytearray, memoryview)):
         return np.frombuffer(data, np.uint8)
     return np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+
+
+# -- v4l2 capture ------------------------------------------------------------
+
+def fourcc(code: str) -> int:
+    """'RGB3' -> the v4l2 32-bit fourcc."""
+    if len(code) != 4:
+        raise ValueError(f"fourcc must be 4 chars, got {code!r}")
+    b = code.encode("ascii")
+    return b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)
+
+
+class V4L2Capture:
+    """mmap-streaming v4l2 capture (nns_v4l2_* in native/src/nnstpu.cpp).
+
+    Negotiates (width, height, fourcc) with the driver — the actual
+    values land on the instance; ``capture(timeout_ms)`` returns one raw
+    frame as a uint8 array, None on timeout (poll your stop event and
+    retry).  Raises RuntimeError with the driver's errno message when
+    the device is not a streaming v4l2 capture node."""
+
+    def __init__(self, device: str, width: int, height: int,
+                 pixfmt: str = "RGB3", n_bufs: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable (g++ build failed); "
+                "v4l2 capture requires it")
+        self._lib = lib
+        w = ctypes.c_int(width)
+        h = ctypes.c_int(height)
+        fc = ctypes.c_uint32(fourcc(pixfmt))
+        err = ctypes.create_string_buffer(256)
+        self._h = lib.nns_v4l2_open(device.encode(), ctypes.byref(w),
+                                    ctypes.byref(h), ctypes.byref(fc),
+                                    n_bufs, err, len(err))
+        if not self._h:
+            raise RuntimeError(
+                f"v4l2 open {device!r}: {err.value.decode(errors='replace')}")
+        self.width = int(w.value)
+        self.height = int(h.value)
+        self.pixfmt = ctypes.string_at(
+            ctypes.byref(ctypes.c_uint32(fc.value)), 4).decode(
+                errors="replace")
+        self.frame_bytes = int(lib.nns_v4l2_frame_bytes(self._h))
+        self.stride = int(lib.nns_v4l2_stride(self._h))  # bytesperline
+
+    def capture(self, timeout_ms: int = 200) -> Optional[np.ndarray]:
+        out = np.empty(self.frame_bytes, np.uint8)
+        n = self._lib.nns_v4l2_capture(self._h, _as_u8p(out), out.nbytes,
+                                       int(timeout_ms))
+        if n == 0:
+            return None
+        if n < 0:
+            raise RuntimeError("v4l2 capture failed (device error)")
+        return out[:n]
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.nns_v4l2_close(self._h)
+            self._h = None
 
 
 # -- crc32 -------------------------------------------------------------------

@@ -298,4 +298,192 @@ void nns_ring_free(void *ring) {
   delete r;
 }
 
+// ---------------------------------------------------------------------------
+// v4l2 capture (ioctl + mmap buffer ring) — the literal camera ingest hot
+// path (reference analog: v4l2src feeding tensor_converter; SURVEY §7's
+// "v4l2src -> tensor_filter" north star).  Streaming I/O: REQBUFS(MMAP),
+// QBUF all, STREAMON; each nns_v4l2_capture select()s with a timeout,
+// DQBUFs one filled buffer, copies the payload out, and immediately QBUFs
+// the slot back — the driver always owns n-1 buffers, so frame drops under
+// a slow consumer happen in the DRIVER ring (newest-overwrites policy per
+// driver), never by unbounded host queueing.
+// ---------------------------------------------------------------------------
+
+}  // extern "C"
+
+#include <linux/videodev2.h>
+#include <sys/ioctl.h>
+#include <sys/select.h>
+
+namespace {
+
+struct V4l2Cap {
+  int fd = -1;
+  uint32_t n_bufs = 0;
+  void *maps[16] = {nullptr};
+  size_t lens[16] = {0};
+  uint32_t frame_bytes = 0;
+  uint32_t stride = 0;  // bytesperline: drivers may pad rows
+};
+
+static int xioctl(int fd, unsigned long req, void *arg) {
+  int r;
+  do {
+    r = ioctl(fd, req, arg);
+  } while (r == -1 && errno == EINTR);
+  return r;
+}
+
+static void set_err(char *err, int errlen, const char *msg) {
+  if (err && errlen > 0) {
+    snprintf(err, (size_t)errlen, "%s (errno %d)", msg, errno);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void nns_v4l2_close(void *handle);  // used by open's error paths
+
+// Negotiates *width/*height/*fourcc with the driver (values updated to
+// what the device actually delivers); returns an opaque handle or null
+// with `err` filled.
+void *nns_v4l2_open(const char *dev, int *width, int *height,
+                    uint32_t *fourcc, int n_bufs, char *err, int errlen) {
+  int fd = open(dev, O_RDWR | O_NONBLOCK);
+  if (fd < 0) {
+    set_err(err, errlen, "open failed");
+    return nullptr;
+  }
+  v4l2_capability cap;
+  memset(&cap, 0, sizeof(cap));
+  if (xioctl(fd, VIDIOC_QUERYCAP, &cap) < 0) {
+    set_err(err, errlen, "VIDIOC_QUERYCAP failed (not a v4l2 device?)");
+    close(fd);
+    return nullptr;
+  }
+  if (!(cap.capabilities & V4L2_CAP_VIDEO_CAPTURE) ||
+      !(cap.capabilities & V4L2_CAP_STREAMING)) {
+    set_err(err, errlen, "device lacks CAPTURE+STREAMING capabilities");
+    close(fd);
+    return nullptr;
+  }
+  v4l2_format fmt;
+  memset(&fmt, 0, sizeof(fmt));
+  fmt.type = V4L2_BUF_TYPE_VIDEO_CAPTURE;
+  fmt.fmt.pix.width = (uint32_t)*width;
+  fmt.fmt.pix.height = (uint32_t)*height;
+  fmt.fmt.pix.pixelformat = *fourcc;
+  fmt.fmt.pix.field = V4L2_FIELD_NONE;
+  if (xioctl(fd, VIDIOC_S_FMT, &fmt) < 0) {
+    set_err(err, errlen, "VIDIOC_S_FMT failed");
+    close(fd);
+    return nullptr;
+  }
+  *width = (int)fmt.fmt.pix.width;
+  *height = (int)fmt.fmt.pix.height;
+  *fourcc = fmt.fmt.pix.pixelformat;
+
+  auto *h = new V4l2Cap();
+  h->fd = fd;
+  h->frame_bytes = fmt.fmt.pix.sizeimage;
+  h->stride = fmt.fmt.pix.bytesperline;
+
+  v4l2_requestbuffers req;
+  memset(&req, 0, sizeof(req));
+  req.count = (uint32_t)(n_bufs < 2 ? 2 : (n_bufs > 16 ? 16 : n_bufs));
+  req.type = V4L2_BUF_TYPE_VIDEO_CAPTURE;
+  req.memory = V4L2_MEMORY_MMAP;
+  if (xioctl(fd, VIDIOC_REQBUFS, &req) < 0 || req.count < 2) {
+    set_err(err, errlen, "VIDIOC_REQBUFS(MMAP) failed");
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  h->n_bufs = req.count;
+  for (uint32_t i = 0; i < req.count; i++) {
+    v4l2_buffer buf;
+    memset(&buf, 0, sizeof(buf));
+    buf.type = V4L2_BUF_TYPE_VIDEO_CAPTURE;
+    buf.memory = V4L2_MEMORY_MMAP;
+    buf.index = i;
+    if (xioctl(fd, VIDIOC_QUERYBUF, &buf) < 0) {
+      set_err(err, errlen, "VIDIOC_QUERYBUF failed");
+      nns_v4l2_close(h);
+      return nullptr;
+    }
+    h->lens[i] = buf.length;
+    h->maps[i] = mmap(nullptr, buf.length, PROT_READ | PROT_WRITE,
+                      MAP_SHARED, fd, buf.m.offset);
+    if (h->maps[i] == MAP_FAILED) {
+      h->maps[i] = nullptr;
+      set_err(err, errlen, "mmap of capture buffer failed");
+      nns_v4l2_close(h);
+      return nullptr;
+    }
+    if (xioctl(fd, VIDIOC_QBUF, &buf) < 0) {
+      set_err(err, errlen, "initial VIDIOC_QBUF failed");
+      nns_v4l2_close(h);
+      return nullptr;
+    }
+  }
+  v4l2_buf_type type = V4L2_BUF_TYPE_VIDEO_CAPTURE;
+  if (xioctl(fd, VIDIOC_STREAMON, &type) < 0) {
+    set_err(err, errlen, "VIDIOC_STREAMON failed");
+    nns_v4l2_close(h);
+    return nullptr;
+  }
+  return h;
+}
+
+long nns_v4l2_frame_bytes(void *handle) {
+  return (long)((V4l2Cap *)handle)->frame_bytes;
+}
+
+long nns_v4l2_stride(void *handle) {
+  return (long)((V4l2Cap *)handle)->stride;
+}
+
+// One frame into `out` (<= cap bytes).  Returns payload bytes, 0 on
+// timeout (caller polls its stop event and retries), <0 on device error.
+long nns_v4l2_capture(void *handle, uint8_t *out, uint64_t cap,
+                      int timeout_ms) {
+  V4l2Cap *h = (V4l2Cap *)handle;
+  fd_set fds;
+  FD_ZERO(&fds);
+  FD_SET(h->fd, &fds);
+  timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  int r = select(h->fd + 1, &fds, nullptr, nullptr, &tv);
+  if (r == 0) return 0;
+  if (r < 0) return -1;
+  v4l2_buffer buf;
+  memset(&buf, 0, sizeof(buf));
+  buf.type = V4L2_BUF_TYPE_VIDEO_CAPTURE;
+  buf.memory = V4L2_MEMORY_MMAP;
+  if (xioctl(h->fd, VIDIOC_DQBUF, &buf) < 0) {
+    return errno == EAGAIN ? 0 : -1;
+  }
+  uint64_t n = buf.bytesused ? buf.bytesused : h->frame_bytes;
+  if (n > cap) n = cap;
+  memcpy(out, h->maps[buf.index], n);
+  if (xioctl(h->fd, VIDIOC_QBUF, &buf) < 0) return -1;
+  return (long)n;
+}
+
+void nns_v4l2_close(void *handle) {
+  V4l2Cap *h = (V4l2Cap *)handle;
+  if (h->fd >= 0) {
+    v4l2_buf_type type = V4L2_BUF_TYPE_VIDEO_CAPTURE;
+    xioctl(h->fd, VIDIOC_STREAMOFF, &type);
+  }
+  for (uint32_t i = 0; i < 16; i++) {
+    if (h->maps[i]) munmap(h->maps[i], h->lens[i]);
+  }
+  if (h->fd >= 0) close(h->fd);
+  delete h;
+}
+
 }  // extern "C"

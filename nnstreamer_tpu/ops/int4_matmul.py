@@ -57,13 +57,33 @@ except ImportError:  # pragma: no cover
 #: reads anyway, so the XLA reference path is the right tool there.
 _MAX_KERNEL_ROWS = 32
 
-#: pallas_call has no GSPMD partitioning rule, so a program traced for a
-#: sharded (tensor-parallel) mesh must use the shardable XLA reference
-#: path instead — sharding is invisible at trace time, so the caller
-#: that builds TP programs (filters/llm.py) clears this flag around its
-#: traces.  Process-global by design: one flag, set while TP programs
-#: compile.
-KERNEL_ENABLED = True
+# pallas_call has no GSPMD partitioning rule, so a program traced for a
+# sharded (tensor-parallel) mesh must use the shardable XLA reference
+# path instead — sharding is invisible at trace time, so the caller that
+# builds TP programs (filters/llm.py) disables the kernel for the
+# lifetime of its filter.  REFCOUNTED, not a bare flag: two concurrent
+# TP filters must not clobber each other's save/restore, and a filter
+# that dies mid-open must not leak a disabled kernel process-wide.
+import threading as _threading
+
+_disable_lock = _threading.Lock()
+_disable_count = 0
+
+
+def disable_kernel() -> None:
+    global _disable_count
+    with _disable_lock:
+        _disable_count += 1
+
+
+def enable_kernel() -> None:
+    global _disable_count
+    with _disable_lock:
+        _disable_count = max(0, _disable_count - 1)
+
+
+def kernel_enabled() -> bool:
+    return _disable_count == 0
 
 
 def pack_int4(wq):
@@ -143,8 +163,9 @@ def matmul_int4(h, packed, scale, *, block_d2: int = 128,
     h: [B, Din] (bf16/f32); packed: [Din/2, F] int8 (:func:`pack_int4`
     layout); scale: [1, F] f32.  Uses the Pallas kernel on TPU for
     decode-shaped B (or anywhere with ``interpret=True``); other
-    backends, large B, non-tiling shapes, and ``KERNEL_ENABLED=False``
-    (TP traces) get :func:`matmul_int4_reference`.
+    backends, large B, non-tiling shapes, and refcount-disabled kernel
+    states (TP traces, :func:`disable_kernel`) get
+    :func:`matmul_int4_reference`.
     """
     B, din = h.shape
     d2, F = packed.shape
@@ -155,7 +176,7 @@ def matmul_int4(h, packed, scale, *, block_d2: int = 128,
         interpret = False
         if jax.default_backend() != "tpu":
             return matmul_int4_reference(h, packed, scale, out_dtype=odt)
-    if (not _HAVE_PALLAS or not KERNEL_ENABLED or d2 % block_d2
+    if (not _HAVE_PALLAS or not kernel_enabled() or d2 % block_d2
             or F % 128 or B > _MAX_KERNEL_ROWS):
         return matmul_int4_reference(h, packed, scale, out_dtype=odt)
 

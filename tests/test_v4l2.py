@@ -1,0 +1,128 @@
+"""v4l2src — the literal camera ingest element (VERDICT r4 Missing #2 /
+Next #5).  No camera exists in CI, so the raw backend streams from a
+FIFO/file of raw frames (the same polling machinery tensor_src_iio
+uses); the native ioctl/mmap backend is compile-checked and gated on a
+real /dev/video* node."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.elements.base import ElementError
+
+
+W, H = 16, 12
+FRAME = W * H * 3
+
+
+def _frames(n):
+    rng = np.random.default_rng(3)
+    return [rng.integers(0, 256, (H, W, 3), dtype=np.uint8)
+            for i in range(n)]
+
+
+def test_streams_from_fifo(tmp_path):
+    fifo = os.path.join(str(tmp_path), "cam")
+    os.mkfifo(fifo)
+    frames = _frames(3)
+
+    def writer():
+        with open(fifo, "wb") as f:
+            for fr in frames:
+                f.write(fr.tobytes())
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    p = nt.Pipeline(
+        f"v4l2src device={fifo} width={W} height={H} num-buffers=3 ! "
+        "tensor_converter ! tensor_sink name=out")
+    with p:
+        for fr in frames:
+            out = p.pull("out", timeout=30)
+            got = np.asarray(out.tensors[0])
+            np.testing.assert_array_equal(got.reshape(H, W, 3), fr)
+        p.wait(timeout=30)
+    t.join(timeout=5)
+
+
+def test_replay_from_file(tmp_path):
+    path = os.path.join(str(tmp_path), "frames.raw")
+    frames = _frames(4)
+    with open(path, "wb") as f:
+        for fr in frames:
+            f.write(fr.tobytes())
+    p = nt.Pipeline(
+        f"v4l2src device={path} width={W} height={H} ! "
+        "tensor_converter ! tensor_sink name=out")
+    with p:
+        for fr in frames:
+            got = np.asarray(p.pull("out", timeout=30).tensors[0])
+            np.testing.assert_array_equal(got.reshape(H, W, 3), fr)
+        p.wait(timeout=30)  # EOF -> EOS
+
+
+def test_north_star_pipeline_runs(tmp_path):
+    """The SURVEY §7 sentence made executable: v4l2src ->
+    tensor_converter -> tensor_transform -> tensor_filter -> sink."""
+    path = os.path.join(str(tmp_path), "frames.raw")
+    rng = np.random.default_rng(1)
+    with open(path, "wb") as f:
+        for _ in range(2):
+            f.write(rng.integers(0, 256, (16, 16, 3),
+                                 dtype=np.uint8).tobytes())
+    p = nt.Pipeline(
+        f"v4l2src device={path} width=16 height=16 ! tensor_converter ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax model=average custom=dims:3:16:16:1 ! "
+        "tensor_sink name=out")
+    with p:
+        for _ in range(2):
+            out = p.pull("out", timeout=60)
+            v = np.asarray(out.tensors[0]).ravel()
+            assert v.shape == (1,) and np.isfinite(v).all()
+        p.wait(timeout=30)
+
+
+def test_missing_device_fails_loudly():
+    p = nt.Pipeline(
+        "v4l2src device=/nonexistent/video9 width=8 height=8 ! "
+        "tensor_converter ! tensor_sink name=out")
+    with pytest.raises(ElementError, match="cannot stat device"):
+        with p:
+            pass
+
+
+def test_bad_format_rejected_at_construction():
+    with pytest.raises(ElementError, match="format"):
+        nt.Pipeline("v4l2src format=YV12 ! tensor_converter ! "
+                    "tensor_sink name=out")
+
+
+def test_native_symbols_compiled():
+    """The ioctl/mmap backend must at least BUILD everywhere (the real
+    capture path is gated on hardware below)."""
+    from nnstreamer_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    lib = native._load()
+    for sym in ("nns_v4l2_open", "nns_v4l2_capture", "nns_v4l2_close",
+                "nns_v4l2_frame_bytes"):
+        assert hasattr(lib, sym)
+    assert native.fourcc("RGB3") == 0x33424752  # '3','B','G','R' LE
+
+
+@pytest.mark.skipif(not os.path.exists("/dev/video0"),
+                    reason="no v4l2 capture hardware")
+def test_real_device_native_capture():  # pragma: no cover - hw gated
+    p = nt.Pipeline(
+        "v4l2src device=/dev/video0 width=320 height=240 num-buffers=2 ! "
+        "tensor_converter ! tensor_sink name=out")
+    with p:
+        out = p.pull("out", timeout=30)
+        assert np.asarray(out.tensors[0]).size > 0
+        p.wait(timeout=30)

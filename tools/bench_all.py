@@ -28,6 +28,7 @@ ROWS = [
     # own in-loop fetch_rtt_ms + rtt_stalls tail attribution.
     ("link_calibration", ["--config", "link"]),
     ("classification", ["--config", "classification"]),
+    ("classification_quant", ["--config", "classification_quant"]),
     ("classification_appsrc", ["--config", "classification",
                                "--source", "appsrc"]),
     ("detection_ssd", ["--config", "detection"]),
@@ -54,6 +55,14 @@ ROWS = [
     ("llm7b_int8_continuous_x4", ["--config", "llm7b", "--llm-quant",
                                   "int8", "--llm-serve", "continuous",
                                   "--llm-streams", "4"]),
+    ("llm7b_int8_continuous_x8", ["--config", "llm7b", "--llm-quant",
+                                  "int8", "--llm-serve", "continuous",
+                                  "--llm-streams", "8"]),
+    ("llm7b_int8_continuous_x16", ["--config", "llm7b", "--llm-quant",
+                                   "int8", "--llm-serve", "continuous",
+                                   "--llm-streams", "16"]),
+    ("llm7b_int4_x16", ["--config", "llm7b", "--llm-quant", "int4",
+                        "--llm-streams", "16"]),
 ]
 
 
