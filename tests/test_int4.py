@@ -136,6 +136,43 @@ def test_int4_forward_equals_dense_dequant():
     assert np.asarray(t4a).min() >= 0
 
 
+def test_kernel_disable_refcount():
+    """TP filters refcount the kernel disable: nesting works, over-
+    release clamps, and the default state is enabled."""
+    from nnstreamer_tpu.ops import int4_matmul as i4
+
+    assert i4.kernel_enabled()
+    i4.disable_kernel()
+    i4.disable_kernel()
+    assert not i4.kernel_enabled()
+    i4.enable_kernel()
+    assert not i4.kernel_enabled()  # one holder still active
+    i4.enable_kernel()
+    assert i4.kernel_enabled()
+    i4.enable_kernel()  # over-release must clamp, not go negative
+    assert i4.kernel_enabled()
+    i4.disable_kernel()
+    assert not i4.kernel_enabled()
+    i4.enable_kernel()
+    assert i4.kernel_enabled()
+
+
+def test_llm_tp_open_disables_kernel_and_close_restores():
+    from nnstreamer_tpu.filters.llm import LLMFramework
+    from nnstreamer_tpu.ops import int4_matmul as i4
+
+    fw = LLMFramework()
+    fw.open({"model": "llama_tiny",
+             "custom": "max_new:2,tp:2,quant:int4,dtype:float32"})
+    try:
+        assert not i4.kernel_enabled()
+    finally:
+        fw.close()
+    assert i4.kernel_enabled()
+    fw.close()  # idempotent: a double close must not over-release
+    assert i4.kernel_enabled()
+
+
 def test_llm_filter_int4_pipeline():
     import nnstreamer_tpu as nt
 

@@ -6,9 +6,10 @@ CPU suite cannot (tests/conftest.py forces the virtual CPU mesh).
 
 Checks: Pallas flash-attention numerics against plain XLA on the real
 backend, the fused classification pipeline, device-NMS detection, LLM
-token streaming, wav2vec2 + ctc decode-on-edge, .tflite file ingestion,
-and a query offload roundtrip.  Prints one PASS/FAIL line each and exits
-nonzero on any failure.
+token streaming, int4 Pallas-kernel decode, wav2vec2 + ctc
+decode-on-edge, .tflite file ingestion (float + fully-quantized integer
+execution), and a query offload roundtrip.  Prints one PASS/FAIL line
+each and exits nonzero on any failure.
 """
 
 from __future__ import annotations
@@ -115,6 +116,36 @@ def llm_stream():
         assert toks[-1].meta.get("stream_last") is True
         p.eos()
         p.wait(timeout=60)
+
+
+def llm_int4_kernel_stream():
+    """r5 path: weight-only int4 decode through the Pallas nibble-unpack
+    kernel (ops/int4_matmul.py) — llama_small's dims tile (d2/F %128==0)
+    so the REAL kernel engages on the chip, not the XLA fallback.
+    Determinism asserted across two identical runs."""
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.ops.int4_matmul import kernel_enabled
+
+    assert kernel_enabled()
+
+    def run():
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_filter framework=llm "
+            "model=llama_small custom=max_new:6,quant:int4,stream_chunk:3 "
+            "invoke-dynamic=true ! tensor_sink name=out")
+        with p:
+            p.push("src", np.array([1, 7, 3, 9], np.int32))
+            ids = [int(np.asarray(p.pull("out", timeout=600).tensors[0])
+                       .ravel()[0]) for _ in range(6)]
+            p.eos()
+            p.wait(timeout=60)
+        return ids
+
+    a, b = run(), run()
+    assert a == b, f"int4 decode not deterministic: {a} vs {b}"
+    assert all(0 <= t < 2048 for t in a)
 
 
 def wav2vec2_ctc_decode_on_edge():
@@ -281,6 +312,7 @@ def main() -> int:
         ("fused classification pipeline", classification_pipeline),
         ("device-NMS detection pipeline", detection_device_nms),
         ("LLM token streaming", llm_stream),
+        ("LLM int4 Pallas-kernel decode", llm_int4_kernel_stream),
         ("wav2vec2 + ctc decode-on-edge", wav2vec2_ctc_decode_on_edge),
         (".tflite file ingestion", tflite_file_ingestion),
         (".tflite fully-quantized graph", tflite_quantized_graph),
