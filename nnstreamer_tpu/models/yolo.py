@@ -117,36 +117,68 @@ def _backbone_feats(params, x, size: int, compute_dtype):
     return feats
 
 
+def _poly_coeffs(g: int, n_out: int, n_anchor: int, box_a):
+    """Per-(position, channel) FMA coefficients for a yolo-family decode
+    head, out = A*sigmoid(raw)^2 + B*sigmoid(raw) + C over the flattened
+    [N_s, n_out] scale block — the whole box decode as ONE lane-friendly
+    pass (the textbook slice/meshgrid/stack form builds minor-dim-3/4
+    tensors that TPU pads to 128 lanes; measured 16 of 26 ms of the v5s
+    step, PROFILE_YOLO_r5.json).  ``box_a``: [n_anchor, 2] quadratic
+    coefficients for the w/h channels (4*anchor, already in the head's
+    output units).  Channels: 0/1 affine cell-centers, 2/3 quadratic
+    w/h, the rest identity (scores)."""
+    gy, gx = np.meshgrid(np.arange(g), np.arange(g), indexing="ij")
+    pos = np.stack([gx, gy], -1).reshape(-1, 2)
+    pos = np.repeat(pos, n_anchor, axis=0)  # [N_s, 2], anchor-minor
+    box_a = np.tile(np.asarray(box_a, np.float32), (g * g, 1))
+    N_s = g * g * n_anchor
+    A = np.zeros((N_s, n_out), np.float32)
+    B = np.zeros((N_s, n_out), np.float32)
+    C = np.zeros((N_s, n_out), np.float32)
+    B[:, 4:] = 1.0
+    B[:, 0] = B[:, 1] = 2.0 / g
+    C[:, 0] = (pos[:, 0] - 0.5) / g
+    C[:, 1] = (pos[:, 1] - 0.5) / g
+    A[:, 2] = box_a[:, 0]
+    A[:, 3] = box_a[:, 1]
+    return A, B, C
+
+
+def _poly_decode(raws, abc):
+    """Concatenate per-scale raw head tensors and run the fused
+    polynomial decode (see :func:`_poly_coeffs`)."""
+    import jax
+    import jax.numpy as jnp
+
+    raw = jnp.concatenate(raws, axis=1).astype(jnp.float32)
+    A = jnp.asarray(np.concatenate([a for a, _, _ in abc]))
+    B = jnp.asarray(np.concatenate([b for _, b, _ in abc]))
+    C = jnp.asarray(np.concatenate([c for _, _, c in abc]))
+    s = jax.nn.sigmoid(raw)
+    return (A * s + B) * s + C
+
+
 def apply(params, x, *, classes: int, size: int, compute_dtype="bfloat16"):
     """[B, size, size, 3] float32 in [0,1] -> [B, N, 5+C] float32
     (yolov5 layout).  ``size`` pins the traced input so N matches the
     bundle's negotiated out_spec."""
-    import jax
     import jax.numpy as jnp
 
     conv2d, _, _ = make_ops(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
     feats = _backbone_feats(params, x, size, compute_dtype)
-    outs = []
 
     B = x.shape[0]
+    raws, abc = [], []
     for stride, fm, hp in feats:
         g = fm.shape[1]
         raw = conv2d(fm, hp["w"], 1) + hp["b"].astype(cdt)
-        raw = raw.reshape(B, g, g, _ANCHORS_PER_CELL, 5 + classes)
-        raw = raw.astype(jnp.float32)
-        s = jax.nn.sigmoid(raw)
-        # yolov5 decode: cell offset + sigmoid box, anchor-scaled w/h
-        gy, gx = jnp.meshgrid(jnp.arange(g), jnp.arange(g), indexing="ij")
-        cx = (s[..., 0] * 2.0 - 0.5 + gx[None, :, :, None]) / g
-        cy = (s[..., 1] * 2.0 - 0.5 + gy[None, :, :, None]) / g
-        anch = jnp.asarray(_ANCHOR_SIZES[stride], jnp.float32)  # [A, 2]
-        w = (s[..., 2] * 2.0) ** 2 * anch[None, None, None, :, 0]
-        hh = (s[..., 3] * 2.0) ** 2 * anch[None, None, None, :, 1]
-        pred = jnp.concatenate(
-            [jnp.stack([cx, cy, w, hh], axis=-1), s[..., 4:]], axis=-1)
-        outs.append(pred.reshape(B, -1, 5 + classes))
-    return jnp.concatenate(outs, axis=1)
+        raws.append(raw.reshape(B, g * g * _ANCHORS_PER_CELL,
+                                5 + classes))
+        anch = np.asarray(_ANCHOR_SIZES[stride], np.float32)  # [A, 2]
+        abc.append(_poly_coeffs(g, 5 + classes, _ANCHORS_PER_CELL,
+                                4.0 * anch))
+    return _poly_decode(raws, abc)
 
 
 def num_predictions_v8(size: int) -> int:
@@ -159,30 +191,21 @@ def apply_v8(params, x, *, classes: int, size: int,
     YOLOv8 (ultralytics) channels-first export layout the reference's
     yolov8 decoder mode consumes: anchor-free (one predictor per cell, no
     objectness column), post-sigmoid class scores, normalized cx,cy,w,h."""
-    import jax
     import jax.numpy as jnp
 
     conv2d, _, _ = make_ops(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
     B = x.shape[0]
-    outs = []
+    raws, abc = [], []
     for stride, fm, hp in _backbone_feats(params, x, size, compute_dtype):
         g = fm.shape[1]
         raw = conv2d(fm, hp["w"], 1) + hp["b"].astype(cdt)
-        raw = raw.reshape(B, g, g, 4 + classes).astype(jnp.float32)
-        s = jax.nn.sigmoid(raw)
-        gy, gx = jnp.meshgrid(jnp.arange(g), jnp.arange(g), indexing="ij")
+        raws.append(raw.reshape(B, g * g, 4 + classes))
         # anchor-free decode: cell-offset centers; w/h from a per-scale
         # prior proportional to the stride (v8's dist2bbox analog)
-        cx = (s[..., 0] * 2.0 - 0.5 + gx[None]) / g
-        cy = (s[..., 1] * 2.0 - 0.5 + gy[None]) / g
-        prior = 4.0 * stride / size
-        w = (s[..., 2] * 2.0) ** 2 * prior
-        hh = (s[..., 3] * 2.0) ** 2 * prior
-        pred = jnp.concatenate(
-            [jnp.stack([cx, cy, w, hh], axis=-1), s[..., 4:]], axis=-1)
-        outs.append(pred.reshape(B, -1, 4 + classes))
-    return jnp.swapaxes(jnp.concatenate(outs, axis=1), 1, 2)
+        prior = 4.0 * (4.0 * stride / size)  # quadratic coeff = 4*prior
+        abc.append(_poly_coeffs(g, 4 + classes, 1, [[prior, prior]]))
+    return jnp.swapaxes(_poly_decode(raws, abc), 1, 2)
 
 
 @register_model("yolov8")
@@ -392,23 +415,12 @@ def apply_v5s(params, x, *, classes: int, size: int,
             params["h_c3_5b"], shortcut=False)
 
     B = x.shape[0]
-    # Detect head, TPU-lane-friendly form.  The textbook decode (slice
-    # per coordinate, meshgrid adds, stack minor-dim-4, concat) builds
-    # tensors whose minor dims are 3 and 4 — TPU pads every lane vector
-    # to 128, so those ops run at ~3% lane utilization and measured
-    # 16 ms of the 26 ms batch-32 step (PROFILE_YOLO_r5.json).  Instead:
-    # every output channel is a fixed per-(position, channel) polynomial
-    # of the sigmoid, out = A*s^2 + B*s + C with
-    #   cx: A=0, B=2/g,          C=(gx-0.5)/g      (affine)
-    #   cy: A=0, B=2/g,          C=(gy-0.5)/g
-    #   w:  A=4*anch_w/size, B=0, C=0  (via (2s)^2*anch)
-    #   h:  A=4*anch_h/size, B=0, C=0
-    #   scores: A=0, B=1, C=0          (identity)
-    # so the whole decode is ONE fused FMA pass over [B, N, 5+C] with
-    # the last dim >= 96 — no minor-dim stacks, no layout changes.
-    n_out = None
-    raws = []
-    abc = []
+    # Detect head as the fused polynomial decode (see _poly_coeffs —
+    # the textbook slice/meshgrid/stack form measured 16 of the 26 ms
+    # batch-32 step, PROFILE_YOLO_r5.json).  Anchors are pixels of the
+    # NETWORK INPUT (ultralytics convention): normalize by the actual
+    # input size.
+    raws, abc = [], []
     for stride, fm in ((8, o3), (16, o4), (32, o5)):
         hp = params[f"det{(stride.bit_length() - 4)}"]
         g = fm.shape[1]
@@ -418,31 +430,9 @@ def apply_v5s(params, x, *, classes: int, size: int,
         raw = raw + jnp.asarray(hp["b"]).astype(cdt)
         n_out = raw.shape[-1] // _ANCHORS_PER_CELL
         raws.append(raw.reshape(B, g * g * _ANCHORS_PER_CELL, n_out))
-
-        # [g*g*3, n_out] coefficient blocks, built host-side at trace
-        gy, gx = np.meshgrid(np.arange(g), np.arange(g), indexing="ij")
-        pos = np.stack([gx, gy], -1)[:, :, None, :].repeat(
-            _ANCHORS_PER_CELL, axis=2).reshape(-1, 2)  # [N_s, 2]
-        anch = (np.asarray(_V5S_ANCHORS_PX[stride], np.float32) / size)
-        anch = np.tile(anch, (g * g, 1))  # [N_s, 2]
-        N_s = g * g * _ANCHORS_PER_CELL
-        A = np.zeros((N_s, n_out), np.float32)
-        Bc = np.zeros((N_s, n_out), np.float32)
-        C = np.zeros((N_s, n_out), np.float32)
-        Bc[:, 4:] = 1.0
-        Bc[:, 0] = Bc[:, 1] = 2.0 / g
-        C[:, 0] = (pos[:, 0] - 0.5) / g
-        C[:, 1] = (pos[:, 1] - 0.5) / g
-        A[:, 2] = 4.0 * anch[:, 0]
-        A[:, 3] = 4.0 * anch[:, 1]
-        abc.append((A, Bc, C))
-
-    raw = jnp.concatenate(raws, axis=1).astype(jnp.float32)  # [B, N, 5+C]
-    A = jnp.asarray(np.concatenate([a for a, _, _ in abc]))
-    Bc = jnp.asarray(np.concatenate([b for _, b, _ in abc]))
-    C = jnp.asarray(np.concatenate([c for _, _, c in abc]))
-    s = jax.nn.sigmoid(raw)
-    return (A * s + Bc) * s + C
+        anch = np.asarray(_V5S_ANCHORS_PX[stride], np.float32) / size
+        abc.append(_poly_coeffs(g, n_out, _ANCHORS_PER_CELL, 4.0 * anch))
+    return _poly_decode(raws, abc)
 
 
 @register_model("yolov5s")
