@@ -1,0 +1,59 @@
+"""Camera ingest -> detection: the SURVEY §7 north-star pipeline string
+(``v4l2src ! tensor_converter ! ... ! tensor_filter ! tensor_decoder``)
+run as written.
+
+With a real camera, point ``device=`` at ``/dev/video0`` and v4l2src
+captures through the native ioctl/mmap streaming ring.  Without one
+(CI, this environment), the element's raw-frame FIFO backend plays the
+camera: a writer thread pushes synthetic RGB frames into a named pipe
+and the SAME pipeline string consumes it.
+"""
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import nnstreamer_tpu as nt
+
+W = H = 96
+N_FRAMES = 3
+
+device = "/dev/video0"
+writer = None
+if not os.path.exists(device):
+    device = os.path.join(tempfile.mkdtemp(prefix="nnstpu_cam_"), "cam")
+    os.mkfifo(device)
+    rng = np.random.default_rng(0)
+
+    def feed():
+        with open(device, "wb") as f:
+            for i in range(N_FRAMES):
+                frame = np.zeros((H, W, 3), np.uint8)
+                frame[20 + 10 * i:40 + 10 * i, 30:60] = 255  # moving box
+                f.write(frame.tobytes())
+
+    writer = threading.Thread(target=feed, daemon=True)
+    writer.start()
+    print(f"no /dev/video0 — fake camera on FIFO {device}")
+
+pipe = nt.Pipeline(
+    f"v4l2src device={device} width={W} height={H} num-buffers={N_FRAMES} ! "
+    "tensor_converter ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+    f"tensor_filter framework=jax model=ssd_mobilenet custom=size:{W},classes:7 ! "
+    f"tensor_decoder mode=bounding_boxes option3=0.0 option4={W}:{H} ! "
+    "tensor_sink name=out",
+)
+with pipe:
+    for i in range(N_FRAMES):
+        buf = pipe.pull("out", timeout=300)
+        dets = buf.meta.get("detections", [])
+        print(f"frame {i}: overlay {buf.tensors[0].shape}, "
+              f"{len(dets)} detections")
+    pipe.wait(timeout=60)
+if writer:
+    writer.join(timeout=5)
+print("camera pipeline done")

@@ -134,9 +134,12 @@ def matmul_int4_reference(h, packed, scale, out_dtype=None):
 
 
 def _int4_kernel(ha_ref, hb_ref, p_ref, s_ref, o_ref, acc_ref):
-    """One contraction-block grid step: two i32 VPU ops + two converts
-    per packed byte, both nibble dots against the resident block."""
-    j = pl.program_id(0)
+    """One (F-block, contraction-block) grid step: two i32 VPU ops + two
+    converts per packed byte, both nibble dots against the resident
+    block.  Grid dim 0 tiles F (VMEM-bounded — a [B, 32000] f32
+    accumulator plus unpack temps blew the 16 MB budget at B=32); dim 1
+    walks the contraction, accumulating in the revisited scratch."""
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _():
@@ -150,9 +153,28 @@ def _int4_kernel(ha_ref, hb_ref, p_ref, s_ref, o_ref, acc_ref):
         jnp.dot(ha_ref[...], M, preferred_element_type=jnp.float32)
         + jnp.dot(hb_ref[...], T, preferred_element_type=jnp.float32))
 
-    @pl.when(j == pl.num_programs(0) - 1)
+    @pl.when(j == pl.num_programs(1) - 1)
     def _():
         o_ref[...] = (acc_ref[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+def _pick_fb(F: int, B: int, block_d2: int) -> int:
+    """Largest 128-multiple divisor of F whose per-block VMEM footprint
+    fits; 0 if none.  Calibrated against Mosaic's actual accounting
+    (observed on chip): the i32 unpack temp is fused away; what counts
+    is the packed int8 block x2 pipeline buffers, the two bf16 nibble
+    planes feeding the dots, and the f32 acc + out blocks.  The decode
+    mats ([128, 11008] at B<=32 ~ 9.9 MB) must stay UNBLOCKED (measured
+    422 GB/s whole); the lm_head shape ([128, 32000] at B=32 ~ 24.6 MB,
+    the chip's 19.2 MB scoped-vmem OOM) must split."""
+    budget = 14 << 20
+    per_elem = block_d2 * 6 + B * 8
+    fb_max = budget // per_elem  # no floor: fb=0 -> caller falls back
+    best = 0
+    for fb in range(128, F + 1, 128):
+        if F % fb == 0 and fb <= fb_max:
+            best = fb
+    return best
 
 
 def matmul_int4(h, packed, scale, *, block_d2: int = 128,
@@ -176,8 +198,9 @@ def matmul_int4(h, packed, scale, *, block_d2: int = 128,
         interpret = False
         if jax.default_backend() != "tpu":
             return matmul_int4_reference(h, packed, scale, out_dtype=odt)
+    fb = _pick_fb(F, B, block_d2)  # 0 when F doesn't tile or fit
     if (not _HAVE_PALLAS or not kernel_enabled() or d2 % block_d2
-            or F % 128 or B > _MAX_KERNEL_ROWS):
+            or not fb or B > _MAX_KERNEL_ROWS):
         return matmul_int4_reference(h, packed, scale, out_dtype=odt)
 
     hlo, hhi = h[:, :d2], h[:, d2:]
@@ -185,16 +208,16 @@ def matmul_int4(h, packed, scale, *, block_d2: int = 128,
     ha = hlo - hb
     out = pl.pallas_call(
         _int4_kernel,
-        grid=(d2 // block_d2,),
+        grid=(F // fb, d2 // block_d2),
         in_specs=[
-            pl.BlockSpec((B, block_d2), lambda j: (0, j)),   # h_lo - h_hi/16
-            pl.BlockSpec((B, block_d2), lambda j: (0, j)),   # h_hi / 16
-            pl.BlockSpec((block_d2, F), lambda j: (j, 0)),   # packed block
-            pl.BlockSpec((1, F), lambda j: (0, 0)),          # scales
+            pl.BlockSpec((B, block_d2), lambda i, j: (0, j)),  # h_lo - h_hi/16
+            pl.BlockSpec((B, block_d2), lambda i, j: (0, j)),  # h_hi / 16
+            pl.BlockSpec((block_d2, fb), lambda i, j: (j, i)),  # packed
+            pl.BlockSpec((1, fb), lambda i, j: (0, i)),         # scales
         ],
-        out_specs=pl.BlockSpec((B, F), lambda j: (0, 0)),
+        out_specs=pl.BlockSpec((B, fb), lambda i, j: (0, i)),
         out_shape=jax.ShapeDtypeStruct((B, F), odt),
-        scratch_shapes=[pltpu.VMEM((B, F), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((B, fb), jnp.float32)],
         interpret=interpret,
     )(ha, hb, packed, scale)
     # the -8 * rowsum(h_lo) bias correction, applied at full precision

@@ -62,6 +62,35 @@ def test_kernel_interpret_matches_reference():
     assert np.abs(got - want).max() / scale < 2e-2
 
 
+def test_fb_blocking_picks_vmem_safe_divisor():
+    from nnstreamer_tpu.ops.int4_matmul import _pick_fb
+
+    # lm_head scale at max kernel rows: MUST block (a [32, 32000] f32
+    # accumulator + unpack temps overflowed the 16 MB VMEM on chip)
+    fb = _pick_fb(32000, 32, 128)
+    assert 0 < fb < 32000 and fb % 128 == 0 and 32000 % fb == 0
+    # decode-scale F fits whole
+    assert _pick_fb(11008, 16, 128) == 11008
+
+
+def test_kernel_interpret_blocked_f_matches_reference():
+    """Multi-F-block grid (the lm_head shape class) against the XLA
+    reference — the revisited accumulator + per-block scales must
+    reassemble the full row exactly."""
+    rng = np.random.default_rng(7)
+    din, f = 512, 32000
+    w = rng.standard_normal((din, f)).astype(np.float32) * 0.05
+    h = rng.standard_normal((32, din)).astype(np.float32)
+    packed, s = quantize_int4(jnp.asarray(w))
+    hb = jnp.asarray(h, jnp.bfloat16)
+    want = np.asarray(matmul_int4_reference(hb, packed, s), np.float32)
+    got = np.asarray(
+        matmul_int4(hb, packed, s, block_d2=128, interpret=True),
+        np.float32)
+    scale = np.abs(want).max() + 1e-9
+    assert np.abs(got - want).max() / scale < 2e-2
+
+
 def test_matmul_int4_shape_validation():
     packed = jnp.zeros((8, 128), jnp.int8)
     s = jnp.ones((1, 128), jnp.float32)
