@@ -114,7 +114,7 @@ def init_params(cfg: LlamaConfig, seed: int = 0, dtype="float32") -> Dict:
 
 
 def _init_params_quant(cfg: LlamaConfig, seed: int, gen_dtype,
-                       qmat, q2d, suffix: str) -> Dict:
+                       qmat, q2d, suffix: str, groups=None) -> Dict:
     """Generate-then-quantize one matrix at a time.
 
     ``quantize_*(init_params(cfg))`` needs the full-precision tree AND
@@ -151,15 +151,32 @@ def _init_params_quant(cfg: LlamaConfig, seed: int, gen_dtype,
         "w_up": ((L, D, F), D),
         "w_down": ((L, F, D), F),
     }
+    import jax.numpy as _jnp
+
     qlay: Dict = {
         "ln_attn": np.ones((L, D), np.float32),
         "ln_mlp": np.ones((L, D), np.float32),
     }
-    for i, name in enumerate(_QUANT_MATS):  # same key order as init_params
-        shape, fan = shapes[name]
-        q, s = qmat(norm_init(ks[i], shape, fan))
-        qlay[name + suffix] = q
-        qlay[name + "_s"] = s
+    key_of = {name: ks[i] for i, name in enumerate(_QUANT_MATS)}
+    if groups is None:
+        groups = tuple((name, (name,)) for name in _QUANT_MATS)
+    for gname, members in groups:
+        # quantize each member with ITS ORIGINAL DONATED — per-output-
+        # channel scales make member-wise quantization exactly equal to
+        # quantizing the concatenation, so fused groups concatenate the
+        # PACKED outputs (0.5-1 byte/param) and the one-bf16-mat peak
+        # holds for fused layouts too
+        qs = []
+        for name in members:
+            shape, fan = shapes[name]
+            qs.append(qmat(norm_init(key_of[name], shape, fan)))
+        if len(qs) == 1:
+            q, s = qs[0]
+        else:
+            q = _jnp.concatenate([p for p, _ in qs], axis=-1)
+            s = _jnp.concatenate([sc for _, sc in qs], axis=-1)
+        qlay[gname + suffix] = q
+        qlay[gname + "_s"] = s
     q, s = q2d(norm_init(k_out, (D, cfg.vocab), D))
     return {
         "embed": norm_init(k_embed, (cfg.vocab, D), D) * 0.5,
@@ -180,15 +197,17 @@ def init_params_int8(cfg: LlamaConfig, seed: int = 0,
 
 def init_params_int4(cfg: LlamaConfig, seed: int = 0,
                      gen_dtype="bfloat16") -> Dict:
-    """int4 per-mat generate-quantize-pack-donate init (see
-    :func:`_init_params_quant`)."""
+    """int4 generate-quantize-pack-donate init (see
+    :func:`_init_params_quant`), grouped per ``_INT4_GROUPS`` — members
+    quantize one at a time (donated) and only the PACKED nibbles
+    concatenate, so the one-bf16-mat HBM peak holds."""
     import jax
 
     from ..ops import int4_matmul as _i4
 
     q2d = jax.jit(_i4.quantize_int4, donate_argnums=(0,))
     return _init_params_quant(cfg, seed, gen_dtype, _qmat4_layered(),
-                              q2d, "_p")
+                              q2d, "_p", groups=_INT4_GROUPS)
 
 
 def load_checkpoint(path: str, cfg: Optional[LlamaConfig] = None,
@@ -548,11 +567,21 @@ def _qmat4_layered():
     return qmat
 
 
+#: int4 fused-mat grouping: per-call fixed cost halves the Pallas
+#: kernel's throughput on the 4096-out mats (8.4 MB/call measured
+#: 176 GB/s vs 373 at >=22 MB), so q/k/v and gate/up quantize into ONE
+#: packed mat each — per-output-channel scales make the concatenation
+#: exactly equal to quantizing separately.
+_INT4_GROUPS = (("wqkv", ("wq", "wk", "wv")), ("wo", ("wo",)),
+                ("wgu", ("w_gate", "w_up")), ("w_down", ("w_down",)))
+
+
 def quantize_int4_params(params: Dict) -> Dict:
     """Weight-only int4 with per-output-channel scales, nibble-packed
     for the Pallas decode kernel (ops/int4_matmul.py): 0.5 bytes/param
     on the seven big mats + lm_head -> ~3.4 GB/token at 7B vs 6.5 int8.
-    Same on-device, per-mat, donated discipline as :func:`quantize_int8`.
+    q/k/v and gate/up fuse into single packed mats (_INT4_GROUPS).
+    Same on-device, donated discipline as :func:`quantize_int8`.
     """
     import jax
     import jax.numpy as jnp
@@ -563,10 +592,20 @@ def quantize_int4_params(params: Dict) -> Dict:
     q2d = jax.jit(_i4.quantize_int4, donate_argnums=(0,))
     lay = params["layers"]
     qlay: Dict = {"ln_attn": lay["ln_attn"], "ln_mlp": lay["ln_mlp"]}
-    for k in _QUANT_MATS:
-        p, s = qmat(jnp.asarray(lay[k]))
-        qlay[k + "_p"] = p
-        qlay[k + "_s"] = s  # [L, 1, out]
+    for name, members in _INT4_GROUPS:
+        # member-wise quantize with each ORIGINAL donated (the 7B HBM
+        # discipline: full-precision mats free as their packed
+        # replacements land); per-output-channel scales make this
+        # exactly equal to quantizing the concatenation, so only the
+        # tiny packed nibbles + scales concatenate
+        qs = [qmat(jnp.asarray(lay[k])) for k in members]
+        if len(qs) == 1:
+            p, s = qs[0]
+        else:
+            p = jnp.concatenate([q for q, _ in qs], axis=-1)
+            s = jnp.concatenate([sc for _, sc in qs], axis=-1)
+        qlay[name + "_p"] = p
+        qlay[name + "_s"] = s  # [L, 1, out]
     p, s = q2d(jnp.asarray(params["lm_head"]))
     return {
         "embed": params["embed"],
@@ -700,12 +739,20 @@ def param_pspecs(quant: bool = False) -> Dict:
             "ln_out": P(None),
             "lm_head": P(None, "model"),
         }
-    out_sharded = {"wq": True, "wk": True, "wv": True, "wo": False,
-                   "w_gate": True, "w_up": True, "w_down": False}
-    # int8 stores q-mats under _q; int4 packs nibbles under _p with the
-    # same [L, in(/2), out] axis meaning, so the specs are shared (int4
-    # TP runs through the shardable XLA reference path of the kernel).
-    suffix = "_p" if str(quant) == "int4" else "_q"
+    # int8 stores q-mats under _q; int4 packs nibbles under _p (with
+    # q|k|v and gate|up FUSED along the out axis, _INT4_GROUPS) — the
+    # [L, in(/2), out] axis meaning is shared, so out-sharded mats split
+    # 'model' on the last axis either way (int4 TP runs through the
+    # shardable XLA reference path of the kernel; the in-program q/k/v
+    # split of a sharded fused mat reshards via GSPMD).
+    if str(quant) == "int4":
+        out_sharded = {"wqkv": True, "wo": False, "wgu": True,
+                       "w_down": False}
+        suffix = "_p"
+    else:
+        out_sharded = {"wq": True, "wk": True, "wv": True, "wo": False,
+                       "w_gate": True, "w_up": True, "w_down": False}
+        suffix = "_q"
     lay = {"ln_attn": P(None, None), "ln_mlp": P(None, None)}
     for k, on_out in out_sharded.items():
         lay[k + suffix] = (P(None, None, "model") if on_out
@@ -772,9 +819,15 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     dt = x.dtype
 
     h = _rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
-    q = _mm(h, lp, "wq", dt).reshape(B, T, H, hd)
-    k = _mm(h, lp, "wk", dt).reshape(B, T, Hkv, hd)
-    v = _mm(h, lp, "wv", dt).reshape(B, T, Hkv, hd)
+    if "wqkv_p" in lp:  # int4 fused q|k|v (one kernel call per layer)
+        qkv = _mm(h, lp, "wqkv", dt)
+        q = qkv[..., :H * hd].reshape(B, T, H, hd)
+        k = qkv[..., H * hd:(H + Hkv) * hd].reshape(B, T, Hkv, hd)
+        v = qkv[..., (H + Hkv) * hd:].reshape(B, T, Hkv, hd)
+    else:
+        q = _mm(h, lp, "wq", dt).reshape(B, T, H, hd)
+        k = _mm(h, lp, "wk", dt).reshape(B, T, Hkv, hd)
+        v = _mm(h, lp, "wv", dt).reshape(B, T, Hkv, hd)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
@@ -843,8 +896,14 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
     import jax.nn as jnn
 
-    gate = jnn.silu(_mm(h, lp, "w_gate", dt))
-    up = _mm(h, lp, "w_up", dt)
+    if "wgu_p" in lp:  # int4 fused gate|up
+        F = lp["wgu_p"].shape[-1] // 2
+        gu = _mm(h, lp, "wgu", dt)
+        gate = jnn.silu(gu[..., :F])
+        up = gu[..., F:]
+    else:
+        gate = jnn.silu(_mm(h, lp, "w_gate", dt))
+        up = _mm(h, lp, "w_up", dt)
     x = x + _mm(gate * up, lp, "w_down", dt)
     return x, kv
 

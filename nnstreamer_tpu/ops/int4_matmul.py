@@ -160,15 +160,14 @@ def _int4_kernel(ha_ref, hb_ref, p_ref, s_ref, o_ref, acc_ref):
 
 def _pick_fb(F: int, B: int, block_d2: int) -> int:
     """Largest 128-multiple divisor of F whose per-block VMEM footprint
-    fits; 0 if none.  Calibrated against Mosaic's actual accounting
-    (observed on chip): the i32 unpack temp is fused away; what counts
-    is the packed int8 block x2 pipeline buffers, the two bf16 nibble
-    planes feeding the dots, and the f32 acc + out blocks.  The decode
-    mats ([128, 11008] at B<=32 ~ 9.9 MB) must stay UNBLOCKED (measured
-    422 GB/s whole); the lm_head shape ([128, 32000] at B=32 ~ 24.6 MB,
-    the chip's 19.2 MB scoped-vmem OOM) must split."""
+    fits; 0 if none.  Calibrated against Mosaic's observed scoped-vmem
+    accounting for the 2-D grid: packed int8 block x2 pipeline buffers
+    PLUS one materialized bf16 nibble plane (bd*fb*4 total) plus the
+    f32 accumulator / output blocks.  Observed anchors: [512, 11008]
+    full-F at B=1 OOM'd at 21.95 MB (bd*fb*4 = 22.5 MB -> must split);
+    [128, 32000] at B=32 OOM'd at 19.2 MB; the split shapes compile."""
     budget = 14 << 20
-    per_elem = block_d2 * 6 + B * 8
+    per_elem = block_d2 * 4 + B * 6
     fb_max = budget // per_elem  # no floor: fb=0 -> caller falls back
     best = 0
     for fb in range(128, F + 1, 128):
@@ -177,7 +176,21 @@ def _pick_fb(F: int, B: int, block_d2: int) -> int:
     return best
 
 
-def matmul_int4(h, packed, scale, *, block_d2: int = 128,
+def _pick_blocks(d2: int, F: int, B: int, block_d2):
+    """(block_d2, fb) for the kernel grid.  Bigger contraction blocks
+    amortize per-grid-step cost — measured 2x mat throughput at B=16
+    for 512 vs 128 — so auto mode takes the largest of 512/256/128 that
+    divides d2 and still leaves a VMEM-fitting F block."""
+    cands = (block_d2,) if block_d2 else (512, 256, 128)
+    for bd in cands:
+        if d2 % bd == 0:
+            fb = _pick_fb(F, B, bd)
+            if fb:
+                return bd, fb
+    return 0, 0
+
+
+def matmul_int4(h, packed, scale, *, block_d2: Optional[int] = None,
                 interpret: Optional[bool] = None, out_dtype=None):
     """``h @ unpack(packed) * scale`` -> [B, F] in ``out_dtype``
     (default ``h.dtype``).
@@ -198,9 +211,9 @@ def matmul_int4(h, packed, scale, *, block_d2: int = 128,
         interpret = False
         if jax.default_backend() != "tpu":
             return matmul_int4_reference(h, packed, scale, out_dtype=odt)
-    fb = _pick_fb(F, B, block_d2)  # 0 when F doesn't tile or fit
-    if (not _HAVE_PALLAS or not kernel_enabled() or d2 % block_d2
-            or not fb or B > _MAX_KERNEL_ROWS):
+    bd, fb = _pick_blocks(d2, F, B, block_d2)  # (0, 0) -> fall back
+    if (not _HAVE_PALLAS or not kernel_enabled() or not fb
+            or B > _MAX_KERNEL_ROWS):
         return matmul_int4_reference(h, packed, scale, out_dtype=odt)
 
     hlo, hhi = h[:, :d2], h[:, d2:]
@@ -208,12 +221,12 @@ def matmul_int4(h, packed, scale, *, block_d2: int = 128,
     ha = hlo - hb
     out = pl.pallas_call(
         _int4_kernel,
-        grid=(F // fb, d2 // block_d2),
+        grid=(F // fb, d2 // bd),
         in_specs=[
-            pl.BlockSpec((B, block_d2), lambda i, j: (0, j)),  # h_lo - h_hi/16
-            pl.BlockSpec((B, block_d2), lambda i, j: (0, j)),  # h_hi / 16
-            pl.BlockSpec((block_d2, fb), lambda i, j: (j, i)),  # packed
-            pl.BlockSpec((1, fb), lambda i, j: (0, i)),         # scales
+            pl.BlockSpec((B, bd), lambda i, j: (0, j)),   # h_lo - h_hi/16
+            pl.BlockSpec((B, bd), lambda i, j: (0, j)),   # h_hi / 16
+            pl.BlockSpec((bd, fb), lambda i, j: (j, i)),  # packed block
+            pl.BlockSpec((1, fb), lambda i, j: (0, i)),   # scales
         ],
         out_specs=pl.BlockSpec((B, fb), lambda i, j: (0, i)),
         out_shape=jax.ShapeDtypeStruct((B, F), odt),

@@ -103,14 +103,38 @@ CFG = llama.PRESETS["llama_tiny"]
 
 def test_quantize_int4_params_pytree():
     params = llama.init_params(CFG, seed=0)
+    # quantize donates the big mats: snapshot the comparison input FIRST
+    wq0 = np.array(params["layers"]["wq"][0])
     qp = llama.quantize_int4_params(params)
     lay = qp["layers"]
-    for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
-        assert k + "_p" in lay and k + "_s" in lay
-        L, din, dout = np.asarray(params["layers"][k]).shape
-        assert lay[k + "_p"].shape == (L, din // 2, dout)
-        assert lay[k + "_s"].shape == (L, 1, dout)
+    L, D = CFG.n_layers, CFG.dim
+    hd = CFG.head_dim
+    qkv_out = (CFG.n_heads + 2 * CFG.n_kv_heads) * hd
+    # fused layout (_INT4_GROUPS): q|k|v and gate|up share one packed mat
+    assert lay["wqkv_p"].shape == (L, D // 2, qkv_out)
+    assert lay["wqkv_s"].shape == (L, 1, qkv_out)
+    assert lay["wo_p"].shape == (L, CFG.n_heads * hd // 2, D)
+    assert lay["wgu_p"].shape == (L, D // 2, 2 * CFG.ffn_hidden)
+    assert lay["w_down_p"].shape == (L, CFG.ffn_hidden // 2, D)
     assert qp["lm_head_p"].shape == (CFG.dim // 2, CFG.vocab)
+    # the fused wqkv block for q IS quantize(wq) — both paths quantize
+    # member-wise and only packed nibbles concatenate.  The oracle here
+    # runs EAGERLY while production runs under the lax.map jit, whose
+    # max-reduction can differ by 1 f32 ULP, shifting a boundary value
+    # one quantization step — so dequantized values compare within one
+    # step of each column's scale.
+    pq, sq = quantize_int4(jnp.asarray(wq0))
+    ncol = CFG.n_heads * hd
+    deq_fused = (np.asarray(unpack_int4(lay["wqkv_p"][0, :, :ncol]),
+                            np.float32) * np.asarray(lay["wqkv_s"][0, :, :ncol]))
+    deq_alone = (np.asarray(unpack_int4(pq), np.float32) * np.asarray(sq))
+    step = np.asarray(sq)[0] * (1 + 1e-5) + 1e-7  # one step per column
+    assert np.all(np.abs(deq_fused - deq_alone) <= step[None, :])
+    # and almost every integer CODE must agree exactly (scales may
+    # differ in the last f32 ULP, so compare codes, not products)
+    codes_fused = np.asarray(unpack_int4(lay["wqkv_p"][0, :, :ncol]))
+    codes_alone = np.asarray(unpack_int4(pq))
+    assert (codes_fused != codes_alone).mean() < 1e-3
 
 
 def test_init_params_int4_matches_quantize_of_init():
