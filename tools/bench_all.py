@@ -63,6 +63,9 @@ ROWS = [
                                    "--llm-streams", "16"]),
     ("llm7b_int4_x16", ["--config", "llm7b", "--llm-quant", "int4",
                         "--llm-streams", "16"]),
+    ("llm7b_int4_continuous_x16", ["--config", "llm7b", "--llm-quant",
+                                   "int4", "--llm-serve", "continuous",
+                                   "--llm-streams", "16"]),
 ]
 
 
