@@ -32,13 +32,11 @@ import threading
 import time
 
 # `JAX_PLATFORMS=cpu python bench.py` must not touch (and hang on) an
-# unreachable device tunnel when a site hook pre-imported jax.
+# unreachable device tunnel when a site hook pre-imported jax.  Called from
+# main(), NOT at import: `import bench` (the probe tests do) must stay free
+# of backend side effects.
 from nnstreamer_tpu.core.platform import (enable_compilation_cache,
                                            honor_jax_platforms)
-
-honor_jax_platforms()
-enable_compilation_cache()
-
 
 # 8-deep in-flight window: measured +29% classification fps over 4 (RTT
 # and host post-processing hide behind more batches); 16 adds only +2%.
@@ -866,6 +864,85 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     }
 
 
+def bench_batching(batches: int, warmup: int, batch_max: int = 8,
+                   dims: int = 256) -> dict:
+    """Adaptive micro-batching row: a BACKLOGGED small-model pipeline
+    (appsrc -> tensor_filter -> tensor_sink) where per-dispatch overhead
+    dominates compute.  ``batch_max=8`` lets the filter stage drain the
+    backlog into bucketed vmapped dispatches (one XLA call per <=8
+    buffers); the row reports the throughput ratio vs the seed's
+    one-dispatch-per-buffer path (``batch_max=1``) on identical input.
+    ``vs_baseline`` is speedup/2.0: 1.0 = the >=2x acceptance bar.
+    Backend-agnostic by design — dispatch overhead exists on every
+    backend, so this row is meaningful on CPU too."""
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics as _metrics
+    from nnstreamer_tpu.utils.profiler import metrics_text
+
+    n = max(384, 3 * batches)
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions={dims},"
+        "types=float32 ! "
+        f"tensor_filter framework=jax model=scaler "
+        f"custom=scale:1.5,dims:{dims} name=f ! "
+        "tensor_sink name=out"
+    )
+    frames = [np.full((dims,), float(i % 7), np.float32) for i in range(8)]
+
+    def run(bmax: int):
+        _metrics.reset()
+        # same queue capacity for both runs: the comparison isolates the
+        # drain->one-dispatch mechanism, not queue depth
+        p = nt.Pipeline(desc, queue_capacity=64, batch_max=bmax)
+        walls = []
+        with p:
+            for i in range(max(64, 8 * warmup)):  # compile every bucket
+                p.push("src", frames[i % len(frames)])
+            for _ in range(max(64, 8 * warmup)):
+                p.pull("out", timeout=120)
+
+            # best-of-3 windows: scheduling noise on a shared host easily
+            # costs 2x on a sub-second window, and the row's claim is the
+            # MECHANISM's steady-state ratio, not the noise floor
+            for _ in range(3):
+                def pusher():
+                    for i in range(n):
+                        p.push("src", frames[i % len(frames)])
+
+                t = threading.Thread(target=pusher, daemon=True)
+                t0 = time.perf_counter()
+                t.start()
+                for _ in range(n):
+                    p.pull("out", timeout=120)
+                walls.append(time.perf_counter() - t0)
+                t.join()
+            p.eos()
+            p.wait(timeout=60)
+        snap = _metrics.snapshot()
+        occ = {k.rsplit(".", 1)[1]: round(v, 2)
+               for k, v in snap.items() if k.startswith("f.batch_occupancy.")}
+        return n / min(walls), occ, "batch_occupancy" in metrics_text()
+
+    fps_batched, occ, visible = run(batch_max)
+    fps_single, _, _ = run(1)
+    speedup = fps_batched / fps_single
+    return {
+        "metric": f"adaptive_batching_speedup_batch{batch_max}_vs_1",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup / 2.0, 3),
+        "fps_batched": round(fps_batched, 1),
+        "fps_unbatched": round(fps_single, 1),
+        "batch_max": batch_max,
+        "buffers": n,
+        "dims": dims,
+        "batch_occupancy": occ,
+        "occupancy_in_metrics_text": visible,
+    }
+
+
 def bench_link() -> dict:
     """Link-calibration row (VERDICT r4 Weak #4): raw H2D/D2H bandwidth
     and small-fetch RTT for THIS session, measured with the same sync
@@ -967,11 +1044,13 @@ def _backend_reachable(attempt_timeout_s: float = 60.0,
 
 
 def main() -> int:
+    honor_jax_platforms()
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="classification",
                     choices=["classification", "classification_quant",
                              "detection", "pose", "segmentation", "audio",
-                             "llm", "llm7b", "link", "all"])
+                             "llm", "llm7b", "link", "batching", "all"])
     # classification defaults to 256: the r3 on-chip session measured 2x
     # the fps AND 2x the MFU of batch 64 (30,137 fps / 0.175 MFU vs
     # 15,116 / 0.088) at a still-interactive 5.4 ms p50 — deeper batches
@@ -1036,6 +1115,7 @@ def main() -> int:
                     "tokens/sec"),
             "llm7b": ("llama2_7b_tokens_per_sec_per_chip", "tokens/sec"),
             "link": ("link_calibration_d2h_mbps", "MB/s"),
+            "batching": ("adaptive_batching_speedup_batch8_vs_1", "x"),
         }
         todo = (["classification", "detection", "pose", "segmentation",
                  "audio", "llm"]
@@ -1092,6 +1172,7 @@ def main() -> int:
                                    serve=args.llm_serve,
                                    text=args.llm_text),
         "link": bench_link,
+        "batching": lambda: bench_batching(args.batches, args.warmup),
     }
     todo = list(runners) if args.config == "all" else [args.config]
     if args.config == "all":

@@ -120,6 +120,55 @@ class Buffer:
         return self
 
 
+# -- micro-batch stack/split ----------------------------------------------
+#
+# The adaptive micro-batching layer (pipeline/batching.py) stacks the
+# tensors of several same-spec buffers on a NEW leading axis, runs one
+# bucketed XLA dispatch, and splits the result back into per-buffer rows.
+# The helpers below are the stack/split primitives: plain jnp ops, so they
+# work standalone AND trace cleanly inside the batcher's jitted program
+# (payloads stay jax Arrays in HBM end to end — the split rows are lazy
+# slices of the batched output, never host copies).
+
+
+def batch_signature(buf: "Buffer") -> Tuple:
+    """Stacking compatibility key: two buffers may join one micro-batch iff
+    their signatures match (same tensor count, shapes, dtypes)."""
+    return tuple(
+        (tuple(t.shape), str(getattr(t, "dtype", type(t)))) for t in buf.tensors
+    )
+
+
+def pad_rows(rows: Sequence[Any], pad_to: int) -> List[Any]:
+    """THE bucket-padding policy: repeat the last row until ``pad_to`` —
+    valid data, so padded programs need no masking, and the repeats are
+    references, not copies (pad rows' outputs are dropped by split_rows).
+    Single implementation shared by stack_tensors and BatchRunner."""
+    rows = list(rows)
+    if pad_to > len(rows):
+        rows += [rows[-1]] * (pad_to - len(rows))
+    return rows
+
+
+def stack_tensors(rows: Sequence[Sequence[Any]], pad_to: Optional[int] = None):
+    """Stack per-buffer tensor rows on a new leading axis.
+
+    ``rows`` is a list of per-buffer tensor tuples (all same signature);
+    returns a tuple of arrays shaped ``[B, ...]``; ``pad_to`` applies
+    :func:`pad_rows` first."""
+    import jax.numpy as jnp
+
+    rows = pad_rows(rows, pad_to) if pad_to is not None else list(rows)
+    k = len(rows[0])
+    return tuple(jnp.stack([r[t] for r in rows]) for t in range(k))
+
+
+def split_rows(arrays: Sequence[Any], n: int) -> List[Tuple]:
+    """Inverse of stack_tensors: ``[B, ...]`` arrays -> n per-buffer tensor
+    tuples (rows past n — bucket padding — are dropped)."""
+    return [tuple(a[i] for a in arrays) for i in range(n)]
+
+
 @dataclasses.dataclass
 class Event:
     """In-band stream event (reference: GstEvent — EOS, segment, caps)."""

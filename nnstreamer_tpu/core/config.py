@@ -23,6 +23,7 @@ _ENV_CONF = "NNS_TPU_CONF"
 _ENV_PLUGINS = "NNS_TPU_PLUGINS"
 _ENV_FW_PRIORITY = "NNS_TPU_FILTER_PRIORITY"
 _ENV_BUCKETING = "NNS_TPU_SHAPE_BUCKETING"
+_ENV_BATCH_MAX = "NNS_TPU_BATCH_MAX"
 
 
 @dataclasses.dataclass
@@ -35,6 +36,15 @@ class Config:
     )
     #: default queue capacity between pipeline stages (buffers)
     queue_capacity: int = 4
+    #: adaptive micro-batching: max already-queued buffers a device stage
+    #: drains into ONE bucketed XLA dispatch (1 = off, the seed semantics)
+    batch_max: int = 1
+    #: allowed stacked batch sizes (bounds XLA recompiles); empty = powers
+    #: of two up to batch_max
+    batch_buckets: List[int] = dataclasses.field(default_factory=list)
+    #: optional wait (ms) for more buffers once one is in hand; 0 = never
+    #: trade latency for occupancy (drain only what is already queued)
+    batch_linger_ms: float = 0.0
     #: pad flexible shapes up to the next bucket to bound XLA recompiles
     shape_bucketing: bool = True
     #: emit per-stage latency measurements
@@ -55,6 +65,15 @@ class Config:
                 cfg.filter_priority = _split(ini.get("filter", "priority"))
             if ini.has_option("common", "queue_capacity"):
                 cfg.queue_capacity = ini.getint("common", "queue_capacity")
+            if ini.has_option("common", "batch_max"):
+                cfg.batch_max = ini.getint("common", "batch_max")
+            if ini.has_option("common", "batch_buckets"):
+                cfg.batch_buckets = [
+                    int(v) for v in _split(ini.get("common", "batch_buckets"))
+                ]
+            if ini.has_option("common", "batch_linger_ms"):
+                cfg.batch_linger_ms = ini.getfloat("common",
+                                                   "batch_linger_ms")
             if ini.has_option("common", "shape_bucketing"):
                 cfg.shape_bucketing = ini.getboolean("common",
                                                      "shape_bucketing")
@@ -65,6 +84,8 @@ class Config:
             cfg.plugin_modules = _split(os.environ[_ENV_PLUGINS])
         if os.environ.get(_ENV_FW_PRIORITY):
             cfg.filter_priority = _split(os.environ[_ENV_FW_PRIORITY])
+        if os.environ.get(_ENV_BATCH_MAX):
+            cfg.batch_max = int(os.environ[_ENV_BATCH_MAX])
         if os.environ.get(_ENV_BUCKETING):
             cfg.shape_bucketing = os.environ[_ENV_BUCKETING].lower() in (
                 "1", "true", "yes", "on")

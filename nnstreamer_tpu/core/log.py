@@ -42,13 +42,18 @@ class Metrics:
         with self._lock:
             self._counters[name] += value
 
-    def observe_latency(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample of a distribution (latency seconds, batch
+        occupancy, ...); snapshot() derives p50/p99/mean/n per series."""
         with self._lock:
             r = self._lat[name]
             if len(r) >= self._lat_cap:
                 # reservoir decimation: keep every other sample
                 del r[::2]
-            r.append(seconds)
+            r.append(value)
+
+    def observe_latency(self, name: str, seconds: float) -> None:
+        self.observe(name, seconds)
 
     def percentile(self, name: str, q: float) -> Optional[float]:
         with self._lock:

@@ -147,6 +147,25 @@ class Element:
         """Handle one input buffer; return downstream pushes."""
         raise NotImplementedError
 
+    def process_batch(self, pad: str, bufs: List[Buffer]) -> Out:
+        """Handle a micro-batch drained from this stage's queue in one call.
+
+        Output order must equal input order.  Default: loop ``process`` —
+        host elements keep exact single-buffer semantics; device stages
+        (FusedElement, tensor_filter with a pure JAX fn) override with one
+        bucketed XLA dispatch.  Only called when the stage was planned
+        batchable (see :meth:`batch_capable`) AND the pipeline runs with
+        ``batch_max > 1``."""
+        outs: Out = []
+        for buf in bufs:
+            outs.extend(self.process(pad, buf))
+        return outs
+
+    def batch_capable(self) -> bool:
+        """True when this element benefits from micro-batching (overridden
+        by device stages); the planner only marks such stages batchable."""
+        return False
+
     def process_group(self, bufs: Dict[str, Buffer]) -> Out:
         """Handle one collated buffer-per-pad group (sync_policy == "all")."""
         raise NotImplementedError

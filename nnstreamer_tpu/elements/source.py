@@ -119,11 +119,16 @@ class AppSrc(SourceElement):
         self._eos.set()
 
     def generate(self) -> Iterator[Union[Buffer, Event]]:
+        stop = getattr(self, "_stop_event", None)
         while True:
             try:
                 yield self._q.get(timeout=0.05)
             except _queue.Empty:
                 if self._eos.is_set() and self._q.empty():
+                    return
+                # stop() without EOS: exit instead of pinning the runner
+                # thread on the join timeout (pipeline teardown, not EOS)
+                if stop is not None and stop.is_set():
                     return
 
 

@@ -39,6 +39,10 @@ class Stage:
     node_ids: List[int]
     head: int  # node id receiving external input
     tail: int  # node id producing external output
+    #: device stage whose runner may drain a micro-batch from its queue
+    #: into one bucketed XLA dispatch (set by the planner; the runtime
+    #: additionally requires the pipeline's batch_max > 1)
+    batchable: bool = False
 
     def external_out_pad(self, edge: Edge) -> str:
         return edge.src_pad
@@ -57,6 +61,7 @@ class FusedElement(Element):
         super().__init__({}, name="+".join(e.name for e in elements))
         self.chain = elements
         self._fn = None
+        self._batcher = None
         self._out_spec: Optional[TensorsSpec] = None
         self._in_spec = specs[0]
         # Tail element may pair its device_fn with a deferred host mapping
@@ -119,26 +124,48 @@ class FusedElement(Element):
         for el in self.chain:
             el.stop()
 
-    def process(self, pad: str, buf: Buffer):
-        import jax.numpy as jnp
-
-        arrays = tuple(jnp.asarray(t) for t in buf.tensors)
-        out = self._jitted()(arrays)
-        # A truncated tail batch (device sources with non-aligned
-        # num-buffers) has a different leading dim than the negotiated
-        # spec: let the buffer derive its spec from the actual arrays so
-        # wire/shm consumers see truthful byte counts.
+    def _finish(self, buf: Buffer, out) -> Buffer:
+        """Shared output tail for the single and batched paths: spec
+        fallback for odd shapes (a truncated tail batch from a device
+        source with non-aligned num-buffers has a different leading dim
+        than the negotiated spec — let the buffer derive its spec so
+        wire/shm consumers see truthful byte counts), plus the deferred
+        host-post mapping with its async D2H already in flight."""
         spec = self._out_spec
-        if spec is not None and len(out) and hasattr(out[0], "shape"):
-            if tuple(out[0].shape) != spec[0].shape:
-                spec = None
+        if (spec is not None and len(out) and hasattr(out[0], "shape")
+                and tuple(out[0].shape) != spec[0].shape):
+            spec = None
         new = buf.with_tensors(list(out), spec=spec)
         if self._host_post is not None:
             for t in out:
                 if hasattr(t, "copy_to_host_async"):
                     t.copy_to_host_async()
             new.meta["_host_post"] = self._host_post
-        return [(SRC, new)]
+        return new
+
+    def process(self, pad: str, buf: Buffer):
+        import jax.numpy as jnp
+
+        arrays = tuple(jnp.asarray(t) for t in buf.tensors)
+        out = self._jitted()(arrays)
+        return [(SRC, self._finish(buf, out))]
+
+    # -- micro-batching ----------------------------------------------------
+    def batch_capable(self) -> bool:
+        return True
+
+    def process_batch(self, pad: str, bufs):
+        """N same-spec buffers -> ONE bucketed vmapped dispatch of the
+        fused program (see pipeline/batching.py); per-buffer outputs keep
+        their own pts/meta and order."""
+        from .batching import BatchRunner
+
+        if self._batcher is None:
+            self._batcher = BatchRunner(
+                self._composed, getattr(self, "_batch_buckets", None),
+                name=self.name)
+        rows = self._batcher.run([tuple(b.tensors) for b in bufs])
+        return [(SRC, self._finish(buf, row)) for buf, row in zip(bufs, rows)]
 
     def finalize(self):
         outs = []
@@ -198,13 +225,29 @@ class FusedSourceElement(SourceElement):
         return self.source.finalize() + self.fused.finalize()
 
 
+def _element_batchable(el: Element) -> bool:
+    """Can this stage's runner drain micro-batches?  Sources have no input
+    queue; batch_capable() must not veto planning by raising (a framework
+    that cannot even load will fail loudly at start() instead)."""
+    if isinstance(el, SourceElement):
+        return False
+    try:
+        return bool(el.batch_capable())
+    except Exception:  # noqa: BLE001 - capability probe only
+        return False
+
+
 def plan_stages(
     graph: PipelineGraph, elements: Dict[int, Element], *, fuse: bool = True
 ) -> List[Stage]:
     """Partition the graph into stages; fuse linear device chains."""
     order = graph.topo_order()
     if not fuse:
-        return [Stage(elements[n.id], [n.id], n.id, n.id) for n in order]
+        return [
+            Stage(elements[n.id], [n.id], n.id, n.id,
+                  batchable=_element_batchable(elements[n.id]))
+            for n in order
+        ]
 
     def linear(nid: int) -> bool:
         ins = graph.in_edges(nid)
@@ -290,12 +333,13 @@ def plan_stages(
                     continue
         grown = grow(node.id)
         if grown is None or len(grown[0]) == 1:
-            stages.append(Stage(elements[node.id], [node.id], node.id, node.id))
+            stages.append(Stage(elements[node.id], [node.id], node.id, node.id,
+                                batchable=_element_batchable(elements[node.id])))
             consumed.add(node.id)
             continue
         chain, specs = grown
         fe = FusedElement([elements[i] for i in chain], specs)
         log.info("fused %d elements into one XLA stage: %s", len(chain), fe.name)
-        stages.append(Stage(fe, chain, chain[0], chain[-1]))
+        stages.append(Stage(fe, chain, chain[0], chain[-1], batchable=True))
         consumed.update(chain)
     return stages

@@ -7,7 +7,9 @@ equivalents:
 * :func:`trace` — context manager around ``jax.profiler`` producing an
   xplane trace viewable in TensorBoard/XProf (device timelines, HBM);
 * :func:`metrics_text` — the process metrics in Prometheus text format
-  (frames in/out, queue depths via gauges, per-stage latency quantiles);
+  (frames in/out, queue depths via gauges, per-stage latency quantiles,
+  and the adaptive micro-batching series: ``<stage>.batch_occupancy``
+  distributions and ``<stage>.batch_pad_waste`` counters — docs/BATCHING.md);
 * :func:`start_metrics_server` — a ``/metrics`` HTTP endpoint (SURVEY
   §5.5 "a /metrics-style counter set").
 """

@@ -1,0 +1,273 @@
+"""Adaptive micro-batching semantics (ISSUE 1 tentpole).
+
+The contract: with ``batch_max > 1`` a device stage drains already-queued
+compatible buffers into ONE bucketed XLA dispatch, while every observable
+single-buffer semantic — output values, strict ordering, pts/meta, EOS
+flush — stays identical to the seed executor; with ``batch_max=1`` (the
+default) the seed code path runs unchanged.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import (Buffer, batch_signature, split_rows,
+                                        stack_tensors)
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.pipeline.batching import BatchRunner, bucket_for
+
+DESC = (
+    "appsrc name=src caps=other/tensors,dimensions=16,types=float32 ! "
+    "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:16 "
+    "name=f ! tensor_sink name=out"
+)
+
+
+def _frames(n):
+    return [np.full((16,), float(i), np.float32) for i in range(n)]
+
+
+def _run(desc, frames, timeout=60, **kw):
+    p = nt.Pipeline(desc, **kw)
+    outs = []
+    with p:
+        for i, x in enumerate(frames):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in frames:
+            outs.append(p.pull("out", timeout=timeout))
+        p.eos()
+        p.wait(timeout=timeout)
+    return outs
+
+
+# -- primitives ------------------------------------------------------------
+
+def test_bucket_for_ladder():
+    assert bucket_for(1) == 1
+    assert bucket_for(3) == 4
+    assert bucket_for(8) == 8
+    assert bucket_for(9) == 16
+    assert bucket_for(5, [2, 6]) == 6
+    assert bucket_for(7, [2, 6]) == 7  # above the ladder: exact
+
+
+def test_stack_split_roundtrip(rng):
+    rows = [tuple(rng.standard_normal((3, 4)).astype(np.float32)
+                  for _ in range(2)) for _ in range(3)]
+    stacked = stack_tensors(rows, pad_to=4)
+    assert all(a.shape == (4, 3, 4) for a in stacked)
+    # pad row repeats the last real row
+    np.testing.assert_array_equal(np.asarray(stacked[0][3]), rows[2][0])
+    back = split_rows(stacked, 3)
+    for want, got in zip(rows, back):
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+
+def test_batch_signature_gates_stacking():
+    a = Buffer([np.zeros((2, 3), np.float32)])
+    b = Buffer([np.ones((2, 3), np.float32)])
+    c = Buffer([np.zeros((2, 3), np.float64)])
+    d = Buffer([np.zeros((3, 2), np.float32)])
+    assert batch_signature(a) == batch_signature(b)
+    assert batch_signature(a) != batch_signature(c)
+    assert batch_signature(a) != batch_signature(d)
+
+
+def test_batch_runner_matches_per_row_fn(rng):
+    fn = lambda arrays: (arrays[0] * 2.0 + 1.0,)  # noqa: E731
+    br = BatchRunner(fn)
+    rows = [(rng.standard_normal((8,)).astype(np.float32),)
+            for _ in range(5)]  # 5 -> bucket 8: three pad rows dropped
+    outs = br.run(rows)
+    assert len(outs) == 5
+    for (x,), (y,) in zip(rows, outs):
+        np.testing.assert_allclose(np.asarray(y), x * 2.0 + 1.0, rtol=1e-6)
+
+
+# -- pipeline semantics ----------------------------------------------------
+
+def test_occupancy_above_one_under_backlog():
+    """A backlogged queue must actually coalesce: with 24 buffers pushed
+    before the first (compile-slowed) dispatch finishes, occupancy > 1."""
+    metrics.reset()
+    frames = _frames(24)
+    outs = _run(DESC, frames, queue_capacity=32, batch_max=8)
+    assert len(outs) == 24
+    snap = metrics.snapshot()
+    assert snap.get("f.batch_occupancy.n", 0) >= 1
+    assert snap.get("f.batch_occupancy.p99", 0) > 1.0
+
+
+def test_strict_output_ordering_and_pts():
+    frames = _frames(32)
+    outs = _run(DESC, frames, queue_capacity=32, batch_max=8)
+    for i, (x, o) in enumerate(zip(frames, outs)):
+        assert o.pts == i
+        np.testing.assert_allclose(np.asarray(o.tensors[0]), x * 2.0)
+
+
+def test_bucket_padding_matches_unbatched_reference():
+    """13 backlogged buffers hit partial buckets (padding); every output
+    must match the batch_max=1 reference run value-for-value."""
+    frames = _frames(13)
+    batched = _run(DESC, frames, queue_capacity=16, batch_max=8)
+    reference = _run(DESC, frames, queue_capacity=16, batch_max=1)
+    for b, r in zip(batched, reference):
+        np.testing.assert_allclose(
+            np.asarray(b.tensors[0]), np.asarray(r.tensors[0]), rtol=1e-6)
+
+
+def test_partial_batch_flushes_at_eos():
+    """3 buffers with batch_max=8: nothing may wait for a full batch — all
+    outputs delivered and EOS completes the pipeline."""
+    frames = _frames(3)
+    outs = _run(DESC, frames, queue_capacity=16, batch_max=8)
+    assert len(outs) == 3
+    for x, o in zip(frames, outs):
+        np.testing.assert_allclose(np.asarray(o.tensors[0]), x * 2.0)
+
+
+def test_batch_max_1_is_bit_identical_to_default():
+    """batch_max=1 must run the exact seed path: outputs byte-identical to
+    the default pipeline's."""
+    frames = _frames(6)
+    explicit = _run(DESC, frames, batch_max=1)
+    default = _run(DESC, frames)
+    for a, b in zip(explicit, default):
+        assert bytes(np.asarray(a.tensors[0])) == bytes(
+            np.asarray(b.tensors[0]))
+        assert a.pts == b.pts
+
+
+def test_fused_stage_batches_and_matches():
+    """A fused transform+filter chain is batchable as one stage; batched
+    outputs match the unbatched fused run."""
+    desc = (
+        "appsrc name=src caps=other/tensors,dimensions=4:4,types=float32 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:2.0 ! "
+        "tensor_filter framework=jax model=scaler custom=scale:4.0,dims:4:4 "
+        "name=f ! tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, batch_max=4)
+    fused = [s for s in p.stages if len(s.node_ids) > 1]
+    assert fused and fused[0].batchable
+    frames = [np.full((4, 4), float(i + 1), np.float32) for i in range(9)]
+    batched = _run(desc, frames, queue_capacity=16, batch_max=4)
+    reference = _run(desc, frames, queue_capacity=16, batch_max=1)
+    for b, r in zip(batched, reference):
+        np.testing.assert_allclose(
+            np.asarray(b.tensors[0]), np.asarray(r.tensors[0]), rtol=1e-6)
+
+
+def test_host_stages_stay_unbatched():
+    """Host-only elements are never planned batchable — their process()
+    semantics are untouched by the batching layer."""
+    p = nt.Pipeline(
+        "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! "
+        "tensor_sink name=out", fuse=False, batch_max=8)
+    by_name = {s.element.name: s.batchable for s in p.stages}
+    assert not any(by_name.values())
+
+
+def test_mixed_spec_buffers_split_batches():
+    """Buffers whose tensor signatures differ must never stack; outputs
+    still arrive in order with correct values (flexible appsrc caps)."""
+    desc = ("appsrc name=src ! "
+            "tensor_filter framework=custom-easy model=batch-double ! "
+            "tensor_sink name=out")
+    from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+    register_custom_easy("batch-double", lambda ins: [ins[0] * 2],
+                         jax_traceable=True)
+    frames = [np.full((4 + (i % 2),), float(i), np.float32)
+              for i in range(10)]
+    outs = _run(desc, frames, queue_capacity=16, batch_max=8)
+    for x, o in zip(frames, outs):
+        np.testing.assert_allclose(np.asarray(o.tensors[0]), x * 2.0)
+
+
+def test_occupancy_visible_in_prometheus_text():
+    from nnstreamer_tpu.utils.profiler import metrics_text
+
+    metrics.reset()
+    _run(DESC, _frames(16), queue_capacity=32, batch_max=8)
+    text = metrics_text()
+    assert "batch_occupancy" in text
+
+
+def test_batch_linger_waits_for_stragglers():
+    """batch_linger_ms > 0: the drain waits for late buffers instead of
+    dispatching singles (explicit latency-for-occupancy trade)."""
+    metrics.reset()
+    p = nt.Pipeline(DESC, queue_capacity=32, batch_max=4,
+                    batch_linger_ms=200.0)
+    frames = _frames(8)
+    outs = []
+    with p:
+        for i in range(0, 8, 2):  # trickle pairs with small gaps
+            p.push("src", frames[i])
+            p.push("src", frames[i + 1])
+            time.sleep(0.01)
+        for _ in frames:
+            outs.append(p.pull("out", timeout=60))
+        p.eos()
+        p.wait(timeout=60)
+    assert len(outs) == 8
+    snap = metrics.snapshot()
+    assert snap.get("f.batch_occupancy.p99", 0) > 1.0
+
+
+# -- shutdown path (satellite: poison instead of 0.1 s polling) ------------
+
+class TestStopLatency:
+    def test_stop_wakes_blocked_stages_immediately(self):
+        """An idle multi-stage pipeline must stop in far less than one
+        seed-era 0.1 s poll interval per hop."""
+        p = nt.Pipeline(
+            "appsrc name=src caps=other/tensors,dimensions=4,types=float32 ! "
+            "tensor_transform mode=typecast option=float32 ! "
+            "tensor_transform mode=typecast option=float32 ! "
+            "tensor_transform mode=typecast option=float32 ! "
+            "tensor_sink name=out", fuse=False)
+        p.start()
+        time.sleep(0.05)  # let every stage block on its queue
+        t0 = time.monotonic()
+        p.stop()
+        dt = time.monotonic() - t0
+        assert dt < 0.5, f"stop took {dt:.3f}s"
+        runners = {id(r): r for r in p._runners.values()}.values()
+        assert not any(r.thread.is_alive() for r in runners)
+
+    def test_stop_unblocks_backpressured_feeder(self):
+        """A producer blocked on a FULL downstream queue must shed and exit
+        promptly on stop()."""
+        from nnstreamer_tpu.core.types import TensorsSpec
+        from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+        spec = TensorsSpec.from_string("4", "float32")
+
+        def slow(ins):
+            time.sleep(0.3)
+            return [np.asarray(ins[0], np.float32)]
+
+        register_custom_easy("stop-slow", slow, in_spec=spec, out_spec=spec)
+        p = nt.Pipeline(
+            "appsrc name=src caps=other/tensors,dimensions=4,types=float32 ! "
+            "tensor_filter framework=custom-easy model=stop-slow ! "
+            "tensor_sink name=out", queue_capacity=1)
+        with p:
+            for _ in range(4):  # floods the 1-deep filter queue
+                p.push("src", np.ones((4,), np.float32))
+            time.sleep(0.1)  # source thread now blocked in feed()
+            t0 = time.monotonic()
+        # context exit calls stop(): the blocked feed must shed, the slow
+        # in-flight process() call (~0.3 s) bounds the join
+        assert time.monotonic() - t0 < 2.0
+
+    def test_clean_eos_still_drains_everything(self):
+        frames = _frames(5)
+        outs = _run(DESC, frames, batch_max=8)
+        assert len(outs) == 5

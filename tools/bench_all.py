@@ -27,6 +27,9 @@ ROWS = [
     # session (VERDICT r4 Weak #4); vision/audio rows also carry their
     # own in-loop fetch_rtt_ms + rtt_stalls tail attribution.
     ("link_calibration", ["--config", "link"]),
+    # backend-agnostic: the micro-batching speedup row measures dispatch
+    # amortization, meaningful on CPU and TPU alike
+    ("adaptive_batching", ["--config", "batching"]),
     ("classification", ["--config", "classification"]),
     ("classification_quant", ["--config", "classification_quant"]),
     ("classification_appsrc", ["--config", "classification",

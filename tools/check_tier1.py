@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Tier-1 regression gate: run the ROADMAP verify command and FAIL when
+the passing-test count drops below the checked-in floor.
+
+    python tools/check_tier1.py            # gate (CI / pre-merge)
+    python tools/check_tier1.py --update   # bump the floor after adding tests
+
+The floor lives in tools/tier1_floor.txt so a PR that silently loses
+passing tests (the batching refactor and everything after it) cannot
+merge green.  DOTS_PASSED is counted exactly the way the ROADMAP verify
+line counts it: dots in pytest's progress lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLOOR_FILE = os.path.join(REPO, "tools", "tier1_floor.txt")
+
+#: the ROADMAP "Tier-1 verify" pytest invocation, verbatim
+PYTEST_ARGS = [
+    "-m", "pytest", "tests/", "-q", "-m", "not slow",
+    "--continue-on-collection-errors", "-p", "no:cacheprovider",
+    "-p", "no:xdist", "-p", "no:randomly",
+]
+
+# ROADMAP's grep uses [.FEsx]; 'X' (xpass) added here so one xpassing test
+# cannot void a whole progress line's pass-dots and fake a regression
+_DOTS_RE = re.compile(r"^[.FEsxX]+( *\[ *[0-9]+%\])?$")
+
+
+def count_dots(text: str) -> int:
+    return sum(line.count(".") for line in text.splitlines()
+               if _DOTS_RE.match(line.strip()))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true",
+                    help="write the measured count as the new floor")
+    ap.add_argument("--timeout", type=int, default=870,
+                    help="seconds before the suite is killed (ROADMAP "
+                         "budget)")
+    args = ap.parse_args()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable] + PYTEST_ARGS, cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=args.timeout)
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        print(f"tier1: suite timed out after {args.timeout}s "
+              f"(partial DOTS_PASSED={count_dots(out)})", file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    print(f"DOTS_PASSED={passed}")
+
+    if args.update:
+        with open(FLOOR_FILE, "w") as f:
+            f.write(f"{passed}\n")
+        print(f"tier1: floor updated to {passed}")
+        return 0
+
+    if not os.path.exists(FLOOR_FILE):
+        print(f"tier1: no floor file at {FLOOR_FILE} — run with --update "
+              "once to check one in", file=sys.stderr)
+        return 2
+    with open(FLOOR_FILE) as f:
+        floor = int(f.read().strip())
+    if passed < floor:
+        print(f"tier1: REGRESSION — {passed} passed < floor {floor} "
+              f"(pytest rc={proc.returncode}); tail:", file=sys.stderr)
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    print(f"tier1: OK — {passed} passed >= floor {floor}")
+    if passed > floor:
+        print(f"tier1: floor can be raised to {passed} "
+              "(python tools/check_tier1.py --update)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
