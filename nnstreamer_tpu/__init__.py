@@ -53,5 +53,6 @@ from .pipeline.parser import ParseError  # noqa: F401
 from .pipeline.graph import PipelineGraph  # noqa: F401
 from .pipeline.runtime import Pipeline  # noqa: F401
 from .elements.filter import SingleShot  # noqa: F401
+from .analysis import PipelineLintError, analyze  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,87 @@
+"""nns-lint: compile-time pipeline verification.
+
+The runtime surfaces pipeline misconfigurations one at a time, mid-stream.
+This package finds them *before a pipeline ever starts*, with three passes
+over the parsed :class:`~nnstreamer_tpu.pipeline.graph.PipelineGraph` —
+no JAX execution, no device, no model files:
+
+1. :mod:`~nnstreamer_tpu.analysis.capsflow` — whole-graph caps/spec
+   propagation through every edge, reporting EVERY incompatibility in one
+   run with element-path diagnostics;
+2. :mod:`~nnstreamer_tpu.analysis.topology` — dangling refs, cycles,
+   unreachable branches, collator arity, tee-diamond deadlock hazards;
+3. :mod:`~nnstreamer_tpu.analysis.purity` — AST lint of device_fns and
+   registered pure filter functions for host side effects that break
+   tracing or silently block fusion/batching.
+
+Entry points::
+
+    report = analyze("appsrc ! tensor_converter ! tensor_sink")
+    report.ok            # no errors
+    print(report.render())
+
+    nt.Pipeline(desc, validate=True)   # raises PipelineLintError on errors
+    python -m nnstreamer_tpu.tools.lint "<pipeline>"   # CLI
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ..pipeline.graph import PipelineGraph
+from ..pipeline.parser import ParseError, parse
+from .diagnostics import (  # noqa: F401
+    Diagnostic,
+    ERROR,
+    PipelineLintError,
+    Report,
+    WARNING,
+)
+
+
+def analyze(
+    pipeline: Union[str, PipelineGraph],
+    *,
+    caps: bool = True,
+    topology: bool = True,
+    purity: bool = True,
+    queue_capacity: Optional[int] = None,
+) -> Report:
+    """Run the static passes; always returns a :class:`Report` (a syntax
+    error becomes a single ``parse-error`` diagnostic rather than an
+    exception, so tools can render every pipeline the same way)."""
+    source = pipeline if isinstance(pipeline, str) else None
+    report = Report(source)
+    if isinstance(pipeline, str):
+        try:
+            graph = parse(pipeline, validate=False)
+        except ParseError as e:
+            report.add("parse-error", ERROR, str(e), pos=e.pos)
+            return report
+    else:
+        graph = pipeline
+
+    def run(name, fn):
+        # the analyzer's contract is report-everything-never-crash: a bug
+        # in one pass must not take down the CLI or the CI gate, and must
+        # not hide the OTHER passes' findings
+        try:
+            report.extend(fn())
+        except Exception as e:  # noqa: BLE001
+            report.add("analyzer-error", ERROR,
+                       f"{name} pass crashed: {e!r} — report this bug")
+
+    if topology:
+        from .topology import check_topology
+
+        run("topology",
+            lambda: check_topology(graph, queue_capacity=queue_capacity))
+    if caps:
+        from .capsflow import propagate
+
+        run("capsflow", lambda: propagate(graph)[0])
+    if purity:
+        from .purity import lint_graph
+
+        run("purity", lambda: lint_graph(graph))
+    return report

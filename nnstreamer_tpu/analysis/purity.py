@@ -1,0 +1,322 @@
+"""jit-purity lint: AST pass over device functions and pure filter fns.
+
+A ``device_fn`` hands the planner a *pure* ``arrays -> arrays`` function to
+trace into a fused XLA program; a ``custom-easy`` model registered with
+``jax_traceable=True`` makes the same promise.  Host side effects inside
+those functions either break tracing outright (``.item()`` / ``float()`` on
+a tracer raises ConcretizationTypeError) or silently poison the program
+(``np.*`` math runs per-trace on host constants, Python RNG / ``time.*``
+bake one host value into the compiled artifact, prints fire at trace time)
+— and any of them silently disqualifies the element from fusion/batching.
+
+The pass never imports JAX and never calls the functions: it reads source
+via ``inspect``, resolves module aliases (``import numpy as np``) from the
+function's globals, and walks the AST of the *pure parts*:
+
+* for a ``device_fn`` method: every function defined INSIDE it (the
+  returned closures) — the method body itself legitimately runs host-side
+  spec math at plan time;
+* for a registered traceable callable: the whole function.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+import weakref
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, ERROR, WARNING
+
+#: code -> severity for everything this pass can emit
+CODES = {
+    "jit-host-call": ERROR,      # numpy math / open() inside a traced fn
+    "jit-host-sync": ERROR,      # .item() / float() / int() on a tracer
+    "jit-rng": ERROR,            # Python or numpy RNG (use jax.random)
+    "jit-host-time": WARNING,    # time.* baked in at trace time
+    "jit-print": WARNING,        # fires once at trace time (jax.debug.print)
+    "jit-global-mutation": ERROR,  # global/nonlocal writes from a traced fn
+    "jit-state-mutation": WARNING,  # self.* assignment inside a traced fn
+}
+
+
+def _module_aliases(namespace: Dict[str, object]) -> Dict[str, str]:
+    """Names in ``namespace`` bound to host modules we care about."""
+    out: Dict[str, str] = {}
+    for nm, val in namespace.items():
+        if not isinstance(val, types.ModuleType):
+            continue
+        mod = val.__name__
+        if mod == "numpy":
+            out[nm] = "numpy"
+        elif mod == "numpy.random":
+            out[nm] = "rng"
+        elif mod == "time":
+            out[nm] = "time"
+        elif mod == "random":
+            out[nm] = "rng"
+    return out
+
+
+def _root_and_chain(expr) -> Tuple[Optional[str], List[str]]:
+    """``np.random.default_rng`` -> ("np", ["random", "default_rng"])."""
+    chain: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        chain.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id, list(reversed(chain))
+    return None, list(reversed(chain))
+
+
+class _PureFnLinter(ast.NodeVisitor):
+    def __init__(self, aliases: Dict[str, str], where: str,
+                 base_line: int = 0):
+        self.aliases = aliases
+        self.where = where
+        self.base_line = base_line
+        #: (code, msg, line, severity-override-or-None)
+        self.found: List[Tuple[str, str, int, Optional[str]]] = []
+
+    def _hit(self, code: str, msg: str, node,
+             severity: Optional[str] = None) -> None:
+        self.found.append(
+            (code, msg, self.base_line + node.lineno, severity))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id == "print":
+                self._hit("jit-print",
+                          "print() fires at trace time, not per buffer — "
+                          "use jax.debug.print", node)
+            elif f.id == "open":
+                self._hit("jit-host-call", "file I/O inside a traced fn",
+                          node)
+            elif f.id in ("float", "int", "bool") and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                # WARNING, not error: statically we cannot tell a traced
+                # value from a plain host scalar (len(), shape math), and
+                # only the former breaks under jit
+                self._hit("jit-host-sync",
+                          f"{f.id}() forces a host sync if its argument is "
+                          "traced (ConcretizationTypeError under jit)",
+                          node, severity=WARNING)
+        elif isinstance(f, ast.Attribute):
+            if f.attr == "item":
+                self._hit("jit-host-sync",
+                          ".item() forces a blocking device->host transfer "
+                          "and breaks tracing", node)
+            root, chain = _root_and_chain(f)
+            kind = self.aliases.get(root) if root else None
+            if kind == "numpy":
+                if "random" in chain[:-1] or chain[-1].startswith("random"):
+                    self._hit("jit-rng",
+                              f"numpy RNG '{root}.{'.'.join(chain)}' is "
+                              "host-side — use jax.random", node)
+                else:
+                    self._hit("jit-host-call",
+                              f"host numpy call '{root}.{'.'.join(chain)}' "
+                              "inside a traced fn (runs per trace, blocks "
+                              "fusion) — use jax.numpy", node)
+            elif kind == "rng":
+                self._hit("jit-rng",
+                          f"host RNG '{root}.{'.'.join(chain)}' — use "
+                          "jax.random", node)
+            elif kind == "time":
+                self._hit("jit-host-time",
+                          f"'{root}.{'.'.join(chain)}' is evaluated ONCE at "
+                          "trace time and baked into the program", node)
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._hit("jit-global-mutation",
+                  f"global {', '.join(node.names)} mutated from a traced fn",
+                  node)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self._hit("jit-global-mutation",
+                  f"nonlocal {', '.join(node.names)} mutated from a traced "
+                  "fn", node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute):
+                root, chain = _root_and_chain(tgt)
+                if root == "self":
+                    self._hit("jit-state-mutation",
+                              f"assignment to self.{'.'.join(chain)} inside "
+                              "a traced fn runs at trace time only", node)
+        self.generic_visit(node)
+
+
+def _lint_fn_node(fn_node, aliases: Dict[str, str], where: str,
+                  base_line: int) -> List[Tuple[str, str, int]]:
+    linter = _PureFnLinter(aliases, where, base_line)
+    body = fn_node.body if not isinstance(fn_node, ast.Lambda) \
+        else [ast.Expr(fn_node.body)]
+    for stmt in body:
+        linter.visit(stmt)
+    return linter.found
+
+
+def _dedupe(found: Iterable[Tuple[str, str, int, Optional[str]]],
+            where: str, pos: Optional[int] = None) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    seen: Set[Tuple[str, str, int]] = set()
+    for code, msg, line, severity in found:
+        key = (code, msg, line)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Diagnostic(code, severity or CODES[code],
+                              f"{msg} [line {line}]", path=where, pos=pos))
+    return out
+
+
+def _source_tree(obj) -> Optional[Tuple[ast.AST, int]]:
+    """(parsed AST, 1-based first line) of ``obj``'s source, or None when
+    source is unavailable/unparseable (builtins, REPL lambdas, ...)."""
+    try:
+        src, line = inspect.getsourcelines(obj)
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(textwrap.dedent("".join(src)))
+    except SyntaxError:
+        return None
+    return tree, line - 1
+
+
+#: source parsing + AST walk results per function/class — Pipeline
+#: construction with validate=True must not re-read files every time.
+#: Weak keys: unregistered test callables don't pin their modules alive.
+_fn_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_cls_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _callable_findings(fn) -> Tuple:
+    try:
+        return _fn_cache[fn]
+    except (KeyError, TypeError):
+        pass
+    got = _source_tree(fn)
+    found: Tuple = ()
+    if got is not None:
+        tree, base = got
+        aliases = _module_aliases(getattr(fn, "__globals__", {}) or {})
+        fns = [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda))]
+        if fns:
+            found = tuple(_lint_fn_node(fns[0], aliases, "", base))
+    try:
+        _fn_cache[fn] = found
+    except TypeError:
+        pass
+    return found
+
+
+def lint_callable(fn, where: str, *, pos: Optional[int] = None
+                  ) -> List[Diagnostic]:
+    """Lint a function that promises to be jit-traceable (its WHOLE body
+    is the pure part) — e.g. a custom-easy model with jax_traceable=True."""
+    return _dedupe(_callable_findings(fn), where, pos)
+
+
+def _device_fn_findings(cls) -> Tuple:
+    try:
+        return _cls_cache[cls]
+    except (KeyError, TypeError):
+        pass
+    found: Tuple = ()
+    fn = cls.__dict__.get("device_fn")
+    got = _source_tree(fn) if fn is not None else None
+    if got is not None:
+        tree, base = got
+        mod = inspect.getmodule(cls)
+        aliases = _module_aliases(vars(mod) if mod else {})
+        outer = next((n for n in ast.walk(tree)
+                      if isinstance(n, ast.FunctionDef)
+                      and n.name == "device_fn"), None)
+        if outer is not None:
+            acc: List = []
+            for n in ast.walk(outer):
+                if n is outer:
+                    continue
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    acc.extend(_lint_fn_node(n, aliases, "", base))
+            found = tuple(acc)
+    try:
+        _cls_cache[cls] = found
+    except TypeError:
+        pass
+    return found
+
+
+def lint_device_fn(cls, where: Optional[str] = None, *,
+                   pos: Optional[int] = None) -> List[Diagnostic]:
+    """Lint the pure closures a class's own ``device_fn`` builds.
+
+    Only functions *defined inside* device_fn are checked: the method body
+    itself runs host-side at plan time (spec math, prop parsing) and is
+    allowed to use numpy.
+    """
+    where = where or f"{cls.__module__}.{cls.__name__}.device_fn"
+    return _dedupe(_device_fn_findings(cls), where, pos)
+
+
+def lint_graph(graph) -> List[Diagnostic]:
+    """Purity pass over one pipeline: device_fns of every element kind in
+    the graph, decoder sub-plugins selected by ``mode=``, and custom-easy
+    models registered as jax_traceable."""
+    from ..core.registry import (
+        KIND_DECODER, KIND_ELEMENT, lookup)
+    from ..elements.base import Element
+
+    diags: List[Diagnostic] = []
+    seen: Set[object] = set()
+    for node in graph.nodes.values():
+        cls = lookup(KIND_ELEMENT, node.kind)
+        if cls is None:
+            continue
+        if cls not in seen and cls.__dict__.get("device_fn") is not None \
+                and cls.__dict__["device_fn"] is not Element.device_fn:
+            seen.add(cls)
+            diags.extend(lint_device_fn(cls, pos=node.pos))
+        if node.kind == "tensor_decoder" and node.props.get("mode"):
+            dcls = lookup(KIND_DECODER, str(node.props["mode"]))
+            if dcls is not None and dcls not in seen \
+                    and dcls.__dict__.get("device_fn") is not None:
+                seen.add(dcls)
+                diags.extend(lint_device_fn(dcls, pos=node.pos))
+        if node.kind == "tensor_filter" and \
+                str(node.props.get("framework", "")).lower() == "custom-easy":
+            from ..filters.custom_easy import _models
+
+            entry = _models.get(str(node.props.get("model")))
+            if entry is not None:
+                fn, _, _, traceable = entry
+                if traceable and fn not in seen:
+                    seen.add(fn)
+                    diags.extend(lint_callable(
+                        fn, f"custom-easy:{node.props.get('model')}",
+                        pos=node.pos))
+    return diags
+
+
+def lint_module(module) -> List[Diagnostic]:
+    """Dogfood entry point: lint every device_fn defined in ``module``
+    (element classes, decoder sub-plugins) — CI runs this over the
+    framework's own plugin modules so a host-side regression in OUR
+    shipped elements fails the gate."""
+    diags: List[Diagnostic] = []
+    for nm, obj in vars(module).items():
+        if not isinstance(obj, type) or obj.__module__ != module.__name__:
+            continue
+        if "device_fn" in obj.__dict__:
+            diags.extend(lint_device_fn(obj))
+    return diags

@@ -23,7 +23,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..core.buffer import Buffer
-from ..core.caps import Caps
+from ..core.caps import Caps, MediaType
 from ..core.registry import register_element
 from ..core.types import TensorSpec, TensorsSpec
 from .base import Element, ElementError, SRC
@@ -32,6 +32,7 @@ from .base import Element, ElementError, SRC
 @register_element("tensor_aggregator")
 class TensorAggregator(Element):
     kind = "tensor_aggregator"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

@@ -106,6 +106,30 @@ class Element:
     #: connected sink pad (mux/merge slowest-sync), "any" processes buffers
     #: as they arrive (join / single-input elements).
     sync_policy: str = "any"
+    #: static pad templates for offline analysis (``nnstreamer_tpu.analysis``):
+    #: pad name -> Caps template (or a tuple of alternative Caps, mirroring
+    #: GstCaps' list-of-structures) describing what the pad can accept or
+    #: produce BEFORE negotiation.  ``sink_%u`` / ``src_%u`` entries match
+    #: numbered request pads; a missing entry means ANY.  Class-level only —
+    #: the analyzer consults it without instantiating the element.
+    PAD_TEMPLATES: Dict[str, object] = {}
+
+    @classmethod
+    def pad_template(cls, pad: str):
+        """Resolve the template for ``pad``: exact name, then the ``%u``
+        request-pad pattern (``sink_3`` -> ``sink_%u``), then the default
+        always-pad (``sink``/``src``), then ANY."""
+        t = cls.PAD_TEMPLATES.get(pad)
+        if t is not None:
+            return t
+        base, sep, idx = pad.rpartition("_")
+        if sep and idx.isdigit():
+            t = cls.PAD_TEMPLATES.get(f"{base}_%u")
+            if t is None:
+                t = cls.PAD_TEMPLATES.get(base)
+            if t is not None:
+                return t
+        return Caps.any()
 
     def __init__(self, props: Optional[Dict[str, object]] = None, name: Optional[str] = None):
         self.props: Dict[str, object] = _TrackedProps(props or {})

@@ -19,7 +19,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.buffer import Buffer
-from ..core.caps import Caps
+from ..core.caps import Caps, MediaType
 from ..core.registry import register_element
 from ..core.types import TensorsSpec
 from .base import Element, ElementError, SRC
@@ -51,6 +51,7 @@ _OPERATORS = {
 @register_element("tensor_if")
 class TensorIf(Element):
     kind = "tensor_if"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

@@ -6,7 +6,7 @@ Reference analog: ``gsttensor_decoder.c`` (SURVEY §2.2): ``other/tensors``
 
 from __future__ import annotations
 
-from ..core.caps import Caps
+from ..core.caps import Caps, MediaType
 from ..core.registry import KIND_DECODER, get as registry_get, register_element
 from .base import Element, ElementError, SRC
 
@@ -14,6 +14,7 @@ from .base import Element, ElementError, SRC
 @register_element("tensor_decoder")
 class TensorDecoder(Element):
     kind = "tensor_decoder"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

@@ -87,6 +87,7 @@ def _load_framework(props: Dict[str, object]) -> Framework:
 @register_element("tensor_filter")
 class TensorFilter(Element):
     kind = "tensor_filter"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

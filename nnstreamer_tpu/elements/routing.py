@@ -177,6 +177,7 @@ class TensorMux(_SyncModes, Element):
 
     kind = "tensor_mux"
     sync_policy = "all"
+    PAD_TEMPLATES = {"sink_%u": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
@@ -219,6 +220,7 @@ class TensorDemux(Element):
     """
 
     kind = "tensor_demux"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
@@ -265,6 +267,7 @@ class TensorMerge(_SyncModes, Element):
 
     kind = "tensor_merge"
     sync_policy = "all"
+    PAD_TEMPLATES = {"sink_%u": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
@@ -311,6 +314,7 @@ class TensorSplit(Element):
     """
 
     kind = "tensor_split"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

@@ -166,6 +166,7 @@ def _parse_arith(option: str) -> List[_ArithOp]:
 @register_element("tensor_transform")
 class TensorTransform(TransformElement):
     kind = "tensor_transform"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.TENSORS)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

@@ -216,6 +216,7 @@ class Compositor(Element):
 
     kind = "compositor"
     sync_policy = "all"
+    PAD_TEMPLATES = {"sink_%u": Caps.new(MediaType.VIDEO)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
@@ -301,6 +302,7 @@ class VideoConvert(Element):
     """
 
     kind = "videoconvert"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.VIDEO)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
@@ -363,6 +365,7 @@ class VideoScale(Element):
     """
 
     kind = "videoscale"
+    PAD_TEMPLATES = {"sink": Caps.new(MediaType.VIDEO)}
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)

@@ -1001,6 +1001,7 @@ def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.mesh import shard_map
     from ..parallel.ring import ring_attention_local
 
     n_seq = int(mesh.shape.get("seq", 1))
@@ -1026,7 +1027,7 @@ def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
         x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
         return _lm_head(params, x, dt)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fwd, mesh=mesh,
         in_specs=(P(), P(None, "seq")),
         out_specs=P(None, "seq", None),

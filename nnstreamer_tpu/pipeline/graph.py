@@ -26,6 +26,10 @@ class Node:
     props: Dict[str, object] = dataclasses.field(default_factory=dict)
     name: Optional[str] = None  # user-assigned name (name=... property)
     caps: Optional[Caps] = None  # for capsfilter pseudo-elements
+    #: 0-based character offset of this element's token in the pipeline
+    #: string (set by the parser; None for programmatically built graphs) —
+    #: lets lint diagnostics point back at the source text.
+    pos: Optional[int] = None
 
     def __str__(self):  # pragma: no cover
         nm = f" name={self.name}" if self.name else ""
@@ -54,13 +58,26 @@ class PipelineGraph:
         self.nodes: Dict[int, Node] = {}
         self.edges: List[Edge] = []
         self.by_name: Dict[str, Node] = {}
+        #: dangling ``name.pad`` refs the parser could not resolve —
+        #: populated only by ``parse(..., validate=False)`` as
+        #: (name, pad, pos) tuples for the analyzer to report.
+        self.unresolved_refs: List[Tuple[str, str, int]] = []
+        #: node ids whose upstream link was dropped because it came from an
+        #: unresolved chain-start ref (validate=False only): the dangling
+        #: ref IS their input, so the analyzer must not also flag them as
+        #: "missing '!'" or unreachable.
+        self.phantom_fed: set = set()
+        #: node ids whose DOWNSTREAM link was dropped because its target
+        #: name never resolved (validate=False only): they did link out,
+        #: just to a bad name — no derived leaf-not-sink noise.
+        self.phantom_out: set = set()
 
     # -- construction ------------------------------------------------------
     def add(self, kind: str, props: Optional[Dict[str, object]] = None,
-            caps: Optional[Caps] = None) -> Node:
+            caps: Optional[Caps] = None, pos: Optional[int] = None) -> Node:
         props = dict(props or {})
         name = props.pop("name", None)
-        node = Node(next(self._next_id), kind, props, name, caps)
+        node = Node(next(self._next_id), kind, props, name, caps, pos)
         self.nodes[node.id] = node
         if name is not None:
             if name in self.by_name:
@@ -102,8 +119,45 @@ class PipelineGraph:
                     ready.append(e.dst)
             ready.sort()
         if len(out) != len(self.nodes):
-            raise GraphError("pipeline graph has a cycle (use tensor_repo for loops)")
+            cyc = self.find_cycle()
+            detail = ""
+            if cyc:
+                detail = " — " + " -> ".join(
+                    self.nodes[i].name or f"{self.nodes[i].kind}[{i}]"
+                    for i in cyc)
+            raise GraphError(
+                "pipeline graph has a cycle (use tensor_repo for loops)"
+                + detail)
         return out
+
+    def find_cycle(self) -> Optional[List[int]]:
+        """Node ids forming one cycle (closed: first == last), or None.
+        Used by topo_order's error message and the static analyzer's
+        topology pass (which must report, not raise)."""
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {i: WHITE for i in self.nodes}
+        stack: List[int] = []
+
+        def dfs(i: int) -> Optional[List[int]]:
+            color[i] = GREY
+            stack.append(i)
+            for e in self.out_edges(i):
+                if color[e.dst] == GREY:
+                    return stack[stack.index(e.dst):] + [e.dst]
+                if color[e.dst] == WHITE:
+                    got = dfs(e.dst)
+                    if got is not None:
+                        return got
+            stack.pop()
+            color[i] = BLACK
+            return None
+
+        for i in sorted(self.nodes):
+            if color[i] == WHITE:
+                got = dfs(i)
+                if got is not None:
+                    return got
+        return None
 
     def validate(self) -> None:
         if not self.nodes:

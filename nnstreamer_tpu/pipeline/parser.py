@@ -28,8 +28,23 @@ from .graph import GraphError, Node, PipelineGraph
 
 
 class ParseError(ValueError):
-    pass
+    """Pipeline-string syntax error.
 
+    ``pos`` is the 0-based character offset of the offending token in the
+    pipeline string (None when no single position applies), so tools — the
+    lint CLI in particular — can point a caret at the source.
+    """
+
+    def __init__(self, message: str, pos: Optional[int] = None):
+        if pos is not None:
+            message = f"{message} (at char {pos})"
+        super().__init__(message)
+        self.pos = pos
+
+
+#: stand-in for an unresolvable chain-start ref under validate=False:
+#: links from it are silently dropped (the analyzer reports the ref itself)
+_PHANTOM = object()
 
 _NAME_RE = re.compile(r"^[A-Za-z_][\w\-]*$")
 _PROP_RE = re.compile(r"^([A-Za-z_][\w\-]*)=(.*)$", re.S)
@@ -39,13 +54,17 @@ _REF_RE = re.compile(r"^([A-Za-z_][\w\-]*)\.([\w\-]*)$")
 _CAPS_RE = re.compile(r"^[a-z]+/[\w\-\.\+]+")
 
 
-def _tokenize(text: str) -> List[str]:
+def _tokenize(text: str) -> List[Tuple[str, int]]:
     """Split on whitespace and '!' outside quotes; quoted spans (single or
-    double) keep their content verbatim — including '!' and spaces."""
-    toks: List[str] = []
+    double) keep their content verbatim — including '!' and spaces.
+    Returns (token, offset) pairs, offset = 0-based char position of the
+    token's first character in ``text`` (diagnostics point there)."""
+    toks: List[Tuple[str, int]] = []
     cur: List[str] = []
+    start = 0
     quote: Optional[str] = None
-    for ch in text:
+    quote_pos = 0
+    for i, ch in enumerate(text):
         if quote is not None:
             if ch == quote:
                 quote = None
@@ -53,20 +72,26 @@ def _tokenize(text: str) -> List[str]:
                 cur.append(ch)
             continue
         if ch in "\"'":
+            if not cur:
+                start = i
             quote = ch
+            quote_pos = i
             continue
         if ch.isspace() or ch == "!":
             if cur:
-                toks.append("".join(cur))
+                toks.append(("".join(cur), start))
                 cur = []
             if ch == "!":
-                toks.append("!")
+                toks.append(("!", i))
             continue
+        if not cur:
+            start = i
         cur.append(ch)
     if quote is not None:
-        raise ParseError(f"unterminated quote in pipeline string: {text!r}")
+        raise ParseError(
+            f"unterminated quote in pipeline string: {text!r}", quote_pos)
     if cur:
-        toks.append("".join(cur))
+        toks.append(("".join(cur), start))
     return toks
 
 
@@ -87,8 +112,15 @@ def _coerce(v: str):
     return v
 
 
-def parse(text: str) -> PipelineGraph:
-    """Parse a pipeline description string into a validated PipelineGraph."""
+def parse(text: str, *, validate: bool = True) -> PipelineGraph:
+    """Parse a pipeline description string into a validated PipelineGraph.
+
+    ``validate=False`` is the static analyzer's entry point: syntax errors
+    still raise, but *semantic* problems that validation would reject —
+    dangling name refs, cycles, double-linked pads — are left in the graph
+    for the analysis passes to report ALL AT ONCE (dangling refs land in
+    ``graph.unresolved_refs`` as ``(name, pad, pos)`` tuples).
+    """
     toks = _tokenize(text)
     if not toks:
         raise ParseError("empty pipeline description")
@@ -98,19 +130,19 @@ def parse(text: str) -> PipelineGraph:
     prev: Optional[Node] = None
     prev_pad = "src"
     want_link = False  # saw '!' and waiting for the next element
-    # deferred name refs: ("name", "pad") we couldn't resolve yet
-    deferred: List[Tuple[str, str, Node, str]] = []  # (name, pad, src_node, src_pad)
+    # deferred name refs we couldn't resolve yet
+    deferred: List[Tuple[str, str, Node, str, int]] = []  # (name, pad, src_node, src_pad, pos)
 
     i = 0
     n = len(toks)
     while i < n:
-        t = toks[i]
+        t, tpos = toks[i]
 
         if t == "!":
             if prev is None:
-                raise ParseError("'!' with no element before it")
+                raise ParseError("'!' with no element before it", tpos)
             if want_link:
-                raise ParseError("two '!' in a row")
+                raise ParseError("two '!' in a row", tpos)
             want_link = True
             i += 1
             continue
@@ -122,8 +154,16 @@ def parse(text: str) -> PipelineGraph:
                 # prev ! name.pad  => link INTO named element's sink pad
                 pad = pad or "sink"
                 target = g.by_name.get(name)
-                if target is None:
-                    deferred.append((name, pad, prev, prev_pad))
+                if prev is _PHANTOM:
+                    # upstream ref already recorded; the SINK-side ref must
+                    # still be checked — a second dangling name here is its
+                    # own finding, a resolved one is phantom-fed
+                    if target is None:
+                        g.unresolved_refs.append((name, pad, tpos))
+                    else:
+                        g.phantom_fed.add(target.id)
+                elif target is None:
+                    deferred.append((name, pad, prev, prev_pad, tpos))
                 else:
                     g.link(prev, target, prev_pad, pad)
                 want_link = False
@@ -132,17 +172,33 @@ def parse(text: str) -> PipelineGraph:
                 # chain start: name.pad ! ...  => link FROM named element's src pad
                 target = g.by_name.get(name)
                 if target is None:
-                    raise ParseError(f"reference to unknown element {name!r}")
+                    if not validate:
+                        # record + parse on: the ref'd chain hangs off a
+                        # phantom source, so downstream elements still
+                        # exist for the analyzer (it reports the dangling
+                        # ref AND whatever else is wrong, in one run).
+                        g.unresolved_refs.append((name, pad or "src", tpos))
+                        prev, prev_pad = _PHANTOM, "src"
+                        i += 1
+                        continue
+                    raise ParseError(
+                        f"reference to unknown element {name!r}", tpos)
                 prev = target
                 prev_pad = pad or _next_src_pad(g, target)
             i += 1
             continue
 
         if _CAPS_RE.match(t) and "=" not in t.split(",", 1)[0]:
-            caps = parse_caps_string(t)
-            node = g.add("capsfilter", {}, caps=caps)
+            try:
+                caps = parse_caps_string(t)
+            except ValueError as e:
+                raise ParseError(str(e), tpos) from None
+            node = g.add("capsfilter", {}, caps=caps, pos=tpos)
             if want_link:
-                g.link(prev, node, prev_pad, "sink")
+                if prev is not _PHANTOM:
+                    g.link(prev, node, prev_pad, "sink")
+                else:
+                    g.phantom_fed.add(node.id)
                 want_link = False
             prev, prev_pad = node, "src"
             i += 1
@@ -153,10 +209,10 @@ def parse(text: str) -> PipelineGraph:
             props: Dict[str, object] = {}
             i += 1
             while i < n:
-                if toks[i] == "!":
+                if toks[i][0] == "!":
                     break
-                pm = _PAD_PROP_RE.match(toks[i])
-                m = pm or _PROP_RE.match(toks[i])
+                pm = _PAD_PROP_RE.match(toks[i][0])
+                m = pm or _PROP_RE.match(toks[i][0])
                 if not m:
                     break
                 key = m.group(1)
@@ -167,28 +223,39 @@ def parse(text: str) -> PipelineGraph:
                     key = f"{pad}::{prop.replace('-', '_')}"
                 props[key] = _coerce(m.group(2))
                 i += 1
-            node = g.add(kind, props)
+            try:
+                node = g.add(kind, props, pos=tpos)
+            except GraphError as e:  # duplicate element name
+                raise ParseError(str(e), tpos) from None
             if want_link:
-                g.link(prev, node, prev_pad, "sink")
+                if prev is not _PHANTOM:
+                    g.link(prev, node, prev_pad, "sink")
+                else:
+                    g.phantom_fed.add(node.id)
                 want_link = False
             elif prev is not None:
                 pass  # new chain begins
             prev, prev_pad = node, "src"
             continue
 
-        raise ParseError(f"unexpected token {t!r}")
+        raise ParseError(f"unexpected token {t!r}", tpos)
 
     if want_link:
-        raise ParseError("pipeline ends with '!'")
+        raise ParseError("pipeline ends with '!'", toks[-1][1])
 
-    for name, pad, src_node, src_pad in deferred:
+    for name, pad, src_node, src_pad, pos in deferred:
         target = g.by_name.get(name)
         if target is None:
-            raise ParseError(f"reference to unknown element {name!r}")
+            if not validate:
+                g.unresolved_refs.append((name, pad, pos))
+                g.phantom_out.add(src_node.id)
+                continue
+            raise ParseError(f"reference to unknown element {name!r}", pos)
         g.link(src_node, target, src_pad, pad)
 
     _assign_request_pads(g)
-    g.validate()
+    if validate:
+        g.validate()
     return g
 
 

@@ -351,6 +351,12 @@ class Pipeline:
     bucketed XLA dispatch (``batch_buckets`` bounds the compiled batch
     sizes, ``batch_linger_ms`` optionally waits for stragglers — see
     docs/BATCHING.md).  Defaults come from :func:`get_config`.
+
+    ``validate=True`` runs the full static analyzer (caps propagation,
+    topology/deadlock, jit-purity — see docs/ANALYSIS.md) over the parsed
+    graph before anything is instantiated and raises
+    :class:`~nnstreamer_tpu.analysis.PipelineLintError` carrying EVERY
+    error at once, instead of the runtime's one-failure-per-start loop.
     """
 
     def __init__(
@@ -362,7 +368,24 @@ class Pipeline:
         batch_max: Optional[int] = None,
         batch_buckets: Optional[List[int]] = None,
         batch_linger_ms: Optional[float] = None,
+        validate: bool = False,
     ):
+        if validate:
+            # Lint BEFORE strict validation: the analyzer reports every
+            # problem at once where parse/validate stop at the first.
+            # Strings are parsed ONCE (leniently) and the same graph flows
+            # on to graph.validate() below.
+            from ..analysis import analyze
+
+            if isinstance(graph, str):
+                source = graph
+                graph = parse_launch(graph, validate=False)
+                report = analyze(graph, queue_capacity=queue_capacity)
+                report.source = source
+                report.raise_if_errors()
+            else:
+                analyze(graph,
+                        queue_capacity=queue_capacity).raise_if_errors()
         if isinstance(graph, str):
             graph = parse_launch(graph)
         graph.validate()

@@ -1,0 +1,281 @@
+"""nns-lint CLI: verify a pipeline string without running it.
+
+    python -m nnstreamer_tpu.tools.lint "videotestsrc ! tensor_converter ! tensor_sink"
+    python -m nnstreamer_tpu.tools.lint --strict "<pipeline>"     # warnings fail too
+    python -m nnstreamer_tpu.tools.lint --dogfood                 # lint OUR device_fns
+    python -m nnstreamer_tpu.tools.lint --examples                # lint examples/ + e2e strings
+
+Exit codes: 0 clean/ok, 1 errors (or warnings with --strict), 2 usage.
+
+Reference analog: gst-launch's parse-only mode plus nnstreamer's strict
+pipeline parser — but whole-graph: every caps incompatibility, topology
+hazard, and jit-purity violation is reported in ONE run with element-path
+locations and source carets.  Runs with ``JAX_PLATFORMS=cpu`` and performs
+no device dispatch: the analyzer never executes JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _render(desc: str, report, *, verbose: bool) -> None:
+    if report.clean:
+        print(f"OK: {desc!r}")
+        return
+    print(f"LINT: {desc!r}")
+    print(report.render())
+
+
+def extract_pipeline_strings(path: str) -> Tuple[List[str], int]:
+    """Pipeline strings passed to ``Pipeline(...)`` / ``parse_launch(...)``
+    in a Python source file, resolved WITHOUT importing it (examples run
+    pipelines at import time).
+
+    f-string placeholders are resolved from module-level constant
+    assignments (``SIZE = 224``) and function-call defaults where
+    possible; calls whose first argument cannot be resolved statically are
+    counted in the second return value so callers can report coverage
+    instead of silently skipping.
+    """
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+
+    consts: Dict[str, object] = {}
+    for stmt in ast.walk(tree):  # any scope; first literal binding wins
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for tgt in stmt.targets:  # W = H = 96 has two targets
+            if isinstance(tgt, ast.Name):
+                try:
+                    consts.setdefault(tgt.id, ast.literal_eval(stmt.value))
+                except (ValueError, TypeError):
+                    pass
+            elif isinstance(tgt, ast.Tuple):
+                try:
+                    vals = ast.literal_eval(stmt.value)
+                    for t, v in zip(tgt.elts, vals):
+                        if isinstance(t, ast.Name):
+                            consts.setdefault(t.id, v)
+                except (ValueError, TypeError):
+                    pass
+
+    def resolve(node) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr):
+            parts = []
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                elif isinstance(v, ast.FormattedValue):
+                    if isinstance(v.value, ast.Name) \
+                            and v.value.id in consts:
+                        parts.append(str(consts[v.value.id]))
+                    else:
+                        return None
+                else:
+                    return None
+            return "".join(parts)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left, right = resolve(node.left), resolve(node.right)
+            if left is not None and right is not None:
+                return left + right
+        return None
+
+    found: List[str] = []
+    skipped = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name not in ("Pipeline", "parse_launch", "parse"):
+            continue
+        got = resolve(node.args[0])
+        if got is None:
+            skipped += 1
+        else:
+            found.append(got)
+    return found, skipped
+
+
+def _diag_key(prefix: str, d, desc: Optional[str] = None) -> str:
+    """Stable baseline key: file/source prefix + a short hash of the
+    pipeline string + code + element path.  The hash pins the acceptance
+    to ONE pipeline string — element labels like ``out`` repeat across the
+    many strings in one file, and a baseline entry must not swallow a new
+    defect in a different pipeline that happens to reuse a name.  No
+    message text — line numbers in messages drift with unrelated edits."""
+    import hashlib
+
+    h = ""
+    if desc is not None:
+        h = hashlib.sha1(desc.encode()).hexdigest()[:8] + ":"
+    return f"{prefix}:{h}{d.code}:{d.path}"
+
+
+def lint_files(paths: List[str], *, strict: bool, verbose: bool,
+               baseline: Optional[set] = None,
+               collected: Optional[List[str]] = None) -> int:
+    from ..analysis import analyze
+
+    rc = 0
+    total = skipped_total = accepted = 0
+    for path in paths:
+        strings, skipped = extract_pipeline_strings(path)
+        skipped_total += skipped
+        for desc in strings:
+            total += 1
+            report = analyze(desc)
+            keys = [_diag_key(os.path.basename(path), d, desc)
+                    for d in report]
+            if collected is not None:
+                collected.extend(keys)
+            fails = [
+                d for d, k in zip(report.diagnostics, keys)
+                if (d.severity == "error" or strict)
+                and (baseline is None or k not in baseline)
+            ]
+            accepted += sum(
+                1 for k in keys if baseline is not None and k in baseline)
+            if fails or verbose:
+                print(f"-- {os.path.basename(path)}")
+                _render(desc, report, verbose=verbose)
+            if fails:
+                rc = 1
+    print(f"linted {total} pipeline string(s) from {len(paths)} file(s)"
+          + (f"; {skipped_total} call(s) not statically resolvable"
+             if skipped_total else "")
+          + (f"; {accepted} baseline-accepted diagnostic(s)"
+             if accepted else ""))
+    return rc
+
+
+def dogfood(*, strict: bool, baseline: Optional[set] = None,
+            collected: Optional[List[str]] = None) -> int:
+    """Lint the framework's OWN device_fns (every built-in plugin module):
+    a host side effect sneaking into a shipped element's pure fn fails CI
+    before it silently knocks that element off the fused-XLA path."""
+    import importlib
+
+    from ..analysis.purity import lint_module
+    from ..core.registry import _BUILTIN_MODULES
+
+    diags = []
+    for modname in _BUILTIN_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        diags.extend(lint_module(mod))
+    keys = [_diag_key("dogfood", d) for d in diags]
+    if collected is not None:
+        collected.extend(keys)
+    fails = [
+        d for d, k in zip(diags, keys)
+        if (d.severity == "error" or strict)
+        and (baseline is None or k not in baseline)
+    ]
+    for d in fails:
+        print(d)
+    n_err = sum(1 for d in diags if d.severity == "error")
+    n_warn = len(diags) - n_err
+    print(f"dogfood: {len(_BUILTIN_MODULES)} modules, "
+          f"{n_err} error(s), {n_warn} warning(s), {len(fails)} new")
+    return 1 if fails else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu.tools.lint",
+        description="Statically verify pipeline strings (caps propagation, "
+                    "topology/deadlock, jit-purity) without running them.",
+    )
+    ap.add_argument("pipeline", nargs="*",
+                    help="pipeline description string(s)")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail (exit 1)")
+    ap.add_argument("--files", nargs="+", metavar="PY",
+                    help="lint every Pipeline(...) string in python files")
+    ap.add_argument("--examples", action="store_true",
+                    help="lint examples/ and tests/test_pipeline_e2e.py")
+    ap.add_argument("--dogfood", action="store_true",
+                    help="lint nnstreamer_tpu's own device_fns")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="accepted-diagnostics file: only NEW diagnostics "
+                         "fail (one key per line, '#' comments)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current diagnostics to --baseline")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print clean results")
+    args = ap.parse_args(argv)
+
+    if not args.pipeline and not args.files and not args.examples \
+            and not args.dogfood:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    baseline: Optional[set] = None
+    if args.baseline and os.path.exists(args.baseline) \
+            and not args.update_baseline:
+        with open(args.baseline) as f:
+            baseline = {
+                ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")
+            }
+    collected: List[str] = []
+
+    rc = 0
+    if args.pipeline:
+        from ..analysis import analyze
+
+        for desc in args.pipeline:
+            report = analyze(desc)
+            _render(desc, report, verbose=args.verbose)
+            if report.errors or (args.strict and report.warnings):
+                rc = 1
+
+    files = list(args.files or [])
+    if args.examples:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        ex_dir = os.path.join(repo, "examples")
+        if os.path.isdir(ex_dir):
+            files += sorted(
+                os.path.join(ex_dir, f) for f in os.listdir(ex_dir)
+                if f.endswith(".py"))
+        e2e = os.path.join(repo, "tests", "test_pipeline_e2e.py")
+        if os.path.exists(e2e):
+            files.append(e2e)
+    if files:
+        rc = max(rc, lint_files(files, strict=args.strict,
+                                verbose=args.verbose, baseline=baseline,
+                                collected=collected))
+
+    if args.dogfood:
+        rc = max(rc, dogfood(strict=args.strict, baseline=baseline,
+                             collected=collected))
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("--update-baseline needs --baseline FILE", file=sys.stderr)
+            return 2
+        with open(args.baseline, "w") as f:
+            f.write("# nns-lint accepted diagnostics "
+                    "(tools/lint.py --update-baseline)\n")
+            for k in sorted(set(collected)):
+                f.write(k + "\n")
+        print(f"baseline updated: {len(set(collected))} accepted "
+              f"diagnostic(s) -> {args.baseline}")
+        return 0
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,392 @@
+"""nns-lint static analyzer tests: golden diagnostics for bad pipelines,
+clean passes over every shipped pipeline string, and the jit-purity
+dogfood over the framework's own elements (a purity regression in a
+shipped device_fn fails HERE before it silently falls off the fused-XLA
+path)."""
+
+import os
+import time as _time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.analysis import PipelineLintError, analyze
+from nnstreamer_tpu.analysis.purity import lint_callable, lint_module
+from nnstreamer_tpu.core.caps import (
+    Caps, MediaType, explain_mismatch, intersect_template)
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.filters.custom_easy import (
+    register_custom_easy, unregister_custom_easy)
+from nnstreamer_tpu.pipeline.parser import ParseError, parse
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(report):
+    return set(report.codes())
+
+
+# ---------------------------------------------------------------------------
+# golden diagnostics: one bad pipeline per failure class
+# ---------------------------------------------------------------------------
+
+BAD_PIPELINES = [
+    # (description string, expected diagnostic code, "error" present?)
+    ("videotestsrc ! tensor_transform mode=typecast option=float32 ! "
+     "tensor_sink",
+     "caps-mismatch", True),  # raw video into a tensors-only pad
+    ("appsrc caps=other/tensors,dimensions=3:8:8:1,types=uint8 ! "
+     "tensor_filter framework=custom-easy model=missing "
+     "input=3:8:8:1 inputtype=float32 ! tensor_sink",
+     "caps-mismatch", True),  # dtype uint8 ⊄ float32 at the filter
+    ("videotestsrc width=8 height=8 ! video/x-raw,format=GRAY8 ! "
+     "tensor_converter ! tensor_sink",
+     "caps-mismatch", True),  # capsfilter: RGB upstream vs GRAY8 filter
+    ("videotestsrc name=v ! tensor_converter ! nosuch. ",
+     "dangling-pad-ref", True),
+    ("appsrc name=a ! tee name=t "
+     "t. ! tensor_mux name=m ! tensor_sink "
+     "t. ! tensor_transform mode=typecast option=float32 ! m.",
+     "tee-deadlock", True),  # queue-less diamond into slowest-sync mux
+    ("tensor_mux name=m ! tensor_transform mode=typecast option=float32 "
+     "! m.",
+     "cycle", True),
+    ("appsrc name=src ! tensor_transform mode=typecast option=float32 "
+     "tensor_sink name=out",
+     "no-input", True),  # the classic missing-'!' juxtaposition
+    ("appsrc ! tensor_transfrom mode=typecast ! tensor_sink",
+     "unknown-element", True),  # typo'd kind, with did-you-mean
+    ("appsrc caps=other/tensors,dimensions=4.4,types=float32.float32 "
+     "name=a ! tensor_demux name=d "
+     "d.src_0 ! tensor_sink name=s0 "
+     "d.src_5 ! tensor_sink name=s5",
+     "pad-arity", True),  # demux pad past the 2-tensor upstream spec
+    ("appsrc name=a ! mux.sink_0 appsrc name=b ! mux.sink_3 "
+     "tensor_mux name=mux ! tensor_sink",
+     "pad-gap", True),  # sink_0/sink_3 gap stalls slowest-sync forever
+    ("appsrc name=a caps=other/tensors,dimensions=4,types=float32 ! "
+     "mux.sink_0 "
+     "appsrc name=b caps=other/tensors,dimensions=4,types=float32 ! "
+     "mux.sink_1 "
+     "tensor_merge name=mux mode=linear option=7 ! tensor_sink",
+     "caps-incompat", True),  # merge dim 7 out of range (configure check)
+    ("videotestsrc ! videotestsrc ! tensor_sink",
+     "source-has-input", True),
+    ("appsrc ! tensor_sink name=s ! tensor_sink",
+     "sink-has-output", True),
+]
+
+
+@pytest.mark.parametrize(
+    "desc,code,is_error",
+    BAD_PIPELINES,
+    ids=[c for _, c, _ in BAD_PIPELINES])
+def test_bad_pipeline_diagnosed(desc, code, is_error):
+    report = analyze(desc)
+    assert code in codes(report), report.render()
+    if is_error:
+        assert any(d.code == code and d.severity == "error" for d in report)
+    # every diagnostic for these pipelines carries an element path or pos
+    diag = next(d for d in report if d.code == code)
+    assert diag.path or diag.pos is not None
+
+
+def test_malformed_props_are_diagnostics_not_crashes():
+    """The analyzer's contract: report, never raise."""
+    for desc in (
+        "appsrc ! tensor_filter framework=custom-easy model=m "
+        "input=garbage ! tensor_sink",
+        "appsrc caps=other/tensors,dimensions=4,types=float32 ! "
+        "tensor_filter input-combination=a,b input=4 ! tensor_sink",
+        "appsrc caps=other/tensors,dimensions=4.4,types=float32.float32 "
+        "name=a ! tensor_demux name=d tensorpick=x d.src_0 ! tensor_sink",
+    ):
+        report = analyze(desc)  # must not raise
+        assert "caps-incompat" in codes(report), report.render()
+        assert "analyzer-error" not in codes(report)
+
+
+def test_both_dangling_refs_reported_after_phantom():
+    report = analyze("badref.src ! other.sink")
+    names = {d.path for d in report if d.code == "dangling-pad-ref"}
+    assert names == {"badref.src", "other.sink"}
+
+
+def test_phantom_fed_node_not_flagged_missing_bang():
+    """'badname. ! tensor_sink' has exactly one problem — the dangling
+    ref — not a derived 'missing !' on the element it feeds."""
+    report = analyze("badname. ! tensor_sink")
+    assert "dangling-pad-ref" in codes(report)
+    assert "no-input" not in codes(report)
+    assert "unreachable" not in codes(report)
+
+
+def test_dangling_sink_ref_no_derived_leaf_warning():
+    """'appsrc ! b.sink' with unknown b: the user DID link appsrc out —
+    only the target name is wrong.  One finding, not two."""
+    report = analyze("appsrc name=a ! b.sink")
+    assert "dangling-pad-ref" in codes(report)
+    assert "leaf-not-sink" not in codes(report)
+
+
+def test_multiline_source_caret_points_at_the_right_column():
+    desc = "appsrc name=a !\n  tensor_transfrom ! tensor_sink"
+    report = analyze(desc)
+    out = report.render()
+    caret_line = None
+    lines = out.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.strip() == "^" and "tensor_transfrom" in lines[i - 1]:
+            caret_line = (lines[i - 1], ln)
+    assert caret_line is not None, out
+    src, caret = caret_line
+    assert src[caret.index("^")] == "t"  # first char of the typo'd kind
+
+
+def test_host_cast_is_warning_not_error():
+    """int()/float() on a non-constant may be plain host-scalar math —
+    the lint cannot prove a tracer is involved, so it must not block
+    validate=True startup (only .item() is certain)."""
+    diags = lint_callable(_impure_sync, "x")
+    d = next(d for d in diags if d.code == "jit-host-sync")
+    assert d.severity == "warning"
+
+
+def test_parse_error_becomes_diagnostic_with_position():
+    report = analyze("videotestsrc ! ! tensor_sink")
+    assert codes(report) == {"parse-error"}
+    d = report.diagnostics[0]
+    assert d.pos == 15
+    assert "^" in report.render()  # caret rendered into the source line
+
+
+def test_all_problems_reported_in_one_run():
+    """The analyzer's whole reason to exist: N independent mistakes, ONE
+    report — not the runtime's first-failure loop."""
+    report = analyze(
+        "videotestsrc ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_sink "  # caps mismatch (video into tensors pad)
+        "appsrc ! tensor_transfrom ! fakesink "  # unknown element
+        "ghost. ! tensor_sink name=x"  # dangling ref
+    )
+    assert {"caps-mismatch", "unknown-element",
+            "dangling-pad-ref"} <= codes(report)
+    assert len(report.errors) >= 3
+
+
+def test_dtype_mismatch_names_the_field():
+    report = analyze(
+        "appsrc caps=other/tensors,dimensions=3:8:8:1,types=uint8 ! "
+        "tensor_filter framework=custom-easy model=missing "
+        "input=3:8:8:1 inputtype=float32 ! tensor_sink")
+    msg = next(d.message for d in report if d.code == "caps-mismatch")
+    assert "uint8" in msg and "float32" in msg and "⊄" in msg
+
+
+def test_queue_on_every_branch_silences_deadlock():
+    report = analyze(
+        "appsrc name=a ! tee name=t "
+        "t. ! queue ! tensor_mux name=m ! tensor_sink "
+        "t. ! queue ! tensor_transform mode=typecast option=float32 ! m.")
+    assert "tee-deadlock" not in codes(report)
+
+
+def test_cycle_through_tensor_repo_is_legal():
+    report = analyze(
+        "appsrc name=src ! tensor_mux name=m ! tee name=t "
+        "t. ! tensor_sink name=out "
+        "t. ! queue ! tensor_reposink slot-name=loop "
+        "tensor_reposrc slot-name=loop "
+        "caps=other/tensors,dimensions=4,types=float32 ! m.")
+    assert "cycle" not in codes(report)
+
+
+# ---------------------------------------------------------------------------
+# clean passes: every pipeline string the repo ships must lint clean
+# ---------------------------------------------------------------------------
+
+def _load_baseline():
+    path = os.path.join(REPO, "tools", "lint_baseline.txt")
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        return {ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")}
+
+
+@pytest.mark.parametrize("fname", [
+    "tests/test_pipeline_e2e.py",
+    "examples",
+])
+def test_shipped_pipeline_strings_lint_clean(fname):
+    from nnstreamer_tpu.tools.lint import (
+        _diag_key, extract_pipeline_strings)
+
+    path = os.path.join(REPO, fname)
+    files = ([os.path.join(path, f) for f in sorted(os.listdir(path))
+              if f.endswith(".py")] if os.path.isdir(path) else [path])
+    baseline = _load_baseline()
+    checked = 0
+    bad = []
+    for f in files:
+        strings, _ = extract_pipeline_strings(f)
+        for desc in strings:
+            checked += 1
+            report = analyze(desc)
+            for d in report.errors:
+                if _diag_key(os.path.basename(f), d, desc) not in baseline:
+                    bad.append((desc, str(d)))
+    assert checked > 0
+    assert not bad, bad
+
+
+def test_dogfood_own_device_fns_are_pure():
+    """Every device_fn the framework ships promises the planner a pure
+    traced fn; a host side effect creeping in fails CI right here."""
+    import importlib
+
+    from nnstreamer_tpu.core.registry import _BUILTIN_MODULES
+
+    diags = []
+    for modname in _BUILTIN_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        diags.extend(lint_module(mod))
+    assert [str(d) for d in diags if d.severity == "error"] == []
+
+
+# ---------------------------------------------------------------------------
+# jit-purity pass
+# ---------------------------------------------------------------------------
+
+_COUNTER = 0
+
+
+def _impure_numpy(ins):
+    return [np.argmax(np.asarray(ins[0]))]
+
+
+def _impure_sync(ins):
+    x = ins[0]
+    return [x * float(x.sum())]
+
+
+def _impure_rng(ins):
+    noise = np.random.default_rng(0).standard_normal(ins[0].shape)
+    return [ins[0] + noise]
+
+
+def _impure_time(ins):
+    t = _time.time()
+    return [ins[0] * t]
+
+
+def _impure_global(ins):
+    global _COUNTER
+    _COUNTER += 1
+    print("invoked", _COUNTER)
+    return [ins[0]]
+
+
+@pytest.mark.parametrize("fn,code", [
+    (_impure_numpy, "jit-host-call"),
+    (_impure_sync, "jit-host-sync"),
+    (_impure_rng, "jit-rng"),
+    (_impure_time, "jit-host-time"),
+    (_impure_global, "jit-global-mutation"),
+], ids=lambda v: v if isinstance(v, str) else v.__name__)
+def test_lint_callable_flags_host_effects(fn, code):
+    diags = lint_callable(fn, fn.__name__)
+    assert code in {d.code for d in diags}, [str(d) for d in diags]
+
+
+def test_print_is_flagged_as_warning():
+    diags = lint_callable(_impure_global, "x")
+    d = next(d for d in diags if d.code == "jit-print")
+    assert d.severity == "warning"
+
+
+def test_impure_registered_filter_fn_flagged_in_pipeline():
+    register_custom_easy(
+        "lint-impure", _impure_rng,
+        in_spec=TensorsSpec.from_string("4", "float32"),
+        out_spec=TensorsSpec.from_string("4", "float32"),
+        jax_traceable=True)
+    try:
+        report = analyze(
+            "appsrc caps=other/tensors,dimensions=4,types=float32 ! "
+            "tensor_filter framework=custom-easy model=lint-impure ! "
+            "tensor_sink")
+        assert "jit-rng" in codes(report)
+        assert any("custom-easy:lint-impure" in d.path for d in report)
+    finally:
+        unregister_custom_easy("lint-impure")
+
+
+def test_pure_jnp_callable_is_clean():
+    def pure(arrays):
+        import jax.numpy as jnp
+
+        return [jnp.tanh(arrays[0])]
+
+    assert lint_callable(pure, "pure") == []
+
+
+# ---------------------------------------------------------------------------
+# parse/plan hook + parser positions
+# ---------------------------------------------------------------------------
+
+def test_pipeline_validate_hook_raises_with_all_errors():
+    desc = ("videotestsrc ! tensor_transform mode=typecast option=float32 "
+            "! tensor_sink "
+            "appsrc ! tensor_transfrom ! fakesink")
+    with pytest.raises(PipelineLintError) as ei:
+        nt.Pipeline(desc, validate=True)
+    assert len(ei.value.report.errors) >= 2
+    assert "caps-mismatch" in ei.value.report.codes()
+
+
+def test_pipeline_validate_hook_passes_clean():
+    p = nt.Pipeline(
+        "videotestsrc num-buffers=1 width=8 height=8 ! tensor_converter "
+        "! tensor_sink name=out", validate=True)
+    with p:
+        p.pull("out", timeout=10)
+        p.wait(timeout=10)
+
+
+def test_parse_error_carries_position():
+    with pytest.raises(ParseError) as ei:
+        parse("videotestsrc ! ! tensor_sink")
+    assert ei.value.pos == 15
+    assert "at char 15" in str(ei.value)
+
+
+def test_nodes_carry_source_positions():
+    g = parse("videotestsrc ! tensor_converter ! tensor_sink")
+    kinds = {n.kind: n.pos for n in g.nodes.values()}
+    assert kinds["videotestsrc"] == 0
+    assert kinds["tensor_converter"] == 15
+    assert kinds["tensor_sink"] == 34
+
+
+# ---------------------------------------------------------------------------
+# caps template helpers (core/caps.py offline surface)
+# ---------------------------------------------------------------------------
+
+def test_intersect_template_alternatives():
+    video = Caps.new(MediaType.VIDEO, format="RGB")
+    tmpl = (Caps.new(MediaType.AUDIO), Caps.new(MediaType.VIDEO))
+    assert intersect_template(video, tmpl) is not None
+    assert intersect_template(video, Caps.new(MediaType.TENSORS)) is None
+
+
+def test_explain_mismatch_spec_fields():
+    a = Caps.tensors(TensorsSpec.from_string("3:8:8:1", "uint8"))
+    b = Caps.tensors(TensorsSpec.from_string("3:8:8:1", "float32"))
+    assert explain_mismatch(a, b) == "dtype uint8 ⊄ float32"
+    c = Caps.tensors(TensorsSpec.from_string("3:16:16:1", "uint8"))
+    assert "dims" in explain_mismatch(a, c)

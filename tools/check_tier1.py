@@ -9,6 +9,12 @@ The floor lives in tools/tier1_floor.txt so a PR that silently loses
 passing tests (the batching refactor and everything after it) cannot
 merge green.  DOTS_PASSED is counted exactly the way the ROADMAP verify
 line counts it: dots in pytest's progress lines.
+
+The gate ALSO runs nns-lint (see docs/ANALYSIS.md) over every pipeline
+string in examples/ + tests/test_pipeline_e2e.py and over the framework's
+own device_fns (the jit-purity dogfood), in strict mode against
+tools/lint_baseline.txt: any diagnostic not already accepted in the
+baseline fails the gate.  ``--update`` refreshes the baseline too.
 """
 
 from __future__ import annotations
@@ -21,6 +27,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLOOR_FILE = os.path.join(REPO, "tools", "tier1_floor.txt")
+LINT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
 
 #: the ROADMAP "Tier-1 verify" pytest invocation, verbatim
 PYTEST_ARGS = [
@@ -39,14 +46,43 @@ def count_dots(text: str) -> int:
                if _DOTS_RE.match(line.strip()))
 
 
+def run_lint_gate(update: bool) -> int:
+    """nns-lint over example/e2e pipeline strings + the purity dogfood,
+    failing on any diagnostic not in the accepted baseline."""
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--examples", "--dogfood", "--strict",
+           "--baseline", LINT_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("lint gate: TIMED OUT after 300s", file=sys.stderr)
+        return 2
+    tag = "updated" if update else ("OK" if proc.returncode == 0
+                                    else "NEW DIAGNOSTICS")
+    print(f"lint gate: {tag}")
+    if proc.returncode != 0:
+        # stdout carries diagnostics, stderr carries crashes/usage errors —
+        # a CI failure must explain itself either way
+        for line in (proc.stdout + proc.stderr).strip().splitlines():
+            print(f"  {line}", file=sys.stderr)
+    return proc.returncode
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
-                    help="write the measured count as the new floor")
+                    help="write the measured count as the new floor (and "
+                         "refresh the lint baseline)")
     ap.add_argument("--timeout", type=int, default=870,
                     help="seconds before the suite is killed (ROADMAP "
                          "budget)")
     args = ap.parse_args()
+
+    lint_rc = run_lint_gate(args.update)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
@@ -67,7 +103,7 @@ def main() -> int:
         with open(FLOOR_FILE, "w") as f:
             f.write(f"{passed}\n")
         print(f"tier1: floor updated to {passed}")
-        return 0
+        return lint_rc
 
     if not os.path.exists(FLOOR_FILE):
         print(f"tier1: no floor file at {FLOOR_FILE} — run with --update "
@@ -85,7 +121,7 @@ def main() -> int:
     if passed > floor:
         print(f"tier1: floor can be raised to {passed} "
               "(python tools/check_tier1.py --update)")
-    return 0
+    return lint_rc
 
 
 if __name__ == "__main__":
