@@ -41,6 +41,35 @@ CODES = {
     "jit-state-mutation": WARNING,  # self.* assignment inside a traced fn
 }
 
+#: bare-name calls that are positively jit-legal even though they look
+#: like framework plumbing: mesh collectives and sharding annotations
+#: imported directly (``from jax.lax import psum``, ``from
+#: jax.experimental.shard_map import shard_map``).  The sharded batching
+#: device paths use these inside traced closures by design.
+_JIT_LEGAL_NAMES = frozenset({
+    "shard_map", "with_sharding_constraint", "psum", "pmean", "pmax",
+    "pmin", "all_gather", "all_to_all", "ppermute", "axis_index",
+})
+
+
+def _classify_module(mod: str) -> Optional[str]:
+    """Module name -> alias kind the linter's rules key on (None = a
+    module we have no opinion about)."""
+    if mod == "numpy":
+        return "numpy"
+    if mod == "numpy.random":
+        return "rng"
+    if mod == "time":
+        return "time"
+    if mod == "random":
+        return "rng"
+    if mod == "jax" or mod.startswith("jax."):
+        # jax/jnp/jax.lax/jax.sharding/... — positively known jit-legal,
+        # including when aliased to a suspicious name (``import jax.numpy
+        # as np`` must never hit the numpy rules).
+        return "jax"
+    return None
+
 
 def _module_aliases(namespace: Dict[str, object]) -> Dict[str, str]:
     """Names in ``namespace`` bound to host modules we care about."""
@@ -48,15 +77,9 @@ def _module_aliases(namespace: Dict[str, object]) -> Dict[str, str]:
     for nm, val in namespace.items():
         if not isinstance(val, types.ModuleType):
             continue
-        mod = val.__name__
-        if mod == "numpy":
-            out[nm] = "numpy"
-        elif mod == "numpy.random":
-            out[nm] = "rng"
-        elif mod == "time":
-            out[nm] = "time"
-        elif mod == "random":
-            out[nm] = "rng"
+        kind = _classify_module(val.__name__)
+        if kind is not None:
+            out[nm] = kind
     return out
 
 
@@ -74,11 +97,33 @@ def _root_and_chain(expr) -> Tuple[Optional[str], List[str]]:
 class _PureFnLinter(ast.NodeVisitor):
     def __init__(self, aliases: Dict[str, str], where: str,
                  base_line: int = 0):
-        self.aliases = aliases
+        # copy: function-local imports below SHADOW the module-level
+        # aliases for this fn only (``import jax.numpy as np`` inside a
+        # traced fn must beat a module-level ``import numpy as np``)
+        self.aliases = dict(aliases)
         self.where = where
         self.base_line = base_line
         #: (code, msg, line, severity-override-or-None)
         self.found: List[Tuple[str, str, int, Optional[str]]] = []
+
+    def _bind(self, name: str, mod: str) -> None:
+        kind = _classify_module(mod)
+        if kind is not None:
+            self.aliases[name] = kind
+        else:
+            self.aliases.pop(name, None)  # shadowed by an unrelated module
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            # plain ``import a.b`` binds the ROOT name; ``as`` binds the alias
+            self._bind(a.asname or a.name.split(".")[0],
+                       a.name if a.asname else a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if not node.module:
+            return
+        for a in node.names:
+            self._bind(a.asname or a.name, f"{node.module}.{a.name}")
 
     def _hit(self, code: str, msg: str, node,
              severity: Optional[str] = None) -> None:
@@ -88,7 +133,9 @@ class _PureFnLinter(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         f = node.func
         if isinstance(f, ast.Name):
-            if f.id == "print":
+            if f.id in _JIT_LEGAL_NAMES:
+                pass  # collectives/sharding annotations: jit-legal
+            elif f.id == "print":
                 self._hit("jit-print",
                           "print() fires at trace time, not per buffer — "
                           "use jax.debug.print", node)
@@ -111,7 +158,16 @@ class _PureFnLinter(ast.NodeVisitor):
                           "and breaks tracing", node)
             root, chain = _root_and_chain(f)
             kind = self.aliases.get(root) if root else None
-            if kind == "numpy":
+            if kind == "jax":
+                # Inside a traced fn, jax.* is the POINT: jnp math,
+                # ``jax.lax`` collectives (psum / all_gather / ppermute),
+                # ``shard_map`` and ``with_sharding_constraint`` are all
+                # jit-legal — the sharded batching device paths lean on
+                # them, and a false positive here would flunk the
+                # dogfood gate.  Explicit branch so no later rule can
+                # accidentally claim a jax-rooted call.
+                pass
+            elif kind == "numpy":
                 if "random" in chain[:-1] or chain[-1].startswith("random"):
                     self._hit("jit-rng",
                               f"numpy RNG '{root}.{'.'.join(chain)}' is "
@@ -197,6 +253,32 @@ _fn_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _cls_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
+def _closure_aliases(fn, aliases: Dict[str, str]) -> Dict[str, str]:
+    """Overlay closure-cell bindings onto the global alias map: a free
+    variable bound in an enclosing scope (``import jax.numpy as np`` in
+    the enclosing function) SHADOWS the module-level name, so resolve it
+    from the live cell — module identity decides, not the alias name."""
+    code = getattr(fn, "__code__", None)
+    cells = getattr(fn, "__closure__", None)
+    if code is None or not cells:
+        return aliases
+    out = dict(aliases)
+    for name, cell in zip(code.co_freevars, cells):
+        try:
+            val = cell.cell_contents
+        except ValueError:  # pragma: no cover - still-unbound cell
+            continue
+        if isinstance(val, types.ModuleType):
+            kind = _classify_module(val.__name__)
+            if kind is not None:
+                out[name] = kind
+            else:
+                out.pop(name, None)
+        else:
+            out.pop(name, None)  # free var shadows a same-named module
+    return out
+
+
 def _callable_findings(fn) -> Tuple:
     try:
         return _fn_cache[fn]
@@ -206,7 +288,8 @@ def _callable_findings(fn) -> Tuple:
     found: Tuple = ()
     if got is not None:
         tree, base = got
-        aliases = _module_aliases(getattr(fn, "__globals__", {}) or {})
+        aliases = _closure_aliases(
+            fn, _module_aliases(getattr(fn, "__globals__", {}) or {}))
         fns = [n for n in ast.walk(tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda))]

@@ -24,6 +24,8 @@ _ENV_PLUGINS = "NNS_TPU_PLUGINS"
 _ENV_FW_PRIORITY = "NNS_TPU_FILTER_PRIORITY"
 _ENV_BUCKETING = "NNS_TPU_SHAPE_BUCKETING"
 _ENV_BATCH_MAX = "NNS_TPU_BATCH_MAX"
+_ENV_DATA_PARALLEL = "NNS_TPU_DATA_PARALLEL"
+_ENV_DISPATCH_DEPTH = "NNS_TPU_DISPATCH_DEPTH"
 
 
 @dataclasses.dataclass
@@ -45,6 +47,17 @@ class Config:
     #: optional wait (ms) for more buffers once one is in hand; 0 = never
     #: trade latency for occupancy (drain only what is already queued)
     batch_linger_ms: float = 0.0
+    #: data-parallel replicas a bucketed micro-batch is sharded over (the
+    #: ``data`` mesh axis): 0 = all local devices once batch_max > 1,
+    #: 1 = single-device dispatch (the pre-mesh behavior), N = exactly N
+    #: local devices.  Only shard-eligible stages (see pipeline/plan.py)
+    #: ever see the mesh.
+    data_parallel: int = 0
+    #: in-flight dispatch window for batching device stages: how many
+    #: micro-batches a runner may have dispatched-but-not-yet-emitted, so
+    #: the next drain overlaps the previous dispatch (1 = the lockstep
+    #: drain->dispatch->emit loop)
+    dispatch_depth: int = 2
     #: pad flexible shapes up to the next bucket to bound XLA recompiles
     shape_bucketing: bool = True
     #: emit per-stage latency measurements
@@ -74,6 +87,10 @@ class Config:
             if ini.has_option("common", "batch_linger_ms"):
                 cfg.batch_linger_ms = ini.getfloat("common",
                                                    "batch_linger_ms")
+            if ini.has_option("common", "data_parallel"):
+                cfg.data_parallel = ini.getint("common", "data_parallel")
+            if ini.has_option("common", "dispatch_depth"):
+                cfg.dispatch_depth = ini.getint("common", "dispatch_depth")
             if ini.has_option("common", "shape_bucketing"):
                 cfg.shape_bucketing = ini.getboolean("common",
                                                      "shape_bucketing")
@@ -86,6 +103,10 @@ class Config:
             cfg.filter_priority = _split(os.environ[_ENV_FW_PRIORITY])
         if os.environ.get(_ENV_BATCH_MAX):
             cfg.batch_max = int(os.environ[_ENV_BATCH_MAX])
+        if os.environ.get(_ENV_DATA_PARALLEL):
+            cfg.data_parallel = int(os.environ[_ENV_DATA_PARALLEL])
+        if os.environ.get(_ENV_DISPATCH_DEPTH):
+            cfg.dispatch_depth = int(os.environ[_ENV_DISPATCH_DEPTH])
         if os.environ.get(_ENV_BUCKETING):
             cfg.shape_bucketing = os.environ[_ENV_BUCKETING].lower() in (
                 "1", "true", "yes", "on")

@@ -190,6 +190,16 @@ class Element:
         by device stages); the planner only marks such stages batchable."""
         return False
 
+    def replicate_params(self, mesh) -> bool:
+        """Place this element's device-resident parameters onto ``mesh``
+        (replicated — every chip holds a copy) so sharded micro-batch
+        dispatches never re-broadcast weights per call.  Called at most
+        ONCE per stage, from the stage thread, before the first sharded
+        dispatch.  Returns True when anything was moved.  Default: no
+        parameters (closure constants are baked into the compiled program
+        and replicated by XLA at compile time)."""
+        return False
+
     def process_group(self, bufs: Dict[str, Buffer]) -> Out:
         """Handle one collated buffer-per-pad group (sync_policy == "all")."""
         raise NotImplementedError

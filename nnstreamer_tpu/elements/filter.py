@@ -301,6 +301,25 @@ class TensorFilter(Element):
         except Exception:  # noqa: BLE001 - capability probe only
             return False
 
+    def replicate_params(self, mesh) -> bool:
+        """Replicate the framework's model params onto ``mesh`` once (the
+        sharded-dispatch prepare contract, elements/base.py).  Deliberately
+        lock-free: callers either run on the stage thread that serializes
+        with process()/process_batch() (the fused-chain path) or already
+        hold ``_fw_lock`` (the prepare hook below)."""
+        return self._replicate_fw_params(self.fw or self._ensure_fw(), mesh)
+
+    def _replicate_fw_params(self, fw, mesh) -> bool:
+        bundle = getattr(fw, "bundle", None)
+        params = getattr(bundle, "params", None)
+        if params is None:
+            return False
+        from ..parallel.sharding import replicate
+
+        bundle.params = replicate(mesh, params)
+        metrics.count(f"{self.name}.param_replications")
+        return True
+
     def process_batch(self, pad: str, bufs):
         """N same-spec buffers -> ONE bucketed vmapped model dispatch.
 
@@ -324,9 +343,21 @@ class TensorFilter(Element):
                 if entry is None:
                     from ..pipeline.batching import BatchRunner
 
+                    mesh = getattr(self, "_shard_mesh", None)
+                    prep = None
+                    if mesh is not None:
+                        # Replicate THIS framework's params once, then hand
+                        # the runner a fresh closure capturing the
+                        # replicated tree.  fw is bound here: a reload mid-
+                        # stream swaps the instance AND the batcher entry,
+                        # so the new framework replicates again (its params
+                        # are new arrays).
+                        def prep(m, fw=fw):
+                            self._replicate_fw_params(fw, m)
+                            return self._batchable_fn(fw)
                     entry = (fw, BatchRunner(
                         fn, getattr(self, "_batch_buckets", None),
-                        name=self.name))
+                        name=self.name, mesh=mesh, prepare=prep))
                     self._batchers = {id(fw): entry}  # drop stale programs
                 rows = entry[1].run(
                     [tuple(self._select_inputs(b.tensors)) for b in bufs])

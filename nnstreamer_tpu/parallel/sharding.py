@@ -30,6 +30,17 @@ def batch_sharding(mesh, ndim: int, axis: str = "data"):
     return _ns(mesh, P(axis, *([None] * (ndim - 1))))
 
 
+def data_sharding(mesh, axis: str = "data"):
+    """Rank-agnostic batch-dim sharding: ``P(axis)`` splits dim 0 and
+    leaves every trailing dim unspecified (= replicated), so ONE sharding
+    serves any mix of tensor ranks — the form jit's
+    ``in_shardings``/``out_shardings`` broadcast over a whole arg/out
+    pytree (the sharded BatchRunner's contract, pipeline/batching.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    return _ns(mesh, P(axis))
+
+
 def shard_batch(mesh, x, axis: str = "data"):
     """Device_put a host batch split over the data axis (zero-copy per shard)."""
     import jax

@@ -43,6 +43,13 @@ class Stage:
     #: into one bucketed XLA dispatch (set by the planner; the runtime
     #: additionally requires the pipeline's batch_max > 1)
     batchable: bool = False
+    #: batchable stage whose bucketed dispatch may additionally be
+    #: SHARDED over the ``data`` axis of a local device mesh: requires a
+    #: static negotiated input spec (one sharded program, not one per
+    #: signature) and no deferred host_post mapping (its async D2H
+    #: ordering is tuned for single-device rows).  The runtime
+    #: additionally requires ``data_parallel`` to resolve to > 1.
+    shardable: bool = False
 
     def external_out_pad(self, edge: Edge) -> str:
         return edge.src_pad
@@ -64,6 +71,7 @@ class FusedElement(Element):
         self._batcher = None
         self._out_spec: Optional[TensorsSpec] = None
         self._in_spec = specs[0]
+        self._specs = list(specs)
         # Tail element may pair its device_fn with a deferred host mapping
         # (e.g. image_labeling: device argmax -> host label text).  The fused
         # stage emits the tiny device outputs with an async D2H already in
@@ -144,9 +152,17 @@ class FusedElement(Element):
         return new
 
     def process(self, pad: str, buf: Buffer):
-        import jax.numpy as jnp
+        # Fused-chain-to-fused-chain hop (the common case): the upstream
+        # stage's outputs are ALREADY device arrays, and jit re-wraps its
+        # own argument types for free — per-tensor jnp.asarray here only
+        # added a host round through the dispatch path (~1.6x the whole
+        # call overhead for a 4-tensor buffer, see PR microbench note).
+        if buf.on_device:
+            arrays = tuple(buf.tensors)
+        else:
+            import jax.numpy as jnp
 
-        arrays = tuple(jnp.asarray(t) for t in buf.tensors)
+            arrays = tuple(jnp.asarray(t) for t in buf.tensors)
         out = self._jitted()(arrays)
         return [(SRC, self._finish(buf, out))]
 
@@ -154,16 +170,39 @@ class FusedElement(Element):
     def batch_capable(self) -> bool:
         return True
 
+    def replicate_params(self, mesh) -> bool:
+        """Replicate every chain element's params onto ``mesh``, then
+        rebuild the composed function so its device_fn closures capture
+        the replicated trees (a stale closure would keep dragging the
+        original single-device arrays into every sharded dispatch)."""
+        moved = False
+        for el in self.chain:
+            moved = el.replicate_params(mesh) or moved
+        if moved:
+            self._fn = None  # re-jit from the recaptured closures
+            self._build(self._specs[0], self._donate)
+        return moved
+
+    def _shard_prepare(self, mesh):
+        """BatchRunner prepare hook: replicate once, hand back the
+        rebuilt composed fn."""
+        self.replicate_params(mesh)
+        return self._composed
+
     def process_batch(self, pad: str, bufs):
         """N same-spec buffers -> ONE bucketed vmapped dispatch of the
         fused program (see pipeline/batching.py); per-buffer outputs keep
-        their own pts/meta and order."""
+        their own pts/meta and order.  With a ``data`` mesh attached by
+        the runtime (``_shard_mesh``), the bucketed batch dim is sharded
+        across the mesh's chips."""
         from .batching import BatchRunner
 
         if self._batcher is None:
+            mesh = getattr(self, "_shard_mesh", None)
             self._batcher = BatchRunner(
                 self._composed, getattr(self, "_batch_buckets", None),
-                name=self.name)
+                name=self.name, mesh=mesh,
+                prepare=self._shard_prepare if mesh is not None else None)
         rows = self._batcher.run([tuple(b.tensors) for b in bufs])
         return [(SRC, self._finish(buf, row)) for buf, row in zip(bufs, rows)]
 
@@ -237,17 +276,31 @@ def _element_batchable(el: Element) -> bool:
         return False
 
 
+def _element_shardable(el: Element, batchable: bool) -> bool:
+    """Shard-eligibility for a SINGLE-element stage: batchable, a STATIC
+    negotiated input spec (a flexible stream re-specializes per buffer
+    signature — sharding would compile a mesh program per signature and
+    defeat the bucket ladder), and no deferred host_post mapping."""
+    if not batchable or getattr(el, "host_post", None) is not None:
+        return False
+    caps = el.in_caps.get(SINK)
+    spec = caps.spec if caps is not None else None
+    return spec is not None and spec.format.value == "static"
+
+
 def plan_stages(
     graph: PipelineGraph, elements: Dict[int, Element], *, fuse: bool = True
 ) -> List[Stage]:
     """Partition the graph into stages; fuse linear device chains."""
     order = graph.topo_order()
     if not fuse:
-        return [
-            Stage(elements[n.id], [n.id], n.id, n.id,
-                  batchable=_element_batchable(elements[n.id]))
-            for n in order
-        ]
+        stages = []
+        for n in order:
+            b = _element_batchable(elements[n.id])
+            stages.append(Stage(
+                elements[n.id], [n.id], n.id, n.id, batchable=b,
+                shardable=_element_shardable(elements[n.id], b)))
+        return stages
 
     def linear(nid: int) -> bool:
         ins = graph.in_edges(nid)
@@ -333,13 +386,18 @@ def plan_stages(
                     continue
         grown = grow(node.id)
         if grown is None or len(grown[0]) == 1:
-            stages.append(Stage(elements[node.id], [node.id], node.id, node.id,
-                                batchable=_element_batchable(elements[node.id])))
+            b = _element_batchable(elements[node.id])
+            stages.append(Stage(
+                elements[node.id], [node.id], node.id, node.id, batchable=b,
+                shardable=_element_shardable(elements[node.id], b)))
             consumed.add(node.id)
             continue
         chain, specs = grown
         fe = FusedElement([elements[i] for i in chain], specs)
         log.info("fused %d elements into one XLA stage: %s", len(chain), fe.name)
-        stages.append(Stage(fe, chain, chain[0], chain[-1], batchable=True))
+        # Fused chains negotiated a static spec by construction (fusable()
+        # requires it); only a deferred host_post gates sharding.
+        stages.append(Stage(fe, chain, chain[0], chain[-1], batchable=True,
+                            shardable=fe._host_post is None))
         consumed.update(chain)
     return stages

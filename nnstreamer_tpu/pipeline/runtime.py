@@ -52,64 +52,76 @@ class _StageQueue:
     """Bounded stage input queue with stop-aware blocking.
 
     Replaces the seed's ``queue.Queue`` + 0.1 s timeout polling: putters
-    and getters block on a condition variable, and :meth:`close` (called by
+    and getters block on condition variables, and :meth:`close` (called by
     ``Pipeline.stop()``) wakes every waiter at once — shutdown latency
     drops from worst-case ~100 ms per hop to ~0, and idle stages burn no
     CPU.  ``close`` also appends a ``(None, _POISON)`` item past the
     capacity bound so a getter that arrives later still returns
-    immediately."""
+    immediately.
+
+    TWO condition variables over one lock (queue.Queue's design), not one
+    shared cv: a single cv needs ``notify_all`` on every put/get to be
+    lost-wakeup-safe (a ``notify`` intended for a getter can land on a
+    blocked putter, who re-waits without passing it on) — and that wakes
+    every blocked producer per buffer, N-1 of which immediately re-block.
+    With ``_not_empty``/``_not_full`` each put/get wakes exactly the ONE
+    waiter that can make progress; ``notify_all`` survives only in
+    :meth:`close`, where waking everyone is the point."""
 
     def __init__(self, capacity: int):
         self._dq: Deque = collections.deque()
         self._cap = max(1, capacity)
-        self._cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
         self._closed = False
 
     def put(self, item) -> bool:
         """Block until space (backpressure); False = pipeline stopping and
         the item was shed."""
-        with self._cv:
+        with self._lock:
             while len(self._dq) >= self._cap:
                 if self._closed:
                     return False
-                self._cv.wait()
+                self._not_full.wait()
             if self._closed:
                 return False
             self._dq.append(item)
-            self._cv.notify_all()
+            self._not_empty.notify()
             return True
 
     def get(self, timeout: Optional[float] = None):
         """Block until an item arrives; ``(None, _POISON)`` once closed and
         drained; None on timeout (used by the batch linger wait)."""
-        with self._cv:
+        with self._lock:
             while not self._dq:
                 if self._closed:
                     return (None, _POISON)
-                if not self._cv.wait(timeout=timeout):
+                if not self._not_empty.wait(timeout=timeout):
                     return None
             item = self._dq.popleft()
-            self._cv.notify_all()
+            self._not_full.notify()
             return item
 
     def get_nowait(self):
         """Non-blocking get; None when empty (the opportunistic drain)."""
-        with self._cv:
+        with self._lock:
             if not self._dq:
                 return None
             item = self._dq.popleft()
-            self._cv.notify_all()
+            self._not_full.notify()
             return item
 
     def close(self) -> None:
-        with self._cv:
+        with self._lock:
             if not self._closed:
                 self._closed = True
                 self._dq.append((None, _POISON))
-            self._cv.notify_all()
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     def qsize(self) -> int:
-        with self._cv:
+        with self._lock:
             return len(self._dq)
 
 
@@ -155,6 +167,13 @@ class _Runner:
             # elements build their BatchRunner lazily; hand them the
             # pipeline's bucket ladder the same way _async_emit is attached
             self.element._batch_buckets = pipeline.batch_buckets
+        # In-flight dispatch window: a batching device stage may hold this
+        # many dispatched-but-unemitted micro-batches, so the next drain
+        # overlaps the previous (async) dispatch instead of waiting behind
+        # the downstream feed.  Emission order is the FIFO deque's.
+        self.dispatch_depth = (max(1, pipeline.dispatch_depth)
+                               if self.batch_max > 1 else 1)
+        self._inflight: Deque[Tuple[list, int]] = collections.deque()
         # Hot-path metric names built ONCE (the seed built f-strings per
         # buffer in _run_stream/_emit).
         name = self.element.name
@@ -209,6 +228,15 @@ class _Runner:
         except Exception as e:  # noqa: BLE001 - must not kill the process
             log.exception("stage %s failed", el.name)
             self.pipeline._record_error(el.name, e)
+            try:
+                # Batches dispatched BEFORE the failing one completed
+                # fine and are still held in the in-flight window —
+                # deliver them (downstream queues are open on this path)
+                # before the error/EOS, exactly what dispatch_depth=1
+                # would have done.
+                self._flush_inflight()
+            except Exception:  # noqa: BLE001 - error path must broadcast
+                log.exception("in-flight flush failed for %s", el.name)
             self._broadcast(Event.error(e))
             self._broadcast(Event.eos())
 
@@ -254,20 +282,48 @@ class _Runner:
             batch.append(nitem)
         return batch, None
 
+    def _emit_oldest_inflight(self) -> None:
+        outs, n = self._inflight.popleft()
+        self._emit(outs)
+        metrics.count(self._m_out, n)
+
+    def _flush_inflight(self) -> None:
+        while self._inflight:
+            self._emit_oldest_inflight()
+
     def _run_stream(self) -> None:
         el = self.element
         all_policy = el.sync_policy == "all" and len(self.in_pads) > 1
         batching = self.batch_max > 1 and not all_policy
+        depth = self.dispatch_depth if batching else 1
         carry = None
         while True:
             if carry is not None:
                 pad, item = carry
                 carry = None
             else:
-                pad, item = self.queue.get()
+                nxt = None
+                if self._inflight:
+                    # Dispatch window open: only keep batches in flight
+                    # while more work is ALREADY queued — before blocking,
+                    # emit everything held, or idle streams would pay the
+                    # window as pure latency.
+                    nxt = self.queue.get_nowait()
+                    if nxt is None:
+                        self._flush_inflight()
+                if nxt is None:
+                    nxt = self.queue.get()
+                pad, item = nxt
             if item is _POISON:
+                # stop(): downstream queues are already closed, so the
+                # flush sheds — but a future clean-shutdown path stays
+                # correct if close semantics ever change.
+                self._flush_inflight()
                 return
             if isinstance(item, Event):
+                # Events are ordering fences: everything dispatched before
+                # the event arrived must be emitted before it is handled.
+                self._flush_inflight()
                 if item.kind == "eos":
                     self._eos_pads.add(pad)
                     if all_policy:
@@ -299,9 +355,20 @@ class _Runner:
                 # meaning whether batching is on or off (same rule the
                 # filter applies to its .invoke series)
                 metrics.observe(self._m_proc, (time.perf_counter() - t0) / n)
-                self._emit(outs)
-                metrics.count(self._m_out, n)
+                if depth > 1:
+                    # Software pipeline: XLA dispatch is async, so the
+                    # runner loops back to drain the NEXT micro-batch
+                    # while this one executes; emission (which may block
+                    # on a full downstream queue) is deferred FIFO until
+                    # the window fills.
+                    self._inflight.append((outs, n))
+                    while len(self._inflight) >= depth:
+                        self._emit_oldest_inflight()
+                else:
+                    self._emit(outs)
+                    metrics.count(self._m_out, n)
                 if carry is not None and carry[1] is _POISON:
+                    self._flush_inflight()
                     return
                 continue
             metrics.count(self._m_in)
@@ -350,7 +417,14 @@ class Pipeline:
     stages drain up to that many already-queued same-spec buffers into ONE
     bucketed XLA dispatch (``batch_buckets`` bounds the compiled batch
     sizes, ``batch_linger_ms`` optionally waits for stragglers — see
-    docs/BATCHING.md).  Defaults come from :func:`get_config`.
+    docs/BATCHING.md).  ``data_parallel`` shards those bucketed dispatches
+    over the ``data`` axis of a local device mesh (0 = every local device,
+    1 = single-device dispatch, N = exactly N chips; the mesh is built
+    lazily at :meth:`start`, off the streaming threads, and only
+    shard-eligible stages see it), and ``dispatch_depth`` opens an
+    in-flight window so a runner drains the next micro-batch while the
+    previous one is still executing — see BATCHING.md "Sharded dispatch".
+    Defaults come from :func:`get_config`.
 
     ``validate=True`` runs the full static analyzer (caps propagation,
     topology/deadlock, jit-purity — see docs/ANALYSIS.md) over the parsed
@@ -368,6 +442,8 @@ class Pipeline:
         batch_max: Optional[int] = None,
         batch_buckets: Optional[List[int]] = None,
         batch_linger_ms: Optional[float] = None,
+        data_parallel: Optional[int] = None,
+        dispatch_depth: Optional[int] = None,
         validate: bool = False,
     ):
         if validate:
@@ -405,6 +481,12 @@ class Pipeline:
         self.batch_linger_ms = float(
             batch_linger_ms if batch_linger_ms is not None
             else cfg.batch_linger_ms)
+        self.data_parallel = max(0, int(
+            data_parallel if data_parallel is not None
+            else cfg.data_parallel))
+        self.dispatch_depth = max(1, int(
+            dispatch_depth if dispatch_depth is not None
+            else cfg.dispatch_depth))
         self._stopping = threading.Event()
         self._errors: List[Tuple[str, BaseException]] = []
         self._err_lock = threading.Lock()
@@ -510,9 +592,50 @@ class Pipeline:
             self._dead = True  # elements stopped: this instance is done
             raise PipelineError(
                 f"unknown element properties (typo?): {unknown}")
+        try:
+            mesh = self._build_data_mesh()
+        except Exception:
+            # Same contract as the unknown-props failure above: elements
+            # already started, so a half-started pipeline must be torn
+            # down NOW (serve threads, sockets, opened models) — and a
+            # retried start() must not silently return a dead instance.
+            self.stop()
+            self._dead = True
+            raise
+        if mesh is not None:
+            # Attached to the ELEMENT the same way _batch_buckets is: the
+            # element's lazy BatchRunner reads it at first batched
+            # dispatch.  Only shard-eligible stages ever see it.
+            for r in {id(r): r for r in self._runners.values()}.values():
+                if r.stage.shardable and r.batch_max > 1:
+                    r.element._shard_mesh = mesh
         for r in {id(r): r for r in self._runners.values()}.values():
             r.thread.start()
         return self
+
+    def _build_data_mesh(self):
+        """Resolve ``data_parallel`` to a ``data``-axis mesh, or None for
+        single-device dispatch.  Built HERE — on the app thread driving
+        start(), never a streaming thread — and lazily: a pipeline with
+        no shard-eligible stage (or batch_max=1, or data_parallel=1)
+        never touches the device backend for this feature."""
+        if self.batch_max <= 1 or self.data_parallel == 1:
+            return None
+        if not any(s.shardable for s in self.stages):
+            return None
+        import jax
+
+        devs = jax.devices()
+        dp = self.data_parallel or len(devs)
+        if dp > len(devs):
+            raise PipelineError(
+                f"data_parallel={dp} needs {dp} local devices, "
+                f"have {len(devs)}")
+        if dp <= 1:
+            return None
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh(data=dp, devices=devs[:dp])
 
     def stop(self) -> None:
         self._stopping.set()

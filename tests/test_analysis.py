@@ -335,6 +335,39 @@ def test_pure_jnp_callable_is_clean():
     assert lint_callable(pure, "pure") == []
 
 
+def test_jax_collectives_and_sharding_are_jit_legal():
+    """jax.lax collectives and shard_map/with_sharding_constraint inside a
+    traced fn are the sharded hot path's vocabulary — never diagnostics
+    (ISSUE 3 satellite: no false positives from the sharded code paths)."""
+    import jax
+
+    def sharded(arrays):
+        x = jax.lax.with_sharding_constraint(arrays[0], None)
+        s = jax.lax.psum(x, axis_name="data")
+        g = jax.lax.all_gather(s, axis_name="data")
+        return [g]
+
+    assert lint_callable(sharded, "sharded") == []
+
+    from jax.lax import psum  # noqa: F401 - exercises the bare-name path
+
+    def bare(arrays):
+        return [psum(arrays[0], axis_name="data")]
+
+    assert lint_callable(bare, "bare") == []
+
+
+def test_jax_numpy_aliased_to_np_is_not_flagged():
+    """``import jax.numpy as np`` must hit the jax allowlist, not the
+    host-numpy rules — module identity decides, not the alias name."""
+    import jax.numpy as np
+
+    def pure(arrays):
+        return [np.sqrt(np.abs(arrays[0]))]
+
+    assert lint_callable(pure, "pure") == []
+
+
 # ---------------------------------------------------------------------------
 # parse/plan hook + parser positions
 # ---------------------------------------------------------------------------

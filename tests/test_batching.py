@@ -271,3 +271,65 @@ class TestStopLatency:
         frames = _frames(5)
         outs = _run(DESC, frames, batch_max=8)
         assert len(outs) == 5
+
+
+# -- _StageQueue (satellite: single-notify, no thundering herd) ------------
+
+class TestStageQueueStress:
+    def test_many_producers_bounded_queue_no_lost_wakeups(self):
+        """8 producers x 200 items through a 3-deep queue, one consumer:
+        with per-item notify() (not notify_all) every item must still
+        arrive — a lost wakeup deadlocks this test inside its timeout."""
+        import threading
+
+        from nnstreamer_tpu.pipeline.runtime import _POISON, _StageQueue
+
+        q = _StageQueue(3)
+        n_prod, per = 8, 200
+        sent = []
+
+        def producer(k):
+            for i in range(per):
+                assert q.put(("pad", (k, i)))
+                sent.append(None)
+
+        threads = [threading.Thread(target=producer, args=(k,), daemon=True)
+                   for k in range(n_prod)]
+        got = []
+        for t in threads:
+            t.start()
+        while len(got) < n_prod * per:
+            item = q.get(timeout=20.0)
+            assert item is not None, (
+                f"consumer starved after {len(got)} items (lost wakeup)")
+            got.append(item[1])
+        for t in threads:
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "producer stuck (lost wakeup)"
+        # per-producer FIFO survives the interleaving
+        by_prod = {}
+        for k, i in got:
+            assert by_prod.get(k, -1) == i - 1
+            by_prod[k] = i
+
+    def test_close_wakes_every_blocked_producer(self):
+        import threading
+
+        from nnstreamer_tpu.pipeline.runtime import _StageQueue
+
+        q = _StageQueue(1)
+        assert q.put(("pad", 0))
+        results = []
+        threads = [
+            threading.Thread(target=lambda: results.append(
+                q.put(("pad", 1))), daemon=True)
+            for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # all six blocked on the full queue
+        q.close()
+        for t in threads:
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+        assert results == [False] * 6  # all shed, none stuck

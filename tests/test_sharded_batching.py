@@ -1,0 +1,327 @@
+"""Mesh-sharded micro-batching semantics (ISSUE 3 tentpole).
+
+The contract: with ``data_parallel`` resolving to N > 1, a shard-eligible
+device stage's bucketed micro-batch is sharded over the ``data`` axis of a
+local N-chip mesh — while every observable semantic (row values, strict
+ordering, pts/meta, uneven tails, EOS flush) stays identical to the
+single-device BatchRunner path, and ``data_parallel=1`` IS that path.
+
+Runs on the suite's virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``, set by conftest.py before
+jax initializes).  ``tools/check_tier1.py`` additionally runs this file as
+its own pytest process so the flag can never arrive too late.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.pipeline.batching import (BatchRunner, bucket_for,
+                                              shard_bucket_for)
+
+DESC = (
+    "appsrc name=src caps=other/tensors,dimensions=16,types=float32 ! "
+    "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:16 "
+    "name=f ! tensor_sink name=out"
+)
+
+
+def _mesh(n):
+    import jax
+
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} local devices")
+    return make_mesh(data=n, devices=jax.devices()[:n])
+
+
+def _frames(n, dims=(16,)):
+    return [np.full(dims, float(i), np.float32) for i in range(n)]
+
+
+def _run(desc, frames, timeout=60, **kw):
+    p = nt.Pipeline(desc, **kw)
+    outs = []
+    with p:
+        for i, x in enumerate(frames):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in frames:
+            outs.append(p.pull("out", timeout=timeout))
+        p.eos()
+        p.wait(timeout=timeout)
+    return outs
+
+
+def _assert_rows_bitwise(got, want):
+    assert len(got) == len(want)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert a.pts == b.pts
+        for x, y in zip(a.tensors, b.tensors):
+            assert bytes(np.asarray(x)) == bytes(np.asarray(y)), f"row {i}"
+
+
+# -- primitives ------------------------------------------------------------
+
+def test_shard_bucket_rounds_to_replica_multiple():
+    assert shard_bucket_for(1, 4) == 4       # ladder 1, rounded to 4
+    assert shard_bucket_for(3, 4) == 4       # ladder 4, already aligned
+    assert shard_bucket_for(5, 4) == 8
+    assert shard_bucket_for(5, 3) == 9       # ladder 8 -> next multiple of 3
+    assert shard_bucket_for(8, 8) == 8
+    assert shard_bucket_for(9, 8) == 16
+    assert shard_bucket_for(7, 1) == bucket_for(7)  # 1 replica = plain ladder
+    assert shard_bucket_for(5, 4, [2, 6]) == 8      # custom ladder 6 -> 8
+
+
+def test_rows_bit_identical_every_occupancy(rng):
+    """Every occupancy of the bucket (1..9, crossing a bucket boundary):
+    sharded rows are byte-equal to the single-device BatchRunner's."""
+    import jax.numpy as jnp
+
+    fn = lambda arrays: (jnp.tanh(arrays[0] * 1.5 + 0.25),)  # noqa: E731
+    single = BatchRunner(fn)
+    sharded = BatchRunner(fn, mesh=_mesh(8))
+    assert sharded.replicas == 8
+    for n in range(1, 10):
+        rows = [(rng.standard_normal((24,)).astype(np.float32),)
+                for _ in range(n)]
+        a = single.run(list(rows))
+        b = sharded.run(list(rows))
+        assert len(a) == len(b) == n
+        for (x,), (y,) in zip(a, b):
+            assert bytes(np.asarray(x)) == bytes(np.asarray(y)), f"n={n}"
+
+
+def test_batch_runner_mesh_with_unit_data_axis_is_unsharded():
+    """A 1-wide data axis must select the exact single-device code path."""
+    br = BatchRunner(lambda arrays: (arrays[0] * 2.0,), mesh=_mesh(1))
+    assert br.mesh is None and br.replicas == 1
+
+
+# -- pipeline semantics ----------------------------------------------------
+
+def test_pipeline_uneven_tail_matches_single_device():
+    """13 backlogged buffers over data_parallel=4: uneven tail buckets pad
+    up to replica multiples; every row byte-equal to the dp=1 run."""
+    frames = _frames(13)
+    sharded = _run(DESC, frames, queue_capacity=16, batch_max=8,
+                   data_parallel=4)
+    reference = _run(DESC, frames, queue_capacity=16, batch_max=8,
+                     data_parallel=1)
+    _assert_rows_bitwise(sharded, reference)
+
+
+def test_data_parallel_1_is_exact_fallback():
+    """data_parallel=1 must never build or attach a mesh: the stage runs
+    the pre-mesh BatchRunner path, byte-identical outputs included."""
+    frames = _frames(9)
+    p = nt.Pipeline(DESC, batch_max=8, data_parallel=1)
+    with p:
+        assert all(
+            getattr(s.element, "_shard_mesh", None) is None
+            for s in p.stages)
+        for i, x in enumerate(frames):
+            p.push("src", nt.Buffer([x], pts=i))
+        outs = [p.pull("out", timeout=60) for _ in frames]
+        p.eos()
+        p.wait(timeout=60)
+    el = p.element("f")
+    for entry in el._batchers.values():
+        assert entry[1].mesh is None
+    _assert_rows_bitwise(outs, _run(DESC, frames, batch_max=8,
+                                    data_parallel=8))
+
+
+def test_param_replication_happens_once():
+    """Many sharded dispatches, ONE replication: the prepare hook runs
+    before the first sharded dispatch only (counter hook proves it)."""
+    metrics.reset()
+    frames = _frames(48)
+    _run(DESC, frames, queue_capacity=64, batch_max=8, data_parallel=4)
+    snap = metrics.snapshot()
+    assert snap.get("f.shard_dispatch", 0) >= 2, snap
+    assert snap.get("f.param_replications") == 1.0
+
+
+def test_per_replica_counters_prove_placement():
+    """data_parallel=8: metrics_text() carries one shard-rows counter per
+    device, all eight non-zero, summing to the dispatched rows."""
+    from nnstreamer_tpu.utils.profiler import metrics_text
+
+    metrics.reset()
+    frames = _frames(32)
+    _run(DESC, frames, queue_capacity=64, batch_max=8, data_parallel=8)
+    snap = metrics.snapshot()
+    rows = {k: v for k, v in snap.items() if k.startswith("f.shard_rows.")}
+    if not rows:
+        pytest.skip("backlog never coalesced (single-buffer dispatches)")
+    assert len(rows) == 8, rows
+    assert all(v > 0 for v in rows.values())
+    # every sharded dispatch places bucket/8 rows per replica, so the sum
+    # is the total of dispatched (incl. pad) rows: a multiple of 8
+    assert sum(rows.values()) % 8 == 0
+    text = metrics_text()
+    assert "shard_rows" in text and "shard_dispatch" in text
+
+
+def test_fused_chain_shards_and_matches():
+    """A fused transform+filter chain is shard-eligible as one stage;
+    sharded outputs byte-equal to the dp=1 fused run."""
+    desc = (
+        "appsrc name=src caps=other/tensors,dimensions=4:4,types=float32 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:2.0 ! "
+        "tensor_filter framework=jax model=scaler custom=scale:4.0,dims:4:4 "
+        "name=f ! tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, batch_max=4, data_parallel=4)
+    fused = [s for s in p.stages if len(s.node_ids) > 1]
+    assert fused and fused[0].batchable and fused[0].shardable
+    frames = [np.full((4, 4), float(i + 1), np.float32) for i in range(11)]
+    sharded = _run(desc, frames, queue_capacity=16, batch_max=4,
+                   data_parallel=4)
+    reference = _run(desc, frames, queue_capacity=16, batch_max=4,
+                     data_parallel=1)
+    _assert_rows_bitwise(sharded, reference)
+
+
+def test_mesh_only_reaches_shardable_stages():
+    """Host stages (converter, sinks) and flexible-spec filters must never
+    see the mesh, whatever data_parallel says."""
+    from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+    register_custom_easy("shard-flex-double", lambda ins: [ins[0] * 2],
+                         jax_traceable=True)
+    desc = ("appsrc name=src ! "  # no caps: flexible per-buffer specs
+            "tensor_filter framework=custom-easy model=shard-flex-double "
+            "name=f ! tensor_sink name=out")
+    p = nt.Pipeline(desc, batch_max=8, data_parallel=8)
+    assert not any(s.shardable for s in p.stages)
+    frames = [np.full((4 + (i % 2),), float(i), np.float32)
+              for i in range(10)]
+    outs = _run(desc, frames, queue_capacity=16, batch_max=8,
+                data_parallel=8)
+    for x, o in zip(frames, outs):
+        np.testing.assert_allclose(np.asarray(o.tensors[0]), x * 2.0)
+
+
+def test_requesting_more_replicas_than_devices_fails():
+    """Over-asking fails the start() cleanly: elements are torn back down
+    and the instance is dead (a retry must raise, not hang a pull)."""
+    import jax
+
+    from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+    p = nt.Pipeline(DESC, batch_max=8,
+                    data_parallel=len(jax.devices()) + 1)
+    with pytest.raises(PipelineError, match="data_parallel"):
+        p.start()
+    runners = {id(r): r for r in p._runners.values()}.values()
+    assert not any(r.thread.is_alive() for r in runners)
+    with pytest.raises(PipelineError, match="failed startup"):
+        p.start()
+
+
+# -- in-flight dispatch window ---------------------------------------------
+
+def test_in_order_emission_under_dispatch_depth():
+    """dispatch_depth=2 with a randomly-slow host stage downstream: the
+    window must never reorder — outputs arrive in exact pts order with
+    correct values across bursty pushes."""
+    from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+    delays = np.random.default_rng(7).uniform(0.0, 0.004, 64)
+
+    def jitter(ins):
+        time.sleep(float(delays[int(np.asarray(ins[0]).flat[0]) % 64]))
+        return [np.asarray(ins[0])]
+
+    register_custom_easy("shard-jitter", jitter)  # host-only: not traceable
+    desc = (
+        "appsrc name=src caps=other/tensors,dimensions=16,types=float32 ! "
+        "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:16 "
+        "name=f ! "
+        "tensor_filter framework=custom-easy model=shard-jitter name=j ! "
+        "tensor_sink name=out"
+    )
+    frames = _frames(40)
+    p = nt.Pipeline(desc, queue_capacity=8, batch_max=8, data_parallel=4,
+                    dispatch_depth=2)
+    outs = []
+    with p:
+        pushed = 0
+        for burst in (7, 1, 12, 3, 17):  # bursty arrivals
+            for _ in range(burst):
+                p.push("src", nt.Buffer([frames[pushed]], pts=pushed))
+                pushed += 1
+            time.sleep(0.002)
+        for _ in range(pushed):
+            outs.append(p.pull("out", timeout=60))
+        p.eos()
+        p.wait(timeout=60)
+    assert [o.pts for o in outs] == list(range(len(frames)))
+    for x, o in zip(frames, outs):
+        np.testing.assert_allclose(np.asarray(o.tensors[0]), x * 2.0)
+
+
+def test_eos_flushes_open_dispatch_window():
+    """An odd trickle with depth=2 must deliver everything at EOS — the
+    window can never strand a dispatched batch."""
+    frames = _frames(5)
+    outs = _run(DESC, frames, queue_capacity=16, batch_max=4,
+                data_parallel=4, dispatch_depth=3)
+    _assert_rows_bitwise(outs, _run(DESC, frames, batch_max=1))
+
+
+def test_stage_failure_flushes_inflight_window():
+    """A batch held in the dispatch window when a LATER batch's dispatch
+    raises must still be delivered before the error propagates — exactly
+    what dispatch_depth=1 would have done."""
+    import threading
+
+    from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+    p = nt.Pipeline(DESC, queue_capacity=32, batch_max=4, data_parallel=1,
+                    dispatch_depth=2)
+    el = p.element("f")
+    first_started, release = threading.Event(), threading.Event()
+    orig_process, orig_batch = el.process, el.process_batch
+
+    def gated(pad, buf):  # holds the stage on buffer 0 so 7 more backlog
+        first_started.set()
+        assert release.wait(10)
+        return orig_process(pad, buf)
+
+    def flaky(pad, bufs):  # drains run 4 then 3; the 3-batch blows up
+        if len(bufs) == 3:
+            raise RuntimeError("boom")
+        return orig_batch(pad, bufs)
+
+    el.process, el.process_batch = gated, flaky
+    frames = _frames(8)
+    with p:
+        p.push("src", nt.Buffer([frames[0]], pts=0))
+        assert first_started.wait(10)
+        for i in range(1, 8):
+            p.push("src", nt.Buffer([frames[i]], pts=i))
+        release.set()
+        # single(1) + the 4-batch held in the window MUST arrive; the
+        # failing 3-batch must not
+        outs = [p.pull("out", timeout=60) for _ in range(5)]
+        assert [o.pts for o in outs] == [0, 1, 2, 3, 4]
+        for x, o in zip(frames, outs):
+            np.testing.assert_allclose(np.asarray(o.tensors[0]), x * 2.0)
+        with pytest.raises(PipelineError, match="boom"):
+            p.pull("out", timeout=10)
+
+
+def test_dispatch_depth_1_keeps_lockstep_semantics():
+    frames = _frames(16)
+    a = _run(DESC, frames, queue_capacity=32, batch_max=8, data_parallel=4,
+             dispatch_depth=1)
+    b = _run(DESC, frames, queue_capacity=32, batch_max=8, data_parallel=4,
+             dispatch_depth=2)
+    _assert_rows_bitwise(a, b)

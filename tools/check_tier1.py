@@ -15,6 +15,12 @@ string in examples/ + tests/test_pipeline_e2e.py and over the framework's
 own device_fns (the jit-purity dogfood), in strict mode against
 tools/lint_baseline.txt: any diagnostic not already accepted in the
 baseline fails the gate.  ``--update`` refreshes the baseline too.
+
+AND it runs tests/test_sharded_batching.py as its OWN pytest process with
+``--xla_force_host_platform_device_count=8`` pinned in XLA_FLAGS: the
+flag must be set before jax initializes, and a separate process
+guarantees it can never arrive too late (or leak a forced device count
+into anything else).
 """
 
 from __future__ import annotations
@@ -72,6 +78,32 @@ def run_lint_gate(update: bool) -> int:
     return proc.returncode
 
 
+def run_sharded_gate(timeout: int = 600) -> int:
+    """tests/test_sharded_batching.py in its own process, with the forced
+    8-host-device XLA flag pinned (see module docstring)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_sharded_batching.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"sharded gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    tag = "OK" if proc.returncode == 0 else "FAILED"
+    print(f"sharded gate: {tag} ({passed} passed)")
+    if proc.returncode != 0:
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+    return proc.returncode
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -83,6 +115,8 @@ def main() -> int:
     args = ap.parse_args()
 
     lint_rc = run_lint_gate(args.update)
+    sharded_rc = run_sharded_gate()
+    lint_rc = lint_rc or sharded_rc
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
