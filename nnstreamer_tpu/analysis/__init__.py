@@ -46,10 +46,26 @@ def analyze(
     topology: bool = True,
     purity: bool = True,
     queue_capacity: Optional[int] = None,
+    deep: bool = False,
+    batch_max: Optional[int] = None,
+    batch_buckets: Optional[list] = None,
+    data_parallel: Optional[int] = None,
+    dispatch_depth: Optional[int] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    max_compiled_variants: Optional[int] = None,
 ) -> Report:
     """Run the static passes; always returns a :class:`Report` (a syntax
     error becomes a single ``parse-error`` diagnostic rather than an
-    exception, so tools can render every pipeline the same way)."""
+    exception, so tools can render every pipeline the same way).
+
+    ``deep=True`` additionally runs the abstract-execution pass
+    (:mod:`~nnstreamer_tpu.analysis.tracecheck`): every device stage is
+    traced symbolically with ``jax.eval_shape`` against the negotiated
+    spec (shape/dtype contract violations, tracing failures) and a static
+    HBM/recompile budget report is attached as ``report.resources``.  The
+    deep pass imports jax — unlike the syntactic passes — but performs
+    zero device dispatch.  The remaining keyword knobs parameterize its
+    resource model and default to the global Config."""
     source = pipeline if isinstance(pipeline, str) else None
     report = Report(source)
     if isinstance(pipeline, str):
@@ -76,12 +92,33 @@ def analyze(
 
         run("topology",
             lambda: check_topology(graph, queue_capacity=queue_capacity))
+    caps_state = {}
     if caps:
         from .capsflow import propagate
 
-        run("capsflow", lambda: propagate(graph)[0])
+        def _run_caps():
+            diags, out_caps = propagate(graph)
+            caps_state["out_caps"] = out_caps  # reused by the deep pass
+            return diags
+
+        run("capsflow", _run_caps)
     if purity:
         from .purity import lint_graph
 
         run("purity", lambda: lint_graph(graph))
+    if deep:
+        from .tracecheck import deep_check
+
+        try:
+            ddiags, resources = deep_check(
+                graph, batch_max=batch_max, batch_buckets=batch_buckets,
+                data_parallel=data_parallel, dispatch_depth=dispatch_depth,
+                hbm_budget_bytes=hbm_budget_bytes,
+                max_compiled_variants=max_compiled_variants,
+                out_caps=caps_state.get("out_caps"))
+            report.extend(ddiags)
+            report.resources = resources
+        except Exception as e:  # noqa: BLE001 - report, never crash
+            report.add("analyzer-error", ERROR,
+                       f"deep pass crashed: {e!r} — report this bug")
     return report

@@ -184,7 +184,7 @@ def _filter_transfer(node, in_caps: Dict[str, Caps], out_pads: List[str]
 
         entry = _models.get(str(props.get("model")))
         if entry is not None:
-            _, reg_in, reg_out, _ = entry
+            reg_in, reg_out = entry[1], entry[2]
             declared_in = declared_in or reg_in
             declared_out = declared_out or reg_out
 

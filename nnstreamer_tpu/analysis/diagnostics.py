@@ -51,6 +51,10 @@ class Report:
     def __init__(self, source: Optional[str] = None):
         self.source = source  # original pipeline string (caret rendering)
         self.diagnostics: List[Diagnostic] = []
+        #: static HBM/recompile estimate from the deep pass
+        #: (:class:`~nnstreamer_tpu.analysis.tracecheck.ResourceReport`);
+        #: None unless analyze(deep=True) ran
+        self.resources = None
 
     def add(self, code: str, severity: str, message: str, *, path: str = "",
             pos: Optional[int] = None) -> None:

@@ -382,7 +382,7 @@ def lint_graph(graph) -> List[Diagnostic]:
 
             entry = _models.get(str(node.props.get("model")))
             if entry is not None:
-                fn, _, _, traceable = entry
+                fn, traceable = entry[0], entry[3]
                 if traceable and fn not in seen:
                     seen.add(fn)
                     diags.extend(lint_callable(

@@ -1,0 +1,492 @@
+"""Deep analysis pass: abstract shape execution + static resource budgeting.
+
+The syntactic passes (capsflow/topology/purity) verify what elements
+*declare*.  This pass verifies what their device code *actually does*:
+after capsflow negotiation it executes every device-capable stage
+SYMBOLICALLY — ``jax.ShapeDtypeStruct`` inputs derived from the negotiated
+spec, traced through the stage's real closure with :func:`jax.eval_shape`
+— and reports, in one run:
+
+1. **shape/dtype contract violations** (``trace-shape-mismatch``): the
+   traced output of a ``device_fn`` / framework ``pure_fn`` disagrees with
+   the spec capsflow propagated downstream, with the field-level diff from
+   :func:`~nnstreamer_tpu.core.caps.explain_mismatch`;
+2. **tracing failures** (``trace-error``): ConcretizationTypeError from
+   data-dependent shapes, dtype promotion explosions, arity bugs — the
+   errors the runtime would hit at the first buffer, surfaced statically
+   with the element path and source caret;
+3. a **static resource report** (:class:`ResourceReport`): per-stage param
+   bytes + abstract activation bytes, multiplied out over the bucket
+   ladder (``pipeline/batching.ladder``), the ``data_parallel``
+   replication plan (``pipeline/plan.replication_plan``) and the
+   ``dispatch_depth`` in-flight window — yielding an estimated per-device
+   HBM high-water mark and a recompile census (distinct compiled
+   signatures), each checked against configurable budgets
+   (``Config.hbm_budget_bytes`` / ``Config.max_compiled_variants``,
+   ``hbm-budget`` / ``recompile-budget`` warnings anchored at the
+   dominant stage).
+
+Unlike the syntactic passes this one imports jax — but it still performs
+**zero device dispatch**: ``eval_shape`` traces, it never compiles or
+executes, and no tensor ever materializes (tests/test_deep_analysis.py
+pins this with dispatch instrumented).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..core.caps import Caps, explain_mismatch
+from ..core.config import get_config
+from ..core.types import TensorFormat, TensorSpec, TensorsSpec
+from ..elements.base import Element, SINK, SRC
+from ..pipeline.batching import ladder as bucket_ladder, shard_bucket_for
+from ..pipeline.graph import PipelineGraph
+from ..pipeline.plan import replication_plan
+from .capsflow import SAFE_CONFIGURE, _element_class, _kahn_order, propagate
+from .diagnostics import Diagnostic, ERROR, WARNING, node_label
+
+
+def _mib(n: int) -> str:
+    return f"{n / (1 << 20):.1f} MiB"
+
+
+@dataclasses.dataclass
+class StageResource:
+    """Static resource estimate for one deep-traced stage (a device
+    element, or a maximal linear chain the planner would fuse)."""
+
+    label: str  # "a+b" for chains, mirroring FusedElement naming
+    param_bytes: int
+    #: peak abstract activation bytes for ONE row (batch entry): max over
+    #: the chain's links of input+output bytes
+    act_row_bytes: int
+    #: rows resident per device at the top of the bucket ladder
+    rows_per_device: int
+    #: distinct compiled signatures this stage contributes (0 = host path)
+    variants: int
+    batchable: bool = False
+    #: would shard if a >1-wide data mesh existed (batchable, no host_post)
+    shard_eligible: bool = False
+    sharded: bool = False
+    pos: Optional[int] = None  # source offset of the stage head
+
+    @property
+    def hbm_bytes(self) -> int:
+        """Per-device HBM this stage plans for: resident params + in-flight
+        activations (dispatch window already multiplied into rows)."""
+        return self.param_bytes + self.act_row_bytes * self.rows_per_device
+
+
+@dataclasses.dataclass
+class ResourceReport:
+    """The deep pass's static resource estimate for one pipeline."""
+
+    stages: List[StageResource]
+    batch_max: int
+    data_parallel: int  # resolved replicas (1 = unsharded)
+    dispatch_depth: int
+    ladder: Tuple[int, ...]
+    hbm_budget_bytes: int = 0
+    max_compiled_variants: int = 0
+
+    @property
+    def hbm_estimate(self) -> int:
+        return sum(s.hbm_bytes for s in self.stages)
+
+    @property
+    def compiled_variants(self) -> int:
+        return sum(s.variants for s in self.stages)
+
+    def summary(self) -> str:
+        return (f"{len(self.stages)} device stage(s), est HBM high-water "
+                f"{_mib(self.hbm_estimate)}"
+                + (f" (budget {_mib(self.hbm_budget_bytes)})"
+                   if self.hbm_budget_bytes else "")
+                + f", {self.compiled_variants} compiled signature(s)"
+                + (f" (max {self.max_compiled_variants})"
+                   if self.max_compiled_variants else ""))
+
+    def render(self) -> str:
+        lines = [
+            "deep resource report "
+            f"(batch_max={self.batch_max}, "
+            f"buckets={','.join(map(str, self.ladder))}, "
+            f"data_parallel={self.data_parallel}, "
+            f"dispatch_depth={self.dispatch_depth})"
+        ]
+        if not self.stages:
+            lines.append("  no device stages traced")
+        for s in self.stages:
+            flags = "".join(
+                f for f, on in (("B", s.batchable), ("S", s.sharded)) if on)
+            lines.append(
+                f"  {s.label}: params {_mib(s.param_bytes)}, "
+                f"act/row {_mib(s.act_row_bytes)}, "
+                f"rows/dev {s.rows_per_device}, "
+                f"programs {s.variants}"
+                + (f" [{flags}]" if flags else ""))
+        lines.append("  totals: " + self.summary())
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class _NodeTrace:
+    """Per-node result of the abstract execution walk."""
+
+    node: object
+    element: Element
+    in_bytes: int
+    out_bytes: int
+    param_bytes: int
+    batchable: bool
+    host_post: bool
+    linear: bool  # single default-pad in/out edges (fusion-chain eligible)
+
+
+def _trace_msg(e: BaseException) -> str:
+    first = str(e).strip().splitlines()
+    head = first[0] if first else repr(e)
+    if len(head) > 300:
+        head = head[:297] + "..."
+    return f"{type(e).__name__}: {head}"
+
+
+def _static(spec: TensorsSpec) -> TensorsSpec:
+    return spec if spec.format == TensorFormat.STATIC else spec.replace(
+        format=TensorFormat.STATIC)
+
+
+def deep_check(
+    graph: PipelineGraph,
+    *,
+    batch_max: Optional[int] = None,
+    batch_buckets: Optional[List[int]] = None,
+    data_parallel: Optional[int] = None,
+    dispatch_depth: Optional[int] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    max_compiled_variants: Optional[int] = None,
+    out_caps: Optional[Dict] = None,
+) -> Tuple[List[Diagnostic], ResourceReport]:
+    """Run the deep pass over a parsed graph.  Knobs default to the global
+    :class:`~nnstreamer_tpu.core.config.Config` the runtime would use, so
+    the report predicts what an actual ``Pipeline(desc)`` would plan.
+    ``out_caps`` lets the caller hand over an existing capsflow
+    :func:`propagate` result instead of re-running negotiation."""
+    cfg = get_config()
+    batch_max = max(1, batch_max if batch_max is not None else cfg.batch_max)
+    # Normalize like BatchRunner does (sorted unique ascending):
+    # bucket_for scans in order, so a raw [8,2,4] would collapse the
+    # census to the first listed bucket and diverge from the runtime.
+    buckets = list(batch_buckets if batch_buckets is not None
+                   else cfg.batch_buckets) or None
+    if buckets:
+        buckets = sorted(set(buckets))
+    dp_knob = max(0, data_parallel if data_parallel is not None
+                  else cfg.data_parallel)
+    dispatch_depth = max(1, dispatch_depth if dispatch_depth is not None
+                         else cfg.dispatch_depth)
+    hbm_budget = (hbm_budget_bytes if hbm_budget_bytes is not None
+                  else cfg.hbm_budget_bytes)
+    max_variants = (max_compiled_variants if max_compiled_variants is not None
+                    else cfg.max_compiled_variants)
+
+    import jax  # backend init only — the pass never dispatches
+
+    n_devices = len(jax.devices())  # what _build_data_mesh sizes against
+    requested = replication_plan(dp_knob, batch_max, n_devices)
+    replicas = min(requested, n_devices)  # model what COULD run; the
+    # over-ask itself becomes a diagnostic below
+    diags: List[Diagnostic] = []
+    if out_caps is None:
+        # capsflow's own diagnostics are the syntactic pass's to report;
+        # here we only need the negotiated specs
+        _, out_caps = propagate(graph)
+
+    traces: Dict[int, _NodeTrace] = {}
+    for node in _kahn_order(graph):
+        got = _trace_node(graph, node, out_caps, diags)
+        if got is not None:
+            traces[node.id] = got
+
+    report = _resources(graph, traces, batch_max=batch_max, buckets=buckets,
+                        replicas=replicas, dispatch_depth=dispatch_depth,
+                        hbm_budget=hbm_budget, max_variants=max_variants)
+    for t in traces.values():
+        # Throwaway trace elements may hold real checkpoints (configure()
+        # opened the framework) — release them now, not at GC.
+        try:
+            t.element.stop()
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+    if requested > n_devices and batch_max > 1 \
+            and any(s.shard_eligible for s in report.stages):
+        # exactly the config the runtime's _build_data_mesh refuses: a
+        # shard-eligible stage + an explicit dp the host cannot supply
+        top = next(s for s in report.stages if s.shard_eligible)
+        diags.append(Diagnostic(
+            "data-parallel-devices", ERROR,
+            f"data_parallel={requested} needs {requested} local devices, "
+            f"have {n_devices} — start() will fail with PipelineError",
+            path=top.label, pos=top.pos))
+    diags.extend(_budget_diags(report))
+    return diags, report
+
+
+def _trace_node(graph, node, out_caps, diags) -> Optional[_NodeTrace]:
+    """Abstractly execute one node's device path; returns its trace record
+    (for resource accounting) or None when the node has no device path."""
+    if node.kind == "capsfilter":
+        return None
+    cls = _element_class(node.kind)
+    if cls is None or cls.device_fn is Element.device_fn:
+        return None
+    ins = graph.in_edges(node.id)
+    if len(ins) != 1 or ins[0].dst_pad != SINK:
+        return None  # device paths are single-sink by construction
+    up = out_caps.get((ins[0].src, ins[0].src_pad))
+    spec = up.spec if up is not None else None
+    if spec is None:
+        return None  # nothing negotiated to derive abstract inputs from
+    label = node_label(node)
+    if spec.is_flexible or bool(node.props.get("invoke_dynamic", False)):
+        diags.append(Diagnostic(
+            "recompile-unbounded", WARNING,
+            "flexible/per-buffer shapes re-specialize the compiled program "
+            "per signature — the recompile census cannot bound this stage "
+            "(bucket flexible streams, or declare a static spec)",
+            path=label, pos=node.pos))
+        return None
+    if node.kind not in SAFE_CONFIGURE and node.kind != "tensor_filter":
+        return None  # configure touches the outside world: not traceable
+    try:
+        el = cls(dict(node.props), name=node.name or f"{node.kind}{node.id}")
+    except Exception:  # noqa: BLE001 - capsflow already diagnosed this
+        return None
+    out_pads = sorted(
+        {e.src_pad for e in graph.out_edges(node.id)}) or [SRC]
+    try:
+        produced = el.configure({SINK: up}, list(out_pads))
+    except Exception:  # noqa: BLE001 - capsflow already diagnosed this
+        return None
+    # The real configure is strictly better informed than capsflow's
+    # static transfer (it loads the framework and learns model I/O the
+    # props never declared) — feed ITS caps to downstream nodes so the
+    # whole deep walk sees what the runtime would negotiate.
+    for pad in out_pads:
+        got = produced.get(pad)
+        if got is not None:
+            out_caps[(node.id, pad)] = got
+
+    try:
+        got = el.abstract_invoke(spec)
+    except Exception as e:  # noqa: BLE001 - the finding, not a crash
+        diags.append(Diagnostic(
+            "trace-error", ERROR,
+            f"abstract execution failed: {_trace_msg(e)}",
+            path=label, pos=node.pos))
+        return None
+    if got is None:
+        return None
+    traced_sds, declared = got
+    traced = TensorsSpec(tuple(
+        TensorSpec.from_shape(tuple(s.shape), s.dtype) for s in traced_sds))
+
+    # The contract: what the trace produces must be what capsflow told
+    # downstream to expect (falling back to the element's own declared
+    # out spec when propagation had nothing static).
+    down = out_caps.get((node.id, SRC))
+    ref = (down.spec if down is not None else None) or declared
+    if ref is not None and not ref.is_flexible \
+            and not traced.is_compatible(_static(ref)):
+        diags.append(Diagnostic(
+            "trace-shape-mismatch", ERROR,
+            "traced output disagrees with the negotiated downstream spec: "
+            + explain_mismatch(Caps.tensors(traced), Caps.tensors(_static(ref))),
+            path=f"{label}:src", pos=node.pos))
+
+    try:
+        params = int(el.param_bytes())
+    except Exception:  # noqa: BLE001 - accounting probe only
+        params = 0
+    try:
+        batchable = bool(el.batch_capable())
+    except Exception:  # noqa: BLE001 - capability probe only
+        batchable = False
+    outs = graph.out_edges(node.id)
+    linear = (len(outs) <= 1 and all(e.src_pad == SRC for e in outs))
+    return _NodeTrace(
+        node=node, element=el, in_bytes=spec.nbytes, out_bytes=traced.nbytes,
+        param_bytes=params, batchable=batchable,
+        host_post=getattr(el, "host_post", None) is not None, linear=linear)
+
+
+def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
+               replicas, dispatch_depth, hbm_budget, max_variants
+               ) -> ResourceReport:
+    """Merge traced nodes into planner-shaped stages (maximal linear chains
+    fuse into ONE program, exactly the plan_stages rule) and multiply the
+    per-stage estimates over the bucket ladder / replication plan."""
+    lad = bucket_ladder(batch_max, buckets)
+    chains: List[List[_NodeTrace]] = []
+    consumed: set = set()
+    for nid in traces:
+        if nid in consumed:
+            continue
+        chain = [traces[nid]]
+        consumed.add(nid)
+        cur = nid
+        while True:
+            t = traces[cur]
+            outs = graph.out_edges(cur)
+            if not t.linear or len(outs) != 1:
+                break
+            nxt = outs[0].dst
+            nt = traces.get(nxt)
+            if (nt is None or nxt in consumed or not nt.linear
+                    or outs[0].dst_pad != SINK
+                    or len(graph.in_edges(nxt)) != 1):
+                break
+            chain.append(nt)
+            consumed.add(nxt)
+            cur = nxt
+        chains.append(chain)
+
+    stages: List[StageResource] = []
+    for chain in chains:
+        fused = len(chain) > 1
+        # an unfused element without a batch path runs .process on HOST —
+        # it compiles nothing and keeps nothing in HBM
+        device = fused or chain[0].batchable \
+            or chain[0].node.kind == "tensor_filter"
+        if not device:
+            continue
+        batchable = fused or chain[0].batchable
+        host_post = chain[-1].host_post
+        shard_eligible = batchable and not host_post
+        sharded = shard_eligible and replicas > 1
+        n_buckets = 1
+        rows = 1
+        window = 1
+        if batchable and batch_max > 1:
+            window = dispatch_depth  # in-flight micro-batches per runner
+            if sharded:
+                sb = sorted({shard_bucket_for(b, replicas, buckets)
+                             for b in lad})
+                n_buckets = len(sb)
+                rows = sb[-1] // replicas
+            else:
+                n_buckets = len(lad)
+                rows = lad[-1]
+        stages.append(StageResource(
+            label="+".join(t.element.name for t in chain),
+            param_bytes=sum(t.param_bytes for t in chain),
+            act_row_bytes=max(t.in_bytes + t.out_bytes for t in chain),
+            rows_per_device=rows * window,
+            variants=n_buckets,
+            batchable=batchable, shard_eligible=shard_eligible,
+            sharded=sharded, pos=chain[0].node.pos))
+    return ResourceReport(
+        stages=stages, batch_max=batch_max, data_parallel=replicas,
+        dispatch_depth=dispatch_depth, ladder=lad,
+        hbm_budget_bytes=int(hbm_budget or 0),
+        max_compiled_variants=int(max_variants or 0))
+
+
+def _budget_diags(report: ResourceReport) -> List[Diagnostic]:
+    """Budget checks, anchored at the dominant stage so the diagnostic
+    carets point at the element to fix, not at the whole pipeline."""
+    diags: List[Diagnostic] = []
+    if report.hbm_budget_bytes and report.stages \
+            and report.hbm_estimate > report.hbm_budget_bytes:
+        top = max(report.stages, key=lambda s: s.hbm_bytes)
+        diags.append(Diagnostic(
+            "hbm-budget", WARNING,
+            f"estimated HBM high-water {_mib(report.hbm_estimate)} exceeds "
+            f"budget {_mib(report.hbm_budget_bytes)} (largest stage: "
+            f"{_mib(top.hbm_bytes)} = params {_mib(top.param_bytes)} + "
+            f"{top.rows_per_device} row(s) x {_mib(top.act_row_bytes)}); "
+            "shrink batch_max/buckets, raise data_parallel, or raise "
+            "Config.hbm_budget_bytes",
+            path=top.label, pos=top.pos))
+    if report.max_compiled_variants and report.stages \
+            and report.compiled_variants > report.max_compiled_variants:
+        top = max(report.stages, key=lambda s: s.variants)
+        diags.append(Diagnostic(
+            "recompile-budget", WARNING,
+            f"{report.compiled_variants} distinct compiled signatures "
+            f"(buckets x stages) exceed max_compiled_variants="
+            f"{report.max_compiled_variants} (largest stage: {top.label} "
+            f"with {top.variants}); trim batch_buckets or lower batch_max",
+            path=top.label, pos=top.pos))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# deep dogfood: abstract-trace the zoo our own plugin modules ship
+# ---------------------------------------------------------------------------
+
+#: zoo models the deep dogfood traces on every CI run: every bundled model
+#: family that builds hermetically (no files, no net) with default opts.
+ZOO_DOGFOOD = (
+    "passthrough", "scaler", "average",
+    "mobilenet_v1", "ssd_mobilenet", "posenet", "deeplab_mobilenet",
+    "yolov5", "yolov8", "speech_commands",
+)
+
+
+def trace_zoo_models(names: Optional[Tuple[str, ...]] = None
+                     ) -> Tuple[List[Diagnostic], int, int]:
+    """Abstractly execute bundled zoo models against their own declared
+    I/O specs: ``eval_shape`` through ``apply_fn`` with params AND inputs
+    abstracted, diffing the traced output against ``bundle.out_spec``.
+    Returns (diagnostics, traced count, skipped count)."""
+    import jax
+
+    from ..models import zoo
+
+    diags: List[Diagnostic] = []
+    traced = skipped = 0
+    for name in names or ZOO_DOGFOOD:
+        try:
+            bundle = zoo.build(name, {})
+        except Exception:  # noqa: BLE001 - optional deps may be absent
+            skipped += 1
+            continue
+        if bundle.in_spec is None or bundle.out_spec is None:
+            skipped += 1
+            continue
+        where = f"zoo:{name}"
+        p_sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+            bundle.params)
+        in_sds = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                       for s in bundle.in_spec)
+        apply_fn = bundle.apply_fn
+
+        def run(p, xs):
+            out = apply_fn(p, *xs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        traced += 1
+        try:
+            out = jax.eval_shape(run, p_sds, in_sds)
+        except Exception as e:  # noqa: BLE001 - the finding
+            diags.append(Diagnostic(
+                "trace-error", ERROR,
+                f"abstract execution failed: {_trace_msg(e)}", path=where))
+            continue
+        got = TensorsSpec(tuple(
+            TensorSpec.from_shape(tuple(s.shape), s.dtype) for s in out))
+        declared = bundle.out_spec
+        if not declared.is_flexible \
+                and not got.is_compatible(_static(declared)):
+            diags.append(Diagnostic(
+                "trace-shape-mismatch", ERROR,
+                "traced output disagrees with the bundle's declared "
+                "out_spec: " + explain_mismatch(
+                    Caps.tensors(got), Caps.tensors(_static(declared))),
+                path=where))
+    return diags, traced, skipped

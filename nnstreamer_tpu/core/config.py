@@ -26,6 +26,8 @@ _ENV_BUCKETING = "NNS_TPU_SHAPE_BUCKETING"
 _ENV_BATCH_MAX = "NNS_TPU_BATCH_MAX"
 _ENV_DATA_PARALLEL = "NNS_TPU_DATA_PARALLEL"
 _ENV_DISPATCH_DEPTH = "NNS_TPU_DISPATCH_DEPTH"
+_ENV_HBM_BUDGET = "NNS_TPU_HBM_BUDGET"
+_ENV_MAX_VARIANTS = "NNS_TPU_MAX_COMPILED_VARIANTS"
 
 
 @dataclasses.dataclass
@@ -60,6 +62,17 @@ class Config:
     dispatch_depth: int = 2
     #: pad flexible shapes up to the next bucket to bound XLA recompiles
     shape_bucketing: bool = True
+    #: static-analysis budget (nns-lint --deep): estimated per-device HBM
+    #: high-water mark in bytes a pipeline may plan for before the deep
+    #: pass warns (0 = no budget).  The estimate multiplies per-stage
+    #: param + abstract activation bytes over the bucket ladder,
+    #: data_parallel replication, and the dispatch_depth in-flight window
+    #: — see docs/ANALYSIS.md "Deep pass".
+    hbm_budget_bytes: int = 0
+    #: static-analysis budget (nns-lint --deep): max distinct compiled XLA
+    #: signatures (buckets x spec variants across device stages) before
+    #: the deep pass warns of a recompile storm (0 = no budget)
+    max_compiled_variants: int = 0
     #: emit per-stage latency measurements
     enable_latency: bool = True
     #: free-form per-framework options ([filter-jax] section of the ini)
@@ -94,6 +107,12 @@ class Config:
             if ini.has_option("common", "shape_bucketing"):
                 cfg.shape_bucketing = ini.getboolean("common",
                                                      "shape_bucketing")
+            if ini.has_option("common", "hbm_budget_bytes"):
+                cfg.hbm_budget_bytes = ini.getint("common",
+                                                  "hbm_budget_bytes")
+            if ini.has_option("common", "max_compiled_variants"):
+                cfg.max_compiled_variants = ini.getint(
+                    "common", "max_compiled_variants")
             for sec in ini.sections():
                 if sec.startswith("filter-"):
                     cfg.framework_options[sec[len("filter-"):]] = dict(ini.items(sec))
@@ -107,6 +126,10 @@ class Config:
             cfg.data_parallel = int(os.environ[_ENV_DATA_PARALLEL])
         if os.environ.get(_ENV_DISPATCH_DEPTH):
             cfg.dispatch_depth = int(os.environ[_ENV_DISPATCH_DEPTH])
+        if os.environ.get(_ENV_HBM_BUDGET):
+            cfg.hbm_budget_bytes = int(os.environ[_ENV_HBM_BUDGET])
+        if os.environ.get(_ENV_MAX_VARIANTS):
+            cfg.max_compiled_variants = int(os.environ[_ENV_MAX_VARIANTS])
         if os.environ.get(_ENV_BUCKETING):
             cfg.shape_bucketing = os.environ[_ENV_BUCKETING].lower() in (
                 "1", "true", "yes", "on")

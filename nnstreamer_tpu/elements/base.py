@@ -222,6 +222,38 @@ class Element:
         tuple of jax arrays (one per tensor).  None => host-only element."""
         return None
 
+    # -- abstract execution (nns-lint --deep) -------------------------------
+    def abstract_invoke(
+        self, in_spec: TensorsSpec
+    ) -> Optional[Tuple[List, Optional[TensorsSpec]]]:
+        """Execute this element's device path SYMBOLICALLY against
+        ``in_spec``: trace :meth:`device_fn`'s closure with
+        ``jax.ShapeDtypeStruct`` inputs via :func:`jax.eval_shape` — zero
+        device dispatch, no buffer ever materializes.  Returns ``(traced
+        output ShapeDtypeStructs, declared out spec)`` so the deep analyzer
+        (``analysis/tracecheck.py``) can diff what the trace actually
+        produces against what negotiation promised downstream.  None when
+        the element has no device path for this spec.  Tracing errors
+        (ConcretizationTypeError from data-dependent shapes, dtype
+        surprises) propagate — the analyzer turns them into diagnostics."""
+        df = self.device_fn(in_spec)
+        if df is None:
+            return None
+        fn, declared = df
+        import jax
+
+        sds = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype) for s in in_spec)
+        out = jax.eval_shape(lambda xs: fn(xs), sds)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return list(out), declared
+
+    def param_bytes(self) -> int:
+        """Bytes of device-resident parameters this element keeps for the
+        pipeline's lifetime (model weights); feeds the deep analyzer's
+        static HBM high-water estimate.  Default: none."""
+        return 0
+
     def get_property(self, key: str, default=None):
         return self.props.get(key, default)
 

@@ -438,6 +438,43 @@ class TensorFilter(Element):
 
         return wrapped, combined
 
+    # -- abstract execution (nns-lint --deep) -------------------------------
+    def abstract_invoke(self, in_spec: TensorsSpec):
+        """Symbolic trace for the deep analyzer: the model core goes through
+        the FRAMEWORK's abstract_invoke (which abstracts params too — a
+        checkpoint's weights never materialize for this), and the
+        input/output-combination plumbing is applied to the ShapeDtypeStruct
+        lists on host, mirroring the wrapped device_fn exactly."""
+        fw = self._ensure_fw()
+        if self.invoke_dynamic or getattr(fw, "streaming", False) \
+                or getattr(fw, "continuous", False):
+            return None  # per-buffer/async shapes: nothing static to check
+        import jax
+
+        sds = [jax.ShapeDtypeStruct(s.shape, s.dtype) for s in in_spec]
+        model_in = ([sds[i] for i in self.input_combination]
+                    if self.input_combination is not None else sds)
+        model_out = fw.abstract_invoke(model_in)
+        if model_out is None:
+            return None
+        if self.output_combination is None:
+            outs = list(model_out)
+        else:
+            outs = [(sds if tag == "i" else list(model_out))[i]
+                    for tag, i in self.output_combination]
+        out_spec = self._out_spec
+        if out_spec is None:
+            _, out_spec = fw.get_model_info()
+        declared = (self._combined_out_spec(out_spec)
+                    if out_spec is not None else None)
+        return outs, declared
+
+    def param_bytes(self) -> int:
+        try:
+            return int(self._ensure_fw().param_bytes())
+        except Exception:  # noqa: BLE001 - accounting probe only
+            return 0
+
     # -- model reload (reference: tensor_filter_common.c ReloadModel) ------
     def reload_model(self, model: Optional[object] = None) -> None:
         """Swap the model without rebuilding the pipeline.

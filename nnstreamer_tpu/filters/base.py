@@ -69,6 +69,33 @@ class Framework:
         """Optional pure JAX function ``tuple(arrays) -> tuple(arrays)``."""
         return None
 
+    # -- abstract execution (nns-lint --deep) -------------------------------
+    def abstract_invoke(self, in_sds: Sequence) -> Optional[List]:
+        """Trace the model SYMBOLICALLY: map input ``jax.ShapeDtypeStruct``s
+        to output ShapeDtypeStructs via :func:`jax.eval_shape` — no device
+        dispatch, no buffer ever materializes.  The deep analysis pass
+        (``analysis/tracecheck.py``) uses this to check the model's *actual*
+        traced output shapes/dtypes against its declared spec before a
+        pipeline ever starts.  Default: eval_shape over :meth:`pure_fn`;
+        frameworks whose params are heavyweight override to abstract the
+        params too (see jax_fw).  Returns None when the framework has no
+        traceable path (host-only runtimes, streaming decode loops)."""
+        fn = self.pure_fn()
+        if fn is None:
+            return None
+        import jax
+
+        out = jax.eval_shape(lambda xs: fn(xs), tuple(in_sds))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return list(out)
+
+    def param_bytes(self) -> int:
+        """Bytes of model parameters resident in device memory while the
+        pipeline runs (0 = none / unknown).  Feeds the deep pass's static
+        HBM high-water estimate."""
+        return 0
+
     # -- events ------------------------------------------------------------
     def handle_event(self, kind: str, payload=None) -> None:
         """Reference eventHandler (model reload etc.)."""

@@ -27,7 +27,9 @@ from ..core.registry import register_filter
 from ..core.types import TensorsSpec
 from .base import Framework, FrameworkError
 
-_models: Dict[str, Tuple[Callable, Optional[TensorsSpec], Optional[TensorsSpec], bool]] = {}
+#: name -> (fn, in_spec, out_spec, jax_traceable, param_bytes)
+_models: Dict[str, Tuple[Callable, Optional[TensorsSpec],
+                         Optional[TensorsSpec], bool, int]] = {}
 _lock = threading.Lock()
 
 
@@ -37,10 +39,17 @@ def register_custom_easy(
     in_spec: Optional[TensorsSpec] = None,
     out_spec: Optional[TensorsSpec] = None,
     jax_traceable: bool = False,
+    param_bytes: int = 0,
 ) -> None:
-    """Register ``fn(list_of_arrays) -> list_of_arrays`` as model ``name``."""
+    """Register ``fn(list_of_arrays) -> list_of_arrays`` as model ``name``.
+
+    ``param_bytes`` declares device-resident weight bytes the callable
+    closes over, feeding the deep analyzer's static HBM estimate (0 =
+    none/unknown).
+    """
     with _lock:
-        _models[name] = (fn, in_spec, out_spec, jax_traceable)
+        _models[name] = (fn, in_spec, out_spec, jax_traceable,
+                         int(param_bytes))
 
 
 def unregister_custom_easy(name: str) -> bool:
@@ -58,6 +67,7 @@ class CustomEasyFramework(Framework):
         self._in: Optional[TensorsSpec] = None
         self._out: Optional[TensorsSpec] = None
         self._traceable = False
+        self._param_bytes = 0
 
     def open(self, props):
         super().open(props)
@@ -70,7 +80,8 @@ class CustomEasyFramework(Framework):
                 self._fn, self._in, self._out, self._traceable = model, None, None, False
                 return
             raise FrameworkError(f"no custom-easy model registered as {key!r}")
-        self._fn, self._in, self._out, self._traceable = entry
+        (self._fn, self._in, self._out, self._traceable,
+         self._param_bytes) = entry
 
     def get_model_info(self):
         return self._in, self._out
@@ -87,3 +98,9 @@ class CustomEasyFramework(Framework):
             return None
         fn = self._fn
         return lambda arrays: tuple(fn(list(arrays)))
+
+    def param_bytes(self) -> int:
+        # declared at registration; abstract_invoke inherits the base
+        # eval_shape-over-pure_fn path (non-traceable models return None
+        # there, so the deep pass never executes host-only callables)
+        return self._param_bytes

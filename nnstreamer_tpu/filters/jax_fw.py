@@ -164,6 +164,44 @@ class JaxFramework(Framework):
 
         return fn
 
+    # -- abstract execution (nns-lint --deep) -------------------------------
+    def abstract_invoke(self, in_sds):
+        """eval_shape through ``apply_fn`` with the params ALSO abstracted
+        (``jax.ShapeDtypeStruct`` per leaf): the trace sees only shapes, so
+        even a multi-GiB checkpoint costs nothing here and a bundle whose
+        params were never materialized (lazy loaders) still traces.  The
+        sharding constraint is skipped — it is shape-preserving and needs a
+        live mesh."""
+        if self.bundle is None:
+            return None
+        import jax
+
+        apply_fn = self.bundle.apply_fn
+        p_sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+            self.bundle.params)
+
+        def run(p, xs):
+            out = apply_fn(p, *xs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        out = jax.eval_shape(run, p_sds, tuple(in_sds))
+        return list(out)
+
+    def param_bytes(self) -> int:
+        if self.bundle is None:
+            return 0
+        import jax
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.bundle.params):
+            nb = getattr(leaf, "nbytes", None)
+            if nb is None and hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                nb = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            total += int(nb or 0)
+        return total
+
 
 def _accel_list(props) -> List[str]:
     from .base import parse_accelerator

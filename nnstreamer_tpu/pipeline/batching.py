@@ -53,6 +53,20 @@ def bucket_for(n: int, buckets: Optional[Sequence[int]] = None) -> int:
     return n
 
 
+def ladder(batch_max: int, buckets: Optional[Sequence[int]] = None
+           ) -> Tuple[int, ...]:
+    """Every bucket size a runner with this ``batch_max`` can ever dispatch
+    (ascending).  Mirrors the runner exactly: ``batch_max`` above the top
+    bucket is CLAMPED to it (runtime._Runner caps the drain at the ladder
+    top precisely so recompiles stay bounded), so the set never contains a
+    size the runtime cannot produce.  This is the compiled-signature
+    ladder the deep analyzer multiplies out for its recompile census and
+    HBM high-water estimate — one compiled program per entry, per stage."""
+    bs = tuple(sorted(set(buckets))) if buckets else DEFAULT_BUCKETS
+    top = bucket_for(min(max(1, batch_max), bs[-1]), bs)
+    return tuple(b for b in bs if b <= top)
+
+
 def shard_bucket_for(n: int, replicas: int,
                      buckets: Optional[Sequence[int]] = None) -> int:
     """Bucket for a batch sharded over ``replicas``: the ladder bucket,

@@ -264,6 +264,22 @@ class FusedSourceElement(SourceElement):
         return self.source.finalize() + self.fused.finalize()
 
 
+def replication_plan(data_parallel: int, batch_max: int,
+                     n_devices: int) -> int:
+    """Resolve the configured ``data_parallel`` knob to the ``data``-axis
+    replica count a pipeline would actually run with — the ONE place the
+    0=auto / 1=off / N=exact semantics live, shared by the runtime's mesh
+    builder and the deep analyzer's static HBM/recompile budgeting.
+    ``n_devices`` is the local device count (the caller queries it so this
+    stays importable without initializing a backend).  Returns 1 whenever
+    sharding would be skipped (batch_max=1, dp=1, or a 1-wide mesh); the
+    dp > n_devices startup error is the caller's to raise/report."""
+    if batch_max <= 1 or data_parallel == 1:
+        return 1
+    dp = data_parallel or n_devices
+    return max(1, dp)
+
+
 def _element_batchable(el: Element) -> bool:
     """Can this stage's runner drain micro-batches?  Sources have no input
     queue; batch_capable() must not veto planning by raising (a framework

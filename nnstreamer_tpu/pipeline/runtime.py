@@ -431,6 +431,11 @@ class Pipeline:
     graph before anything is instantiated and raises
     :class:`~nnstreamer_tpu.analysis.PipelineLintError` carrying EVERY
     error at once, instead of the runtime's one-failure-per-start loop.
+    ``validate="deep"`` additionally abstractly executes every device
+    stage (``jax.eval_shape`` — zero dispatch) so shape/dtype contract
+    violations and tracing failures raise HERE too, with this pipeline's
+    own batch/sharding knobs feeding the static HBM/recompile budgets
+    (docs/ANALYSIS.md "Deep pass").
     """
 
     def __init__(
@@ -444,7 +449,7 @@ class Pipeline:
         batch_linger_ms: Optional[float] = None,
         data_parallel: Optional[int] = None,
         dispatch_depth: Optional[int] = None,
-        validate: bool = False,
+        validate: Union[bool, str] = False,
     ):
         if validate:
             # Lint BEFORE strict validation: the analyzer reports every
@@ -453,15 +458,22 @@ class Pipeline:
             # on to graph.validate() below.
             from ..analysis import analyze
 
+            deep = validate == "deep"
+            kw = dict(queue_capacity=queue_capacity, deep=deep)
+            if deep:
+                # the deep pass budgets with THIS pipeline's knobs, not
+                # just the global config defaults
+                kw.update(batch_max=batch_max, batch_buckets=batch_buckets,
+                          data_parallel=data_parallel,
+                          dispatch_depth=dispatch_depth)
             if isinstance(graph, str):
                 source = graph
                 graph = parse_launch(graph, validate=False)
-                report = analyze(graph, queue_capacity=queue_capacity)
+                report = analyze(graph, **kw)
                 report.source = source
                 report.raise_if_errors()
             else:
-                analyze(graph,
-                        queue_capacity=queue_capacity).raise_if_errors()
+                analyze(graph, **kw).raise_if_errors()
         if isinstance(graph, str):
             graph = parse_launch(graph)
         graph.validate()
@@ -625,8 +637,10 @@ class Pipeline:
             return None
         import jax
 
+        from .plan import replication_plan
+
         devs = jax.devices()
-        dp = self.data_parallel or len(devs)
+        dp = replication_plan(self.data_parallel, self.batch_max, len(devs))
         if dp > len(devs):
             raise PipelineError(
                 f"data_parallel={dp} needs {dp} local devices, "

@@ -4,6 +4,7 @@
     python -m nnstreamer_tpu.tools.lint --strict "<pipeline>"     # warnings fail too
     python -m nnstreamer_tpu.tools.lint --dogfood                 # lint OUR device_fns
     python -m nnstreamer_tpu.tools.lint --examples                # lint examples/ + e2e strings
+    python -m nnstreamer_tpu.tools.lint --deep "<pipeline>"       # + abstract execution
 
 Exit codes: 0 clean/ok, 1 errors (or warnings with --strict), 2 usage.
 
@@ -11,7 +12,11 @@ Reference analog: gst-launch's parse-only mode plus nnstreamer's strict
 pipeline parser — but whole-graph: every caps incompatibility, topology
 hazard, and jit-purity violation is reported in ONE run with element-path
 locations and source carets.  Runs with ``JAX_PLATFORMS=cpu`` and performs
-no device dispatch: the analyzer never executes JAX.
+no device dispatch: the syntactic passes never execute JAX, and ``--deep``
+(abstract shape execution + static HBM/recompile budgeting, see
+docs/ANALYSIS.md "Deep pass") only ever traces with ``jax.eval_shape`` —
+it also prints the per-pipeline resource report, and with ``--dogfood``
+abstract-traces the bundled zoo model families.
 """
 
 from __future__ import annotations
@@ -26,12 +31,14 @@ from typing import Dict, List, Optional, Tuple
 def _render(desc: str, report, *, verbose: bool) -> None:
     if report.clean:
         print(f"OK: {desc!r}")
-        return
-    print(f"LINT: {desc!r}")
-    print(report.render())
+    else:
+        print(f"LINT: {desc!r}")
+        print(report.render())
+    if getattr(report, "resources", None) is not None:
+        print(report.resources.render())
 
 
-def extract_pipeline_strings(path: str) -> Tuple[List[str], int]:
+def extract_pipeline_strings(path: str) -> Tuple[List[str], List[Tuple[int, str]]]:
     """Pipeline strings passed to ``Pipeline(...)`` / ``parse_launch(...)``
     in a Python source file, resolved WITHOUT importing it (examples run
     pipelines at import time).
@@ -39,11 +46,13 @@ def extract_pipeline_strings(path: str) -> Tuple[List[str], int]:
     f-string placeholders are resolved from module-level constant
     assignments (``SIZE = 224``) and function-call defaults where
     possible; calls whose first argument cannot be resolved statically are
-    counted in the second return value so callers can report coverage
-    instead of silently skipping.
+    returned in the second list as ``(lineno, source snippet)`` so callers
+    can report each un-lintable call BY NAME instead of silently skipping
+    (the CI gate baselines them: a new unresolvable call fails).
     """
     with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
+        source = f.read()
+    tree = ast.parse(source, filename=path)
 
     consts: Dict[str, object] = {}
     for stmt in ast.walk(tree):  # any scope; first literal binding wins
@@ -88,7 +97,7 @@ def extract_pipeline_strings(path: str) -> Tuple[List[str], int]:
         return None
 
     found: List[str] = []
-    skipped = 0
+    skipped: List[Tuple[int, str]] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -99,7 +108,12 @@ def extract_pipeline_strings(path: str) -> Tuple[List[str], int]:
             continue
         got = resolve(node.args[0])
         if got is None:
-            skipped += 1
+            snippet = (ast.get_source_segment(source, node.args[0])
+                       or f"{name}(...)")
+            snippet = " ".join(snippet.split())
+            if len(snippet) > 80:
+                snippet = snippet[:77] + "..."
+            skipped.append((node.lineno, snippet))
         else:
             found.append(got)
     return found, skipped
@@ -120,21 +134,56 @@ def _diag_key(prefix: str, d, desc: Optional[str] = None) -> str:
     return f"{prefix}:{h}{d.code}:{d.path}"
 
 
+def _unresolved_keys(fname: str, skipped: List[Tuple[int, str]]
+                     ) -> List[str]:
+    """Stable baseline keys for un-lintable ``Pipeline(...)`` calls: file +
+    a hash of the (whitespace-normalized) argument source, so the key
+    survives unrelated line drift; identical snippets in one file get an
+    occurrence index.  No line numbers — those churn with every edit."""
+    import hashlib
+
+    seen: Dict[str, int] = {}
+    keys = []
+    for _, snippet in skipped:
+        n = seen.get(snippet, 0)
+        seen[snippet] = n + 1
+        h = hashlib.sha1(f"{snippet}#{n}".encode()).hexdigest()[:8]
+        keys.append(f"{fname}:unresolvable-pipeline:{h}")
+    return keys
+
+
 def lint_files(paths: List[str], *, strict: bool, verbose: bool,
                baseline: Optional[set] = None,
-               collected: Optional[List[str]] = None) -> int:
+               collected: Optional[List[str]] = None,
+               deep: bool = False) -> int:
     from ..analysis import analyze
 
     rc = 0
     total = skipped_total = accepted = 0
     for path in paths:
+        fname = os.path.basename(path)
         strings, skipped = extract_pipeline_strings(path)
-        skipped_total += skipped
+        skipped_total += len(skipped)
+        # Un-lintable calls are named findings, not a silent count: each
+        # becomes a warning keyed into the baseline, so a NEW example the
+        # analyzer cannot see fails the strict CI gate instead of
+        # shrinking coverage.
+        ukeys = _unresolved_keys(fname, skipped)
+        if collected is not None:
+            collected.extend(ukeys)
+        for (lineno, snippet), k in zip(skipped, ukeys):
+            is_new = baseline is None or k not in baseline
+            accepted += 1 if (baseline is not None and k in baseline) else 0
+            if strict and is_new:
+                rc = 1
+            if verbose or (strict and is_new):
+                print(f"warning[unresolvable-pipeline] {fname}:{lineno}: "
+                      f"Pipeline argument not statically resolvable: "
+                      f"{snippet}")
         for desc in strings:
             total += 1
-            report = analyze(desc)
-            keys = [_diag_key(os.path.basename(path), d, desc)
-                    for d in report]
+            report = analyze(desc, deep=deep)
+            keys = [_diag_key(fname, d, desc) for d in report]
             if collected is not None:
                 collected.extend(keys)
             fails = [
@@ -145,8 +194,10 @@ def lint_files(paths: List[str], *, strict: bool, verbose: bool,
             accepted += sum(
                 1 for k in keys if baseline is not None and k in baseline)
             if fails or verbose:
-                print(f"-- {os.path.basename(path)}")
+                print(f"-- {fname}")
                 _render(desc, report, verbose=verbose)
+            elif deep and getattr(report, "resources", None) is not None:
+                print(f"-- {fname}: deep: {report.resources.summary()}")
             if fails:
                 rc = 1
     print(f"linted {total} pipeline string(s) from {len(paths)} file(s)"
@@ -158,10 +209,14 @@ def lint_files(paths: List[str], *, strict: bool, verbose: bool,
 
 
 def dogfood(*, strict: bool, baseline: Optional[set] = None,
-            collected: Optional[List[str]] = None) -> int:
+            collected: Optional[List[str]] = None, deep: bool = False) -> int:
     """Lint the framework's OWN device_fns (every built-in plugin module):
     a host side effect sneaking into a shipped element's pure fn fails CI
-    before it silently knocks that element off the fused-XLA path."""
+    before it silently knocks that element off the fused-XLA path.  With
+    ``deep``, additionally abstract-trace the bundled zoo model families
+    (mobilenet/ssd/posenet/yolo/...) against their declared I/O specs via
+    ``jax.eval_shape`` — a model whose apply_fn drifts from its declared
+    out_spec fails here, statically, with zero dispatch."""
     import importlib
 
     from ..analysis.purity import lint_module
@@ -175,6 +230,15 @@ def dogfood(*, strict: bool, baseline: Optional[set] = None,
             continue
         diags.extend(lint_module(mod))
     keys = [_diag_key("dogfood", d) for d in diags]
+    zoo_note = ""
+    if deep:
+        from ..analysis.tracecheck import trace_zoo_models
+
+        zdiags, traced, skipped = trace_zoo_models()
+        diags.extend(zdiags)
+        keys.extend(_diag_key("deep-zoo", d) for d in zdiags)
+        zoo_note = (f", {traced} zoo model(s) abstract-traced"
+                    + (f" ({skipped} skipped)" if skipped else ""))
     if collected is not None:
         collected.extend(keys)
     fails = [
@@ -186,7 +250,7 @@ def dogfood(*, strict: bool, baseline: Optional[set] = None,
         print(d)
     n_err = sum(1 for d in diags if d.severity == "error")
     n_warn = len(diags) - n_err
-    print(f"dogfood: {len(_BUILTIN_MODULES)} modules, "
+    print(f"dogfood: {len(_BUILTIN_MODULES)} modules{zoo_note}, "
           f"{n_err} error(s), {n_warn} warning(s), {len(fails)} new")
     return 1 if fails else 0
 
@@ -207,6 +271,11 @@ def main(argv=None) -> int:
                     help="lint examples/ and tests/test_pipeline_e2e.py")
     ap.add_argument("--dogfood", action="store_true",
                     help="lint nnstreamer_tpu's own device_fns")
+    ap.add_argument("--deep", action="store_true",
+                    help="also abstractly execute every device stage "
+                         "(jax.eval_shape: shape/dtype contract checks + "
+                         "static HBM/recompile budgets; imports jax, zero "
+                         "dispatch)")
     ap.add_argument("--baseline", metavar="FILE",
                     help="accepted-diagnostics file: only NEW diagnostics "
                          "fail (one key per line, '#' comments)")
@@ -236,7 +305,7 @@ def main(argv=None) -> int:
         from ..analysis import analyze
 
         for desc in args.pipeline:
-            report = analyze(desc)
+            report = analyze(desc, deep=args.deep)
             _render(desc, report, verbose=args.verbose)
             if report.errors or (args.strict and report.warnings):
                 rc = 1
@@ -256,11 +325,11 @@ def main(argv=None) -> int:
     if files:
         rc = max(rc, lint_files(files, strict=args.strict,
                                 verbose=args.verbose, baseline=baseline,
-                                collected=collected))
+                                collected=collected, deep=args.deep))
 
     if args.dogfood:
         rc = max(rc, dogfood(strict=args.strict, baseline=baseline,
-                             collected=collected))
+                             collected=collected, deep=args.deep))
 
     if args.update_baseline:
         if not args.baseline:

@@ -46,18 +46,68 @@ def trace(logdir: str):
             jax.profiler.stop_trace()
 
 
-_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def _prom_name(name: str) -> str:
     return _NAME_RE.sub("_", name)
 
 
+#: HELP/TYPE metadata for the batching/sharding series (docs/BATCHING.md)
+#: so Prometheus scrapes are well-formed self-describing exposition, keyed
+#: by the raw series suffix the runtime emits per stage.
+_SERIES_META = {
+    "batch_occupancy": ("buffers drained per micro-batch dispatch "
+                        "(distribution)", "gauge"),
+    "batch_pad_waste": ("pad rows appended to reach the bucket size",
+                        "counter"),
+    "shard_rows": ("rows placed on each mesh device by sharded dispatches",
+                   "counter"),
+    "shard_dispatch": ("sharded micro-batch dispatches", "counter"),
+    "param_replications": ("one-time stage parameter replications onto "
+                           "the mesh", "counter"),
+}
+
+
+def _series_meta(raw: str):
+    """(help, type) when ``raw`` belongs to a documented batching/sharding
+    series (including derived ``.p50``/``.mean`` quantile samples and
+    per-device ``.dN`` placement counters), else None."""
+    for key, (help_, typ) in _SERIES_META.items():
+        if raw.endswith("." + key) or f".{key}." in raw or raw == key \
+                or raw.startswith(key + "."):
+            if raw.endswith((".p50", ".p99", ".mean", ".n")):
+                return help_, "gauge"  # derived summary samples
+            return help_, typ
+    return None
+
+
 def metrics_text() -> str:
-    """Render the global metrics registry in Prometheus text format."""
+    """Render the global metrics registry in Prometheus text format.
+
+    Sanitized names that COLLIDE (``a.b:c`` and ``a.b/c`` both sanitize to
+    ``a_b_c``) are disambiguated deterministically: every colliding raw
+    name gets a short hash of itself appended, so no sample silently
+    shadows another and the same registry always renders the same text.
+    Batching/sharding series carry ``# HELP``/``# TYPE`` headers.
+    """
+    import hashlib
+
+    snap = metrics.snapshot()
+    by_prom: dict = {}
+    for raw in snap:
+        by_prom.setdefault(_prom_name(raw), []).append(raw)
     lines = []
-    for name, value in sorted(metrics.snapshot().items()):
-        lines.append(f"nnstpu_{_prom_name(name)} {value:.9g}")
+    for prom in sorted(by_prom):
+        raws = sorted(by_prom[prom])
+        for raw in raws:
+            name = prom if len(raws) == 1 else \
+                f"{prom}_{hashlib.sha1(raw.encode()).hexdigest()[:6]}"
+            meta = _series_meta(raw)
+            if meta is not None:
+                lines.append(f"# HELP nnstpu_{name} {meta[0]}")
+                lines.append(f"# TYPE nnstpu_{name} {meta[1]}")
+            lines.append(f"nnstpu_{name} {snap[raw]:.9g}")
     return "\n".join(lines) + "\n"
 
 

@@ -29,6 +29,12 @@ class Watchdog:
         self._timer: Optional[threading.Timer] = None
         self._lock = threading.Lock()
         self._fired = False
+        # Arming generation: a pending _fire that lost the race against a
+        # feed/disarm/re-arm (Timer.cancel cannot stop a callback that has
+        # already STARTED and is blocked on our lock) sees a stale
+        # generation and returns — it must neither fire with an expired
+        # deadline nor double-fire after a re-arm.
+        self._gen = 0
 
     def arm(self) -> "Watchdog":
         with self._lock:
@@ -39,26 +45,41 @@ class Watchdog:
     def _schedule_locked(self) -> None:
         if self._timer is not None:
             self._timer.cancel()
-        self._timer = threading.Timer(self.timeout, self._fire)
+        self._gen += 1
+        self._timer = threading.Timer(self.timeout, self._fire,
+                                      args=(self._gen,))
         self._timer.daemon = True
         self._timer.start()
 
-    def _fire(self) -> None:
+    def _fire(self, gen: int) -> None:
         with self._lock:
-            if self._fired or self._timer is None:
+            if self._fired or self._timer is None or gen != self._gen:
                 return
             self._fired = True
         self.on_timeout()
 
     def feed(self) -> None:
-        """Reset the countdown (call from the watched loop)."""
+        """Reset the countdown (call from the watched loop).
+
+        A documented NO-OP on a watchdog that is disarmed or has already
+        FIRED: the timeout callback ran (or is running), and feeding must
+        neither resurrect the countdown nor re-fire it — the watched
+        operation was already declared hung, and racing a feed against the
+        in-flight ``on_timeout`` would otherwise re-arm a timer nobody
+        owns.  Re-arm explicitly with :meth:`arm` to reuse the watchdog.
+        """
         with self._lock:
-            if self._timer is None:
+            if self._timer is None or self._fired:
                 return
             self._schedule_locked()
 
     def disarm(self) -> None:
+        """Stop the countdown.  Safe to call at ANY point relative to the
+        timer — including after ``_fire`` has started (the callback either
+        completed already or sees the bumped generation and returns): never
+        raises, never lets a second fire through."""
         with self._lock:
+            self._gen += 1
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None

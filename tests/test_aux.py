@@ -43,6 +43,63 @@ class TestWatchdog:
         assert count == [1]
         assert wd.fired
 
+    def test_disarm_during_fire_no_raise_no_double_fire(self):
+        """The arm→fire→disarm race: disarm landing after ``_fire`` has
+        STARTED (callback in flight, Timer.cancel can no longer stop it)
+        must neither raise nor let a second fire through."""
+        started = threading.Event()
+        release = threading.Event()
+        count = []
+
+        def on_timeout():
+            count.append(1)
+            started.set()
+            release.wait(2.0)
+
+        wd = Watchdog(0.02, on_timeout).arm()
+        assert started.wait(1.0)
+        wd.disarm()  # callback still running: must be a clean no-op
+        release.set()
+        time.sleep(0.1)
+        assert count == [1]
+        assert wd.fired
+
+    def test_feed_after_fire_is_noop(self):
+        """feed() on a fired watchdog is a documented no-op: it must not
+        resurrect the countdown or re-fire (re-arm explicitly instead)."""
+        count = []
+        wd = Watchdog(0.02, lambda: count.append(1)).arm()
+        time.sleep(0.1)
+        assert count == [1]
+        wd.feed()  # fired: no-op
+        time.sleep(0.1)
+        assert count == [1]
+        wd.disarm()
+        wd.feed()  # disarmed: also a no-op
+        time.sleep(0.1)
+        assert count == [1]
+
+    def test_stale_fire_cannot_outrun_feed_or_rearm(self):
+        """A timer callback that already expired but lost the lock race to
+        feed()/disarm()/arm() carries a stale generation and must not fire.
+        Driven directly (no sleep races): _fire with a stale gen is exactly
+        the thread Timer.cancel() could not stop."""
+        count = []
+        wd = Watchdog(60.0, lambda: count.append(1)).arm()
+        stale = wd._gen
+        wd.feed()  # bumps the generation; the old timer is now stale
+        wd._fire(stale)
+        assert count == [] and not wd.fired
+        wd._fire(wd._gen)  # the CURRENT generation does fire
+        assert count == [1] and wd.fired
+        wd.disarm()
+        # re-arm: a leftover callback from before the disarm stays dead
+        old = wd._gen
+        wd.arm()
+        wd._fire(old)
+        assert count == [1]
+        wd.disarm()
+
     def test_trainer_watchdog_times_out_hung_subplugin(self):
         from nnstreamer_tpu.core.registry import register_trainer
         from nnstreamer_tpu.trainer.subplugin import TrainerSubplugin
@@ -80,6 +137,38 @@ class TestMetricsEndpoint:
         metrics.count("aux.test.frames", 3)
         text = metrics_text()
         assert "nnstpu_aux_test_frames 3" in text
+
+    def test_colliding_sanitized_names_disambiguated(self):
+        """Two raw names that sanitize identically must BOTH render, under
+        distinct deterministic names — one sample silently shadowing the
+        other corrupts the scrape."""
+        metrics.count("aux.col:x", 3)
+        metrics.count("aux.col/x", 5)
+        first = metrics_text()
+        again = metrics_text()
+        lines = [ln for ln in first.splitlines()
+                 if ln.startswith("nnstpu_aux_col_x") and " " in ln]
+        assert len(lines) == 2, first
+        names = {ln.split()[0] for ln in lines}
+        assert len(names) == 2
+        assert {ln.split()[1] for ln in lines} == {"3", "5"}
+        # deterministic: same registry, same rendering
+        assert first == again
+
+    def test_batching_series_carry_help_and_type(self):
+        metrics.count("mystage.batch_pad_waste", 4)
+        metrics.count("mystage.shard_rows.d0", 8)
+        metrics.observe("mystage.batch_occupancy", 6.0)
+        text = metrics_text()
+        assert "# HELP nnstpu_mystage_batch_pad_waste" in text
+        assert "# TYPE nnstpu_mystage_batch_pad_waste counter" in text
+        assert "# TYPE nnstpu_mystage_shard_rows_d0 counter" in text
+        # derived quantile samples of a distribution are gauges
+        assert "# TYPE nnstpu_mystage_batch_occupancy_p50 gauge" in text
+        # TYPE must precede its sample line (well-formed exposition)
+        lines = text.splitlines()
+        t = lines.index("# TYPE nnstpu_mystage_batch_pad_waste counter")
+        assert lines[t + 1].startswith("nnstpu_mystage_batch_pad_waste 4")
 
     def test_http_metrics(self):
         metrics.count("aux.http.hits", 7)

@@ -1,0 +1,517 @@
+"""Deep analyzer tests: abstract shape execution + static HBM/recompile
+budgeting (nns-lint --deep, docs/ANALYSIS.md "Deep pass").
+
+Model-family stand-ins (mobilenet / ssd / posenet / llama-decode, in the
+models/testmodels.py spirit) are registered as custom-easy and zoo models,
+each with a seeded BAD twin whose traced output contradicts its declared
+spec — the deep pass must catch every one statically, with element-path +
+caret diagnostics and ZERO device dispatch (instrumented below).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.analysis import PipelineLintError, analyze
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+from nnstreamer_tpu.models.zoo import ModelBundle, register_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(dims, dtype="float32"):
+    return TensorsSpec.from_string(dims, dtype)
+
+
+def _ce(name, fn, in_dims, out_dims, in_dtype="float32",
+        out_dtype="float32", n_out=1, param_bytes=0):
+    outs = ",".join([out_dims] if isinstance(out_dims, str) else out_dims)
+    types = ",".join([out_dtype] * (len(outs.split(","))
+                                    if isinstance(out_dims, str) else n_out))
+    register_custom_easy(
+        name, fn,
+        in_spec=TensorsSpec.from_string(in_dims, in_dtype),
+        out_spec=TensorsSpec.from_string(outs, types),
+        jax_traceable=True, param_bytes=param_bytes)
+
+
+# -- model-family stand-ins (good) ------------------------------------------
+
+_W_NET = np.zeros((32 * 32 * 3, 1001), np.float32)
+
+
+def _mobilenet_like(ins):
+    import jax.numpy as jnp
+
+    x = ins[0].astype(jnp.float32)
+    return [jnp.dot(x.reshape((1, -1)), _W_NET)]
+
+
+def _ssd_like(ins):
+    import jax.numpy as jnp
+
+    x = ins[0].astype(jnp.float32)
+    m = jnp.mean(x)
+    return [jnp.zeros((1, 100, 4), jnp.float32) + m,
+            jnp.zeros((1, 100), jnp.float32) + m]
+
+
+def _posenet_like(ins):
+    import jax.numpy as jnp
+
+    return [jnp.zeros((1, 9, 9, 17), jnp.float32) + jnp.mean(ins[0])]
+
+
+_W_VOCAB = np.zeros((256, 128), np.float32)
+
+
+def _llama_decode_like(ins):
+    import jax.numpy as jnp
+
+    tok = ins[0].reshape((-1,))
+    return [jnp.asarray(_W_VOCAB)[tok]]  # (1, 128) logits
+
+
+_ce("deeptest_mobilenet", _mobilenet_like, "3:32:32:1", "1001:1",
+    param_bytes=_W_NET.nbytes)
+_ce("deeptest_ssd", _ssd_like, "3:32:32:1", "4:100:1,100:1")
+_ce("deeptest_posenet", _posenet_like, "3:32:32:1", "17:9:9:1")
+_ce("deeptest_llama", _llama_decode_like, "1:1", "128:1",
+    in_dtype="int32", param_bytes=_W_VOCAB.nbytes)
+
+
+# -- seeded bad twins: declared spec contradicts the traced output ----------
+
+def _bad_shape(ins):  # declares 1001:1, traces (1, 3072)
+    import jax.numpy as jnp
+
+    return [ins[0].reshape((1, -1))]
+
+
+def _bad_dtype(ins):  # declares float32, traces bool
+    return [ins[0] > 0]
+
+
+def _bad_arity(ins):  # declares ONE output, traces two
+    return [ins[0], ins[0]]
+
+
+def _bad_promote(ins):  # declares int32, + 0.5 silently promotes to float32
+    return [ins[0] + 0.5]
+
+
+def _bad_rank(ins):  # declares 3:32:32:1, mean drops the spatial rank
+    import jax.numpy as jnp
+
+    return [jnp.mean(ins[0], axis=(1, 2))]
+
+
+def _bad_datadep(ins):  # data-dependent output shape: untraceable
+    import jax.numpy as jnp
+
+    return [jnp.nonzero(ins[0])[0]]
+
+
+def _bad_hostsync(ins):  # float() on a traced value: ConcretizationTypeError
+    return [ins[0] * float(ins[0].sum())]
+
+
+_ce("deeptest_bad_shape", _bad_shape, "3:32:32:1", "1001:1")
+_ce("deeptest_bad_dtype", _bad_dtype, "3:32:32:1", "3:32:32:1")
+_ce("deeptest_bad_arity", _bad_arity, "3:32:32:1", "3:32:32:1")
+_ce("deeptest_bad_promote", _bad_promote, "4:4", "4:4",
+    in_dtype="int32", out_dtype="int32")
+_ce("deeptest_bad_rank", _bad_rank, "3:32:32:1", "3:32:32:1")
+_ce("deeptest_bad_datadep", _bad_datadep, "4:4", "16")
+_ce("deeptest_bad_hostsync", _bad_hostsync, "4:4", "4:4")
+
+
+@register_model("deeptest_zoo_net")
+def _zoo_net(opts):
+    w = np.zeros((32 * 32 * 3, 1001), np.float32)
+
+    def apply_fn(params, x):
+        import jax.numpy as jnp
+
+        return jnp.dot(x.astype(jnp.float32).reshape((1, -1)), params["w"])
+
+    return ModelBundle(apply_fn=apply_fn, params={"w": w},
+                       in_spec=_spec("3:32:32:1"), out_spec=_spec("1001:1"),
+                       name="deeptest_zoo_net")
+
+
+@register_model("deeptest_zoo_badnet")
+def _zoo_badnet(opts):
+    w = np.zeros((32 * 32 * 3, 1000), np.float32)  # 1000 != declared 1001
+
+    def apply_fn(params, x):
+        import jax.numpy as jnp
+
+        return jnp.dot(x.astype(jnp.float32).reshape((1, -1)), params["w"])
+
+    return ModelBundle(apply_fn=apply_fn, params={"w": w},
+                       in_spec=_spec("3:32:32:1"), out_spec=_spec("1001:1"),
+                       name="deeptest_zoo_badnet")
+
+
+def _pipe(model, dims="3:32:32:1", dtype="float32", fw="custom-easy",
+          extra=""):
+    return (f"appsrc caps=other/tensors,dimensions={dims},types={dtype} ! "
+            f"tensor_filter framework={fw} model={model}{extra} ! "
+            "tensor_sink")
+
+
+def codes(report):
+    return set(report.codes())
+
+
+# ---------------------------------------------------------------------------
+# golden bad pipelines: every seeded fixture caught, with path + caret
+# ---------------------------------------------------------------------------
+
+BAD_DEEP_PIPELINES = [
+    (_pipe("deeptest_bad_shape"), "trace-shape-mismatch"),
+    (_pipe("deeptest_bad_dtype"), "trace-shape-mismatch"),
+    (_pipe("deeptest_bad_arity"), "trace-shape-mismatch"),
+    (_pipe("deeptest_bad_promote", dims="4:4", dtype="int32"),
+     "trace-shape-mismatch"),
+    (_pipe("deeptest_bad_rank"), "trace-shape-mismatch"),
+    (_pipe("deeptest_bad_datadep", dims="4:4"), "trace-error"),
+    (_pipe("deeptest_bad_hostsync", dims="4:4"), "trace-error"),
+    (_pipe("deeptest_zoo_badnet", fw="jax"), "trace-shape-mismatch"),
+    # family twins wired through a WRONG declared filter output: the
+    # element-level props override the registry spec, so the traced model
+    # output contradicts what capsflow propagated downstream
+    (_pipe("deeptest_ssd", extra=" output=4:100:1,10:1 "
+           "outputtype=float32,float32"), "trace-shape-mismatch"),
+    (_pipe("deeptest_posenet", extra=" output=17:17:9:1"),
+     "trace-shape-mismatch"),
+    (_pipe("deeptest_llama", dims="1:1", dtype="int32",
+           extra=" output=64:1"), "trace-shape-mismatch"),
+]
+
+
+@pytest.mark.parametrize("desc,code", BAD_DEEP_PIPELINES,
+                         ids=[f"{c}-{i}" for i, (_, c)
+                              in enumerate(BAD_DEEP_PIPELINES)])
+def test_seeded_fixture_caught_with_path_and_caret(desc, code):
+    report = analyze(desc, deep=True)
+    assert code in codes(report), report.render()
+    diag = next(d for d in report if d.code == code)
+    assert diag.severity == "error"
+    assert diag.path, str(diag)
+    assert diag.pos is not None, str(diag)
+    assert "^" in report.render(), report.render()  # source caret
+
+
+@pytest.mark.parametrize("model", [
+    "deeptest_mobilenet", "deeptest_ssd", "deeptest_posenet",
+])
+def test_good_families_trace_clean(model):
+    report = analyze(_pipe(model), deep=True)
+    assert report.ok, report.render()
+    assert report.resources is not None
+    assert len(report.resources.stages) == 1
+
+
+def test_llama_decode_standin_traces_clean():
+    report = analyze(_pipe("deeptest_llama", dims="1:1", dtype="int32"),
+                     deep=True)
+    assert report.ok, report.render()
+
+
+def test_zoo_jax_framework_traces_with_abstract_params():
+    report = analyze(_pipe("deeptest_zoo_net", fw="jax"), deep=True)
+    assert report.ok, report.render()
+    # params are accounted (the jax fw sums its bundle leaves)
+    st = report.resources.stages[0]
+    assert st.param_bytes == 32 * 32 * 3 * 1001 * 4
+
+
+def test_shallow_analyze_has_no_resources_and_misses_trace_bugs():
+    """deep=False keeps the jax-free fast path: the same bad pipeline
+    passes the syntactic passes (the declared specs are consistent)."""
+    report = analyze(_pipe("deeptest_bad_shape"))
+    assert report.resources is None
+    assert "trace-shape-mismatch" not in codes(report)
+
+
+# ---------------------------------------------------------------------------
+# static resource report: HBM high-water + recompile census + budgets
+# ---------------------------------------------------------------------------
+
+def test_resource_report_multiplies_bucket_ladder():
+    report = analyze(_pipe("deeptest_mobilenet"), deep=True,
+                     batch_max=8, data_parallel=1, dispatch_depth=2)
+    res = report.resources
+    assert res.ladder == (1, 2, 4, 8)
+    st = res.stages[0]
+    assert st.batchable and not st.sharded
+    assert st.variants == 4  # one compiled program per bucket
+    assert st.rows_per_device == 8 * 2  # top bucket x dispatch window
+    assert st.param_bytes == _W_NET.nbytes
+    row = st.act_row_bytes
+    assert row == (32 * 32 * 3) * 4 + 1001 * 4  # in + traced out, float32
+    assert res.hbm_estimate == st.param_bytes + row * 16
+
+
+def test_resource_report_sharded_rounds_buckets_to_replicas():
+    report = analyze(_pipe("deeptest_mobilenet"), deep=True,
+                     batch_max=8, data_parallel=4, dispatch_depth=1)
+    st = report.resources.stages[0]
+    assert st.sharded
+    # ladder {1,2,4,8} rounds to replica multiples {4,8}: 2 programs,
+    # top bucket 8 / 4 replicas = 2 rows resident per device
+    assert st.variants == 2
+    assert st.rows_per_device == 2
+
+
+def test_unsorted_buckets_census_matches_runtime():
+    """BatchRunner sorts its bucket ladder; the census must normalize the
+    same way or an unsorted [8,2,4] collapses every entry to the first
+    listed bucket >= n and under-counts compiled signatures."""
+    want = analyze(_pipe("deeptest_mobilenet"), deep=True, batch_max=8,
+                   batch_buckets=[2, 4, 8], data_parallel=4).resources
+    got = analyze(_pipe("deeptest_mobilenet"), deep=True, batch_max=8,
+                  batch_buckets=[8, 2, 4], data_parallel=4).resources
+    assert got.ladder == want.ladder == (2, 4, 8)
+    assert got.stages[0].variants == want.stages[0].variants
+    assert got.stages[0].rows_per_device == want.stages[0].rows_per_device
+
+
+def test_hbm_budget_warning_anchors_dominant_stage():
+    report = analyze(_pipe("deeptest_mobilenet"), deep=True, batch_max=64,
+                     data_parallel=1, hbm_budget_bytes=1 << 20)
+    diag = next(d for d in report if d.code == "hbm-budget")
+    assert diag.severity == "warning"
+    assert diag.path and diag.pos is not None
+    assert "^" in report.render()
+    assert "budget" in diag.message and "MiB" in diag.message
+
+
+def test_recompile_budget_warning():
+    report = analyze(_pipe("deeptest_mobilenet"), deep=True, batch_max=256,
+                     data_parallel=1, max_compiled_variants=3)
+    diag = next(d for d in report if d.code == "recompile-budget")
+    assert diag.severity == "warning"
+    assert diag.path and diag.pos is not None
+
+
+def test_budgets_off_by_default():
+    report = analyze(_pipe("deeptest_mobilenet"), deep=True, batch_max=256)
+    assert "hbm-budget" not in codes(report)
+    assert "recompile-budget" not in codes(report)
+
+
+def test_invoke_dynamic_flagged_recompile_unbounded():
+    report = analyze(_pipe("deeptest_mobilenet",
+                           extra=" invoke-dynamic=true"), deep=True)
+    diag = next(d for d in report if d.code == "recompile-unbounded")
+    assert diag.severity == "warning"
+    assert diag.pos is not None
+
+
+def test_example_pipeline_gets_resource_report():
+    """The e2e-style image pipeline from the examples: the deep pass must
+    produce a populated resource report (the acceptance bar)."""
+    desc = ("videotestsrc num-buffers=8 width=224 height=224 device=true ! "
+            "tensor_converter ! tensor_transform mode=arithmetic "
+            "option=typecast:float32,div:127.5,add:-1.0 ! "
+            "tensor_filter framework=jax model=mobilenet_v1 "
+            "custom=dtype:float32 ! tensor_sink name=out")
+    report = analyze(desc, deep=True, batch_max=4, data_parallel=1)
+    assert report.ok, report.render()
+    res = report.resources
+    assert res is not None and len(res.stages) >= 1
+    assert res.hbm_estimate > 0
+    assert res.compiled_variants >= 1
+    assert "deep resource report" in res.render()
+    assert "est HBM high-water" in res.summary()
+
+
+def test_fused_chain_merges_into_one_stage():
+    desc = ("appsrc caps=other/tensors,dimensions=3:32:32:1,types=float32 ! "
+            "tensor_transform mode=arithmetic option=div:2.0 ! "
+            "tensor_filter framework=custom-easy model=deeptest_mobilenet ! "
+            "tensor_sink")
+    report = analyze(desc, deep=True, batch_max=4, data_parallel=1)
+    assert report.ok, report.render()
+    (st,) = report.resources.stages
+    assert "+" in st.label  # transform + filter fused, ONE program set
+    assert st.variants == 3  # ladder (1,2,4), not 2 stages x 3
+
+
+# ---------------------------------------------------------------------------
+# zero device dispatch (the acceptance bar: instrumented, not assumed)
+# ---------------------------------------------------------------------------
+
+def test_deep_pass_performs_zero_device_dispatch(monkeypatch):
+    """Every jit-compiled call and device_put is trapped: the deep pass
+    must complete (diagnostics, resource report, budgets) without ONE
+    device dispatch — eval_shape traces, it never executes."""
+    import jax
+
+    real_jit = jax.jit
+
+    def guarded_jit(*a, **k):
+        real_jit(*a, **k)  # building the wrapper is legal (no dispatch)
+
+        def trap(*aa, **kk):
+            raise AssertionError("jit-compiled call during deep analysis")
+
+        return trap
+
+    def no_device_put(*a, **k):
+        raise AssertionError("device_put during deep analysis")
+
+    monkeypatch.setattr(jax, "jit", guarded_jit)
+    monkeypatch.setattr(jax, "device_put", no_device_put)
+
+    good = analyze(_pipe("deeptest_zoo_net", fw="jax"), deep=True,
+                   batch_max=8, data_parallel=1, hbm_budget_bytes=1)
+    assert "analyzer-error" not in codes(good), good.render()
+    assert good.resources is not None
+    assert "hbm-budget" in codes(good)
+    bad = analyze(_pipe("deeptest_bad_shape"), deep=True)
+    assert "trace-shape-mismatch" in codes(bad)
+
+    from nnstreamer_tpu.analysis.tracecheck import trace_zoo_models
+
+    diags, traced, _ = trace_zoo_models(
+        names=("passthrough", "scaler", "average"))
+    assert traced == 3
+    assert [str(d) for d in diags] == []
+
+
+def test_zoo_dogfood_families_trace_clean():
+    """The CI deep-dogfood list: the real bundled model families
+    (mobilenet/ssd/posenet at least) eval_shape-trace clean against their
+    declared specs."""
+    from nnstreamer_tpu.analysis.tracecheck import trace_zoo_models
+
+    diags, traced, skipped = trace_zoo_models(
+        names=("mobilenet_v1", "ssd_mobilenet", "posenet"))
+    assert traced == 3 and skipped == 0
+    assert [str(d) for d in diags] == []
+
+
+# ---------------------------------------------------------------------------
+# entry points: validate="deep", CLI --deep
+# ---------------------------------------------------------------------------
+
+def test_pipeline_validate_deep_raises_trace_errors():
+    with pytest.raises(PipelineLintError) as ei:
+        nt.Pipeline(_pipe("deeptest_bad_shape"), validate="deep")
+    assert "trace-shape-mismatch" in ei.value.report.codes()
+
+
+def test_pipeline_validate_deep_passes_clean_and_runs():
+    p = nt.Pipeline(
+        "appsrc name=src caps=other/tensors,dimensions=4:4,types=float32 ! "
+        "tensor_filter framework=jax model=scaler "
+        "custom=scale:2.0,dims:4:4 ! tensor_sink name=out",
+        validate="deep")
+    with p:
+        p.push("src", [np.ones((4, 4), np.float32)])
+        p.eos()
+        buf = p.pull("out", timeout=10)
+        p.wait(timeout=10)
+    np.testing.assert_allclose(np.asarray(buf.tensors[0]),
+                               np.full((4, 4), 2.0, np.float32))
+
+
+def test_pipeline_validate_true_stays_shallow():
+    # bool validate must not pay the deep pass (nor catch trace bugs):
+    # exact PR2 semantics preserved
+    nt.Pipeline(_pipe("deeptest_bad_shape"), validate=True)
+
+
+def test_cli_deep_flag(capsys):
+    from nnstreamer_tpu.tools.lint import main
+
+    rc = main(["--deep", _pipe("deeptest_mobilenet")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "deep resource report" in out
+
+    rc = main(["--deep", _pipe("deeptest_bad_shape")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "trace-shape-mismatch" in out
+
+
+def test_cli_unresolved_calls_are_named_warnings(tmp_path, capsys):
+    """A Pipeline(...) call the linter cannot resolve statically is a
+    NAMED warning with a stable baseline key — strict mode fails on a new
+    one instead of silently shrinking coverage."""
+    f = tmp_path / "ex.py"
+    f.write_text("import nnstreamer_tpu as nt\n"
+                 "def go(d):\n"
+                 "    return nt.Pipeline(d + ' ! tensor_sink')\n")
+    from nnstreamer_tpu.tools.lint import main
+
+    rc = main(["--files", str(f), "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unresolvable-pipeline" in out and "ex.py:3" in out
+    # non-strict: counted but not failing
+    assert main(["--files", str(f)]) == 0
+
+
+def test_unresolved_keys_stable_across_line_drift(tmp_path):
+    from nnstreamer_tpu.tools.lint import (
+        _unresolved_keys, extract_pipeline_strings)
+
+    a = tmp_path / "a.py"
+    a.write_text("import nnstreamer_tpu as nt\nnt.Pipeline(desc)\n")
+    _, sk1 = extract_pipeline_strings(str(a))
+    a.write_text("import nnstreamer_tpu as nt\n\n\n# moved\n"
+                 "nt.Pipeline(desc)\n")
+    _, sk2 = extract_pipeline_strings(str(a))
+    assert sk1[0][0] != sk2[0][0]  # line moved...
+    assert _unresolved_keys("a.py", sk1) == _unresolved_keys("a.py", sk2)
+
+
+# ---------------------------------------------------------------------------
+# helper units: bucket ladder + replication plan
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder():
+    from nnstreamer_tpu.pipeline.batching import ladder
+
+    assert ladder(1) == (1,)
+    assert ladder(8) == (1, 2, 4, 8)
+    assert ladder(6) == (1, 2, 4, 8)  # bucket_for(6) tops the ladder
+    assert ladder(3, buckets=[2, 4]) == (2, 4)
+    # the runtime CLAMPS batch_max to the ladder top — the census must
+    # never model a dispatch size the runner cannot produce
+    assert ladder(500) == (1, 2, 4, 8, 16, 32, 64, 128, 256)
+    assert ladder(9, buckets=[2, 4]) == (2, 4)
+
+
+def test_data_parallel_over_local_devices_is_an_error():
+    """An explicit data_parallel the host cannot supply fails at start()
+    with PipelineError — the deep pass surfaces it statically (the whole
+    point of static analysis), anchored at the shard-eligible stage."""
+    report = analyze(_pipe("deeptest_mobilenet"), deep=True,
+                     batch_max=8, data_parallel=64)
+    diag = next(d for d in report if d.code == "data-parallel-devices")
+    assert diag.severity == "error"
+    assert diag.path and diag.pos is not None
+    # auto (0) can never over-ask; dp=1 never builds a mesh
+    for dp in (0, 1):
+        ok = analyze(_pipe("deeptest_mobilenet"), deep=True,
+                     batch_max=8, data_parallel=dp)
+        assert "data-parallel-devices" not in codes(ok)
+
+
+def test_replication_plan_matches_runtime_semantics():
+    from nnstreamer_tpu.pipeline.plan import replication_plan
+
+    assert replication_plan(0, 1, 8) == 1      # batching off: no mesh
+    assert replication_plan(1, 8, 8) == 1      # explicit single-device
+    assert replication_plan(0, 8, 8) == 8      # auto: all local devices
+    assert replication_plan(4, 8, 8) == 4      # exact

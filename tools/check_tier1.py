@@ -14,7 +14,16 @@ The gate ALSO runs nns-lint (see docs/ANALYSIS.md) over every pipeline
 string in examples/ + tests/test_pipeline_e2e.py and over the framework's
 own device_fns (the jit-purity dogfood), in strict mode against
 tools/lint_baseline.txt: any diagnostic not already accepted in the
-baseline fails the gate.  ``--update`` refreshes the baseline too.
+baseline fails the gate — including ``unresolvable-pipeline`` warnings,
+so a new example the linter cannot see statically fails CI instead of
+silently shrinking coverage.  ``--update`` refreshes the baseline too.
+
+AND it runs the DEEP pass (``lint --deep --dogfood --examples``, see
+docs/ANALYSIS.md "Deep pass") against tools/deep_baseline.txt, pinned to
+``JAX_PLATFORMS=cpu``: every example/e2e pipeline string is abstractly
+executed (shape/dtype contract checks + static HBM/recompile budgets)
+and the bundled zoo model families are eval_shape-traced against their
+declared specs — zero device dispatch, every run.
 
 AND it runs tests/test_sharded_batching.py as its OWN pytest process with
 ``--xla_force_host_platform_device_count=8`` pinned in XLA_FLAGS: the
@@ -34,6 +43,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLOOR_FILE = os.path.join(REPO, "tools", "tier1_floor.txt")
 LINT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
+DEEP_BASELINE = os.path.join(REPO, "tools", "deep_baseline.txt")
 
 #: the ROADMAP "Tier-1 verify" pytest invocation, verbatim
 PYTEST_ARGS = [
@@ -78,6 +88,33 @@ def run_lint_gate(update: bool) -> int:
     return proc.returncode
 
 
+def run_deep_gate(update: bool, timeout: int = 600) -> int:
+    """The deep-analysis gate: abstract shape execution + static
+    HBM/recompile budgeting over every example/e2e pipeline string plus
+    the zoo-model dogfood, strict against tools/deep_baseline.txt.  Its
+    own subprocess with JAX_PLATFORMS=cpu pinned: the deep pass imports
+    jax (the syntactic lint gate stays jax-free) but never dispatches."""
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--deep", "--examples", "--dogfood", "--strict",
+           "--baseline", DEEP_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"deep gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    tag = "updated" if update else ("OK" if proc.returncode == 0
+                                    else "NEW DIAGNOSTICS")
+    print(f"deep gate: {tag}")
+    if proc.returncode != 0:
+        for line in (proc.stdout + proc.stderr).strip().splitlines():
+            print(f"  {line}", file=sys.stderr)
+    return proc.returncode
+
+
 def run_sharded_gate(timeout: int = 600) -> int:
     """tests/test_sharded_batching.py in its own process, with the forced
     8-host-device XLA flag pinned (see module docstring)."""
@@ -115,8 +152,9 @@ def main() -> int:
     args = ap.parse_args()
 
     lint_rc = run_lint_gate(args.update)
+    deep_rc = run_deep_gate(args.update)
     sharded_rc = run_sharded_gate()
-    lint_rc = lint_rc or sharded_rc
+    lint_rc = lint_rc or deep_rc or sharded_rc
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
