@@ -28,6 +28,8 @@ _ENV_DATA_PARALLEL = "NNS_TPU_DATA_PARALLEL"
 _ENV_DISPATCH_DEPTH = "NNS_TPU_DISPATCH_DEPTH"
 _ENV_HBM_BUDGET = "NNS_TPU_HBM_BUDGET"
 _ENV_MAX_VARIANTS = "NNS_TPU_MAX_COMPILED_VARIANTS"
+_ENV_TRACE = "NNS_TPU_TRACE"
+_ENV_TRACE_RING = "NNS_TPU_TRACE_RING"
 
 
 @dataclasses.dataclass
@@ -73,6 +75,14 @@ class Config:
     #: signatures (buckets x spec variants across device stages) before
     #: the deep pass warns of a recompile storm (0 = no budget)
     max_compiled_variants: int = 0
+    #: flight-recorder trace mode (utils/tracing.py, docs/OBSERVABILITY.md):
+    #: ``off`` = no recorder installed (hot paths pay one pointer check),
+    #: ``ring`` = always-on bounded ring of span events (post-mortem mode;
+    #: watchdog fires / pipeline errors dump the recent window),
+    #: ``full`` = unbounded capture for short profiling runs
+    trace_mode: str = "off"
+    #: span capacity of the ``ring`` trace mode
+    trace_ring_capacity: int = 65536
     #: emit per-stage latency measurements
     enable_latency: bool = True
     #: free-form per-framework options ([filter-jax] section of the ini)
@@ -113,6 +123,12 @@ class Config:
             if ini.has_option("common", "max_compiled_variants"):
                 cfg.max_compiled_variants = ini.getint(
                     "common", "max_compiled_variants")
+            if ini.has_option("common", "trace_mode"):
+                cfg.trace_mode = ini.get("common",
+                                         "trace_mode").strip().lower()
+            if ini.has_option("common", "trace_ring_capacity"):
+                cfg.trace_ring_capacity = ini.getint(
+                    "common", "trace_ring_capacity")
             for sec in ini.sections():
                 if sec.startswith("filter-"):
                     cfg.framework_options[sec[len("filter-"):]] = dict(ini.items(sec))
@@ -130,6 +146,10 @@ class Config:
             cfg.hbm_budget_bytes = int(os.environ[_ENV_HBM_BUDGET])
         if os.environ.get(_ENV_MAX_VARIANTS):
             cfg.max_compiled_variants = int(os.environ[_ENV_MAX_VARIANTS])
+        if os.environ.get(_ENV_TRACE):
+            cfg.trace_mode = os.environ[_ENV_TRACE].strip().lower()
+        if os.environ.get(_ENV_TRACE_RING):
+            cfg.trace_ring_capacity = int(os.environ[_ENV_TRACE_RING])
         if os.environ.get(_ENV_BUCKETING):
             cfg.shape_bucketing = os.environ[_ENV_BUCKETING].lower() in (
                 "1", "true", "yes", "on")

@@ -1,20 +1,40 @@
 """Structured logging (reference analog: nnstreamer_log.c nns_logi/logw/loge).
 
-Also hosts the lightweight metrics counter set promised by SURVEY.md §5.5:
+Also hosts the lightweight metrics registry promised by SURVEY.md §5.5:
 frames in/out, queue depths, bytes moved, per-stage latency percentiles are
 recorded in-process and dumped on demand — the reference had only GST debug
 categories plus tensor_filter's latency property.
+
+Three sample families (all rendered by ``utils/profiler.metrics_text`` in
+Prometheus text format, docs/OBSERVABILITY.md):
+
+* **counters** (:meth:`Metrics.count`) — monotonically increasing totals;
+* **gauges** (:meth:`Metrics.gauge`) — set-not-add instantaneous values
+  (queue depths, staleness watermarks — fed by the runtime's sampler);
+* **distributions** (:meth:`Metrics.observe` /
+  :meth:`Metrics.observe_latency`) — a BOUNDED per-series reservoir
+  (decimating at ``_lat_cap`` samples, so a hot stage can never grow
+  process memory without limit) from which quantiles derive, and — for
+  ``observe_latency`` series — a cumulative fixed-bucket **histogram**
+  (``LATENCY_BUCKETS``), the real ``_bucket``/``_sum``/``_count``
+  exposition Prometheus can aggregate across scrapes.
+
+Thread-safety discipline: every mutation and every raw-state copy happens
+under one lock, but derived work (sorting reservoirs for quantiles) runs
+on the COPY outside the lock — concurrent runner writes never stall
+behind a scrape's O(n log n).
 """
 
 from __future__ import annotations
 
+import bisect
 import collections
 import logging
 import math
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _FMT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
 _configured = False
@@ -29,56 +49,108 @@ def logger(name: str) -> logging.Logger:
     return logging.getLogger(name)
 
 
+#: histogram bucket upper bounds (seconds) for every observe_latency
+#: series: 100 µs .. 10 s log-ish spaced (explicit ``le`` labels in the
+#: Prometheus exposition; the final implicit bucket is +Inf)
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class Metrics:
-    """Process-wide counters + latency reservoirs, thread-safe."""
+    """Process-wide counters + gauges + latency reservoirs/histograms,
+    thread-safe (see module docstring for the lock discipline)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = collections.defaultdict(float)
+        self._gauges: Dict[str, float] = {}
         self._lat: Dict[str, List[float]] = collections.defaultdict(list)
+        #: per-series reservoir bound: at cap, every other sample is
+        #: dropped (decimation keeps a uniform-ish spread of the stream's
+        #: lifetime instead of only its head or tail)
         self._lat_cap = 4096
+        # name -> [bucket_counts(len(LATENCY_BUCKETS)+1 incl +Inf),
+        #          sum, count]
+        self._hist: Dict[str, list] = {}
 
     def count(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += value
 
-    def observe(self, name: str, value: float) -> None:
-        """Record one sample of a distribution (latency seconds, batch
-        occupancy, ...); snapshot() derives p50/p99/mean/n per series."""
+    def gauge(self, name: str, value: float) -> None:
+        """Set an instantaneous value (queue depth, staleness watermark)."""
         with self._lock:
-            r = self._lat[name]
-            if len(r) >= self._lat_cap:
-                # reservoir decimation: keep every other sample
-                del r[::2]
-            r.append(value)
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample of a distribution (batch occupancy, sizes,
+        ...); snapshot() derives p50/p99/mean/n per series.  The reservoir
+        is BOUNDED at ``_lat_cap`` (decimation), so a hot series costs
+        O(cap) memory for the process lifetime, not O(samples)."""
+        with self._lock:
+            self._observe_locked(name, value)
+
+    def _observe_locked(self, name: str, value: float) -> None:
+        r = self._lat[name]
+        if len(r) >= self._lat_cap:
+            # reservoir decimation: keep every other sample
+            del r[::2]
+        r.append(value)
 
     def observe_latency(self, name: str, seconds: float) -> None:
-        self.observe(name, seconds)
+        """observe() + cumulative fixed-bucket histogram update — the
+        series Prometheus can aggregate (``<name>_bucket{le=...}``)."""
+        i = bisect.bisect_left(LATENCY_BUCKETS, seconds)
+        with self._lock:
+            self._observe_locked(name, seconds)
+            h = self._hist.get(name)
+            if h is None:
+                h = self._hist[name] = [
+                    [0] * (len(LATENCY_BUCKETS) + 1), 0.0, 0]
+            h[0][i] += 1
+            h[1] += seconds
+            h[2] += 1
 
     def percentile(self, name: str, q: float) -> Optional[float]:
         with self._lock:
-            r = sorted(self._lat.get(name, ()))
+            r = list(self._lat.get(name, ()))
         if not r:
             return None
+        r.sort()  # on the copy — never under the lock
         idx = min(len(r) - 1, max(0, math.ceil(q / 100.0 * len(r)) - 1))
         return r[idx]
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
-            for name, r in self._lat.items():
-                if r:
-                    s = sorted(r)
-                    out[f"{name}.p50"] = s[len(s) // 2]
-                    out[f"{name}.p99"] = s[min(len(s) - 1, int(len(s) * 0.99))]
-                    out[f"{name}.mean"] = sum(s) / len(s)
-                    out[f"{name}.n"] = float(len(s))
+            out.update(self._gauges)
+            lat = {name: list(r) for name, r in self._lat.items() if r}
+        for name, s in lat.items():  # derived stats on copies, lock-free
+            s.sort()
+            out[f"{name}.p50"] = s[len(s) // 2]
+            out[f"{name}.p99"] = s[min(len(s) - 1, int(len(s) * 0.99))]
+            out[f"{name}.mean"] = sum(s) / len(s)
+            out[f"{name}.n"] = float(len(s))
         return out
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def histograms(self) -> Dict[str, Tuple[List[int], float, int]]:
+        """Copy of every latency histogram: name -> (per-bucket counts
+        incl. the final +Inf bucket, sum_seconds, count)."""
+        with self._lock:
+            return {name: (list(h[0]), h[1], h[2])
+                    for name, h in self._hist.items()}
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._lat.clear()
+            self._hist.clear()
 
 
 metrics = Metrics()

@@ -357,7 +357,8 @@ class TensorFilter(Element):
                             return self._batchable_fn(fw)
                     entry = (fw, BatchRunner(
                         fn, getattr(self, "_batch_buckets", None),
-                        name=self.name, mesh=mesh, prepare=prep))
+                        name=self.name, mesh=mesh, prepare=prep,
+                        tracer=getattr(self, "_trace_rec", None)))
                     self._batchers = {id(fw): entry}  # drop stale programs
                 rows = entry[1].run(
                     [tuple(self._select_inputs(b.tensors)) for b in bufs])

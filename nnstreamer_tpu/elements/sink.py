@@ -19,6 +19,7 @@ import numpy as np
 from ..core.buffer import Buffer
 from ..core.log import metrics
 from ..core.registry import register_element
+from ..utils.tracing import META_TRACE_ID
 from .base import SinkElement
 
 
@@ -177,6 +178,22 @@ class TensorSink(SinkElement):
         return out
 
     def _materialize(self, item, timeout: float) -> Buffer:
+        # set by this pipeline's runner iff ITS trace_mode != off
+        tracer = getattr(self, "_trace_rec", None)
+        if tracer is not None:
+            # host-fetch span: the D2H / deferred host_post cost the app's
+            # pop() pays (the last hop of the per-buffer timeline)
+            import time as _time
+
+            t0 = _time.monotonic_ns()
+            out = self._materialize_inner(item, timeout)
+            tracer.record("fetch", self.name,
+                          out.meta.get(META_TRACE_ID), t0,
+                          _time.monotonic_ns() - t0)
+            return out
+        return self._materialize_inner(item, timeout)
+
+    def _materialize_inner(self, item, timeout: float) -> Buffer:
         import concurrent.futures as _cf
 
         if isinstance(item, _cf.Future):  # background-resolved host buffer

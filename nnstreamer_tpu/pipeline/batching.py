@@ -92,9 +92,13 @@ class BatchRunner:
 
     def __init__(self, fn: Callable, buckets: Optional[Sequence[int]] = None,
                  name: Optional[str] = None, mesh=None,
-                 prepare: Optional[Callable] = None):
+                 prepare: Optional[Callable] = None, tracer=None):
         self.fn = fn
         self.buckets = tuple(sorted(set(buckets))) if buckets else None
+        self._name = name or "batch"
+        # the owning pipeline's flight recorder (None = that pipeline runs
+        # trace_mode=off, even if another pipeline enabled the global one)
+        self._tracer = tracer
         self._progs: Dict[int, Callable] = {}
         self._pad_metric = f"{name}.batch_pad_waste" if name else None
         self._shard_metric = f"{name}.shard_rows" if name else None
@@ -155,8 +159,10 @@ class BatchRunner:
         live inside it like the single-device path's does); split rows are
         lazy slices of the sharded outputs."""
         import jax
+        import time as _time
 
         n = len(rows)
+        t_trace0 = _time.monotonic_ns() if self._tracer is not None else 0
         if not self._prepared:
             # Param replication is once-per-runner, BEFORE the first
             # program builds: the jitted closure must capture the
@@ -204,6 +210,14 @@ class BatchRunner:
         import numpy as np
 
         host = [np.asarray(a) for a in outs]
+        if t_trace0:
+            # the sharded-dispatch window: stack+device_put+program+fetch
+            # as one span (per-row trace ids live one layer up, in the
+            # runner's batch span — this is the device-side cost bucket)
+            self._tracer.record("shard", self._name, None, t_trace0,
+                                _time.monotonic_ns() - t_trace0,
+                                rows=n, bucket=bucket,
+                                replicas=self.replicas)
         return [tuple(h[i] for h in host) for i in range(n)]
 
     @staticmethod

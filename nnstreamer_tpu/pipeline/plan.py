@@ -202,7 +202,8 @@ class FusedElement(Element):
             self._batcher = BatchRunner(
                 self._composed, getattr(self, "_batch_buckets", None),
                 name=self.name, mesh=mesh,
-                prepare=self._shard_prepare if mesh is not None else None)
+                prepare=self._shard_prepare if mesh is not None else None,
+                tracer=getattr(self, "_trace_rec", None))
         rows = self._batcher.run([tuple(b.tensors) for b in bufs])
         return [(SRC, self._finish(buf, row)) for buf, row in zip(bufs, rows)]
 

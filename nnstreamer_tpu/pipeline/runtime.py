@@ -33,6 +33,7 @@ from ..core.config import get_config
 from ..core.log import Timer, logger, metrics
 from ..core.registry import KIND_ELEMENT, get as registry_get
 from ..elements.base import Element, SinkElement, SourceElement, SRC
+from ..utils import tracing
 from .graph import PipelineGraph
 from .parser import parse as parse_launch
 from .plan import Stage, plan_stages
@@ -173,16 +174,31 @@ class _Runner:
         # the downstream feed.  Emission order is the FIFO deque's.
         self.dispatch_depth = (max(1, pipeline.dispatch_depth)
                                if self.batch_max > 1 else 1)
-        self._inflight: Deque[Tuple[list, int]] = collections.deque()
+        self._inflight: Deque[Tuple[list, int, int]] = collections.deque()
         # Hot-path metric names built ONCE (the seed built f-strings per
         # buffer in _run_stream/_emit).
         name = self.element.name
+        self._nm = name
         self._m_in = f"{name}.in"
         self._m_out = f"{name}.out"
         self._m_dropped = f"{name}.dropped"
         self._m_proc = f"{name}.proc"
         self._m_push = f"{name}.push"
         self._m_occupancy = f"{name}.batch_occupancy"
+        self._m_qwait = f"{name}.queue_wait"
+        self._m_e2e = f"{name}.e2e_latency"
+        # Flight recorder (docs/OBSERVABILITY.md): None when trace_mode is
+        # off — every instrumentation site below reduces to one pointer
+        # check, and no meta stamps are written (the untraced code path).
+        self._tr = tracing.recorder if pipeline.trace_mode != "off" else None
+        # Attached to the ELEMENT the same way _batch_buckets is, so the
+        # sink's fetch span and the lazy BatchRunner's shard span follow
+        # THIS pipeline's trace_mode, not whatever another pipeline in the
+        # process switched the global recorder to.
+        self.element._trace_rec = self._tr
+        self._is_sink = isinstance(self.element, SinkElement)
+        self._last_sink_ns = 0  # sampler reads: staleness watermark
+        self._max_pts = None  # watermark_pts gauge is a high-water mark
 
     # -- wiring ------------------------------------------------------------
     def connect(self, out_pad: str, port: _Port) -> None:
@@ -192,6 +208,12 @@ class _Runner:
     def feed(self, pad: str, item: Union[Buffer, Event]) -> None:
         """Blocking put (backpressure point); sheds the item when the
         pipeline is stopping."""
+        if self._tr is not None and isinstance(item, Buffer):
+            # queue-wait span start (popped by the consuming runner).  A
+            # tee'd buffer shares one meta dict across branches, so the
+            # stamp reflects the LAST feed — per-branch waits of shared
+            # buffers are approximate by design (documented).
+            item.meta[tracing.META_ENQUEUE_NS] = time.monotonic_ns()
         self.queue.put((pad, item))
 
     def _emit(self, outs: List[Tuple[str, Union[Buffer, Event]]]) -> None:
@@ -242,9 +264,24 @@ class _Runner:
 
     def _run_source(self) -> None:
         el = self.element
+        tr = self._tr
         for item in el.generate():
             if self.pipeline._stopping.is_set():
                 break
+            if tr is not None:
+                buf = item[1] if isinstance(item, tuple) else item
+                if isinstance(buf, Buffer):
+                    # INGRESS: the per-buffer trace id is born here and
+                    # rides Buffer.meta through every derived buffer
+                    # downstream (with_tensors copies meta; the runner
+                    # back-fills fresh Buffers — see _propagate_trace).
+                    tid = buf.meta.get(tracing.META_TRACE_ID)
+                    if tid is None:
+                        tid = tracing.next_trace_id()
+                        buf.meta[tracing.META_TRACE_ID] = tid
+                    t = time.monotonic_ns()
+                    buf.meta[tracing.META_INGRESS_NS] = t
+                    tr.record("ingress", self._nm, tid, t, 0, pts=buf.pts)
             with Timer(self._m_push):
                 self._emit([(SRC, item)] if not isinstance(item, tuple) else [item])
             metrics.count(self._m_out)
@@ -283,9 +320,84 @@ class _Runner:
         return batch, None
 
     def _emit_oldest_inflight(self) -> None:
-        outs, n = self._inflight.popleft()
+        outs, n, t_disp = self._inflight.popleft()
+        if self._tr is not None and t_disp:
+            tid = next((o.meta.get(tracing.META_TRACE_ID)
+                        for _, o in outs if isinstance(o, Buffer)), None)
+            self._tr.record("inflight", self._nm, tid, t_disp,
+                            time.monotonic_ns() - t_disp, rows=n)
         self._emit(outs)
         metrics.count(self._m_out, n)
+
+    # -- tracing helpers ---------------------------------------------------
+    def _propagate_trace(self, ins: List[Buffer], outs) -> None:
+        """Back-fill trace meta onto output buffers an element built from
+        scratch (with_tensors already copies meta).  Row-aligned when the
+        element emitted one output per input (the batch contract);
+        otherwise every output inherits the first input's identity
+        (fan-out: tee/demux branches share the frame's trace id)."""
+        if not outs:
+            return
+        aligned = len(outs) == len(ins)
+        for i, (_, o) in enumerate(outs):
+            if not isinstance(o, Buffer):
+                continue
+            src = ins[i] if aligned else ins[0]
+            if tracing.META_TRACE_ID not in o.meta:
+                o.meta[tracing.META_TRACE_ID] = \
+                    src.meta.get(tracing.META_TRACE_ID)
+            if (tracing.META_INGRESS_NS not in o.meta
+                    and tracing.META_INGRESS_NS in src.meta):
+                o.meta[tracing.META_INGRESS_NS] = \
+                    src.meta[tracing.META_INGRESS_NS]
+
+    def _trace_queue_wait(self, buf: Buffer, end_ns: int) -> Optional[int]:
+        """Record the queue-wait span for one consumed buffer; returns its
+        trace id.  Pops the enqueue stamp so a re-queued buffer (tee'd
+        branch) never double-counts."""
+        tid = buf.meta.get(tracing.META_TRACE_ID)
+        tq = buf.meta.pop(tracing.META_ENQUEUE_NS, None)
+        if tq is not None and end_ns >= tq:
+            self._tr.record("queue", self._nm, tid, tq, end_ns - tq)
+            metrics.observe_latency(self._m_qwait, (end_ns - tq) / 1e9)
+        return tid
+
+    def _trace_sink_delivery(self, buf: Buffer, end_ns: int) -> None:
+        """End-to-end span + staleness/watermark state at sink delivery."""
+        self._last_sink_ns = end_ns
+        if buf.pts is not None and (self._max_pts is None
+                                    or buf.pts > self._max_pts):
+            # high-water mark, matching the exposed HELP text: mux/tee
+            # fan-in can deliver pts out of order
+            self._max_pts = buf.pts
+            metrics.gauge(f"{self._nm}.watermark_pts", float(buf.pts))
+        ts0 = buf.meta.get(tracing.META_INGRESS_NS)
+        if ts0 is not None and end_ns >= ts0:
+            metrics.observe_latency(self._m_e2e, (end_ns - ts0) / 1e9)
+            self._tr.record("e2e", self._nm,
+                            buf.meta.get(tracing.META_TRACE_ID),
+                            ts0, end_ns - ts0)
+
+    def _trace_batch(self, batch: List[Buffer], outs, tdr0: int,
+                     dt: float) -> None:
+        """Spans for one micro-batch: per-member queue waits, the batch
+        formation window (first buffer in hand -> dispatch), and the
+        dispatch span LINKING every member row's trace id — so the
+        amortized device time (``per_row_ns``) is attributable per row
+        even though XLA saw one program call."""
+        tr = self._tr
+        tids = [self._trace_queue_wait(b, tdr0) for b in batch]
+        n = len(batch)
+        dur = int(dt * 1e9)
+        disp0 = time.monotonic_ns() - dur
+        if n > 1:
+            tr.record("batch", self._nm, tids[0], tdr0,
+                      max(0, disp0 - tdr0), trace_ids=tids, rows=n)
+            tr.record("stage", self._nm, tids[0], disp0, dur,
+                      trace_ids=tids, rows=n, per_row_ns=dur // n)
+        else:
+            tr.record("stage", self._nm, tids[0], disp0, dur)
+        self._propagate_trace(batch, outs)
 
     def _flush_inflight(self) -> None:
         while self._inflight:
@@ -343,7 +455,9 @@ class _Runner:
                 self._pending.setdefault(pad, []).append(item)
                 self._try_groups()
                 continue
+            tr = self._tr
             if batching:
+                tdr0 = time.monotonic_ns() if tr is not None else 0
                 batch, carry = self._drain_batch(pad, item)
                 n = len(batch)
                 metrics.count(self._m_in, n)
@@ -354,14 +468,19 @@ class _Runner:
                 # PER-BUFFER proc time: the .proc series must keep one
                 # meaning whether batching is on or off (same rule the
                 # filter applies to its .invoke series)
-                metrics.observe(self._m_proc, (time.perf_counter() - t0) / n)
+                dt = time.perf_counter() - t0
+                metrics.observe_latency(self._m_proc, dt / n)
+                if tr is not None:
+                    self._trace_batch(batch, outs, tdr0, dt)
                 if depth > 1:
                     # Software pipeline: XLA dispatch is async, so the
                     # runner loops back to drain the NEXT micro-batch
                     # while this one executes; emission (which may block
                     # on a full downstream queue) is deferred FIFO until
                     # the window fills.
-                    self._inflight.append((outs, n))
+                    self._inflight.append(
+                        (outs, n,
+                         time.monotonic_ns() if tr is not None else 0))
                     while len(self._inflight) >= depth:
                         self._emit_oldest_inflight()
                 else:
@@ -372,8 +491,21 @@ class _Runner:
                     return
                 continue
             metrics.count(self._m_in)
-            with Timer(self._m_proc):
+            if tr is None:
+                with Timer(self._m_proc):
+                    outs = el.process(pad, item)
+            else:
+                now0 = time.monotonic_ns()
+                tid = self._trace_queue_wait(item, now0)
+                t0 = time.perf_counter()
                 outs = el.process(pad, item)
+                dt = time.perf_counter() - t0
+                metrics.observe_latency(self._m_proc, dt)
+                dur = int(dt * 1e9)
+                tr.record("stage", self._nm, tid, now0, dur)
+                self._propagate_trace([item], outs)
+                if self._is_sink:
+                    self._trace_sink_delivery(item, now0 + dur)
             self._emit(outs)
             metrics.count(self._m_out)
 
@@ -401,8 +533,26 @@ class _Runner:
             if not all(self._pending.get(p) for p in self.in_pads):
                 return
             group = {p: self._pending[p].pop(0) for p in self.in_pads}
-            with Timer(self._m_proc):
+            tr = self._tr
+            if tr is None:
+                with Timer(self._m_proc):
+                    outs = el.process_group(group)
+            else:
+                members = list(group.values())
+                now0 = time.monotonic_ns()
+                tids = [self._trace_queue_wait(b, now0) for b in members]
+                t0 = time.perf_counter()
                 outs = el.process_group(group)
+                dt = time.perf_counter() - t0
+                metrics.observe_latency(self._m_proc, dt)
+                # collation span LINKS every contributing pad's trace id
+                # (the mux/collator fan-in analog of the batch linkage)
+                tr.record("stage", self._nm, tids[0], now0, int(dt * 1e9),
+                          trace_ids=tids)
+                self._propagate_trace([members[0]], outs)
+                if self._is_sink:
+                    self._trace_sink_delivery(
+                        members[0], now0 + int(dt * 1e9))
             self._emit(outs)
             metrics.count(self._m_out)
 
@@ -424,6 +574,11 @@ class Pipeline:
     shard-eligible stages see it), and ``dispatch_depth`` opens an
     in-flight window so a runner drains the next micro-batch while the
     previous one is still executing — see BATCHING.md "Sharded dispatch".
+    ``trace_mode`` (``off``/``ring``/``full``) switches on the per-buffer
+    flight recorder: span events for every stage/queue/batch/dispatch
+    keyed by trace ids assigned at source ingress, dumped with
+    :meth:`dump_trace` as Perfetto-loadable Chrome trace JSON and to the
+    log on watchdog fires / stage errors — docs/OBSERVABILITY.md.
     Defaults come from :func:`get_config`.
 
     ``validate=True`` runs the full static analyzer (caps propagation,
@@ -449,6 +604,7 @@ class Pipeline:
         batch_linger_ms: Optional[float] = None,
         data_parallel: Optional[int] = None,
         dispatch_depth: Optional[int] = None,
+        trace_mode: Optional[str] = None,
         validate: Union[bool, str] = False,
     ):
         if validate:
@@ -499,6 +655,16 @@ class Pipeline:
         self.dispatch_depth = max(1, int(
             dispatch_depth if dispatch_depth is not None
             else cfg.dispatch_depth))
+        self.trace_mode = str(
+            trace_mode if trace_mode is not None else cfg.trace_mode)
+        if self.trace_mode not in ("off", "ring", "full"):
+            raise PipelineError(
+                f"trace_mode must be off|ring|full, got {self.trace_mode!r}")
+        if self.trace_mode != "off":
+            # the flight recorder is process-wide (like core.log.metrics);
+            # an off pipeline never touches it
+            tracing.recorder.configure(self.trace_mode,
+                                       cfg.trace_ring_capacity)
         self._stopping = threading.Event()
         self._errors: List[Tuple[str, BaseException]] = []
         self._err_lock = threading.Lock()
@@ -623,6 +789,13 @@ class Pipeline:
                     r.element._shard_mesh = mesh
         for r in {id(r): r for r in self._runners.values()}.values():
             r.thread.start()
+        if self.trace_mode != "off":
+            # queue-depth / backpressure / staleness gauges, sampled off
+            # the streaming threads (docs/OBSERVABILITY.md); daemon +
+            # stop-event bound, so teardown never waits on it
+            self._sampler = threading.Thread(
+                target=self._sample_loop, name="nns-sampler", daemon=True)
+            self._sampler.start()
         return self
 
     def _build_data_mesh(self):
@@ -688,6 +861,40 @@ class Pipeline:
     def _record_error(self, name: str, exc: BaseException) -> None:
         with self._err_lock:
             self._errors.append((name, exc))
+        # Post-mortem: every stall/crash report carries the recent span
+        # timeline when the flight recorder is on (no-op otherwise).
+        tracing.dump_recent_to_log(
+            log, reason=f"stage {name} failed: {exc!r}")
+
+    # -- observability -----------------------------------------------------
+    def sample_queues(self) -> None:
+        """One sampler tick: queue-depth / in-flight-window gauges per
+        stage, staleness watermark per sink (seconds since last delivery).
+        Public so apps can sample on their own cadence without the
+        tracer's thread."""
+        now = time.monotonic_ns()
+        for r in {id(r): r for r in self._runners.values()}.values():
+            metrics.gauge(f"{r._nm}.queue_depth", float(r.queue.qsize()))
+            if r.dispatch_depth > 1:
+                metrics.gauge(f"{r._nm}.inflight_window",
+                              float(len(r._inflight)))
+            if r._is_sink and r._last_sink_ns:
+                metrics.gauge(f"{r._nm}.staleness_s",
+                              (now - r._last_sink_ns) / 1e9)
+
+    def _sample_loop(self, period_s: float = 0.1) -> None:
+        while not self._stopping.wait(period_s):
+            try:
+                self.sample_queues()
+            except Exception:  # noqa: BLE001 - sampler must never die loud
+                log.exception("queue sampler tick failed")
+
+    def dump_trace(self, path: str) -> int:
+        """Write the flight recorder's current contents as Chrome
+        trace-event JSON (Perfetto / chrome://tracing); returns the span
+        count.  See docs/OBSERVABILITY.md and
+        ``python -m nnstreamer_tpu.tools.trace``."""
+        return tracing.dump_chrome(tracing.recorder.events(), path)
 
     def __enter__(self) -> "Pipeline":
         return self.start()

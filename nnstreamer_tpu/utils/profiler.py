@@ -6,12 +6,19 @@ equivalents:
 
 * :func:`trace` — context manager around ``jax.profiler`` producing an
   xplane trace viewable in TensorBoard/XProf (device timelines, HBM);
-* :func:`metrics_text` — the process metrics in Prometheus text format
-  (frames in/out, queue depths via gauges, per-stage latency quantiles,
-  and the adaptive micro-batching series: ``<stage>.batch_occupancy``
-  distributions and ``<stage>.batch_pad_waste`` counters — docs/BATCHING.md);
-* :func:`start_metrics_server` — a ``/metrics`` HTTP endpoint (SURVEY
-  §5.5 "a /metrics-style counter set").
+  the per-buffer flight recorder (``utils/tracing.py``, Chrome
+  trace-event JSON for Perfetto) covers the pipeline layer —
+  docs/OBSERVABILITY.md;
+* :func:`metrics_text` — the process metrics in Prometheus text format:
+  counters, sampler-fed gauges (queue depth, staleness watermark), REAL
+  cumulative histograms with explicit buckets for every
+  ``observe_latency`` series (stage latency, queue wait, end-to-end
+  pipeline latency), and the batching/sharding series
+  (``<stage>.batch_occupancy`` / ``<stage>.batch_pad_waste`` —
+  docs/BATCHING.md);
+* :func:`start_metrics_server` / :func:`stop_metrics_server` /
+  :func:`metrics_server` — a ``/metrics`` HTTP endpoint with clean
+  shutdown (SURVEY §5.5 "a /metrics-style counter set").
 """
 
 from __future__ import annotations
@@ -22,7 +29,7 @@ import re
 import threading
 from typing import Optional
 
-from ..core.log import logger, metrics
+from ..core.log import LATENCY_BUCKETS, logger, metrics
 
 log = logger(__name__)
 
@@ -53,9 +60,9 @@ def _prom_name(name: str) -> str:
     return _NAME_RE.sub("_", name)
 
 
-#: HELP/TYPE metadata for the batching/sharding series (docs/BATCHING.md)
-#: so Prometheus scrapes are well-formed self-describing exposition, keyed
-#: by the raw series suffix the runtime emits per stage.
+#: HELP/TYPE metadata keyed by the raw series suffix the runtime emits per
+#: stage, so Prometheus scrapes are well-formed self-describing exposition
+#: (docs/BATCHING.md, docs/OBSERVABILITY.md).
 _SERIES_META = {
     "batch_occupancy": ("buffers drained per micro-batch dispatch "
                         "(distribution)", "gauge"),
@@ -66,13 +73,32 @@ _SERIES_META = {
     "shard_dispatch": ("sharded micro-batch dispatches", "counter"),
     "param_replications": ("one-time stage parameter replications onto "
                            "the mesh", "counter"),
+    "queue_depth": ("stage input queue depth (sampler gauge)", "gauge"),
+    "inflight_window": ("dispatched-but-unemitted micro-batches held in "
+                        "the dispatch window (sampler gauge)", "gauge"),
+    "staleness_s": ("seconds since this sink last delivered a buffer "
+                    "(pipeline staleness watermark, sampler gauge)",
+                    "gauge"),
+    "watermark_pts": ("highest presentation timestamp delivered at this "
+                      "sink (ns)", "gauge"),
+}
+
+#: HELP text for histogram series, by raw-name suffix (fallback generic)
+_HIST_HELP = {
+    "proc": "per-buffer stage process latency, seconds (histogram)",
+    "invoke": "model invocation latency, seconds (histogram)",
+    "push": "source push latency, seconds (histogram)",
+    "queue_wait": "seconds a buffer waited in the stage input queue "
+                  "(histogram; trace_mode != off)",
+    "e2e_latency": "source-ingress-to-sink-delivery pipeline latency, "
+                   "seconds (histogram; trace_mode != off)",
 }
 
 
 def _series_meta(raw: str):
-    """(help, type) when ``raw`` belongs to a documented batching/sharding
-    series (including derived ``.p50``/``.mean`` quantile samples and
-    per-device ``.dN`` placement counters), else None."""
+    """(help, type) when ``raw`` belongs to a documented series (including
+    derived ``.p50``/``.mean`` quantile samples and per-device ``.dN``
+    placement counters), else None."""
     for key, (help_, typ) in _SERIES_META.items():
         if raw.endswith("." + key) or f".{key}." in raw or raw == key \
                 or raw.startswith(key + "."):
@@ -82,32 +108,85 @@ def _series_meta(raw: str):
     return None
 
 
+def _hist_help(raw: str) -> str:
+    for key, help_ in _HIST_HELP.items():
+        if raw.endswith("." + key) or raw == key:
+            return help_
+    return "latency seconds (histogram)"
+
+
+def _dedup_prom_names(raws) -> dict:
+    """raw -> exposition name: sanitized, with colliding sanitizations
+    (``a.b:c`` and ``a.b/c`` both -> ``a_b_c``) disambiguated by a short
+    deterministic hash of the raw name — the SAME rule for every sample
+    family, so no series silently shadows another and the same registry
+    always renders the same text."""
+    import hashlib
+
+    by_prom: dict = {}
+    for raw in raws:
+        by_prom.setdefault(_prom_name(raw), []).append(raw)
+    out = {}
+    for prom, group in by_prom.items():
+        for raw in group:
+            out[raw] = prom if len(group) == 1 else \
+                f"{prom}_{hashlib.sha1(raw.encode()).hexdigest()[:6]}"
+    return out
+
+
+def _render_histograms(lines: list) -> None:
+    """Cumulative ``_bucket``/``_sum``/``_count`` exposition for every
+    observe_latency series (real Prometheus histograms — aggregatable
+    across scrapes, unlike the point-in-time quantile gauges)."""
+    hists = metrics.histograms()
+    names = _dedup_prom_names(hists)
+    for raw in sorted(hists):
+        counts, total, n = hists[raw]
+        name = f"nnstpu_{names[raw]}"
+        lines.append(f"# HELP {name} {_hist_help(raw)}")
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for bound, c in zip(LATENCY_BUCKETS, counts):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
+        cum += counts[-1]
+        lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{name}_sum {total:.9g}")
+        lines.append(f"{name}_count {n}")
+
+
 def metrics_text() -> str:
     """Render the global metrics registry in Prometheus text format.
 
-    Sanitized names that COLLIDE (``a.b:c`` and ``a.b/c`` both sanitize to
-    ``a_b_c``) are disambiguated deterministically: every colliding raw
-    name gets a short hash of itself appended, so no sample silently
-    shadows another and the same registry always renders the same text.
-    Batching/sharding series carry ``# HELP``/``# TYPE`` headers.
+    Histograms first (``observe_latency`` series), then gauges, then
+    counters + derived quantile samples.  Sanitized names that COLLIDE
+    (``a.b:c`` and ``a.b/c`` both sanitize to ``a_b_c``) are
+    disambiguated deterministically: every colliding raw name gets a
+    short hash of itself appended, so no sample silently shadows another
+    and the same registry always renders the same text (scraping twice
+    yields identical series names).
     """
-    import hashlib
-
+    lines: list = []
+    _render_histograms(lines)
+    gauges = metrics.gauges()
+    gnames = _dedup_prom_names(gauges)
+    for raw in sorted(gauges):
+        name = f"nnstpu_{gnames[raw]}"
+        meta = _series_meta(raw)
+        lines.append(f"# HELP {name} "
+                     f"{meta[0] if meta else 'instantaneous gauge'}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {gauges[raw]:.9g}")
     snap = metrics.snapshot()
-    by_prom: dict = {}
-    for raw in snap:
-        by_prom.setdefault(_prom_name(raw), []).append(raw)
-    lines = []
-    for prom in sorted(by_prom):
-        raws = sorted(by_prom[prom])
-        for raw in raws:
-            name = prom if len(raws) == 1 else \
-                f"{prom}_{hashlib.sha1(raw.encode()).hexdigest()[:6]}"
-            meta = _series_meta(raw)
-            if meta is not None:
-                lines.append(f"# HELP nnstpu_{name} {meta[0]}")
-                lines.append(f"# TYPE nnstpu_{name} {meta[1]}")
-            lines.append(f"nnstpu_{name} {snap[raw]:.9g}")
+    counters = [raw for raw in snap if raw not in gauges]
+    cnames = _dedup_prom_names(counters)
+    for raw in sorted(counters):
+        name = cnames[raw]
+        meta = _series_meta(raw)
+        if meta is not None:
+            lines.append(f"# HELP nnstpu_{name} {meta[0]}")
+            lines.append(f"# TYPE nnstpu_{name} {meta[1]}")
+        lines.append(f"nnstpu_{name} {snap[raw]:.9g}")
     return "\n".join(lines) + "\n"
 
 
@@ -128,10 +207,44 @@ class _MetricsHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
+class _MetricsServer(http.server.ThreadingHTTPServer):
+    # SO_REUSEADDR: a restart must rebind the port without waiting out
+    # TIME_WAIT (http.server sets it too — pinned explicitly here so the
+    # contract survives a base-class change)
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 def start_metrics_server(port: int = 0, host: str = "127.0.0.1"):
     """Serve ``/metrics`` on a daemon thread; returns the HTTPServer (its
-    ``server_port`` reports the bound port; call ``shutdown()`` to stop)."""
-    srv = http.server.ThreadingHTTPServer((host, port), _MetricsHandler)
-    threading.Thread(target=srv.serve_forever, daemon=True,
-                     name=f"metrics:{srv.server_port}").start()
+    ``server_port`` reports the bound port).  Stop cleanly with
+    :func:`stop_metrics_server` (or use the :func:`metrics_server`
+    context manager)."""
+    srv = _MetricsServer((host, port), _MetricsHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name=f"metrics:{srv.server_port}")
+    srv._nns_thread = t  # joined by stop_metrics_server
+    t.start()
     return srv
+
+
+def stop_metrics_server(srv, timeout: float = 5.0) -> None:
+    """Shut the ``/metrics`` endpoint down and release its port: stops the
+    serve loop, joins the server thread, closes the listening socket.
+    Safe to call twice."""
+    srv.shutdown()
+    t = getattr(srv, "_nns_thread", None)
+    if t is not None and t.is_alive():
+        t.join(timeout=timeout)
+    srv.server_close()
+
+
+@contextlib.contextmanager
+def metrics_server(port: int = 0, host: str = "127.0.0.1"):
+    """``with metrics_server() as srv:`` — endpoint for the block's
+    lifetime, cleanly stopped on exit."""
+    srv = start_metrics_server(port, host)
+    try:
+        yield srv
+    finally:
+        stop_metrics_server(srv)

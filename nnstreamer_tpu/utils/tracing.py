@@ -1,0 +1,328 @@
+"""nns-trace: per-buffer flight recorder + stage span tracing.
+
+Reference analog (SURVEY §5.1): GStreamer tracers / gst-shark attribute
+latency per element by hooking pad-push probes.  The TPU build's analog is
+a process-wide **flight recorder**: a lock-cheap ring buffer of span
+events (stage enter/exit, queue wait, batch-formation linger, in-flight
+dispatch window, sharded dispatch, host fetch, end-to-end delivery) keyed
+by a per-buffer **trace id** assigned at source ingress and threaded
+through ``Buffer.meta`` — so "where did frame N spend its 40 ms?" has an
+answer even after the batching/sharding machinery amortized N's device
+time across a micro-batch.
+
+Three trace modes (``Config.trace_mode`` / ``Pipeline(trace_mode=...)``):
+
+* ``off``  — the default.  No recorder is installed: every hot-path hook
+  reduces to one ``is not None`` check, and no meta stamps are written.
+* ``ring`` — always-on flight recorder: the last ``trace_ring_capacity``
+  spans in a ``deque(maxlen=...)``.  Appends are GIL-atomic (no lock on
+  the hot path); eviction is oldest-first.  This is the post-mortem mode:
+  watchdog fires and ``Pipeline._record_error`` dump the recent window to
+  the log automatically.
+* ``full`` — unbounded event list for short profiling runs that must not
+  lose the head of the timeline.
+
+Exports: :func:`to_chrome` renders Chrome trace-event JSON (one track per
+stage, flow arrows binding batch dispatch spans to every member row's
+trace id) loadable in Perfetto / ``chrome://tracing`` alongside the
+``utils.profiler.trace`` xplane; :func:`dump_recent_to_log` formats the
+last K seconds for crash reports; ``python -m nnstreamer_tpu.tools.trace``
+validates/summarizes dumps.  See docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+#: span taxonomy (docs/OBSERVABILITY.md) — kind -> meaning
+SPAN_KINDS: Dict[str, str] = {
+    "ingress": "trace id born at a source (instant; args carry pts)",
+    "queue": "buffer waited in a stage's input queue",
+    "batch": "batch formation: first buffer in hand -> dispatch start "
+             "(drain + linger)",
+    "stage": "element process()/process_batch()/process_group() execution"
+             " (batch spans LINK member trace ids; per_row_ns amortizes)",
+    "inflight": "dispatched-but-unemitted window (dispatch_depth > 1)",
+    "shard": "sharded bucketed dispatch incl. the assembled host fetch",
+    "fetch": "sink host materialization (D2H / deferred host_post)",
+    "e2e": "source ingress -> sink delivery for one buffer",
+}
+
+#: buffer-meta keys the tracer owns (stamped only when tracing is active)
+META_TRACE_ID = "_tid"
+META_INGRESS_NS = "_ts0"
+META_ENQUEUE_NS = "_tq"
+
+DEFAULT_RING_CAPACITY = 65536
+
+_trace_ids = itertools.count(1)
+
+
+def next_trace_id() -> int:
+    """Process-unique per-buffer trace id (assigned at source ingress)."""
+    return next(_trace_ids)
+
+
+class Span(NamedTuple):
+    """One recorded span.  ``ts``/``dur`` are ``time.monotonic_ns()``
+    values (dur 0 = instant event); ``tid`` is the buffer trace id (None
+    for spans not attributable to one buffer, e.g. sharded dispatches);
+    ``args`` is an optional dict of extras (``trace_ids`` on batch-linked
+    spans, ``rows``, ``per_row_ns``, ``pts``)."""
+
+    ts: int
+    dur: int
+    kind: str
+    stage: str
+    tid: Optional[int]
+    args: Optional[Dict[str, Any]]
+
+
+class FlightRecorder:
+    """Lock-cheap ring buffer of :class:`Span` events.
+
+    The hot path is :meth:`record` → ``deque.append`` — GIL-atomic, so
+    concurrent runner threads never contend on a lock, and a bounded
+    ``maxlen`` deque evicts oldest-first without allocation churn.  The
+    lock below guards only cold operations (configure/clear/snapshot
+    consistency of mode flips).  ``active`` is the single attribute every
+    instrumentation site checks; with mode ``off`` callers hold ``None``
+    instead of the recorder, so the off cost is one pointer test.
+    """
+
+    def __init__(self, mode: str = "off",
+                 capacity: int = DEFAULT_RING_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self.mode = "off"
+        self.capacity = capacity
+        self.active = False
+        if mode != "off":
+            self.configure(mode, capacity)
+
+    def configure(self, mode: str,
+                  capacity: Optional[int] = None) -> "FlightRecorder":
+        """Switch mode (off/ring/full).  ``ring`` bounds the buffer at
+        ``capacity`` spans; ``full`` is unbounded; ``off`` stops recording
+        but keeps already-captured events readable (post-mortem).
+
+        Re-configuring with the SAME bound keeps the live deque; changing
+        it rebuilds the deque (existing spans carried over), and a
+        concurrent lock-free ``record`` that already fetched the old
+        reference may land its span in the orphan — acceptable for a
+        flight recorder (reconfigure happens at pipeline construction,
+        not mid-stream, and loses at most the handful of spans in
+        flight), and the alternative is a lock on every hot-path append."""
+        if mode not in ("off", "ring", "full"):
+            raise ValueError(
+                f"trace_mode must be off|ring|full, got {mode!r}")
+        with self._lock:
+            cap = capacity or self.capacity or DEFAULT_RING_CAPACITY
+            if mode == "ring" and (self._ring.maxlen != cap):
+                self._ring = collections.deque(self._ring, maxlen=cap)
+            elif mode == "full" and self._ring.maxlen is not None:
+                self._ring = collections.deque(self._ring)
+            self.mode = mode
+            self.capacity = cap
+            self.active = mode != "off"
+        return self
+
+    # -- hot path ----------------------------------------------------------
+    def record(self, kind: str, stage: str, tid: Optional[int],
+               ts_ns: int, dur_ns: int, **args) -> None:
+        """Append one span.  No lock: deque.append is GIL-atomic and the
+        ring's maxlen does the eviction."""
+        self._ring.append(
+            Span(ts_ns, dur_ns, kind, stage, tid, args or None))
+
+    # -- cold path ---------------------------------------------------------
+    def events(self) -> List[Span]:
+        """Snapshot of the current ring, oldest first."""
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def recent(self, seconds: float) -> List[Span]:
+        """Spans whose END falls within ``seconds`` of the newest event
+        (the watchdog post-mortem window)."""
+        evs = self.events()
+        if not evs:
+            return []
+        horizon = max(e.ts + e.dur for e in evs) - int(seconds * 1e9)
+        return [e for e in evs if e.ts + e.dur >= horizon]
+
+
+#: the process-wide recorder (one per process, like ``core.log.metrics``);
+#: ``Pipeline(trace_mode=...)`` configures it, runners hold it (or None)
+recorder = FlightRecorder()
+
+
+# -- Chrome trace-event export ----------------------------------------------
+
+def to_chrome(events: Sequence[Span]) -> Dict[str, Any]:
+    """Render spans as a Chrome trace-event JSON object (Perfetto /
+    chrome://tracing 'JSON array format' under ``traceEvents``).
+
+    * one track (tid) per stage, named via thread_name metadata;
+    * spans become complete events (``ph=X``, µs timebase), instants
+      (dur 0) become ``ph=i``;
+    * every span with linked ``trace_ids`` (a batched dispatch) gets flow
+      arrows (``ph=s``/``ph=f``) from each member row's most recent prior
+      span — Perfetto draws the per-row attribution the batch amortized;
+    * ``traceEvents`` is sorted by ``ts`` (validated by
+      :func:`validate_chrome`).
+    """
+    evs = sorted(events, key=lambda e: (e.ts, e.dur))
+    track: Dict[str, int] = {}
+    out: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = [{
+        "ph": "M", "pid": 1, "tid": 0, "ts": 0, "name": "process_name",
+        "args": {"name": "nnstreamer_tpu"},
+    }]
+    last_by_tid: Dict[int, Dict[str, Any]] = {}
+    flow_ids = itertools.count(1)
+    flows: List[Dict[str, Any]] = []
+    for e in evs:
+        t = track.get(e.stage)
+        if t is None:
+            t = track[e.stage] = len(track) + 1
+            meta.append({"ph": "M", "pid": 1, "tid": t, "ts": 0,
+                         "name": "thread_name", "args": {"name": e.stage}})
+        args: Dict[str, Any] = {}
+        if e.tid is not None:
+            args["trace_id"] = e.tid
+        if e.args:
+            args.update(e.args)
+        rec = {
+            "name": e.kind, "cat": e.kind,
+            "ph": "X" if e.dur > 0 else "i",
+            "ts": e.ts / 1e3, "pid": 1, "tid": t, "args": args,
+        }
+        if e.dur > 0:
+            rec["dur"] = e.dur / 1e3
+        else:
+            rec["s"] = "t"  # instant scope: thread
+        # flow arrows: batch dispatch span -> every member row's previous
+        # span (per-row attribution of the amortized device time)
+        linked = (e.args or {}).get("trace_ids")
+        if linked:
+            for member in linked:
+                src = last_by_tid.get(member)
+                if src is None or src is rec:
+                    continue
+                fid = next(flow_ids)
+                flows.append({
+                    "ph": "s", "id": fid, "pid": 1, "tid": src["tid"],
+                    "ts": src["ts"] + src.get("dur", 0.0),
+                    "name": "row", "cat": "row-link",
+                })
+                flows.append({
+                    "ph": "f", "bp": "e", "id": fid, "pid": 1,
+                    "tid": t, "ts": rec["ts"],
+                    "name": "row", "cat": "row-link",
+                })
+        if e.tid is not None:
+            last_by_tid[e.tid] = rec
+        out.append(rec)
+    # flows carry ts of their anchors; merge + resort so the stream stays
+    # monotonic in ts (the validator's contract)
+    all_events = meta + out + flows
+    all_events.sort(key=lambda r: (r["ts"], 0 if r["ph"] == "M" else 1))
+    return {"traceEvents": all_events, "displayTimeUnit": "ms",
+            "otherData": {"spanKinds": dict(SPAN_KINDS)}}
+
+
+def dump_chrome(events: Sequence[Span], path: str) -> int:
+    """Write :func:`to_chrome` JSON to ``path``; returns the span count."""
+    with open(path, "w") as f:
+        json.dump(to_chrome(events), f)
+    return len(events)
+
+
+def validate_chrome(obj: Any) -> List[str]:
+    """Schema-check a Chrome trace object (as loaded from JSON).  Returns
+    a list of problems (empty = valid): ``traceEvents`` list present,
+    required keys per event, non-negative durations, and the event stream
+    monotonic in ``ts``."""
+    problems: List[str] = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be an object with a 'traceEvents' list"]
+    evs = obj["traceEvents"]
+    if not isinstance(evs, list):
+        return ["'traceEvents' must be a list"]
+    last_ts = None
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            if key not in e:
+                problems.append(f"event {i}: missing {key!r}")
+        ph = e.get("ph")
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i}: ts must be a number")
+            continue
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i}: X event needs dur >= 0")
+        if last_ts is not None and ts < last_ts:
+            problems.append(
+                f"event {i}: ts {ts} < previous {last_ts} (not monotonic)")
+        last_ts = ts
+    return problems
+
+
+# -- post-mortem log dump ----------------------------------------------------
+
+def format_recent(seconds: float = 5.0,
+                  rec: Optional[FlightRecorder] = None) -> List[str]:
+    """The last ``seconds`` of the ring as human-readable timeline lines
+    (newest window, oldest first), relative to the newest event."""
+    rec = rec or recorder
+    evs = rec.recent(seconds)
+    if not evs:
+        return []
+    t_end = max(e.ts + e.dur for e in evs)
+    lines = []
+    for e in sorted(evs, key=lambda s: s.ts):
+        rel_ms = (e.ts - t_end) / 1e6
+        tid = f" #{e.tid}" if e.tid is not None else ""
+        extra = ""
+        if e.args:
+            extra = " " + " ".join(
+                f"{k}={v}" for k, v in sorted(e.args.items()))
+        lines.append(
+            f"  {rel_ms:+10.3f}ms {e.stage:<20s} {e.kind:<8s}"
+            f" {e.dur / 1e6:9.3f}ms{tid}{extra}")
+    return lines
+
+
+def dump_recent_to_log(log, seconds: float = 5.0, reason: str = "",
+                       rec: Optional[FlightRecorder] = None) -> int:
+    """Dump the recent flight-recorder window to ``log`` (a stdlib
+    logger) — the watchdog-fire / pipeline-error post-mortem.  No-op when
+    the recorder is off or empty; returns the number of spans dumped.
+    Never raises (a crash report must not crash)."""
+    try:
+        rec = rec or recorder
+        if not rec.active:
+            return 0
+        lines = format_recent(seconds, rec)
+        if not lines:
+            return 0
+        head = (f"flight recorder: last {seconds:g}s "
+                f"({len(lines)} spans){' — ' + reason if reason else ''}")
+        log.error("%s\n%s", head, "\n".join(lines))
+        return len(lines)
+    except Exception:  # noqa: BLE001 - post-mortem path must not raise
+        return 0

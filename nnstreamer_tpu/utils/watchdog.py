@@ -11,6 +11,11 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..core.log import logger
+from .tracing import dump_recent_to_log
+
+log = logger(__name__)
+
 
 class Watchdog:
     """Arm/feed/disarm timer.  If ``timeout`` elapses without a feed, the
@@ -56,6 +61,12 @@ class Watchdog:
             if self._fired or self._timer is None or gen != self._gen:
                 return
             self._fired = True
+        # Post-mortem FIRST (never raises, no-op when tracing is off):
+        # the hang report carries the flight recorder's recent timeline —
+        # including the stalled stage's last span — even if on_timeout
+        # aborts the process.
+        dump_recent_to_log(
+            log, reason=f"watchdog fired after {self.timeout}s")
         self.on_timeout()
 
     def feed(self) -> None:
@@ -114,6 +125,8 @@ def call_with_watchdog(fn: Callable, timeout: float, what: str = "call"):
     t.start()
     t.join(timeout)
     if t.is_alive():
+        dump_recent_to_log(
+            log, reason=f"{what} exceeded watchdog timeout {timeout}s")
         raise TimeoutError(f"{what} exceeded watchdog timeout {timeout}s")
     if "exc" in box:
         raise box["exc"]

@@ -30,6 +30,14 @@ AND it runs tests/test_sharded_batching.py as its OWN pytest process with
 flag must be set before jax initializes, and a separate process
 guarantees it can never arrive too late (or leak a forced device count
 into anything else).
+
+AND it runs the tracing gate (tools/tracing_gate.py, see
+docs/OBSERVABILITY.md): a backlogged batching run with
+``trace_mode=ring`` must dump schema-valid Chrome trace JSON whose
+batched dispatch spans link every member row's trace id, ``/metrics``
+must serve bucketed histograms for stage latency / queue wait / e2e
+latency, and ``trace_mode=off`` must be STRUCTURALLY untraced (recorder
+monkeypatched to raise) with measured overhead within 2%.
 """
 
 from __future__ import annotations
@@ -141,6 +149,28 @@ def run_sharded_gate(timeout: int = 600) -> int:
     return proc.returncode
 
 
+def run_tracing_gate(timeout: int = 600) -> int:
+    """tools/tracing_gate.py in its own process (fresh recorder/metrics
+    state, CPU pinned): flight-recorder e2e + off-mode purity + overhead."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "tracing_gate.py")]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"tracing gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    tag = "OK" if proc.returncode == 0 else "FAILED"
+    print(f"tracing gate: {tag}")
+    for line in proc.stdout.strip().splitlines():
+        if line.startswith("tracing gate:") and line != "tracing gate: OK":
+            print(f"  {line}")
+    if proc.returncode != 0:
+        for line in (proc.stdout + proc.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+    return proc.returncode
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -154,7 +184,8 @@ def main() -> int:
     lint_rc = run_lint_gate(args.update)
     deep_rc = run_deep_gate(args.update)
     sharded_rc = run_sharded_gate()
-    lint_rc = lint_rc or deep_rc or sharded_rc
+    tracing_rc = run_tracing_gate()
+    lint_rc = lint_rc or deep_rc or sharded_rc or tracing_rc
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
