@@ -797,8 +797,17 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         custom += f",tokenizer:{_text_vocab_file(model)},stop_eos:0"
     n_streams = max(2, streams)
     if serve == "continuous":
-        # admission granularity = one chunk; slots sized to the stream mix
-        custom += f",serve:continuous,slots:{n_streams}"
+        # admission granularity = one chunk; slots sized to the stream mix.
+        # The paged-KV pool is sized to the WORKLOAD, not the worst case:
+        # every stream reserves ceil((T + max_new) / block_size) blocks at
+        # admission, so this pool admits all slots concurrently while a
+        # max_seq-worst-case pool at x64 would hold ~1.6x the HBM for
+        # rows no stream can ever write.
+        block_size = 16
+        need = -(-(prompt_len + max_new) // block_size)
+        custom += (f",serve:continuous,slots:{n_streams}"
+                   f",block_size:{block_size}"
+                   f",kv_blocks:{n_streams * need}")
     # invoke-dynamic only for the continuous path: the committed static
     # rows were measured without it, and it must stay that way so this
     # commit reproduces the artifact's exact pipelines.  The '!' before

@@ -1,11 +1,14 @@
-"""Continuous batching: clients join a RUNNING decode loop.
+"""Continuous serving: clients join a RUNNING paged-KV decode loop.
 
-``custom=serve:continuous,slots:N`` keeps one per-row-position decode
-loop alive; each queued prompt is admitted into a free slot at a chunk
-boundary (bucketed batch-1 prefill written into the slot's KV rows), so
-a late client starts receiving tokens while earlier streams are still
-decoding — the serving shape neither the reference's per-request
-llama.cpp filter nor static group batching can express.
+``custom=serve:continuous,slots:N`` keeps one decode loop alive over a
+block-paged KV cache (docs/SERVING.md §4): each queued prompt is
+admitted into a free slot by reserving pool blocks, prefilled in
+``prefill_chunk``-sized steps interleaved with the running decode, and
+decoded at its own depth through its own block table — so a late
+client starts receiving tokens while earlier streams are still
+decoding, and short streams never pay cache bandwidth for long ones.
+``block_size`` sets the pool granularity; stream join/leave/complete
+never recompiles (the decode signature is fixed).
 
     python examples/llm_continuous_serving.py
 """
@@ -21,13 +24,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import nnstreamer_tpu as nt  # noqa: E402
 
 MAX_NEW = 16
+SLOTS = 2
+BLOCK_SIZE = 8
+PREFILL_CHUNK = 8
 
 
 def main():
     srv = nt.Pipeline(
         "tensor_query_serversrc name=ssrc port=0 id=11 ! "
         f"tensor_filter framework=llm model=llama_tiny "
-        f"custom=max_new:{MAX_NEW},serve:continuous,slots:2,stream_chunk:2 "
+        f"custom=max_new:{MAX_NEW},serve:continuous,slots:{SLOTS},"
+        f"stream_chunk:2,block_size:{BLOCK_SIZE},"
+        f"prefill_chunk:{PREFILL_CHUNK} "
         "invoke-dynamic=true ! "
         "tensor_query_serversink id=11")
     with srv:

@@ -71,12 +71,17 @@ class StageResource:
     shard_eligible: bool = False
     sharded: bool = False
     pos: Optional[int] = None  # source offset of the stage head
+    #: paged KV block pool resident for the stage's lifetime (continuous
+    #: LLM serving — filters/llm.py serving_plan)
+    pool_bytes: int = 0
 
     @property
     def hbm_bytes(self) -> int:
-        """Per-device HBM this stage plans for: resident params + in-flight
-        activations (dispatch window already multiplied into rows)."""
-        return self.param_bytes + self.act_row_bytes * self.rows_per_device
+        """Per-device HBM this stage plans for: resident params + KV pool
+        + in-flight activations (dispatch window already multiplied into
+        rows)."""
+        return (self.param_bytes + self.pool_bytes
+                + self.act_row_bytes * self.rows_per_device)
 
 
 @dataclasses.dataclass
@@ -123,7 +128,9 @@ class ResourceReport:
                 f for f, on in (("B", s.batchable), ("S", s.sharded)) if on)
             lines.append(
                 f"  {s.label}: params {_mib(s.param_bytes)}, "
-                f"act/row {_mib(s.act_row_bytes)}, "
+                + (f"kv pool {_mib(s.pool_bytes)}, " if s.pool_bytes
+                   else "")
+                + f"act/row {_mib(s.act_row_bytes)}, "
                 f"rows/dev {s.rows_per_device}, "
                 f"programs {s.variants}"
                 + (f" [{flags}]" if flags else ""))
@@ -205,7 +212,16 @@ def deep_check(
         _, out_caps = propagate(graph)
 
     traces: Dict[int, _NodeTrace] = {}
+    serving_stages: List[StageResource] = []
     for node in _kahn_order(graph):
+        serving = _llm_serving_stage(node, diags)
+        if serving is not None:
+            # continuous LLM serving is priced STATICALLY (building the
+            # element would materialize the full parameter set); True =
+            # a serving stage that couldn't be priced, already diagnosed
+            if isinstance(serving, StageResource):
+                serving_stages.append(serving)
+            continue
         got = _trace_node(graph, node, out_caps, diags)
         if got is not None:
             traces[node.id] = got
@@ -213,6 +229,7 @@ def deep_check(
     report = _resources(graph, traces, batch_max=batch_max, buckets=buckets,
                         replicas=replicas, dispatch_depth=dispatch_depth,
                         hbm_budget=hbm_budget, max_variants=max_variants)
+    report.stages.extend(serving_stages)
     for t in traces.values():
         # Throwaway trace elements may hold real checkpoints (configure()
         # opened the framework) — release them now, not at GC.
@@ -232,6 +249,89 @@ def deep_check(
             path=top.label, pos=top.pos))
     diags.extend(_budget_diags(report))
     return diags, report
+
+
+#: tensor_filter ``framework=`` names that resolve to the llm framework
+_LLM_FRAMEWORKS = ("llm", "llamacpp", "llama.cpp")
+
+
+def _llm_serving_stage(node, diags):
+    """Price a ``serve:continuous`` llm filter statically.
+
+    Returns ``None`` when the node is not a continuous-serving llm
+    filter, a :class:`StageResource` when priced, or ``True`` when it IS
+    one but could not be priced (diagnostic already appended) — either
+    way a non-None result means the generic trace walk must skip the
+    node: the standing loop's programs have a CLOSED census by
+    construction (``serving_plan``), so the ``invoke-dynamic`` flag that
+    normally means "recompile per signature" does not apply; and
+    building the element to trace it would materialize the full
+    parameter set, which at 7B is exactly what a static pass must never
+    do.
+
+    The paged decode signature is static in every admission-state
+    dimension (block tables / positions / occupancy change VALUES only).
+    If the serving knobs themselves cannot be resolved to ints — the one
+    way the signature could come to depend on occupancy — the stage gets
+    the ``recompile-unbounded`` warning the census cannot bound."""
+    if node.kind != "tensor_filter":
+        return None
+    if str(node.props.get("framework", "")).lower() not in _LLM_FRAMEWORKS:
+        return None
+    from ..filters.base import parse_custom_options
+
+    opts = parse_custom_options(str(node.props.get("custom", "")))
+    if str(opts.get("serve", "")).lower() != "continuous":
+        return None
+    label = node_label(node)
+    from ..models import llama
+
+    model = str(node.props.get("model") or "llama_tiny")
+    cfg = llama.resolve_config(model, opts)
+    if cfg is None:
+        diags.append(Diagnostic(
+            "serving-unpriced", WARNING,
+            f"serve:continuous with model {model!r}: the config lives in "
+            "the checkpoint file, which a static pass must not open — "
+            "the paged KV pool cannot be priced (use a preset model name "
+            "to budget it statically)",
+            path=label, pos=node.pos))
+        return True
+    try:
+        slots = int(opts.get("slots", 4))
+        plan_kw = dict(
+            slots=slots,
+            block_size=max(1, int(opts.get("block_size", 16))),
+            kv_blocks=max(0, int(opts.get("kv_blocks", 0))),
+            prefill_chunk=max(1, int(opts.get("prefill_chunk", 32))),
+        )
+        int(opts.get("stream_chunk", 8))  # the decode chunk length
+    except (TypeError, ValueError):
+        diags.append(Diagnostic(
+            "recompile-unbounded", WARNING,
+            "continuous decode signature depends on unresolvable serving "
+            "knobs (slots/block_size/prefill_chunk/stream_chunk must be "
+            "integer literals) — the compiled-variant census cannot "
+            "bound this stage",
+            path=label, pos=node.pos))
+        return True
+    from ..filters.llm import serving_plan
+
+    dtype = str(opts.get("dtype", "bfloat16"))
+    plan = serving_plan(cfg, dtype=dtype, **plan_kw)
+    params = llama.param_bytes_estimate(
+        cfg, quant=str(opts.get("quant", "")).lower(),
+        param_dtype=str(opts.get("param_dtype", "float32")))
+    # Per-slot in-flight activations of the decode step: the f32 logits
+    # row dominates ([vocab] per slot per scan step), plus the hidden
+    # state at a couple of residencies — a deliberate over-estimate that
+    # stays O(vocab + dim), nowhere near pool/param scale.
+    act_row = 4 * cfg.vocab + 8 * cfg.dim
+    return StageResource(
+        label=label, param_bytes=params, act_row_bytes=act_row,
+        rows_per_device=slots, variants=plan["programs"],
+        batchable=False, shard_eligible=False, sharded=False,
+        pos=node.pos, pool_bytes=plan["pool_bytes"])
 
 
 def _trace_node(graph, node, out_caps, diags) -> Optional[_NodeTrace]:
@@ -406,9 +506,14 @@ def _budget_diags(report: ResourceReport) -> List[Diagnostic]:
             f"estimated HBM high-water {_mib(report.hbm_estimate)} exceeds "
             f"budget {_mib(report.hbm_budget_bytes)} (largest stage: "
             f"{_mib(top.hbm_bytes)} = params {_mib(top.param_bytes)} + "
-            f"{top.rows_per_device} row(s) x {_mib(top.act_row_bytes)}); "
+            + (f"kv pool {_mib(top.pool_bytes)} + " if top.pool_bytes
+               else "")
+            + f"{top.rows_per_device} row(s) x {_mib(top.act_row_bytes)}); "
             "shrink batch_max/buckets, raise data_parallel, or raise "
-            "Config.hbm_budget_bytes",
+            "Config.hbm_budget_bytes"
+            + (" (paged pools: shrink kv_blocks/slots — a smaller pool "
+               "defers admission instead of overflowing)"
+               if top.pool_bytes else ""),
             path=top.label, pos=top.pos))
     if report.max_compiled_variants and report.stages \
             and report.compiled_variants > report.max_compiled_variants:

@@ -227,8 +227,12 @@ class TensorFilter(Element):
             # Standing serve loop: enqueue the request (its meta — query
             # connection/msg ids — rides along) and return; the loop's
             # thread emits one buffer per generated token via async emit.
+            # The loop's serve.admit/prefill_chunk/decode spans follow
+            # THIS pipeline's trace_mode (the element-pinned recorder,
+            # same contract as the sink fetch span).
             import functools as _ft
 
+            fw._trace_rec = getattr(self, "_trace_rec", None)
             fw.submit(self._select_inputs(buf.tensors), dict(buf.meta),
                       _ft.partial(self._emit_serve_token, buf))
             self._n_invoked += 1

@@ -61,6 +61,52 @@ def _next_bucket(t: int) -> int:
     return b
 
 
+def serving_plan(cfg, *, slots: int, block_size: int = 16,
+                 kv_blocks: int = 0, prefill_chunk: int = 32,
+                 dtype: str = "bfloat16") -> Dict[str, int]:
+    """Static sizing of the paged-KV serving state, WITHOUT building
+    anything — one home for the arithmetic :class:`_ContinuousLoop` and
+    the deep lint's resource report (analysis/tracecheck.py) must agree
+    on, so pricing a 7B pool never materializes 7B params.
+
+    Returns a dict:
+
+    * ``max_blocks`` — block-table width per slot.  Prefill pads prompts
+      to ``prefill_chunk`` multiples, so the table must span the largest
+      padded prompt (its final chunk's END position), not just
+      ``max_seq`` — otherwise that chunk's context length would clamp to
+      zero mid-prefill.  The extra entries stay sentinel forever.
+    * ``n_blocks`` — pool size.  ``kv_blocks`` 0 = worst case
+      (``slots * ceil(max_seq/block_size)``: admission never defers on
+      blocks); larger is clamped (a slot can't use more than its table).
+    * ``pool_bytes`` — HBM the k+v block pool occupies
+      (:func:`~nnstreamer_tpu.models.llama.paged_cache_bytes`).
+    * ``programs`` — compiled XLA signatures the standing loop ever
+      uses: the ``[slots]``-row paged decode chunk, the
+      ``[1, prefill_chunk]`` prefill step, and the slot-token setter.
+      Every shape is static in admission state — stream join/leave/
+      complete changes VALUES only — which is why this census is CLOSED
+      (the compile-counter pin in tests/test_llm_continuous.py).
+    """
+    import math
+
+    from ..models import llama as _llama
+
+    bs = max(1, int(block_size))
+    C = max(1, int(prefill_chunk))
+    pad_max = math.ceil((cfg.max_seq - 1) / C) * C
+    max_blocks = math.ceil(max(cfg.max_seq, pad_max) / bs)
+    worst = int(slots) * math.ceil(cfg.max_seq / bs)
+    n_blocks = min(int(kv_blocks), worst) if kv_blocks else worst
+    return {
+        "max_blocks": max_blocks,
+        "n_blocks": n_blocks,
+        "pool_bytes": _llama.paged_cache_bytes(cfg, n_blocks, bs,
+                                               dtype=dtype),
+        "programs": 3,
+    }
+
+
 class ByteTokenizer:
     """Byte-level tokenizer: id = byte + n_special.  Deterministic, no vocab
     file.  ids 0..n_special-1 are special (0=pad, 1=bos, 2=eos)."""
@@ -93,8 +139,15 @@ class LLMFramework(Framework):
     1 = strict per-token streaming),
     ``tp:N`` (tensor-parallel ways over a ``model`` mesh axis),
     ``serve:continuous`` + ``slots:N`` (continuous batching: a standing
-    per-row-position decode loop that admits queued prompts into free
-    slots at chunk boundaries — see :class:`_ContinuousLoop`),
+    decode loop over a block-paged KV cache that admits queued prompts
+    into free slots via chunked prefill — see :class:`_ContinuousLoop`),
+    ``block_size:N`` (KV pool block granularity, default 16),
+    ``kv_blocks:N`` (pool size in blocks; default 0 = worst-case
+    ``slots * ceil(max_seq/block_size)``; smaller pools defer admission
+    instead of overflowing),
+    ``prefill_chunk:N`` (tokens per chunked-prefill step, default 32) and
+    ``prefill_budget:N`` (prefill tokens interleaved per decode
+    iteration, default one chunk),
     ``quant:int8`` / ``quant:int4`` (weight-only quantization; int4 is
     nibble-packed and decodes through the Pallas kernel in
     ops/int4_matmul.py on TPU),
@@ -145,6 +198,14 @@ class LLMFramework(Framework):
         # reference analog.
         self.continuous = str(opts.pop("serve", "")).lower() == "continuous"
         self.slots = int(opts.pop("slots", 4))
+        # Paged-KV serving knobs (see _ContinuousLoop): pool granularity,
+        # pool size (0 = worst case: no admission ever defers), chunked-
+        # prefill step and the per-iteration prefill token budget.
+        self.block_size = max(1, int(opts.pop("block_size", 16)))
+        self.kv_blocks = max(0, int(opts.pop("kv_blocks", 0)))
+        self.prefill_chunk = max(1, int(opts.pop("prefill_chunk", 32)))
+        self.prefill_budget = max(
+            1, int(opts.pop("prefill_budget", self.prefill_chunk)))
         self.dtype = opts.get("dtype", "bfloat16")
         try:
             self.bundle = build_model(model, opts)
@@ -401,21 +462,50 @@ class LLMFramework(Framework):
 
 
 class _ContinuousLoop:
-    """Standing decode loop for ``custom=serve:continuous``.
+    """Standing decode loop for ``custom=serve:continuous`` over a
+    block-paged KV cache.
 
-    One thread owns a ``slots``-row KV cache and a per-row position
-    vector (models/llama.py per-row ``pos_offset``).  Each iteration:
-    (1) admit queued prompts into idle slots — a bucketed batch-1 prefill
-    written into the slot's cache rows (``llama.write_cache_slot``), its
-    first token emitted immediately; (2) run ONE ``lax.scan`` decode
-    chunk advancing every live slot, each at its own depth; (3) emit each
-    live slot's tokens to its own requester and retire finished slots.
-    A stream admitted mid-flight therefore starts decoding at the next
-    chunk boundary instead of waiting for the running group to finish —
-    continuous batching, the serving shape a static group cannot express.
-    Idle slots decode garbage rows parked out of cache range (their
-    writes are dropped); their FLOPs ride along — static shapes are the
-    price of zero recompiles.
+    **The pool.**  One thread owns a fixed block pool
+    ``[L, n_blocks, block_size, H_kv, hd]`` (models/llama.py
+    ``init_paged_cache``), a host-side free list of block ids, and a per-
+    slot block table ``[slots, max_blocks]`` whose entries map a stream's
+    logical block j to a pool block (``n_blocks`` = unallocated
+    sentinel).  The paged decode step (``forward_paged`` →
+    ops/attention.py ``paged_attention``) gathers ONLY each stream's live
+    blocks, so per-step HBM traffic scales with the *sum of live sequence
+    lengths* instead of ``slots × max_seq`` — a short stream stops paying
+    cache bandwidth for the longest one, which is what lets full-
+    occupancy throughput keep scaling past 8 streams.
+
+    **Admission = reservation.**  A prompt is admitted when a slot AND
+    ``ceil((T + max_new) / block_size)`` free blocks exist — the blocks a
+    stream could ever write are reserved up front, so a LIVE stream can
+    never stall mid-decode on an empty free list (no allocation
+    deadlock; an undersized ``kv_blocks`` pool defers *admission*
+    instead).  Reservation holds capacity, not bandwidth: the attention
+    kernel still reads only ``ceil(len/block_size)`` blocks per row.
+    Tables change only at admit/retire, on the host.
+
+    **Chunked prefill.**  An admitted prompt pads to a multiple of
+    ``prefill_chunk`` (waste < one chunk — vs the old power-of-two
+    bucketing's up-to-2x; counted in ``llm.serve.prefill_pad_waste``)
+    and prefills CHUNK BY CHUNK straight into its reserved blocks,
+    interleaved between decode chunks under ``prefill_budget`` tokens
+    per iteration — a long prompt no longer parks the whole loop behind
+    one monolithic batch-1 prefill + cache-copy, which is what a late
+    joiner's first-token latency was made of.
+
+    **Fixed decode signature.**  Both programs — the per-chunk paged
+    decode ``[slots]``-row scan and the ``[1, prefill_chunk]`` prefill
+    step — take (pool, tables, positions) with shapes static in every
+    admission-state dimension; stream join/leave/complete changes
+    VALUES only.  Warm once, recompile never (pinned by the compile-
+    counter test in tests/test_llm_continuous.py and priced by the deep
+    lint's resource report).  Idle slots decode garbage parked at
+    position ``max_blocks * block_size`` — their table lookups resolve
+    to the sentinel, writes drop, context length is 0, and the paged
+    kernel issues ZERO block DMAs for them: an idle slot costs FLOPs,
+    not HBM bandwidth.
     """
 
     def __init__(self, fw: LLMFramework):
@@ -428,6 +518,17 @@ class _ContinuousLoop:
 
         self.fw = fw
         cfg, temperature = fw.cfg, fw.temperature
+        bs = fw.block_size
+        # Pool/table sizing shared with the deep lint (serving_plan's
+        # docstring carries the rationale): table spans the largest
+        # chunk-padded prompt, pool defaults to the worst case.
+        plan = serving_plan(cfg, slots=fw.slots, block_size=bs,
+                            kv_blocks=fw.kv_blocks,
+                            prefill_chunk=fw.prefill_chunk, dtype=fw.dtype)
+        self.max_blocks = plan["max_blocks"]
+        self.n_blocks = plan["n_blocks"]
+        self.sentinel = self.n_blocks  # unallocated table entry
+        self.park = self.max_blocks * bs  # idle-slot position
         self._pending: "_q.Queue" = _q.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -439,30 +540,50 @@ class _ContinuousLoop:
         # return with a live request pending and EOS would cut it off.
         self._idle_lock = threading.Lock()
         self._error: Optional[BaseException] = None
-        #: (meta, emit) entries mid-admission, crash-visible; a list —
-        #: several async admissions can be in flight per iteration
+        #: admission-order queue (drained from _pending) + per-slot
+        #: prefill-in-progress states; BOTH crash-visible: a request in
+        #: either is in neither _pending nor a live slot, and a loop
+        #: failure must abort it instead of stranding its client
+        self._waiting: list = []
         self._admitting: list = []
 
-        def decode_rows(params, tok, cache, key, pos, length):
+        def decode_chunk(params, tok, pool, tables, pos, key, length):
+            """``length`` paged decode steps as ONE program (lax.scan):
+            every slot advances at its own depth through its own blocks.
+            ``pos`` arrives fresh from host bookkeeping each call, so a
+            parked row can never creep toward int32 wraparound."""
             def step(carry, _):
-                tok, cache, key, pos = carry
+                tok, pool, key, p = carry
                 key, sub = jax.random.split(key)
-                logits, cache = llama.forward_cached(
-                    params, tok[:, None], cache, pos, cfg,
+                logits, pool = llama.forward_paged(
+                    params, tok[:, None], pool, tables, p, cfg,
                     compute_dtype=fw.dtype)
                 nxt = llama.sample_token(logits[:, -1], sub, temperature,
                                          fw.top_k, fw.top_p)
-                return (nxt, cache, key, pos + 1), nxt
+                return (nxt, pool, key, p + 1), nxt
 
-            (tok, cache, key, pos), toks = lax.scan(
-                step, (tok, cache, key, pos), None, length=length)
-            return jnp.moveaxis(toks, 0, 1), tok, cache, key, pos
+            (tok, pool, key, _), toks = lax.scan(
+                step, (tok, pool, key, pos), None, length=length)
+            return jnp.moveaxis(toks, 0, 1), tok, pool, key
 
-        self._decode_rows = jax.jit(
-            decode_rows, static_argnames=("length",), donate_argnums=(2,))
-        # slot index passed as a traced scalar: ONE admission program
-        self._write_slot = jax.jit(llama.write_cache_slot,
-                                   donate_argnums=(0,))
+        self._decode = jax.jit(
+            decode_chunk, static_argnames=("length",), donate_argnums=(2,))
+
+        def prefill_step(params, toks, pool, table, pos0, logit_off):
+            """One [1, prefill_chunk] prefill chunk written directly into
+            the slot's blocks; returns the ``logit_off`` position's
+            logits ([1, vocab] — the last REAL token on the final chunk)
+            so the first-token sample needs no separate program."""
+            logits, pool = llama.forward_paged(
+                params, toks, pool, table, pos0, cfg,
+                compute_dtype=fw.dtype, logit_off=logit_off)
+            return logits[:, 0], pool
+
+        self._prefill = jax.jit(prefill_step, donate_argnums=(2,))
+        # tok updates keep the token vector device-resident (slot index
+        # and value traced: ONE program for every admission)
+        self._set_tok = jax.jit(lambda a, i, v: a.at[i].set(v),
+                                donate_argnums=(0,))
         self._thread = threading.Thread(
             target=self._run, name="llm-serve", daemon=True)
         self._thread.start()
@@ -521,17 +642,20 @@ class _ContinuousLoop:
                 except Exception:  # noqa: BLE001
                     pass
 
-            # Terminate every live, mid-admission, and queued stream so
-            # no client hangs to its timeout waiting on a dead loop.  The
-            # queue drain + idle-set run under _idle_lock, pairing with
-            # submit(): no request can enter the queue after the drain.
+            # Terminate every live, mid-prefill, waiting, and queued
+            # stream so no client hangs to its timeout waiting on a dead
+            # loop.  The queue drain + idle-set run under _idle_lock,
+            # pairing with submit(): no request can enter the queue
+            # after the drain.
             import queue as _q
 
             for slot in list(getattr(self, "_live_slots", []) or []):
                 if slot is not None:
                     abort(slot[0], slot[1], 1 << 30)
-            for entry in list(self._admitting):
-                abort(*entry)
+            for st in list(self._admitting):
+                abort(st["meta"], st["emit"])
+            for _, meta, emit in list(self._waiting):
+                abort(meta, emit)
             with self._idle_lock:
                 self._error = e
                 while True:
@@ -542,37 +666,45 @@ class _ContinuousLoop:
                     abort(meta, emit)
                 self._idle.set()
 
+    def _span(self, rec, kind: str, t0_ns: int, **args) -> None:
+        if rec is not None and rec.active:
+            now = time.monotonic_ns()
+            rec.record(kind, "llm.serve", None, t0_ns, now - t0_ns, **args)
+
     def _run_inner(self) -> None:
+        import math
         import queue as _q
 
         import jax
         import jax.numpy as jnp
 
         fw, cfg = self.fw, self.fw.cfg
-        B = fw.slots
+        B, bs, C = fw.slots, fw.block_size, fw.prefill_chunk
         params = fw.bundle.params
-        cache = llama.init_cache(cfg, B, dtype=fw.dtype)
-        # tok/pos live ON DEVICE between chunks (r4): materializing them
-        # per chunk cost two tunnel roundtrips per iteration on top of
-        # the one that delivers tokens.  Host keeps only bookkeeping
-        # (remaining/sidx/slots) that never needs device values.
-        pos = jnp.full((B,), cfg.max_seq, jnp.int32)  # parked = idle
+        pool = llama.init_paged_cache(cfg, self.n_blocks, bs,
+                                      dtype=fw.dtype)
+        # Device carries tok/pool/key between chunks (r4: materializing
+        # them per chunk cost tunnel roundtrips).  EVERYTHING ELSE is
+        # host bookkeeping: positions advance deterministically (+length
+        # per chunk for live rows, parked otherwise) and block tables
+        # change only at admit/retire, so both live as numpy and ride to
+        # the device as tiny async H2D args — never a fetch.
         tok = jnp.zeros((B,), jnp.int32)
+        key = jax.random.PRNGKey(fw.seed)
+        pos = np.full((B,), self.park, np.int32)  # parked = idle
+        tables = np.full((B, self.max_blocks), self.sentinel, np.int32)
+        free = list(range(self.n_blocks))  # host free list (block ids)
+        slot_blocks: list = [[] for _ in range(B)]
+        # Bookkeeping published on self (mutated in place, so the refs
+        # stay live): the leak/contamination tests read them after
+        # drain(), and a post-mortem can see the pool state.
+        self._pos, self._tables = pos, tables
+        self._free, self._slot_blocks = free, slot_blocks
         remaining = np.zeros((B,), np.int64)
         sidx = np.zeros((B,), np.int64)
         slots: list = [None] * B  # (meta, emit) per live slot
         self._live_slots = slots  # visible to the crash terminator
-        key = jax.random.PRNGKey(fw.seed)
         eos = getattr(fw.tokenizer, "eos", -1) if fw.stop_eos else -1
-
-        # tiny jitted updates keeping tok/pos device-resident
-        set_slot = jax.jit(lambda a, i, v: a.at[i].set(v),
-                           donate_argnums=(0,))
-        park_idle = jax.jit(
-            lambda p, idle: jnp.where(idle, cfg.max_seq, p),
-            donate_argnums=(0,))
-
-        from ..core.config import get_config as _gc
 
         import os as _os
         trace = _os.environ.get("NNSTPU_SERVE_TRACE") == "1"
@@ -585,139 +717,203 @@ class _ContinuousLoop:
                 print(f"[serve {time.monotonic():.3f}] {tag}",
                       file=_sys.stderr, flush=True)
 
+        def alloc(n_tokens: int) -> list:
+            need = math.ceil(n_tokens / bs)
+            blocks, free[:] = free[:need], free[need:]
+            return blocks
+
+        def retire(s: int) -> None:
+            free.extend(slot_blocks[s])
+            slot_blocks[s] = []
+            tables[s, :] = self.sentinel
+            pos[s] = self.park
+            slots[s] = None
+            remaining[s] = 0
+            metrics.gauge(f"llm.serve.slot{s}.occupied", 0.0)
+
         # Warm EVERY program the loop uses before admitting real work:
         # over a tunneled device, first-use costs (trace + compile +
         # program upload) run 0.5-2 s EACH and land on the first
-        # requests' critical path otherwise (traced: park_idle's first
-        # compile alone delayed a join by 0.7 s).  llama.cpp servers
-        # warm up the same way.  The garbage this writes into slot 0's
-        # cache rows stays masked behind parked positions until a real
-        # admission overwrites it.
-        warm_T = min(32, cfg.max_seq - 1)
-        logits_w, small_w = fw._fwd(
-            params, jnp.zeros((1, warm_T), jnp.int32),
-            llama.init_cache(cfg, 1, dtype=fw.dtype), 0)
-        cache = self._write_slot(cache, small_w, np.int32(0))
+        # requests' critical path otherwise.  llama.cpp servers warm up
+        # the same way.  Warmup allocates real blocks (exercising the
+        # allocator), writes garbage through them, and frees them —
+        # nothing real can attend it (the slot re-parks).
+        warm_blocks = alloc(min(C, self.n_blocks * bs))
+        tables[0, :len(warm_blocks)] = warm_blocks
+        logits_w, pool = self._prefill(
+            params, jnp.zeros((1, C), jnp.int32), pool, tables[:1],
+            pos[:1] * 0, np.int32(C - 1))
         key, sub = jax.random.split(key)
-        first_w = llama.sample_token(logits_w[:, -1], sub, fw.temperature,
+        first_w = llama.sample_token(logits_w, sub, fw.temperature,
                                      fw.top_k, fw.top_p)[0]
-        tok = set_slot(tok, np.int32(0), first_w)     # device-scalar variant
-        pos = set_slot(pos, np.int32(0), np.int32(0))  # host-scalar variant
-        toks_w, tok, cache, key, pos = self._decode_rows(
-            params, tok, cache, key, pos, length=fw.chunk)
+        tok = self._set_tok(tok, np.int32(0), first_w)
+        toks_w, tok, pool, key = self._decode(
+            params, tok, pool, tables, pos, key, length=fw.chunk)
         np.asarray(toks_w)
-        pos = park_idle(pos, jnp.asarray(np.ones((B,), bool)))
+        free.extend(warm_blocks)
+        tables[0, :] = self.sentinel
         _tr("warmup done")
 
         while not self._stop.is_set():
             progressed = False
-            # 1. admission: dispatch EVERY pending prompt's prefill +
-            # cache write + first-token sample asynchronously — no host
-            # sync yet.  The syncs happen in step 3, AFTER the decode
-            # chunk is dispatched, so admission work overlaps the running
-            # group's compute instead of stalling it (the r3 gap: serve
-            # ran at 60% of its own decode ceiling because prefills sat
-            # on the decode critical path).
-            free = np.flatnonzero(remaining == 0)
-            fi = 0
-            admitted = []  # (slot, meta, emit, first_dev, n)
-            while fi < free.size:
+            rec = getattr(fw, "_trace_rec", None)
+            # 0. drain the thread-handoff queue into the admission-order
+            # list (FIFO preserved when the head defers on capacity)
+            while True:
                 try:
-                    prompt, meta, emit = self._pending.get_nowait()
+                    self._waiting.append(self._pending.get_nowait())
                 except _q.Empty:
                     break
-                slot = int(free[fi])
-                fi += 1
-                # Crash-visibility marker: a request mid-admission is in
-                # neither _pending nor a slot — without it, a loop
-                # failure during ITS prefill would orphan it (client
-                # hangs to timeout instead of seeing stream_aborted).
-                # A LIST: several admissions can be in flight per
-                # iteration now that prefills dispatch asynchronously.
-                # Entries removed by IDENTITY (meta dicts may hold
-                # arrays, so tuple == is not safe).
-                entry = (meta, emit)
-                self._admitting.append(entry)
+
+            # 1. admission: move waiting prompts into free slots while a
+            # slot AND the stream's full block reservation are available.
+            # Host-only bookkeeping — no device work yet.  Head-of-line
+            # deferral keeps FIFO fairness: a huge prompt waits for
+            # capacity rather than being overtaken forever.
+            while self._waiting:
+                freeslots = np.flatnonzero(remaining == 0)
+                freeslots = [int(s) for s in freeslots
+                             if slots[s] is None and not any(
+                                 st["slot"] == s for st in self._admitting)]
+                if not freeslots:
+                    break
+                prompt, meta, emit = self._waiting[0]
                 T = prompt.shape[1]
                 if T >= cfg.max_seq:
                     # reject oversize prompts with a terminated stream
+                    self._waiting.pop(0)
                     self._emit_token(emit, {**meta, "stream_aborted": True},
                                      0, 0, True)
-                    self._admitting[:] = [
-                        e for e in self._admitting if e is not entry]
                     continue
-                small = llama.init_cache(cfg, 1, dtype=fw.dtype)
-                P = T
-                if _gc().shape_bucketing:
-                    P = min(_next_bucket(T), cfg.max_seq - 1)
+                n = max(1, min(fw.max_new, cfg.max_seq - T))
+                if T + n > self.n_blocks * bs:
+                    # the reservation exceeds the WHOLE pool: no amount
+                    # of retiring ever satisfies it, so deferring would
+                    # wedge the loop (head-of-line FIFO) — reject like
+                    # the oversize case instead
+                    self._waiting.pop(0)
+                    self._emit_token(emit, {**meta, "stream_aborted": True},
+                                     0, 0, True)
+                    continue
+                if len(free) * bs < T + n:
+                    break  # pool full: defer admission, never overflow
+                t_admit = time.monotonic_ns()
+                self._waiting.pop(0)
+                s = freeslots[0]
+                blocks = alloc(T + n)
+                slot_blocks[s] = blocks
+                tables[s, :len(blocks)] = blocks
+                # chunk-multiple padding (replaces the old power-of-two
+                # prompt bucketing on this path: waste < one chunk)
+                P = math.ceil(T / C) * C
                 if P > T:
                     prompt = np.pad(prompt, ((0, 0), (0, P - T)))
-                logits, small = fw._fwd(params, jnp.asarray(prompt), small, 0)
-                cache = self._write_slot(cache, small, np.int32(slot))
-                key, sub = jax.random.split(key)
-                first_dev = llama.sample_token(
-                    logits[:, T - 1], sub, fw.temperature, fw.top_k,
-                    fw.top_p)[0]
-                n = max(1, min(fw.max_new, cfg.max_seq - T))
-                if n > 1:
-                    # provisional occupancy; step 3 retires it if the
-                    # materialized first token turns out to be EOS
-                    tok = set_slot(tok, np.int32(slot), first_dev)
-                    pos = set_slot(pos, np.int32(slot), np.int32(T))
-                    remaining[slot] = n - 1
-                    sidx[slot] = 1
-                    slots[slot] = (meta, emit)
-                    # now covered by _live_slots: drop the _admitting
-                    # marker so a crash between here and step 3 aborts the
-                    # stream ONCE, not via both lists
-                    self._admitting[:] = [
-                        e for e in self._admitting if e is not entry]
-                    entry = None
-                admitted.append((slot, meta, emit, first_dev, n, entry))
-                _tr(f"admitted slot {slot} (dispatched prefill)")
+                metrics.count("llm.serve.prefill_tokens", P)
+                metrics.count("llm.serve.prefill_pad_waste", P - T)
+                self._admitting.append({
+                    "slot": s, "prompt": prompt.astype(np.int32), "T": T,
+                    "P": P, "p": 0, "n": n, "meta": meta, "emit": emit,
+                    "first": None})
+                self._span(rec, "serve.admit", t_admit, slot=s, tokens=T,
+                           blocks=len(blocks))
+                _tr(f"admitted slot {s} ({T} tokens, {len(blocks)} blocks)")
                 progressed = True
 
-            # 2. dispatch one chunk of per-row decode for the live slots
-            # (still async).  The chunk length is ALWAYS fw.chunk: a
-            # variable tail length would compile a fresh 7B program per
-            # distinct value (the remote-compile cost dwarfs the tokens
-            # it saves — measured 3x throughput loss).  Streams that
-            # finish mid-chunk have their overshoot tokens discarded
-            # (rows keep decoding garbage until chunk end; out-of-range
-            # cache writes drop, outputs are never emitted).
+            # 2. chunked prefill: dispatch up to prefill_budget tokens of
+            # [1, C] prefill chunks straight into the admitting streams'
+            # blocks (async — no host sync here).  With no live decode
+            # the budget is waived: there is nothing to interleave with,
+            # and finishing the prompt sooner IS the latency win.
+            budget = fw.prefill_budget if (remaining > 0).any() else 1 << 30
+            newly_live = []  # (slot, state) — first token syncs in step 4
+            for st in list(self._admitting):
+                while budget > 0 and st["p"] < st["P"]:
+                    t_pf = time.monotonic_ns()
+                    s, p = st["slot"], st["p"]
+                    final = p + C >= st["P"]
+                    # last REAL token's offset within this chunk (only
+                    # meaningful on the final chunk; intermediate chunks
+                    # are all real tokens and their logits are unused)
+                    off = np.int32(st["T"] - 1 - p if final else 0)
+                    logits, pool = self._prefill(
+                        params, jnp.asarray(st["prompt"][:, p:p + C]),
+                        pool, tables[s:s + 1],
+                        np.asarray([p], np.int32), off)
+                    st["p"] = p + C
+                    budget -= C
+                    self._span(rec, "serve.prefill_chunk", t_pf, slot=s,
+                               pos=p, final=bool(final))
+                    progressed = True
+                    if final:
+                        # first-token sample stays EAGER (outside jit):
+                        # logits are already device-resident and the
+                        # dispatch overlaps the decode chunk below
+                        key, sub = jax.random.split(key)
+                        st["first"] = llama.sample_token(
+                            logits, sub, fw.temperature, fw.top_k,
+                            fw.top_p)[0]
+                        tok = self._set_tok(tok, np.int32(s), st["first"])
+                        pos[s] = st["T"]
+                        remaining[s] = st["n"] - 1
+                        sidx[s] = 1
+                        # provisional occupancy for EVERY newly-live
+                        # stream (n==1 included): between leaving
+                        # _admitting and its step-4 first-token emission
+                        # the stream must be visible to the crash
+                        # terminator, and slots[] is the only place it
+                        # looks.  Step 4 retires n==1/EOS immediately.
+                        slots[s] = (st["meta"], st["emit"])
+                        newly_live.append(st)
+                        self._admitting.remove(st)
+                        metrics.gauge(f"llm.serve.slot{s}.occupied", 1.0)
+                        _tr(f"prefill complete slot {s}")
+                        break
+
+            # 3. dispatch one chunk of per-row paged decode for the live
+            # slots (still async).  The chunk length is ALWAYS fw.chunk:
+            # a variable tail would compile a fresh 7B program per
+            # distinct value.  Streams that finish mid-chunk keep
+            # decoding garbage until chunk end (writes stay inside their
+            # reserved blocks or drop; outputs are never emitted).
             live = remaining > 0
             toks_dev = None
             if live.any():
-                length = fw.chunk
-                toks_dev, tok, cache, key, pos = self._decode_rows(
-                    params, tok, cache, key, pos, length=length)
+                t_dec = time.monotonic_ns()
+                toks_dev, tok, pool, key = self._decode(
+                    params, tok, pool, tables, pos, key, length=fw.chunk)
+                pos[live] += fw.chunk  # parked rows stay parked
                 _tr("chunk dispatched")
                 progressed = True
+            metrics.gauge("llm.serve.occupancy", float(live.sum()))
+            metrics.gauge("llm.serve.free_blocks", float(len(free)))
 
-            # 3. materialize + emit the admitted first tokens — the
+            # 4. materialize + emit the admitted first tokens — the
             # device is already computing the chunk, so this sync rides
             # under it; the late joiner's first token leaves here, one
             # dispatch (not one drained queue) after submit.
-            for slot, meta, emit, first_dev, n, entry in admitted:
-                _tr(f"first-token sync begins slot {slot}")
-                first = int(np.asarray(first_dev))
-                _tr(f"first-token synced slot {slot}")
-                first_last = n == 1 or first == eos
-                self._emit_token(emit, meta, first, 0, first_last)
-                if first_last and n > 1:
-                    # provisional occupancy rolled back (EOS on token 0);
-                    # the in-flight chunk's row decodes garbage that
-                    # step 4 skips via remaining==0, and park_idle
-                    # re-parks its position at chunk end
-                    slots[slot] = None
-                    remaining[slot] = 0
-                if entry is not None:  # n==1: never entered _live_slots
-                    self._admitting[:] = [
-                        e for e in self._admitting if e is not entry]
+            for st in newly_live:
+                s = st["slot"]
+                _tr(f"first-token sync begins slot {s}")
+                first = int(np.asarray(st["first"]))
+                _tr(f"first-token synced slot {s}")
+                first_last = st["n"] == 1 or first == eos
+                self._emit_token(st["emit"], st["meta"], first, 0,
+                                 first_last)
+                if first_last:
+                    # n==1 or EOS on token 0: the in-flight chunk's row
+                    # decodes garbage that step 5 skips via remaining==0
+                    retire(s)
 
-            # 4. deliver the chunk's tokens
+            # 5. deliver the chunk's tokens
             if toks_dev is not None:
                 host = np.asarray(toks_dev)  # ONE roundtrip per chunk
+                # the decode span closes HERE, at materialization: the
+                # jit call above only enqueued the async dispatch, so a
+                # span closed there would time host dispatch (~us) and
+                # hide the actual device time — the number the trace
+                # exists to attribute
+                self._span(rec, "serve.decode", t_dec,
+                           occupancy=int(live.sum()), chunk=fw.chunk)
                 _tr("chunk materialized")
                 for j in range(host.shape[1]):
                     for s in np.flatnonzero(live):
@@ -731,18 +927,13 @@ class _ContinuousLoop:
                         sidx[s] += 1
                         remaining[s] -= 1
                         if last:
-                            slots[s] = None
-                            remaining[s] = 0
-                # Re-park EVERY idle row each chunk (the device advanced
-                # all rows by `length`; a long-parked row's int32
-                # position would otherwise creep toward wraparound,
-                # where negative positions turn dropped cache writes
-                # into corrupting in-range ones).
-                pos = park_idle(pos, jnp.asarray(remaining == 0))
+                            retire(int(s))
 
             if not progressed:
                 with self._idle_lock:
-                    if self._pending.empty() and not (remaining > 0).any():
+                    if self._pending.empty() and not self._waiting \
+                            and not self._admitting \
+                            and not (remaining > 0).any():
                         self._idle.set()
                 self._wake.wait(0.02)
                 self._wake.clear()

@@ -807,10 +807,13 @@ def _repeat_kv(x, n_rep: int):
 
 
 def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
-           attn_fn=None):
+           attn_fn=None, paged_tables=None):
     """One transformer block.  ``kv=(k_cache, v_cache)`` enables cached
     decode (x is the new suffix, written at ``pos_offset``); ``attn_fn``
-    overrides plain causal attention (ring attention under shard_map)."""
+    overrides plain causal attention (ring attention under shard_map);
+    ``paged_tables`` ([B, max_blocks] int32) switches ``kv`` to the
+    block-pool layout ([n_blocks, bs, Hkv, hd] per layer) with per-row
+    positions — the continuous-serving paged path."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -832,7 +835,40 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     k = _rope(k, positions, cfg.rope_theta)
 
     mask = None
-    if kv is not None:
+    if paged_tables is not None:
+        # Block-pool write + paged attention.  Writes scatter each new
+        # K/V row into (pool block, offset) looked up through the row's
+        # block table; a parked/overshooting position resolves to the
+        # n_blocks sentinel and the write DROPS — idle slots decode
+        # garbage without touching live blocks, recycled blocks can't be
+        # written through a stale (cleared) table.
+        from ..ops.attention import paged_attention
+
+        k_pool, v_pool = kv  # [n_blocks, bs, Hkv, hd]
+        n_blocks, bs = k_pool.shape[0], k_pool.shape[1]
+        max_blocks = paged_tables.shape[1]
+        idx = pos_offset[:, None] + jnp.arange(T)[None, :]  # [B, T]
+        valid = (idx >= 0) & (idx < max_blocks * bs)
+        slot_blk = jnp.clip(idx // bs, 0, max_blocks - 1)
+        blk = jnp.where(
+            valid,
+            jnp.take_along_axis(paged_tables, slot_blk, axis=1),
+            n_blocks)  # sentinel -> dropped scatter
+        off = idx % bs
+        k_pool = k_pool.at[blk, off].set(k.astype(k_pool.dtype),
+                                         mode="drop")
+        v_pool = v_pool.at[blk, off].set(v.astype(v_pool.dtype),
+                                         mode="drop")
+        # context = everything written so far incl. this suffix; a parked
+        # row (pos >= max_blocks*bs) gets len 0 — the paged kernel then
+        # issues ZERO block DMAs for it, which is the whole traffic story
+        lens = jnp.where(pos_offset + T <= max_blocks * bs,
+                         pos_offset + T, 0).astype(jnp.int32)
+        attn = paged_attention(q, k_pool, v_pool, paged_tables,
+                               lens).astype(dt)
+        kv = (k_pool, v_pool)
+        # falls through to the shared wo/residual/MLP tail below
+    elif kv is not None:
         k_cache, v_cache = kv  # [B, S_max, Hkv, hd]
         if getattr(pos_offset, "ndim", 0) == 1:
             # Per-row positions ([B] int32, T==1): each batch row writes
@@ -867,9 +903,12 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
     # k/v ARE the filled cache rows, so attention reduces to causal
     # attention over the prompt — the flash kernel's case — instead of a
     # masked sweep over all S_max cache rows.
-    prefill = kv is not None and type(pos_offset) is int and pos_offset == 0
+    prefill = (paged_tables is None and kv is not None
+               and type(pos_offset) is int and pos_offset == 0)
 
-    if attn_fn is not None:
+    if paged_tables is not None:
+        pass  # paged attention computed above; shared tail below
+    elif attn_fn is not None:
         attn = attn_fn(q, _repeat_kv(k_all, H // Hkv), _repeat_kv(v_all, H // Hkv))
     elif kv is None or prefill:
         # Blockwise flash kernel (Pallas; falls back to plain XLA attention
@@ -935,26 +974,128 @@ def init_cache(cfg: LlamaConfig, batch: int, dtype="bfloat16"):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def write_cache_slot(cache: Dict, slot_cache: Dict, slot) -> Dict:
-    """Copy a single-row cache (from a batch-1 prefill) into row ``slot``
-    of a multi-slot cache — how a new stream is admitted into a running
-    continuous-batching decode.  Shapes: cache [L, B, S, Hkv, hd],
-    slot_cache [L, 1, S, Hkv, hd]."""
-    from jax import lax
-
-    return {
-        name: lax.dynamic_update_slice(
-            cache[name], slot_cache[name].astype(cache[name].dtype),
-            (0, slot, 0, 0, 0))
-        for name in ("k", "v")
-    }
-
-
 def cache_pspecs() -> Dict:
     from jax.sharding import PartitionSpec as P
 
     return {"k": P(None, None, None, "model", None),
             "v": P(None, None, None, "model", None)}
+
+
+# -- block-paged KV cache (continuous serving) ------------------------------
+
+def init_paged_cache(cfg: LlamaConfig, n_blocks: int, block_size: int,
+                     dtype="bfloat16"):
+    """Block-pool KV cache: k/v of [L, n_blocks, block_size, H_kv, head_dim].
+
+    The pool replaces the dense per-slot [L, B, S_max, ...] cache for
+    continuous serving: streams own BLOCKS (via a per-slot block table),
+    not S_max rows, so per-decode-step HBM traffic scales with the sum of
+    live sequence lengths (ops/attention.py paged kernel) and a short
+    stream stops paying for the longest one."""
+    import jax.numpy as jnp
+
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_cache_bytes(cfg: LlamaConfig, n_blocks: int, block_size: int,
+                      dtype="bfloat16") -> int:
+    """Static HBM footprint of :func:`init_paged_cache` (k + v), without
+    building anything — the deep-lint resource report prices the pool
+    through this, so the arithmetic lives next to the allocation."""
+    itemsize = 2 if str(dtype) in ("bfloat16", "float16") else 4
+    return (2 * cfg.n_layers * n_blocks * block_size * cfg.n_kv_heads
+            * cfg.head_dim * itemsize)
+
+
+def resolve_config(model: str, opts: Dict) -> Optional[LlamaConfig]:
+    """The preset + ``custom=`` override arithmetic of :func:`_build`,
+    WITHOUT building weights — static analysis (deep lint) resolves the
+    serving config through this so pricing a 7B pool never materializes
+    7B params.  None for checkpoint paths (their config lives in the
+    file; static passes must not open it)."""
+    if model not in PRESETS:
+        return None
+    cfg = PRESETS[model]
+    overrides = {}
+    for field in ("vocab", "dim", "n_layers", "n_heads", "n_kv_heads",
+                  "ffn_hidden", "max_seq"):
+        if field in opts:
+            overrides[field] = int(opts[field])
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def param_bytes_estimate(cfg: LlamaConfig, quant: str = "",
+                         param_dtype: str = "float32") -> int:
+    """Static parameter-set HBM footprint for one replica, by arithmetic
+    (no weights built): the seven big layer mats + lm_head at the quant
+    width (int8 1 B + f32 scales, int4 0.5 B + scales, else the param
+    dtype's width), embed at param dtype, norms f32."""
+    L, D, H, Hkv, F = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                       cfg.ffn_hidden)
+    hd = cfg.head_dim
+    big_elems = L * (D * H * hd + 2 * D * Hkv * hd + H * hd * D
+                     + 2 * D * F + F * D)
+    head_elems = D * cfg.vocab
+    out_channels = L * (H * hd + 2 * Hkv * hd + D + 2 * F + D)
+    itemsize = 2 if str(param_dtype) in ("bfloat16", "float16") else 4
+    q = str(quant).lower()
+    if q == "int8":
+        mats = big_elems + head_elems
+        scales = 4 * (out_channels + cfg.vocab)
+    elif q == "int4":
+        mats = (big_elems + head_elems) // 2
+        scales = 4 * (out_channels + cfg.vocab)
+    else:
+        mats = (big_elems + head_elems) * itemsize
+        scales = 0
+    embed = cfg.vocab * D * itemsize
+    norms = 4 * (2 * L * D + D)
+    return mats + scales + embed + norms
+
+
+def forward_paged(params, tokens, pool, block_tables, pos,
+                  cfg: LlamaConfig, compute_dtype="bfloat16",
+                  logit_off=None):
+    """Forward a suffix against the block-paged KV pool.
+
+    ``tokens``: [B, T] (T == 1 for the continuous decode step, B == 1 with
+    T == prefill_chunk for a chunked-prefill step); ``pool``: the
+    :func:`init_paged_cache` pytree; ``block_tables``: [B, max_blocks]
+    int32 (entries >= n_blocks are unallocated sentinels); ``pos``: [B]
+    int32 — the position token 0 of each row writes at (a parked row
+    passes ``max_blocks * block_size`` or larger and neither writes nor
+    attends).  Every shape here is static in (B, T, pool, max_blocks):
+    stream join/leave/retire only changes VALUES, which is what pins the
+    continuous loop at zero recompiles.
+
+    ``logit_off`` (traced scalar): return logits for ONLY that suffix
+    position — [B, 1, vocab].  A chunked-prefill step needs one
+    position's logits (the last REAL token; pad rows fill the chunk
+    tail), and slicing before the lm_head keeps the vocab matmul at one
+    row instead of T."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dt = jnp.dtype(compute_dtype)
+    B, T = tokens.shape
+    x = jnp.asarray(params["embed"]).astype(dt)[tokens]
+    positions = pos[:, None] + jnp.arange(T)[None, :]
+
+    def body(x, layer):
+        lp, kc, vc = layer
+        x, (kc, vc) = _block(cfg, lp, x, positions, kv=(kc, vc),
+                             pos_offset=pos, paged_tables=block_tables)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"]))
+    x = _rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    if logit_off is not None:
+        x = lax.dynamic_slice_in_dim(x, logit_off, 1, axis=1)
+    return _lm_head(params, x, dt), {"k": k_new, "v": v_new}
 
 
 def forward_cached(params, tokens, cache, pos_offset, cfg: LlamaConfig,

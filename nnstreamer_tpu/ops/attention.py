@@ -209,3 +209,195 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-pool) attention — the continuous-serving decode path
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens,
+                              *, scale: Optional[float] = None):
+    """Plain-XLA paged attention (the kernel's semantics, materialized).
+
+    ``q``: [B, T, H, D] query suffix (T=1 decode, T=C prefill chunk);
+    ``k_pool``/``v_pool``: [n_blocks, block_size, H_kv, D] shared block
+    pool; ``block_tables``: [B, max_blocks] int32 — row b's logical block
+    j lives in pool block ``block_tables[b, j]`` (entries >= n_blocks are
+    unallocated sentinels); ``context_lens``: [B] int32 — tokens
+    attendable per row INCLUDING the suffix (the suffix's K/V must
+    already be written into the pool).  Query t of row b sits at absolute
+    position ``context_lens[b] - T + t``.
+
+    Gathers each row's full table (B x max_blocks x block_size reads —
+    correct everywhere, traffic-optimal nowhere; the TPU kernel below is
+    the path that only touches live blocks) and applies EXACTLY the dense
+    masked-decode formulation from models/llama.py so paged and dense
+    caches emit identical greedy tokens.
+    """
+    B, T, H, D = q.shape
+    n_blocks, bs, hkv, _ = k_pool.shape
+    scale_v = (D ** -0.5) if scale is None else scale
+    dt = q.dtype
+    # Sentinel entries clip to a real block: their logical positions sit
+    # at/after the allocated extent, so the position mask hides them.
+    tbl = jnp.clip(block_tables, 0, n_blocks - 1)
+    k_all = k_pool[tbl].reshape(B, -1, hkv, D).astype(dt)
+    v_all = v_pool[tbl].reshape(B, -1, hkv, D).astype(dt)
+    if H != hkv:  # GQA: mirror the dense path's repeat-then-einsum order
+        rep = H // hkv
+        S = k_all.shape[1]
+        k_all = jnp.broadcast_to(
+            k_all[:, :, :, None, :], (B, S, hkv, rep, D)).reshape(B, S, H, D)
+        v_all = jnp.broadcast_to(
+            v_all[:, :, :, None, :], (B, S, hkv, rep, D)).reshape(B, S, H, D)
+    q_pos = (context_lens[:, None] - T) + jnp.arange(T)[None, :]  # [B, T]
+    k_pos = jnp.arange(k_all.shape[1])
+    mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                   preferred_element_type=jnp.float32) * scale_v
+    s = jnp.where(mask, s, jnp.float32(-1e30))
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), v_all)
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref, *,
+                  scale: float):
+    """One stream (batch row) per grid cell.
+
+    The whole point of paging: the kv stream for row ``b`` is
+    ``ceil(len/bs)`` DMA'd blocks — idle and short rows fetch nothing
+    beyond their own live prefix, so per-step HBM traffic is the SUM of
+    live lengths, not B x S_max.  ``tbl_ref``/``len_ref`` are
+    scalar-prefetched SMEM (available before the body runs, so the block
+    ids can steer the DMAs); k/v pools stay in HBM (ANY) and blocks
+    stream through a 2-slot VMEM scratch like the flash kernel above.
+    """
+    H, D = q_ref.shape
+    bs = k_hbm.shape[1]
+    hkv = k_hbm.shape[2]
+    G = H // hkv
+    b = pl.program_id(0)
+    L = len_ref[b]
+    nb = (L + bs - 1) // bs  # live blocks only — the traffic contract
+
+    q = q_ref[:].astype(jnp.float32) * scale  # [H, D]
+    qg = q.reshape(1, hkv, G, D)
+
+    def scoped(kbuf, vbuf, ksem, vsem):
+        def kdma(slot, i):
+            return pltpu.make_async_copy(
+                k_hbm.at[tbl_ref[b, i]], kbuf.at[slot], ksem.at[slot])
+
+        def vdma(slot, i):
+            return pltpu.make_async_copy(
+                v_hbm.at[tbl_ref[b, i]], vbuf.at[slot], vsem.at[slot])
+
+        @pl.when(nb > 0)
+        def _():
+            kdma(0, 0).start()
+            vdma(0, 0).start()
+
+        def body(i, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(i, 2)
+            nxt = jax.lax.rem(i + 1, 2)
+
+            @pl.when(i + 1 < nb)
+            def _():  # prefetch the next live block while computing
+                kdma(nxt, i + 1).start()
+                vdma(nxt, i + 1).start()
+
+            kdma(slot, i).wait()
+            vdma(slot, i).wait()
+            kblk = kbuf[slot].astype(jnp.float32)  # [bs, hkv, D]
+            vblk = vbuf[slot].astype(jnp.float32)
+            # decode GEMV: VPU mul-reduce (no transposes — Mosaic keeps
+            # the 128-lane minor dim intact); scores [bs, hkv, G]
+            s = jnp.sum(qg * kblk[:, :, None, :], axis=-1)
+            # the final block is partially valid: the single query sits
+            # at position L-1 and attends positions < L
+            pos = i * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (bs, hkv, G), 0)
+            s = jnp.where(pos < L, s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=0))
+            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - shift[None])
+            alpha = jnp.exp(jnp.where(jnp.isfinite(m), m, shift) - shift)
+            l_new = l * alpha + jnp.sum(p, axis=0)
+            acc_new = acc * alpha[:, :, None] + jnp.sum(
+                p[:, :, :, None] * vblk[:, :, None, :], axis=0)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((hkv, G), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((hkv, G), jnp.float32)
+        acc0 = jnp.zeros((hkv, G, D), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, acc0))
+        # L == 0 (idle slot): l stays 0 and the row emits zeros — finite
+        # garbage the serve loop never reads
+        o_ref[:] = (acc / jnp.maximum(l[:, :, None], 1e-30)).reshape(
+            H, D).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        kbuf=pltpu.VMEM((2,) + k_hbm.shape[1:], k_hbm.dtype),
+        vbuf=pltpu.VMEM((2,) + v_hbm.shape[1:], v_hbm.dtype),
+        ksem=pltpu.SemaphoreType.DMA((2,)),
+        vsem=pltpu.SemaphoreType.DMA((2,)),
+    )
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
+                    scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Attention over a block-paged KV pool (continuous LLM serving).
+
+    Shapes as in :func:`paged_attention_reference`.  The Pallas kernel
+    runs on TPU (or under ``interpret=True``) for the decode shape
+    (T == 1) when head dim tiles the 128-lane DMA; prefill chunks
+    (T > 1) and non-TPU backends take the reference path.  Per-row HBM
+    traffic on the kernel path is ``ceil(context_len / block_size)``
+    blocks — the reason paged decode scales with the sum of live
+    sequence lengths instead of B x S_max.
+    """
+    B, T, H, D = q.shape
+    n_blocks, bs, hkv, _ = k_pool.shape
+    scale_v = (D ** -0.5) if scale is None else scale
+    if interpret is None:
+        interpret = False
+        if jax.default_backend() != "tpu":
+            return paged_attention_reference(
+                q, k_pool, v_pool, block_tables, context_lens, scale=scale_v)
+    if (
+        not _HAVE_PALLAS
+        or T != 1
+        or H % hkv
+        or k_pool.shape != v_pool.shape
+        # Mosaic DMA lane tiling (the flash kernel's constraint)
+        or (not interpret and D % 128)
+    ):
+        return paged_attention_reference(
+            q, k_pool, v_pool, block_tables, context_lens, scale=scale_v)
+
+    import functools as _ft
+
+    # sentinel entries must not index past the pool when a DMA is (never)
+    # issued for them; clip on host side of the call
+    tbl = jnp.clip(block_tables, 0, n_blocks - 1).astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((None, H, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # pools stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((None, H, D), lambda b, *_: (b, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _ft.partial(_paged_kernel, scale=scale_v),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(tbl, context_lens.astype(jnp.int32), q[:, 0], k_pool, v_pool)
+    return out[:, None]

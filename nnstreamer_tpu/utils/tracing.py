@@ -50,6 +50,17 @@ SPAN_KINDS: Dict[str, str] = {
     "shard": "sharded bucketed dispatch incl. the assembled host fetch",
     "fetch": "sink host materialization (D2H / deferred host_post)",
     "e2e": "source ingress -> sink delivery for one buffer",
+    "serve.admit": "continuous LLM serving: prompt admitted into a slot "
+                   "(args: slot, tokens, blocks reserved)",
+    "serve.prefill_chunk": "continuous LLM serving: one chunked-prefill "
+                           "step written into the slot's pool blocks "
+                           "(args: slot, pos, final; times the ASYNC "
+                           "dispatch — device time overlaps the decode "
+                           "chunk by design)",
+    "serve.decode": "continuous LLM serving: one paged decode chunk over "
+                    "the live slots (args: occupancy, chunk; closes at "
+                    "chunk materialization, so it covers the device "
+                    "time)",
 }
 
 #: buffer-meta keys the tracer owns (stamped only when tracing is active)

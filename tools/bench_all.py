@@ -69,6 +69,18 @@ ROWS = [
     ("llm7b_int4_continuous_x16", ["--config", "llm7b", "--llm-quant",
                                    "int4", "--llm-serve", "continuous",
                                    "--llm-streams", "16"]),
+    # paged-KV scaling rows (ISSUE 6): per-step cache traffic follows the
+    # sum of live lengths, so full-occupancy tok/s should keep scaling
+    # near-linearly where the dense-cache loop went sublinear past x8
+    ("llm7b_int8_continuous_x32", ["--config", "llm7b", "--llm-quant",
+                                   "int8", "--llm-serve", "continuous",
+                                   "--llm-streams", "32"]),
+    ("llm7b_int8_continuous_x64", ["--config", "llm7b", "--llm-quant",
+                                   "int8", "--llm-serve", "continuous",
+                                   "--llm-streams", "64"]),
+    ("llm7b_int4_continuous_x32", ["--config", "llm7b", "--llm-quant",
+                                   "int4", "--llm-serve", "continuous",
+                                   "--llm-streams", "32"]),
 ]
 
 

@@ -38,6 +38,16 @@ batched dispatch spans link every member row's trace id, ``/metrics``
 must serve bucketed histograms for stage latency / queue wait / e2e
 latency, and ``trace_mode=off`` must be STRUCTURALLY untraced (recorder
 monkeypatched to raise) with measured overhead within 2%.
+
+AND it runs the serving gate (docs/SERVING.md §4):
+tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
+bit-identity, block allocator churn, and the compile-counter pin that
+stream join/leave/complete triggers ZERO XLA compilations once the
+continuous loop is warm — then ``lint --deep`` over
+examples/llm_continuous_serving.py with ``NNS_TPU_HBM_BUDGET`` pinned
+below the estimate, asserting the resource report prices the paged KV
+block pool (the "kv pool" line + the budget warning naming it), strict
+against tools/serving_deep_baseline.txt.
 """
 
 from __future__ import annotations
@@ -52,6 +62,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLOOR_FILE = os.path.join(REPO, "tools", "tier1_floor.txt")
 LINT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
 DEEP_BASELINE = os.path.join(REPO, "tools", "deep_baseline.txt")
+SERVING_BASELINE = os.path.join(REPO, "tools", "serving_deep_baseline.txt")
+
+#: HBM budget the serving gate pins for the example's deep lint: far
+#: below the llama_tiny estimate, so the hbm-budget warning (naming the
+#: paged KV pool) must fire and be baseline-accepted — proving the pool
+#: is actually priced against Config.hbm_budget_bytes, not just rendered.
+SERVING_GATE_BUDGET = str(1 << 20)
 
 #: the ROADMAP "Tier-1 verify" pytest invocation, verbatim
 PYTEST_ARGS = [
@@ -171,6 +188,55 @@ def run_tracing_gate(timeout: int = 600) -> int:
     return proc.returncode
 
 
+def run_serving_gate(update: bool, timeout: int = 900) -> int:
+    """Continuous-serving gate (see module docstring): the paged-KV test
+    file as its own pytest process (compile-counter pin included), then
+    the deep lint of the serving example with a sub-estimate HBM budget
+    pinned — the report must price the paged KV pool."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_llm_continuous.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"serving gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"serving gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    env["NNS_TPU_HBM_BUDGET"] = SERVING_GATE_BUDGET
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--deep", "-v", "--strict",
+           "--files", os.path.join("examples", "llm_continuous_serving.py"),
+           "--baseline", SERVING_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("serving gate: deep lint TIMED OUT after 300s",
+              file=sys.stderr)
+        return 2
+    priced = "kv pool" in lint.stdout
+    ok = lint.returncode == 0 and priced
+    tag = ("updated" if update else
+           "OK" if ok else
+           "POOL NOT PRICED" if not priced else "NEW DIAGNOSTICS")
+    print(f"serving gate: {tag} ({passed} tests passed)")
+    if not ok and not update:
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -185,7 +251,8 @@ def main() -> int:
     deep_rc = run_deep_gate(args.update)
     sharded_rc = run_sharded_gate()
     tracing_rc = run_tracing_gate()
-    lint_rc = lint_rc or deep_rc or sharded_rc or tracing_rc
+    serving_rc = run_serving_gate(args.update)
+    lint_rc = lint_rc or deep_rc or sharded_rc or tracing_rc or serving_rc
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
