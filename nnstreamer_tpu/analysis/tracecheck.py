@@ -44,6 +44,7 @@ from ..elements.base import Element, SINK, SRC
 from ..pipeline.batching import ladder as bucket_ladder, shard_bucket_for
 from ..pipeline.graph import PipelineGraph
 from ..pipeline.plan import replication_plan
+from ..pipeline.residency import FetchEdge, compute_floor_ms, fetch_ms
 from .capsflow import SAFE_CONFIGURE, _element_class, _kahn_order, propagate
 from .diagnostics import Diagnostic, ERROR, WARNING, node_label
 
@@ -95,6 +96,12 @@ class ResourceReport:
     ladder: Tuple[int, ...]
     hbm_budget_bytes: int = 0
     max_compiled_variants: int = 0
+    #: planned D2H per sink edge (pipeline/residency.py): what actually
+    #: crosses to host per buffer, priced against the calibrated link
+    #: when one is configured (Config.link_d2h_mbps)
+    fetch_edges: List[FetchEdge] = dataclasses.field(default_factory=list)
+    link_d2h_mbps: float = 0.0
+    link_rtt_ms: float = 0.0
 
     @property
     def hbm_estimate(self) -> int:
@@ -134,6 +141,22 @@ class ResourceReport:
                 f"rows/dev {s.rows_per_device}, "
                 f"programs {s.variants}"
                 + (f" [{flags}]" if flags else ""))
+        for e in self.fetch_edges:
+            size = "?" if e.bytes_per_buffer < 0 else f"{e.bytes_per_buffer} B"
+            via = f" via {e.reduced}" if e.reduced else ""
+            priced = ""
+            if self.link_d2h_mbps > 0 and e.bytes_per_buffer >= 0:
+                # the RTT is shown but excluded from d2h_ms and the
+                # fetch-bound decision: it amortizes behind the async
+                # fetch window, link occupancy cannot (docs/FETCH.md)
+                rtt = (f" + {self.link_rtt_ms:g} ms rtt/pull"
+                       if self.link_rtt_ms > 0 else "")
+                priced = (f" (d2h {e.d2h_ms:.2f} ms on "
+                          f"{self.link_d2h_mbps:g} MB/s{rtt} vs compute "
+                          f"floor {e.compute_floor_ms:.2f} ms)")
+            lines.append(
+                f"  fetch {e.sink} <- {e.producer}: {size}/buffer"
+                f"{via}{priced}")
         lines.append("  totals: " + self.summary())
         return "\n".join(lines)
 
@@ -174,6 +197,8 @@ def deep_check(
     dispatch_depth: Optional[int] = None,
     hbm_budget_bytes: Optional[int] = None,
     max_compiled_variants: Optional[int] = None,
+    link_d2h_mbps: Optional[float] = None,
+    link_rtt_ms: Optional[float] = None,
     out_caps: Optional[Dict] = None,
 ) -> Tuple[List[Diagnostic], ResourceReport]:
     """Run the deep pass over a parsed graph.  Knobs default to the global
@@ -198,6 +223,10 @@ def deep_check(
                   else cfg.hbm_budget_bytes)
     max_variants = (max_compiled_variants if max_compiled_variants is not None
                     else cfg.max_compiled_variants)
+    d2h_mbps = float(link_d2h_mbps if link_d2h_mbps is not None
+                     else cfg.link_d2h_mbps)
+    rtt_ms = float(link_rtt_ms if link_rtt_ms is not None
+                   else cfg.link_fetch_rtt_ms)
 
     import jax  # backend init only — the pass never dispatches
 
@@ -230,6 +259,9 @@ def deep_check(
                         replicas=replicas, dispatch_depth=dispatch_depth,
                         hbm_budget=hbm_budget, max_variants=max_variants)
     report.stages.extend(serving_stages)
+    report.link_d2h_mbps = d2h_mbps
+    report.link_rtt_ms = rtt_ms
+    diags.extend(_fetch_check(graph, traces, out_caps, report))
     for t in traces.values():
         # Throwaway trace elements may hold real checkpoints (configure()
         # opened the framework) — release them now, not at GC.
@@ -492,6 +524,71 @@ def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
         dispatch_depth=dispatch_depth, ladder=lad,
         hbm_budget_bytes=int(hbm_budget or 0),
         max_compiled_variants=int(max_variants or 0))
+
+
+def _fetch_check(graph, traces: Dict[int, _NodeTrace], out_caps,
+                 report: ResourceReport) -> List[Diagnostic]:
+    """Price each sink edge's planned D2H bytes against the calibrated
+    link (``Config.link_d2h_mbps`` / ``NNS_TPU_LINK_D2H_MBPS``, the bench
+    ``link_calibration`` row) and flag ``fetch-bound`` pipelines — where
+    the planned transfer time per buffer exceeds even the producing
+    stages' HBM-roofline compute FLOOR, so no amount of compute overlap
+    can hide the link — statically, before a chip is touched.
+
+    The payload per edge is what the residency planner would actually
+    ship: a producer whose device tail pairs ``device_fn`` with
+    ``host_post`` crosses only its tiny traced device outputs (argmax ids,
+    kept boxes); anything else crosses the negotiated spec.  The deep pass
+    prices the pipeline AS WRITTEN — the runtime's reduced-output
+    auto-selection can only shrink these numbers further (docs/FETCH.md).
+    """
+    diags: List[Diagnostic] = []
+    # per-buffer compute floor: the slowest device stage bounds a
+    # pipelined graph; each stage's floor is streaming its params + one
+    # buffer's activations through HBM once
+    floor_ms = max((compute_floor_ms(s.param_bytes + s.act_row_bytes)
+                    for s in report.stages), default=0.0)
+    for node in graph.nodes.values():
+        cls = _element_class(node.kind)
+        if cls is None or not getattr(cls, "is_sink", False):
+            continue
+        sink_label = node_label(node)
+        for e in graph.in_edges(node.id):
+            src_node = graph.nodes[e.src]
+            t = traces.get(e.src)
+            if t is not None:
+                nbytes = t.out_bytes
+                reduced = "fused host_post" if t.host_post else None
+            else:
+                up = out_caps.get((e.src, e.src_pad))
+                spec = up.spec if up is not None else None
+                nbytes = (-1 if spec is None or spec.is_flexible
+                          else int(spec.nbytes))
+                reduced = None
+            edge = FetchEdge(sink=sink_label, producer=node_label(src_node),
+                             bytes_per_buffer=nbytes, reduced=reduced,
+                             compute_floor_ms=floor_ms)
+            if report.link_d2h_mbps > 0 and nbytes >= 0:
+                # bandwidth term ONLY: the RTT amortizes behind the async
+                # fetch window (the whole point of fetch_depth), but link
+                # OCCUPANCY is serial — bytes/bandwidth is the floor no
+                # overlap can hide
+                edge.d2h_ms = fetch_ms(nbytes, report.link_d2h_mbps)
+                if report.stages and edge.d2h_ms > floor_ms:
+                    diags.append(Diagnostic(
+                        "fetch-bound", WARNING,
+                        f"planned sink fetch of {nbytes} bytes/buffer "
+                        f"occupies the calibrated d2h link for "
+                        f"{edge.d2h_ms:.2f} ms ({report.link_d2h_mbps:g} "
+                        f"MB/s), above the device stages' HBM-roofline "
+                        f"compute floor of {floor_ms:.2f} ms — the "
+                        "pipeline is fetch-bound: shrink what crosses "
+                        "(fused sink reduction, reduced/native-stride "
+                        "output, tensors/classmap decode modes) or "
+                        "accept link-bound throughput",
+                        path=sink_label, pos=node.pos))
+            report.fetch_edges.append(edge)
+    return diags
 
 
 def _budget_diags(report: ResourceReport) -> List[Diagnostic]:

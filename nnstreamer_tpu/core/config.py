@@ -30,6 +30,11 @@ _ENV_HBM_BUDGET = "NNS_TPU_HBM_BUDGET"
 _ENV_MAX_VARIANTS = "NNS_TPU_MAX_COMPILED_VARIANTS"
 _ENV_TRACE = "NNS_TPU_TRACE"
 _ENV_TRACE_RING = "NNS_TPU_TRACE_RING"
+_ENV_FETCH_DEPTH = "NNS_TPU_FETCH_DEPTH"
+_ENV_DONATE_INGRESS = "NNS_TPU_DONATE_INGRESS"
+_ENV_REDUCE_OUTPUTS = "NNS_TPU_REDUCE_OUTPUTS"
+_ENV_LINK_D2H_MBPS = "NNS_TPU_LINK_D2H_MBPS"
+_ENV_LINK_RTT_MS = "NNS_TPU_LINK_RTT_MS"
 
 
 @dataclasses.dataclass
@@ -64,6 +69,34 @@ class Config:
     dispatch_depth: int = 2
     #: pad flexible shapes up to the next bucket to bound XLA recompiles
     shape_bucketing: bool = True
+    #: async fetch window at sinks (the output-side twin of
+    #: ``dispatch_depth``): how many buffers a tensor_sink may have in
+    #: background D2H / host-post resolution at once, so the fetch of
+    #: buffer N overlaps the dispatch of buffer N+1 instead of being paid
+    #: inside pop().  1 = the serial resolver — see docs/FETCH.md.
+    fetch_depth: int = 2
+    #: donate host-fed ingress buffers to the fused program (appsrc et al:
+    #: the stage device_puts the pushed frame and XLA reuses that HBM for
+    #: outputs — steady-state H2D stops allocating).  Only applies where
+    #: the planner can prove sole ownership; see docs/FETCH.md.
+    donate_ingress: bool = True
+    #: HBM-residency planner: let the planner auto-select a model's
+    #: REDUCED output (e.g. deeplab's native-stride class map, 256x less
+    #: D2H) when every downstream consumer's negotiated caps admit it —
+    #: "fetch the smaller thing" becomes the default instead of a
+    #: hand-tuned custom= option.  See docs/FETCH.md "Residency rules".
+    reduce_outputs: bool = True
+    #: calibrated D2H link bandwidth in MB/s (the bench ``link_calibration``
+    #: row) — lets nns-lint --deep price each sink edge's planned fetch
+    #: bytes in milliseconds and flag ``fetch-bound`` pipelines statically.
+    #: 0 = uncalibrated: fetch bytes are still reported, never priced.
+    link_d2h_mbps: float = 0.0
+    #: calibrated small-fetch roundtrip (ms), recorded next to the
+    #: bandwidth term in the deep pass's fetch report.  Deliberately NOT
+    #: part of the ``fetch-bound`` decision: the RTT amortizes behind the
+    #: async fetch window (the point of ``fetch_depth``), link occupancy
+    #: cannot — see docs/FETCH.md "Static fetch pricing".
+    link_fetch_rtt_ms: float = 0.0
     #: static-analysis budget (nns-lint --deep): estimated per-device HBM
     #: high-water mark in bytes a pipeline may plan for before the deep
     #: pass warns (0 = no budget).  The estimate multiplies per-stage
@@ -123,6 +156,19 @@ class Config:
             if ini.has_option("common", "max_compiled_variants"):
                 cfg.max_compiled_variants = ini.getint(
                     "common", "max_compiled_variants")
+            if ini.has_option("common", "fetch_depth"):
+                cfg.fetch_depth = ini.getint("common", "fetch_depth")
+            if ini.has_option("common", "donate_ingress"):
+                cfg.donate_ingress = ini.getboolean("common",
+                                                    "donate_ingress")
+            if ini.has_option("common", "reduce_outputs"):
+                cfg.reduce_outputs = ini.getboolean("common",
+                                                    "reduce_outputs")
+            if ini.has_option("common", "link_d2h_mbps"):
+                cfg.link_d2h_mbps = ini.getfloat("common", "link_d2h_mbps")
+            if ini.has_option("common", "link_fetch_rtt_ms"):
+                cfg.link_fetch_rtt_ms = ini.getfloat(
+                    "common", "link_fetch_rtt_ms")
             if ini.has_option("common", "trace_mode"):
                 cfg.trace_mode = ini.get("common",
                                          "trace_mode").strip().lower()
@@ -146,6 +192,18 @@ class Config:
             cfg.hbm_budget_bytes = int(os.environ[_ENV_HBM_BUDGET])
         if os.environ.get(_ENV_MAX_VARIANTS):
             cfg.max_compiled_variants = int(os.environ[_ENV_MAX_VARIANTS])
+        if os.environ.get(_ENV_FETCH_DEPTH):
+            cfg.fetch_depth = int(os.environ[_ENV_FETCH_DEPTH])
+        if os.environ.get(_ENV_DONATE_INGRESS):
+            cfg.donate_ingress = os.environ[_ENV_DONATE_INGRESS].lower() in (
+                "1", "true", "yes", "on")
+        if os.environ.get(_ENV_REDUCE_OUTPUTS):
+            cfg.reduce_outputs = os.environ[_ENV_REDUCE_OUTPUTS].lower() in (
+                "1", "true", "yes", "on")
+        if os.environ.get(_ENV_LINK_D2H_MBPS):
+            cfg.link_d2h_mbps = float(os.environ[_ENV_LINK_D2H_MBPS])
+        if os.environ.get(_ENV_LINK_RTT_MS):
+            cfg.link_fetch_rtt_ms = float(os.environ[_ENV_LINK_RTT_MS])
         if os.environ.get(_ENV_TRACE):
             cfg.trace_mode = os.environ[_ENV_TRACE].strip().lower()
         if os.environ.get(_ENV_TRACE_RING):

@@ -56,6 +56,13 @@ LATENCY_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: an admission (h2d) or materialization (d2h) wait above this is a real
+#: transport/backlog stall, not a lock hop — ONE threshold for both
+#: halves of the fetch-engine stall split (``<src>.h2d_stalls`` in
+#: elements/source.py, ``<sink>.d2h_stalls`` in elements/sink.py) so the
+#: two directions stay comparable.  docs/FETCH.md "Stall accounting".
+STALL_FLOOR_S = 1e-3
+
 
 class Metrics:
     """Process-wide counters + gauges + latency reservoirs/histograms,

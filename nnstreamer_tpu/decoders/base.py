@@ -53,6 +53,13 @@ class Decoder:
     # the streaming threads.  None => device outputs ARE the final payload.
     host_post = None
 
+    #: HBM-residency planner opt-in (pipeline/residency.py): True when this
+    #: decoder's output contract survives an upstream model emitting its
+    #: REDUCED output geometry (e.g. a native-stride score map instead of
+    #: the full-res blow-up).  Conservative default: a decoder that
+    #: produces fixed-geometry media (overlays, canvases) must stay False.
+    admits_reduced_payload = False
+
 
 def load_labels(path_or_name: str) -> List[str]:
     """Load a labels file (one label per line, reference format).  A few

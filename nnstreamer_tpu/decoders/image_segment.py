@@ -48,6 +48,11 @@ class ImageSegment(Decoder):
             raise ValueError(f"option1 (output form) must be "
                              f"overlay|classmap, got {out_mode!r}")
         self.out_mode = out_mode
+        # classmap output is geometry-agnostic (flexible tensors caps; the
+        # argmax works at any spatial stride, and the map IS the class
+        # decision) — the residency planner may feed it a native-stride
+        # score map.  overlay is fixed-geometry RGBA media: full res only.
+        self.admits_reduced_payload = out_mode == "classmap"
 
     def out_caps(self, in_spec: Optional[TensorsSpec]) -> Caps:
         if self.out_mode == "classmap":

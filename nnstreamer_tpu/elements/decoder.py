@@ -50,3 +50,10 @@ class TensorDecoder(Element):
     def host_post(self):
         """Deferred host mapping paired with the decoder's device_fn."""
         return self.decoder.host_post
+
+    @property
+    def admits_reduced_payload(self):
+        """Residency-planner opt-in, delegated to the decoder sub-plugin
+        (pipeline/residency.py): True only when the decode is
+        geometry-agnostic (e.g. image_segment classmap)."""
+        return getattr(self.decoder, "admits_reduced_payload", False)

@@ -97,6 +97,13 @@ class TensorFilter(Element):
         self.latency_report = bool(self.props.get("latency", get_config().enable_latency))
         self._in_spec: Optional[TensorsSpec] = None
         self._out_spec: Optional[TensorsSpec] = None
+        #: set by the HBM-residency planner (pipeline/residency.py) BEFORE
+        #: negotiation when every downstream consumer admits reduced
+        #: output geometry; configure() then asks the framework to switch
+        self._reduced_admissible = False
+        #: description of the reduced output the planner selected (None =
+        #: full output crosses); read by the residency plan and bench
+        self.reduced_output_selected: Optional[str] = None
         self._lat_ema: Optional[float] = None
         self._n_invoked = 0
         self._batchers: Dict[int, object] = {}
@@ -136,6 +143,19 @@ class TensorFilter(Element):
             # tokens from their own serve thread, decoupled from any one
             # input buffer — same async-emit contract as the query client.
             self.wants_async_emit = True
+        if (self._reduced_admissible
+                and self.reduced_output_selected is None
+                and not self.props.get("output")):
+            # Residency planner: every downstream consumer admits reduced
+            # geometry and no explicit output= prop pins it — switch the
+            # model to its reduced variant (no-op when none exists), so
+            # the smaller payload is what negotiation propagates and the
+            # sink edge fetches.  docs/FETCH.md "Residency rules".
+            desc = fw.select_reduced_output()
+            if desc:
+                self.reduced_output_selected = desc
+                log.info("%s: residency planner selected reduced output: "
+                         "%s", self.name, desc)
         fw_in, fw_out = fw.get_model_info()
 
         # explicit props override / fill in what the fw doesn't know

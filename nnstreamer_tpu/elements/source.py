@@ -15,12 +15,15 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time as _time
 from typing import Iterator, Optional, Union
 
 import numpy as np
 
 from ..core.buffer import Buffer, Event
 from ..core.caps import Caps, MediaType, parse_caps_string, video_bpp
+from ..core.log import STALL_FLOOR_S
+from ..core.log import metrics as _metrics
 from ..core.registry import register_element
 from ..core.types import TensorsSpec, parse_fraction
 from .base import ElementError, SourceElement, SRC
@@ -107,10 +110,20 @@ class AppSrc(SourceElement):
             buf = Buffer([np.asarray(data)], pts=pts)
         if self._inflight_sem is not None:
             stop = getattr(self, "_stop_event", None)
+            t0 = _time.perf_counter()
             while not self._inflight_sem.acquire(timeout=0.1):
                 if self._eos.is_set() or (stop is not None
                                           and stop.is_set()):
                     raise RuntimeError("appsrc stopping; push abandoned")
+            # h2d-wait accounting (the ingress half of the stall split;
+            # the sink counts the d2h half): time the PUSH blocked on
+            # admission is the transport/backlog wait, distinct from the
+            # pull-side fetch wait that used to be conflated with it in
+            # one rtt_stalls number.
+            wait = _time.perf_counter() - t0
+            _metrics.count(f"{self.name}.h2d_wait_ms", wait * 1e3)
+            if wait > STALL_FLOOR_S:
+                _metrics.count(f"{self.name}.h2d_stalls")
             buf.meta["_inflight_credit"] = _InflightCredit(
                 self._inflight_sem)
         self._q.put(buf)

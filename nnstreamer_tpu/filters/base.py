@@ -69,6 +69,17 @@ class Framework:
         """Optional pure JAX function ``tuple(arrays) -> tuple(arrays)``."""
         return None
 
+    def select_reduced_output(self) -> Optional[str]:
+        """Switch the loaded model to its REDUCED output variant, when one
+        exists (``ModelBundle.reduced_variant`` — e.g. deeplab's
+        native-stride score map).  Called by tensor_filter during caps
+        negotiation, only after the HBM-residency planner proved every
+        downstream consumer admits the reduced geometry
+        (pipeline/residency.py, docs/FETCH.md).  Returns a human-readable
+        description of the switch, or None when the model has no reduced
+        form.  Default: none."""
+        return None
+
     # -- abstract execution (nns-lint --deep) -------------------------------
     def abstract_invoke(self, in_sds: Sequence) -> Optional[List]:
         """Trace the model SYMBOLICALLY: map input ``jax.ShapeDtypeStruct``s

@@ -74,7 +74,6 @@ class JaxFramework(Framework):
                     self._device = devs[0]
                     break
 
-        apply_fn = self.bundle.apply_fn
         params = self.bundle.params
         if self._device is not None:
             params = jax.device_put(params, self._device)
@@ -83,7 +82,16 @@ class JaxFramework(Framework):
         self._sharding = None
         if mesh_spec:
             self._setup_mesh(mesh_spec, params)
-            params = self.bundle.params
+        self._rebuild_jitted()
+
+    def _rebuild_jitted(self):
+        """(Re)build the standalone jitted path over the CURRENT bundle —
+        one implementation shared by open() and select_reduced_output()
+        so dispatch-path changes apply to both."""
+        import jax
+
+        apply_fn = self.bundle.apply_fn
+        params = self.bundle.params
         constrain = self._constrain
 
         def run(*inputs):
@@ -132,6 +140,20 @@ class JaxFramework(Framework):
         self._sharding = NamedSharding(mesh, P("data"))
         replicated = NamedSharding(mesh, P())
         self.bundle.params = jax.device_put(params, replicated)
+
+    def select_reduced_output(self):
+        """Swap in the bundle's reduced output variant (residency planner
+        contract, filters/base.py).  The variant thunk shares the live
+        bundle's params — device placement / mesh replication applied at
+        open() carries over — so only the apply closure and out spec
+        change; the standalone jitted path is rebuilt over them."""
+        b = self.bundle
+        if b is None or b.reduced_variant is None:
+            return None
+        desc = b.reduced_desc or "reduced output"
+        self.bundle = b.reduced_variant()
+        self._rebuild_jitted()
+        return desc
 
     def close(self):
         self.bundle = None

@@ -117,10 +117,12 @@ def _deeplab(opts: Dict[str, str]) -> ModelBundle:
     params = init_params(width=width, classes=classes, seed=seed)
     apply_fn = functools.partial(apply, compute_dtype=dtype, upsample=up)
     out_size = size
+    native_size = size
+    for _ in range(4):  # stride 16 = four SAME stride-2 stages
+        native_size = -(-native_size // 2)
     if not up:
-        for _ in range(4):  # stride 16 = four SAME stride-2 stages
-            out_size = -(-out_size // 2)
-    return ModelBundle(
+        out_size = native_size
+    bundle = ModelBundle(
         apply_fn=apply_fn,
         params=params,
         in_spec=TensorsSpec.from_string(f"3:{size}:{size}:{batch}", "float32"),
@@ -129,3 +131,27 @@ def _deeplab(opts: Dict[str, str]) -> ModelBundle:
         param_pspecs=param_pspecs(),
         name="deeplab_mobilenet",
     )
+    if up and "upsample" not in opts:
+        # Offer the HBM-residency planner the native-stride variant — but
+        # ONLY when the caller didn't pin upsample explicitly (an explicit
+        # upsample:1 means full resolution was asked for).  The thunk
+        # reads ``bundle.params`` at call time, so device placement /
+        # mesh replication applied after build carries over, and the
+        # 16x16-downsampled score map shares every weight.
+        def _reduced(b=bundle, n=native_size):
+            import dataclasses as _dc
+
+            return _dc.replace(
+                b,
+                apply_fn=functools.partial(
+                    apply, compute_dtype=dtype, upsample=False),
+                out_spec=TensorsSpec.from_string(
+                    f"{classes}:{n}:{n}:{batch}", "float32"),
+                reduced_variant=None, reduced_desc="")
+
+        ratio = (size * size) // max(1, native_size * native_size)
+        bundle.reduced_variant = _reduced
+        bundle.reduced_desc = (
+            f"native-stride score map [{batch},{native_size},{native_size},"
+            f"{classes}] ({ratio}x less D2H than full resolution)")
+    return bundle

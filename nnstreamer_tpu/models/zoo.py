@@ -40,6 +40,17 @@ class ModelBundle:
     #: tokenizer.ggml.* vocab -> models/tokenizer.py); the llm framework
     #: uses it in place of its byte-level fallback
     tokenizer: object = None
+    #: optional REDUCED output variant for the HBM-residency planner
+    #: (pipeline/residency.py, docs/FETCH.md): a thunk returning a bundle
+    #: that shares THIS bundle's params (read at call time, so device
+    #: placement/replication survives) but emits a smaller output — e.g.
+    #: deeplab's native-stride score map vs the full-res bilinear blow-up.
+    #: The planner selects it only when every downstream consumer admits
+    #: arbitrary tensor geometry.  None = no reduced form exists, or the
+    #: caller pinned the output explicitly.
+    reduced_variant: Optional[Callable[[], "ModelBundle"]] = None
+    #: human description of the reduced variant (logged when selected)
+    reduced_desc: str = ""
 
 
 _builders: Dict[str, Callable[[Dict[str, str]], ModelBundle]] = {}

@@ -64,7 +64,7 @@ class FusedElement(Element):
     kind = "fused"
 
     def __init__(self, elements: List[Element], specs: List[TensorsSpec],
-                 donate: bool = False):
+                 donate: bool = False, ingress_put: bool = False):
         super().__init__({}, name="+".join(e.name for e in elements))
         self.chain = elements
         self._fn = None
@@ -72,6 +72,14 @@ class FusedElement(Element):
         self._out_spec: Optional[TensorsSpec] = None
         self._in_spec = specs[0]
         self._specs = list(specs)
+        # Host-fed ingress donation (docs/FETCH.md): the stage device_puts
+        # the pushed host arrays itself and hands XLA freshly-minted device
+        # buffers it solely owns — the donated program then reuses their
+        # HBM for outputs, so steady-state H2D stops allocating.  Only set
+        # by the planner when the feeding source is a host source with
+        # this stage as its single consumer.
+        self._ingress_put = ingress_put
+        self._donate_active = False  # decided at first _jitted() call
         # Tail element may pair its device_fn with a deferred host mapping
         # (e.g. image_labeling: device argmax -> host label text).  The fused
         # stage emits the tiny device outputs with an async D2H already in
@@ -116,8 +124,10 @@ class FusedElement(Element):
             # compile, so gate it.
             if self._donate and jax.default_backend() not in ("cpu",):
                 self._fn = jax.jit(self._composed, donate_argnums=(0,))
+                self._donate_active = True
             else:
                 self._fn = jax.jit(self._composed)
+                self._donate_active = False
         return self._fn
 
     @property
@@ -157,13 +167,35 @@ class FusedElement(Element):
         # own argument types for free — per-tensor jnp.asarray here only
         # added a host round through the dispatch path (~1.6x the whole
         # call overhead for a 4-tensor buffer, see PR microbench note).
+        fn = self._jitted()  # first call decides _donate_active
+        ingress_put = self._ingress_put and self._donate_active
         if buf.on_device:
-            arrays = tuple(buf.tensors)
+            if ingress_put:
+                # The donated program consumes its inputs.  An app CAN
+                # push device arrays through appsrc (no host copy to
+                # mint fresh ownership from), so force a copy — handing
+                # app-owned arrays to donate_argnums would invalidate
+                # the caller's references ("Array has been deleted").
+                import jax.numpy as jnp
+
+                arrays = tuple(jnp.array(t, copy=True) for t in buf.tensors)
+            else:
+                arrays = tuple(buf.tensors)
+        elif ingress_put:
+            # Donated ingress: explicit device_put mints device arrays
+            # this call solely owns (the app's numpy frame is copied,
+            # never aliased), so the donated program may reuse their HBM
+            # for outputs.  When donation is compiled OUT (CPU backend)
+            # ingress_put is False and the plain asarray path below
+            # avoids paying copies that protect nothing.
+            import jax
+
+            arrays = tuple(jax.device_put(t) for t in buf.tensors)
         else:
             import jax.numpy as jnp
 
             arrays = tuple(jnp.asarray(t) for t in buf.tensors)
-        out = self._jitted()(arrays)
+        out = fn(arrays)
         return [(SRC, self._finish(buf, out))]
 
     # -- micro-batching ----------------------------------------------------
@@ -306,9 +338,17 @@ def _element_shardable(el: Element, batchable: bool) -> bool:
 
 
 def plan_stages(
-    graph: PipelineGraph, elements: Dict[int, Element], *, fuse: bool = True
+    graph: PipelineGraph, elements: Dict[int, Element], *, fuse: bool = True,
+    donate_ingress: bool = False
 ) -> List[Stage]:
-    """Partition the graph into stages; fuse linear device chains."""
+    """Partition the graph into stages; fuse linear device chains.
+
+    ``donate_ingress`` lets a fused chain fed by a HOST source (appsrc,
+    file/camera ingest — not ``device=true`` test sources, which already
+    donate via the folded-source path) device_put its input buffers and
+    donate them to the compiled program: the planner can prove sole
+    ownership when the source has this chain as its only consumer, so XLA
+    reuses the ingress HBM for outputs (docs/FETCH.md)."""
     order = graph.topo_order()
     if not fuse:
         stages = []
@@ -410,7 +450,22 @@ def plan_stages(
             consumed.add(node.id)
             continue
         chain, specs = grown
-        fe = FusedElement([elements[i] for i in chain], specs)
+        donate = False
+        if donate_ingress:
+            ins = graph.in_edges(chain[0])
+            if len(ins) == 1:
+                feeder = elements[ins[0].src]
+                # Host source with a single consumer: every pushed buffer
+                # is minted fresh by the chain's own device_put and this
+                # program is its only reader — donation is legal.  A
+                # device=true source folds (and donates) above instead.
+                donate = (isinstance(feeder, SourceElement)
+                          and getattr(feeder, "device", None) is not True
+                          and len(graph.out_edges(ins[0].src)) == 1)
+        fe = FusedElement([elements[i] for i in chain], specs,
+                          donate=donate, ingress_put=donate)
+        if donate:
+            log.info("ingress donation enabled for fused stage %s", fe.name)
         log.info("fused %d elements into one XLA stage: %s", len(chain), fe.name)
         # Fused chains negotiated a static spec by construction (fusable()
         # requires it); only a deferred host_post gates sharding.

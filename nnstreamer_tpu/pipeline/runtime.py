@@ -574,6 +574,15 @@ class Pipeline:
     shard-eligible stages see it), and ``dispatch_depth`` opens an
     in-flight window so a runner drains the next micro-batch while the
     previous one is still executing — see BATCHING.md "Sharded dispatch".
+    ``fetch_depth`` is the OUTPUT-side twin: up to that many sink buffers
+    resolve D2H / deferred host_post concurrently on a background pool, so
+    fetches overlap the next dispatch instead of serializing in ``pop()``;
+    ``donate_ingress`` donates host-fed (appsrc) input buffers to the
+    fused program so steady-state H2D reuses HBM; ``reduce_outputs`` lets
+    the HBM-residency planner auto-select a model's reduced output (e.g.
+    deeplab's native-stride class map) when every downstream consumer's
+    caps admit it — see docs/FETCH.md.  The plan is exposed as
+    ``Pipeline.residency``.
     ``trace_mode`` (``off``/``ring``/``full``) switches on the per-buffer
     flight recorder: span events for every stage/queue/batch/dispatch
     keyed by trace ids assigned at source ingress, dumped with
@@ -604,6 +613,9 @@ class Pipeline:
         batch_linger_ms: Optional[float] = None,
         data_parallel: Optional[int] = None,
         dispatch_depth: Optional[int] = None,
+        fetch_depth: Optional[int] = None,
+        donate_ingress: Optional[bool] = None,
+        reduce_outputs: Optional[bool] = None,
         trace_mode: Optional[str] = None,
         validate: Union[bool, str] = False,
     ):
@@ -655,6 +667,14 @@ class Pipeline:
         self.dispatch_depth = max(1, int(
             dispatch_depth if dispatch_depth is not None
             else cfg.dispatch_depth))
+        self.fetch_depth = max(1, int(
+            fetch_depth if fetch_depth is not None else cfg.fetch_depth))
+        self.donate_ingress = bool(
+            donate_ingress if donate_ingress is not None
+            else cfg.donate_ingress)
+        self.reduce_outputs = bool(
+            reduce_outputs if reduce_outputs is not None
+            else cfg.reduce_outputs)
         self.trace_mode = str(
             trace_mode if trace_mode is not None else cfg.trace_mode)
         if self.trace_mode not in ("off", "ring", "full"):
@@ -680,13 +700,37 @@ class Pipeline:
                 el = cls(dict(node.props), name=node.name or f"{node.kind}{node.id}")
             self.elements[node.id] = el
 
-        # 2. caps negotiation in topo order
+        # 2. HBM-residency pre-pass: mark filters whose downstream
+        # consumers ALL admit reduced output geometry, so negotiation
+        # below can switch them to the model's reduced variant — "fetch
+        # the smaller thing" by default (pipeline/residency.py,
+        # docs/FETCH.md).  Runs BEFORE negotiation: it changes the specs.
+        from . import residency as _residency
+
+        if self.reduce_outputs:
+            _residency.mark_reduced_admissible(graph, self.elements)
+
+        # 3. caps negotiation in topo order
         self._negotiate()
 
-        # 3. plan stages (fusion pass)
-        self.stages: List[Stage] = plan_stages(graph, self.elements, fuse=fuse)
+        # 4. plan stages (fusion pass + ingress donation)
+        self.stages: List[Stage] = plan_stages(
+            graph, self.elements, fuse=fuse,
+            donate_ingress=self.donate_ingress)
 
-        # 4. wire runners
+        # 5. residency plan: what crosses to host per sink edge (logged;
+        # exposed as Pipeline.residency for apps/bench/tests)
+        self.residency = _residency.plan_residency(
+            graph, self.elements, self.stages)
+        if self.residency.fetch or self.residency.reduced_outputs:
+            log.info("%s", self.residency.render())
+        # sinks read the pipeline's fetch window width (same attach
+        # pattern as _batch_buckets)
+        for el in self.elements.values():
+            if isinstance(el, SinkElement):
+                el._fetch_depth = self.fetch_depth
+
+        # 6. wire runners
         self._runners: Dict[int, _Runner] = {}
         node_to_stage: Dict[int, Stage] = {}
         for st in self.stages:

@@ -49,6 +49,10 @@ SPAN_KINDS: Dict[str, str] = {
     "inflight": "dispatched-but-unemitted window (dispatch_depth > 1)",
     "shard": "sharded bucketed dispatch incl. the assembled host fetch",
     "fetch": "sink host materialization (D2H / deferred host_post)",
+    "fetch.window": "buffer submitted into a sink's async fetch window "
+                    "(instant; args: depth = submitted-but-unmaterialized "
+                    "fetches; CONCURRENCY is bounded by fetch_depth, the "
+                    "backlog only by queue capacity — docs/FETCH.md)",
     "e2e": "source ingress -> sink delivery for one buffer",
     "serve.admit": "continuous LLM serving: prompt admitted into a slot "
                    "(args: slot, tokens, blocks reserved)",

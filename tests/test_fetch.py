@@ -1,0 +1,466 @@
+"""HBM-residency planner + pipelined async fetch engine (ISSUE 7).
+
+The contract under test (docs/FETCH.md):
+
+* ``fetch_depth`` opens an async fetch window at sinks — up to that many
+  buffers resolve D2H / deferred host_post concurrently — with emission
+  order strictly FIFO whatever order resolutions finish;
+* EOS and stage errors flush the window: everything admitted before the
+  boundary is still delivered;
+* host-fed ingress donation (``donate_ingress``) is bit-identical to the
+  non-donated path and only planned where sole ownership is provable;
+* device-resident intermediate edges NEVER cross to host (transfers
+  trapped, the way deep-lint tests trap dispatch);
+* the planner auto-selects a model's REDUCED output exactly when every
+  downstream consumer admits it;
+* the deep lint prices per-sink-edge fetch bytes against the calibrated
+  link and flags ``fetch-bound`` pipelines with zero device dispatch.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.analysis import analyze
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.pipeline.runtime import PipelineError
+from nnstreamer_tpu.core.config import get_config
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.pipeline.residency import (HBM_GBPS, compute_floor_ms,
+                                               fetch_ms)
+
+DIMS = 16
+
+DESC = (
+    f"appsrc name=src caps=other/tensors,dimensions={DIMS},types=float32 ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,add:1.0 ! "
+    f"tensor_filter framework=jax model=scaler custom=scale:2.0,dims:{DIMS} "
+    "name=f ! tensor_sink name=out"
+)
+
+SEG = (
+    "videotestsrc device=true batch=2 num-buffers=4 width=64 height=64 "
+    "name=src ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+    "tensor_filter framework=jax model=deeplab_mobilenet "
+    "custom=size:64,batch:2 name=f ! "
+    "tensor_decoder mode=image_segment option1=classmap ! "
+    "tensor_sink name=out"
+)
+
+
+def _frames(n):
+    return [np.full((DIMS,), float(i), np.float32) for i in range(n)]
+
+
+def codes(report):
+    return [d.code for d in report.diagnostics]
+
+
+# ---------------------------------------------------------------------------
+# fetch window: in-order emission, flush, accounting
+# ---------------------------------------------------------------------------
+
+def test_fetch_window_in_order_with_random_delays(monkeypatch):
+    """fetch_depth=2 resolves materializations concurrently; randomized
+    per-buffer delays must not reorder what pop() returns."""
+    real = Buffer.to_host
+    rng = random.Random(7)
+
+    def slow(self):
+        time.sleep(rng.random() * 0.004)
+        return real(self)
+
+    monkeypatch.setattr(Buffer, "to_host", slow)
+    n = 24
+    p = nt.Pipeline(DESC, fetch_depth=2)
+    outs = []
+    with p:
+        for i, x in enumerate(_frames(n)):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in range(n):
+            outs.append(p.pull("out", timeout=60))
+        p.eos()
+        p.wait(timeout=60)
+    assert [o.pts for o in outs] == list(range(n))
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(
+            np.asarray(o.tensors[0]), (float(i) + 1.0) * 2.0)
+
+
+def test_fetch_depth_resolution_prop_beats_pipeline_beats_config():
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    el = TensorSink({"fetch_depth": 5})
+    assert el.fetch_depth == 5
+    el2 = TensorSink({})
+    el2._fetch_depth = 3  # what the runner attaches from the pipeline knob
+    assert el2.fetch_depth == 3
+    el3 = TensorSink({})
+    assert el3.fetch_depth == max(1, get_config().fetch_depth)
+
+
+def test_eos_flushes_fetch_window():
+    """Buffers admitted before EOS are all delivered after wait() — the
+    window's pending resolutions survive the pipeline winding down."""
+    n = 12
+    p = nt.Pipeline(DESC, fetch_depth=2)
+    with p:
+        for i, x in enumerate(_frames(n)):
+            p.push("src", nt.Buffer([x], pts=i))
+        p.eos()
+        p.wait(timeout=60)
+        outs = [p.pull("out", timeout=30) for _ in range(n)]
+    assert [o.pts for o in outs] == list(range(n))
+
+
+def test_stage_error_still_delivers_prior_window():
+    """A stage failure mid-stream flushes, not drops, the buffers that
+    were already past it (then check() reports the failure)."""
+    from nnstreamer_tpu.core.types import TensorsSpec
+    from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+    calls = {"n": 0}
+
+    def boom(ins):
+        calls["n"] += 1
+        if calls["n"] > 4:
+            raise RuntimeError("deliberate stage failure")
+        return [np.asarray(ins[0]) * 2.0]
+
+    spec = TensorsSpec.from_string(str(DIMS), "float32")
+    register_custom_easy("fetch-boom", boom, in_spec=spec, out_spec=spec)
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions={DIMS},"
+        "types=float32 ! "
+        "tensor_filter framework=custom-easy model=fetch-boom name=f ! "
+        "tensor_sink name=out"
+    )
+    p = nt.Pipeline(desc, fetch_depth=2)
+    outs = []
+    with p:
+        src = p.element("src")
+        for i, x in enumerate(_frames(8)):
+            # raw element push: Pipeline.push() re-checks for errors and
+            # would raise mid-loop once the failure lands
+            src.push(nt.Buffer([x], pts=i))
+        for _ in range(4):
+            outs.append(p.pull("out", timeout=30))
+        time.sleep(0.3)  # let the failing buffer hit the stage
+        with pytest.raises(PipelineError):
+            p.check()
+    assert [o.pts for o in outs] == [0, 1, 2, 3]
+
+
+def test_materialization_timeout_carries_trace_id(monkeypatch, caplog):
+    """A fetch-window timeout names the buffer's trace id and dumps the
+    flight-recorder ring, like watchdog fires (satellite: host_post
+    resolver errors are debuggable)."""
+    import logging
+
+    real = Buffer.to_host
+
+    def very_slow(self):
+        time.sleep(1.5)
+        return real(self)
+
+    monkeypatch.setattr(Buffer, "to_host", very_slow)
+    p = nt.Pipeline(DESC, fetch_depth=2, trace_mode="ring")
+    with caplog.at_level(logging.ERROR,
+                         logger="nnstreamer_tpu.elements.sink"):
+        with p:
+            p.push("src", nt.Buffer([_frames(1)[0]], pts=0))
+            # wait for the stage to SUBMIT the future (first-buffer jit
+            # compile is load-dependent) so the short pull timeout below
+            # bounds materialization, not arrival
+            sink = p.element("out")
+            deadline = time.monotonic() + 30.0
+            while sink._q.empty() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not sink._q.empty(), "stage never delivered the future"
+            with pytest.raises(TimeoutError) as ei:
+                p.pull("out", timeout=0.25)
+    assert "trace id" in str(ei.value)
+    assert any("flight recorder" in r.message for r in caplog.records)
+
+
+def test_wait_stall_accounting_split():
+    """h2d (appsrc admission) and d2h (sink materialization) waits land in
+    SEPARATE metric series — the satellite's rtt_stalls split."""
+    metrics.reset()
+    desc = DESC.replace("appsrc name=src", "appsrc name=src max-inflight=1")
+    p = nt.Pipeline(desc, fetch_depth=1)
+    with p:
+        for i, x in enumerate(_frames(6)):
+            p.push("src", nt.Buffer([x], pts=i))
+            p.pull("out", timeout=30)
+        p.eos()
+        p.wait(timeout=30)
+    snap = metrics.snapshot()
+    assert "src.h2d_wait_ms" in snap
+    assert "out.d2h_wait_ms" in snap
+
+
+def test_fetch_window_span_and_gauge():
+    """With tracing on, every window submit records a fetch.window span
+    carrying the outstanding depth."""
+    from nnstreamer_tpu.utils import tracing
+
+    tracing.recorder.configure("ring")
+    tracing.recorder.clear()
+    p = nt.Pipeline(DESC, fetch_depth=2, trace_mode="ring")
+    with p:
+        for i, x in enumerate(_frames(8)):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in range(8):
+            p.pull("out", timeout=30)
+        p.eos()
+        p.wait(timeout=30)
+    spans = [e for e in tracing.recorder.events() if e.kind == "fetch.window"]
+    assert spans, "no fetch.window spans recorded"
+    assert all(e.args and e.args.get("depth", 0) >= 1 for e in spans)
+    tracing.recorder.configure("off")
+
+
+# ---------------------------------------------------------------------------
+# ingress donation
+# ---------------------------------------------------------------------------
+
+def _fused_stages(p):
+    return [s.element for s in p.stages if s.element.kind == "fused"]
+
+
+def test_ingress_donation_planned_and_bit_identical():
+    x = np.arange(DIMS, dtype=np.float32)
+    outs = {}
+    for flag in (True, False):
+        p = nt.Pipeline(DESC, donate_ingress=flag)
+        fused = _fused_stages(p)
+        assert fused and fused[0]._ingress_put is flag
+        with p:
+            p.push("src", x)
+            outs[flag] = np.asarray(p.pull("out", timeout=60).tensors[0])
+            p.eos()
+            p.wait(timeout=30)
+    assert np.array_equal(outs[True], outs[False])
+
+
+def test_donation_vetoed_without_sole_consumer():
+    """A source feeding a tee is not sole-consumed by the fused chain —
+    the planner must not donate."""
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions={DIMS},"
+        "types=float32 ! tee name=t "
+        "t. ! tensor_transform mode=arithmetic option=typecast:float32,"
+        "add:1.0 ! "
+        f"tensor_filter framework=jax model=scaler custom=scale:2.0,"
+        f"dims:{DIMS} name=f ! tensor_sink name=out "
+        "t. ! fakesink name=devnull"
+    )
+    p = nt.Pipeline(desc, donate_ingress=True)
+    for fe in _fused_stages(p):
+        assert not fe._ingress_put
+
+
+def test_device_source_fold_keeps_plain_donation():
+    """The folded device-source path donates WITHOUT the ingress
+    device_put (its arrays are already device-minted)."""
+    p = nt.Pipeline(
+        "videotestsrc device=true batch=2 num-buffers=4 width=16 "
+        "height=16 name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,"
+        "div:255.0 ! tensor_sink name=out", donate_ingress=True)
+    folded = [s.element for s in p.stages
+              if getattr(s.element, "fused", None) is not None]
+    assert folded
+    assert folded[0].fused._donate and not folded[0].fused._ingress_put
+
+
+# ---------------------------------------------------------------------------
+# device residency: zero D2H on intermediate edges
+# ---------------------------------------------------------------------------
+
+def test_device_resident_intermediate_edges_zero_d2h(monkeypatch):
+    """Between the fused device stage and a to_host=false sink (through a
+    tee), NOTHING may cross to host: the framework's fetch chokepoints
+    (Buffer.to_host / Buffer.resolve) are trapped, the way deep-lint
+    tests trap dispatch."""
+    desc = (
+        "videotestsrc device=true batch=2 num-buffers=6 width=16 "
+        "height=16 name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,"
+        "div:255.0 ! tee name=t "
+        "t. ! tensor_sink name=a to_host=false "
+        "t. ! tensor_sink name=b to_host=false"
+    )
+    p = nt.Pipeline(desc)
+
+    def trap(self):
+        raise AssertionError("D2H on a device-resident path")
+
+    monkeypatch.setattr(Buffer, "to_host", trap)
+    monkeypatch.setattr(Buffer, "resolve", trap)
+    with p:
+        for _ in range(3):
+            a = p.pull("a", timeout=60)
+            b = p.pull("b", timeout=60)
+            assert a.on_device and b.on_device
+        p.wait(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# reduced-output selection goldens
+# ---------------------------------------------------------------------------
+
+def test_reduced_output_selected_for_classmap():
+    p = nt.Pipeline(SEG)
+    assert p.residency.reduced_outputs == ["f"]
+    [edge] = p.residency.fetch
+    assert edge.reduced == "fused host_post"
+    # native stride 64/16 = 4: the classmap payload is 2*4*4 u8
+    assert edge.bytes_per_buffer == 2 * 4 * 4
+    with p:
+        out = p.pull("out", timeout=120)
+        p.wait(timeout=120)
+    assert np.asarray(out.tensors[0]).shape == (2, 4, 4)
+
+
+def test_reduced_output_not_selected_for_overlay():
+    p = nt.Pipeline(SEG.replace(" option1=classmap", ""))
+    assert p.residency.reduced_outputs == []
+    with p:
+        out = p.pull("out", timeout=120)
+        p.wait(timeout=120)
+    assert np.asarray(out.tensors[0]).shape == (2, 64, 64, 4)
+
+
+def test_reduced_output_not_selected_when_pinned():
+    """An explicit upsample option pins the geometry: no offer, even with
+    an admitting consumer chain."""
+    p = nt.Pipeline(SEG.replace("custom=size:64,batch:2",
+                                "custom=size:64,batch:2,upsample:1"))
+    assert p.residency.reduced_outputs == []
+    with p:
+        out = p.pull("out", timeout=120)
+        p.wait(timeout=120)
+    assert np.asarray(out.tensors[0]).shape == (2, 64, 64)
+
+
+def test_reduced_output_knob_opt_out():
+    p = nt.Pipeline(SEG, reduce_outputs=False)
+    assert p.residency.reduced_outputs == []
+
+
+def test_reduced_output_matches_explicit_native_stride():
+    """Planner-selected reduced output is bit-identical to the hand-tuned
+    custom=upsample:0 row it replaces."""
+    auto = nt.Pipeline(SEG)
+    hand = nt.Pipeline(SEG.replace("custom=size:64,batch:2",
+                                   "custom=size:64,batch:2,upsample:0"))
+    outs = {}
+    for tag, p in (("auto", auto), ("hand", hand)):
+        with p:
+            outs[tag] = np.asarray(p.pull("out", timeout=120).tensors[0])
+            p.wait(timeout=120)
+    assert np.array_equal(outs["auto"], outs["hand"])
+
+
+# ---------------------------------------------------------------------------
+# deep lint: fetch pricing + fetch-bound
+# ---------------------------------------------------------------------------
+
+FETCH_BOUND = (
+    "videotestsrc device=true batch=8 num-buffers=32 width=224 height=224 "
+    "name=src ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+    "tensor_filter framework=jax model=deeplab_mobilenet "
+    "custom=size:224,batch:8 name=f ! "
+    "tensor_decoder mode=image_segment ! tensor_sink name=out"
+)
+
+
+def test_fetch_pricing_units():
+    assert fetch_ms(38_200_000, 38.2) == pytest.approx(1000.0)
+    assert fetch_ms(0, 38.2, rtt_ms=88.0) == pytest.approx(88.0)
+    assert fetch_ms(1 << 20, 0.0) == 0.0  # uncalibrated: never priced
+    assert compute_floor_ms(int(HBM_GBPS * 1e9)) == pytest.approx(1e3)
+
+
+@pytest.fixture
+def calibrated_link():
+    cfg = get_config()
+    old = (cfg.link_d2h_mbps, cfg.link_fetch_rtt_ms)
+    cfg.link_d2h_mbps, cfg.link_fetch_rtt_ms = 38.2, 88.0
+    yield cfg
+    cfg.link_d2h_mbps, cfg.link_fetch_rtt_ms = old
+
+
+def test_deep_lint_flags_fetch_bound(calibrated_link):
+    report = analyze(FETCH_BOUND, deep=True)
+    assert "fetch-bound" in codes(report)
+    edges = report.resources.fetch_edges
+    assert len(edges) == 1
+    # overlay host_post ships the full-res u8 class map: 8*224*224
+    assert edges[0].bytes_per_buffer == 8 * 224 * 224
+    assert edges[0].reduced == "fused host_post"
+    assert edges[0].d2h_ms > edges[0].compute_floor_ms > 0
+
+
+def test_deep_lint_fetch_ok_for_tiny_payload(calibrated_link):
+    """Classification's fused argmax ships bytes, not frames: priced but
+    never flagged."""
+    desc = (
+        "videotestsrc device=true batch=64 num-buffers=64 width=224 "
+        "height=224 name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,"
+        "add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax model=mobilenet_v1 "
+        "custom=size:224,batch:64 name=f ! "
+        "tensor_decoder mode=image_labeling ! tensor_sink name=out"
+    )
+    report = analyze(desc, deep=True)
+    assert "fetch-bound" not in codes(report)
+    [edge] = report.resources.fetch_edges
+    assert edge.bytes_per_buffer == 64 * (4 + 4)  # [B]i32 + [B]f32
+
+
+def test_deep_lint_fetch_unpriced_without_calibration():
+    report = analyze(FETCH_BOUND, deep=True)
+    assert "fetch-bound" not in codes(report)
+    [edge] = report.resources.fetch_edges
+    assert edge.bytes_per_buffer == 8 * 224 * 224
+    assert edge.d2h_ms == 0.0
+
+
+def test_fetch_check_zero_device_dispatch(monkeypatch, calibrated_link):
+    """The fetch pricing pass is pure arithmetic: the fetch-bound verdict
+    lands with every jit call and device_put trapped."""
+    import jax
+
+    real_jit = jax.jit
+
+    def guarded_jit(*a, **k):
+        real_jit(*a, **k)
+
+        def trap(*aa, **kk):
+            raise AssertionError("jit-compiled call during deep analysis")
+
+        return trap
+
+    def no_device_put(*a, **k):
+        raise AssertionError("device_put during deep analysis")
+
+    monkeypatch.setattr(jax, "jit", guarded_jit)
+    monkeypatch.setattr(jax, "device_put", no_device_put)
+    report = analyze(FETCH_BOUND, deep=True)
+    assert "analyzer-error" not in codes(report), report.render()
+    assert "fetch-bound" in codes(report)
+
+
+def test_resource_report_renders_fetch_edges(calibrated_link):
+    text = analyze(FETCH_BOUND, deep=True).resources.render()
+    assert "fetch out <- " in text
+    assert "d2h" in text and "compute floor" in text

@@ -34,6 +34,11 @@ ROWS = [
     ("classification_quant", ["--config", "classification_quant"]),
     ("classification_appsrc", ["--config", "classification",
                                "--source", "appsrc"]),
+    # fetch-engine A/B (ISSUE 7): fetch_depth=2 + ingress donation vs the
+    # serial resolver — the row carries the h2d/d2h stall split,
+    # fetch_overlap_ms and window depth; the appsrc/segmentation rows
+    # above/below carry the same fields for their own paths
+    ("async_fetch_ab", ["--config", "fetch"]),
     ("detection_ssd", ["--config", "detection"]),
     ("detection_yolov5s", ["--config", "detection",
                            "--detection-model", "yolov5s"]),

@@ -39,6 +39,14 @@ must serve bucketed histograms for stage latency / queue wait / e2e
 latency, and ``trace_mode=off`` must be STRUCTURALLY untraced (recorder
 monkeypatched to raise) with measured overhead within 2%.
 
+AND it runs the fetch gate (docs/FETCH.md): tests/test_fetch.py in its own
+pytest process (fetch-window in-order emission, ingress-donation identity,
+zero-d2h pins for device-resident edges, reduced-output selection
+goldens), then ``lint --deep`` over examples/fetch_bound.py with the
+calibrated link pinned (NNS_TPU_LINK_D2H_MBPS/NNS_TPU_LINK_RTT_MS),
+asserting the ``fetch-bound`` diagnostic fires, strict against
+tools/fetch_deep_baseline.txt.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -63,6 +71,15 @@ FLOOR_FILE = os.path.join(REPO, "tools", "tier1_floor.txt")
 LINT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
 DEEP_BASELINE = os.path.join(REPO, "tools", "deep_baseline.txt")
 SERVING_BASELINE = os.path.join(REPO, "tools", "serving_deep_baseline.txt")
+FETCH_BASELINE = os.path.join(REPO, "tools", "fetch_deep_baseline.txt")
+
+#: calibrated link the fetch gate pins for the deliberately fetch-bound
+#: example (the BENCH_ALL_r5 ``link_calibration`` row: 38.2 MB/s d2h,
+#: 88 ms small-fetch RTT) — the ``fetch-bound`` diagnostic must fire and
+#: be baseline-accepted, proving planned fetch bytes are actually priced
+#: against Config.link_d2h_mbps, not just rendered.
+FETCH_GATE_D2H_MBPS = "38.2"
+FETCH_GATE_RTT_MS = "88"
 
 #: HBM budget the serving gate pins for the example's deep lint: far
 #: below the llama_tiny estimate, so the hbm-budget warning (naming the
@@ -237,6 +254,57 @@ def run_serving_gate(update: bool, timeout: int = 900) -> int:
     return 0
 
 
+def run_fetch_gate(update: bool, timeout: int = 900) -> int:
+    """Fetch-engine gate (docs/FETCH.md): tests/test_fetch.py as its own
+    pytest process (in-order fetch-window emission, donation identity,
+    zero-d2h pins, reduced-output selection goldens), then ``lint --deep``
+    over the deliberately fetch-bound example with the calibrated link
+    pinned — the ``fetch-bound`` diagnostic must fire, strict against
+    tools/fetch_deep_baseline.txt."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_fetch.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"fetch gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"fetch gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    env["NNS_TPU_LINK_D2H_MBPS"] = FETCH_GATE_D2H_MBPS
+    env["NNS_TPU_LINK_RTT_MS"] = FETCH_GATE_RTT_MS
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--deep", "-v", "--strict",
+           "--files", os.path.join("examples", "fetch_bound.py"),
+           "--baseline", FETCH_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("fetch gate: deep lint TIMED OUT after 300s", file=sys.stderr)
+        return 2
+    flagged = "fetch-bound" in lint.stdout
+    ok = lint.returncode == 0 and flagged
+    tag = ("updated" if update else
+           "OK" if ok else
+           "FETCH-BOUND NOT FLAGGED" if not flagged else "NEW DIAGNOSTICS")
+    print(f"fetch gate: {tag} ({passed} tests passed)")
+    if not ok and not update:
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -252,7 +320,9 @@ def main() -> int:
     sharded_rc = run_sharded_gate()
     tracing_rc = run_tracing_gate()
     serving_rc = run_serving_gate(args.update)
-    lint_rc = lint_rc or deep_rc or sharded_rc or tracing_rc or serving_rc
+    fetch_rc = run_fetch_gate(args.update)
+    lint_rc = (lint_rc or deep_rc or sharded_rc or tracing_rc or serving_rc
+               or fetch_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
