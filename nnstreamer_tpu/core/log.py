@@ -19,6 +19,18 @@ Prometheus text format, docs/OBSERVABILITY.md):
   (``LATENCY_BUCKETS``), the real ``_bucket``/``_sum``/``_count``
   exposition Prometheus can aggregate across scrapes.
 
+Every family optionally splits **per tenant** (docs/SERVING.md "Front
+door"): ``count/gauge/observe_latency`` accept ``tenant=``.  For
+counters and latency observations a non-None tenant updates BOTH the
+base series (the aggregate everyone already scrapes) and a labeled twin
+rendered as ``{tenant="..."}`` samples under the same exposition
+family.  Gauges are the exception: a tenant gauge writes ONLY the
+labeled twin — gauges are set-not-add, so writing one tenant's value
+through to the base sample would clobber the aggregate (the base gauge
+is set separately, e.g. by the runtime sampler).  ``tenant=None`` is
+byte-for-byte the pre-tenant hot path — no extra lookups, no labeled
+state.
+
 Thread-safety discipline: every mutation and every raw-state copy happens
 under one lock, but derived work (sorting reservoirs for quantiles) runs
 on the COPY outside the lock — concurrent runner writes never stall
@@ -80,15 +92,30 @@ class Metrics:
         # name -> [bucket_counts(len(LATENCY_BUCKETS)+1 incl +Inf),
         #          sum, count]
         self._hist: Dict[str, list] = {}
+        # labeled twins, keyed (name, tenant) — populated only when a
+        # caller passes tenant= (docs/SERVING.md "Front door")
+        self._lcounters: Dict[Tuple[str, str], float] = \
+            collections.defaultdict(float)
+        self._lgauges: Dict[Tuple[str, str], float] = {}
+        self._llat: Dict[Tuple[str, str], List[float]] = \
+            collections.defaultdict(list)
+        self._lhist: Dict[Tuple[str, str], list] = {}
 
-    def count(self, name: str, value: float = 1.0) -> None:
+    def count(self, name: str, value: float = 1.0,
+              tenant: Optional[str] = None) -> None:
         with self._lock:
             self._counters[name] += value
+            if tenant is not None:
+                self._lcounters[(name, tenant)] += value
 
-    def gauge(self, name: str, value: float) -> None:
+    def gauge(self, name: str, value: float,
+              tenant: Optional[str] = None) -> None:
         """Set an instantaneous value (queue depth, staleness watermark)."""
         with self._lock:
-            self._gauges[name] = float(value)
+            if tenant is not None:
+                self._lgauges[(name, tenant)] = float(value)
+            else:
+                self._gauges[name] = float(value)
 
     def observe(self, name: str, value: float) -> None:
         """Record one sample of a distribution (batch occupancy, sizes,
@@ -96,37 +123,79 @@ class Metrics:
         is BOUNDED at ``_lat_cap`` (decimation), so a hot series costs
         O(cap) memory for the process lifetime, not O(samples)."""
         with self._lock:
-            self._observe_locked(name, value)
+            self._observe_locked(self._lat, name, value)
 
-    def _observe_locked(self, name: str, value: float) -> None:
-        r = self._lat[name]
+    def _observe_locked(self, store, key, value: float) -> None:
+        r = store[key]
         if len(r) >= self._lat_cap:
             # reservoir decimation: keep every other sample
             del r[::2]
         r.append(value)
 
-    def observe_latency(self, name: str, seconds: float) -> None:
+    def _hist_locked(self, store, key, i: int, seconds: float) -> None:
+        h = store.get(key)
+        if h is None:
+            h = store[key] = [[0] * (len(LATENCY_BUCKETS) + 1), 0.0, 0]
+        h[0][i] += 1
+        h[1] += seconds
+        h[2] += 1
+
+    def observe_latency(self, name: str, seconds: float,
+                        tenant: Optional[str] = None) -> None:
         """observe() + cumulative fixed-bucket histogram update — the
-        series Prometheus can aggregate (``<name>_bucket{le=...}``)."""
+        series Prometheus can aggregate (``<name>_bucket{le=...}``).
+        ``tenant`` additionally feeds the labeled twin series."""
         i = bisect.bisect_left(LATENCY_BUCKETS, seconds)
         with self._lock:
-            self._observe_locked(name, seconds)
-            h = self._hist.get(name)
-            if h is None:
-                h = self._hist[name] = [
-                    [0] * (len(LATENCY_BUCKETS) + 1), 0.0, 0]
-            h[0][i] += 1
-            h[1] += seconds
-            h[2] += 1
+            self._observe_locked(self._lat, name, seconds)
+            self._hist_locked(self._hist, name, i, seconds)
+            if tenant is not None:
+                key = (name, tenant)
+                self._observe_locked(self._llat, key, seconds)
+                self._hist_locked(self._lhist, key, i, seconds)
 
-    def percentile(self, name: str, q: float) -> Optional[float]:
+    def observe_latency_labeled(self, name: str, seconds: float,
+                                tenant: str) -> None:
+        """Update ONLY the labeled twin (no base-series sample) — for
+        call sites that already fed the base series once per dispatch
+        and split the amortized per-row time across member tenants."""
+        i = bisect.bisect_left(LATENCY_BUCKETS, seconds)
         with self._lock:
-            r = list(self._lat.get(name, ()))
+            key = (name, tenant)
+            self._observe_locked(self._llat, key, seconds)
+            self._hist_locked(self._lhist, key, i, seconds)
+
+    def percentile(self, name: str, q: float,
+                   tenant: Optional[str] = None) -> Optional[float]:
+        with self._lock:
+            if tenant is not None:
+                r = list(self._llat.get((name, tenant), ()))
+            else:
+                r = list(self._lat.get(name, ()))
         if not r:
             return None
         r.sort()  # on the copy — never under the lock
         idx = min(len(r) - 1, max(0, math.ceil(q / 100.0 * len(r)) - 1))
         return r[idx]
+
+    def fraction_over(self, name: str, threshold_s: float,
+                      tenant: Optional[str] = None
+                      ) -> Tuple[float, int]:
+        """(fraction of recorded samples strictly above ``threshold_s``,
+        total samples) for one ``observe_latency`` series, computed from
+        the cumulative histogram — the SLO engine's bad-event source
+        (utils/slo.py).  Resolution is one bucket: samples in the bucket
+        the threshold falls into count as UNDER (optimistic by at most
+        one bucket width)."""
+        with self._lock:
+            h = (self._lhist.get((name, tenant)) if tenant is not None
+                 else self._hist.get(name))
+            if h is None or not h[2]:
+                return 0.0, 0
+            counts, _total, n = list(h[0]), h[1], h[2]
+        j = bisect.bisect_left(LATENCY_BUCKETS, threshold_s)
+        over = sum(counts[j + 1:])
+        return over / n, n
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -152,12 +221,51 @@ class Metrics:
             return {name: (list(h[0]), h[1], h[2])
                     for name, h in self._hist.items()}
 
+    # -- labeled (per-tenant) accessors -----------------------------------
+    def labeled_histograms(self) -> Dict[Tuple[str, str],
+                                         Tuple[List[int], float, int]]:
+        """Copy of every tenant-labeled latency histogram:
+        (name, tenant) -> (bucket counts incl. +Inf, sum_seconds, n)."""
+        with self._lock:
+            return {key: (list(h[0]), h[1], h[2])
+                    for key, h in self._lhist.items()}
+
+    def reservoir(self, name: str,
+                  tenant: Optional[str] = None) -> List[float]:
+        """Copy of one distribution's bounded reservoir (the quantile
+        source) — base series, or the labeled twin when ``tenant``."""
+        with self._lock:
+            if tenant is not None:
+                return list(self._llat.get((name, tenant), ()))
+            return list(self._lat.get(name, ()))
+
+    def labeled_counters(self) -> Dict[Tuple[str, str], float]:
+        with self._lock:
+            return dict(self._lcounters)
+
+    def labeled_gauges(self) -> Dict[Tuple[str, str], float]:
+        with self._lock:
+            return dict(self._lgauges)
+
+    def tenants(self, name: str) -> List[str]:
+        """Sorted tenant label values seen on any labeled family whose
+        series name equals ``name`` (histograms + counters + gauges)."""
+        with self._lock:
+            seen = {t for (n, t) in self._lhist if n == name}
+            seen.update(t for (n, t) in self._lcounters if n == name)
+            seen.update(t for (n, t) in self._lgauges if n == name)
+        return sorted(seen)
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._lat.clear()
             self._hist.clear()
+            self._lcounters.clear()
+            self._lgauges.clear()
+            self._llat.clear()
+            self._lhist.clear()
 
 
 metrics = Metrics()

@@ -45,6 +45,10 @@ log = logger(__name__)
 
 _META_MSG = "_query_msg"
 _META_CONN = "_query_conn"
+#: tenant identity riding the wire meta (utils/tracing.META_TENANT):
+#: stamped by the client (``tenant=`` prop / appsrc / hello fallback),
+#: read by the server for per-tenant accounting + admission decisions
+_META_TENANT = "_tenant"
 #: serversrc batching: list of per-request meta dicts riding one stacked
 #: buffer; serversink splits output rows back to each client.
 _META_BATCH = "_query_batch"
@@ -65,13 +69,40 @@ class _ServerCore:
     The serversrc drains ``inbound``; the serversink routes responses back
     through ``send()`` using the connection id stamped into buffer meta
     (the GstMetaQuery analog).
+
+    **Admission control** (docs/SERVING.md "Front door"): ``max_backlog``
+    bounds the inbound queue; when it is full the ``admission`` policy
+    decides what happens instead of the reader blocking the TCP stream
+    behind an unbounded backlog:
+
+    * ``block`` — the pre-admission behavior: the reader stalls until
+      space frees (TCP backpressure propagates to the client's send);
+    * ``shed`` — the request is DROPPED and the client receives an
+      immediate empty response with ``meta["shed"]=True`` (same msg id),
+      so it is never left waiting out its timeout.  Every shed is
+      counted (``query_server.shed``, split per tenant) and
+      span-stamped ``admit.shed`` with the victim's trace id;
+    * ``downgrade`` — the request moves to a bounded LOW-PRIORITY lane
+      drained only when the main queue is empty (counted as
+      ``query_server.downgraded`` + ``admit.downgrade`` span); if the
+      low lane is also full, it sheds as above.
     """
 
-    def __init__(self, host: str, port: int, topic: str = ""):
+    def __init__(self, host: str, port: int, topic: str = "",
+                 max_backlog: int = 256, admission: str = "block",
+                 on_admit_event=None):
         self.topic = topic
-        self.inbound: _queue.Queue = _queue.Queue(maxsize=256)
+        self.admission = admission
+        self.max_backlog = max_backlog
+        self.inbound: _queue.Queue = _queue.Queue(maxsize=max_backlog)
+        self.lowprio: _queue.Queue = _queue.Queue(maxsize=max_backlog)
+        #: serversrc hook: called as (kind, buf, backlog) for every
+        #: "shed"/"downgrade" decision (span stamping with the element's
+        #: own recorder — the core stays pipeline-agnostic)
+        self.on_admit_event = on_admit_event
         self._conns: Dict[int, socket.socket] = {}
         self._conn_locks: Dict[int, threading.Lock] = {}
+        self._conn_tenants: Dict[int, str] = {}
         self._next_conn = 0
         self._lock = threading.Lock()
         self._listener = TcpListener(host, port, self._reader, name="query")
@@ -82,15 +113,19 @@ class _ServerCore:
         return self._listener.stopping
 
     def _reader(self, conn: socket.socket) -> None:
-        if server_handshake(conn, "hello", self.topic) is None:
+        hello = server_handshake(conn, "hello", self.topic)
+        if hello is None:
             log.warning("query: connection rejected at handshake")
             return
         conn.settimeout(0.2)
+        conn_tenant = str(hello.get("tenant", "") or "") or None
         with self._lock:
             cid = self._next_conn
             self._next_conn += 1
             self._conns[cid] = conn
             self._conn_locks[cid] = threading.Lock()
+            if conn_tenant is not None:
+                self._conn_tenants[cid] = conn_tenant
         try:
             while not self._stopping.is_set():
                 try:
@@ -101,15 +136,75 @@ class _ServerCore:
                     return
                 buf, _flags = wire.decode_buffer(raw)
                 buf.meta[_META_CONN] = cid
-                metrics.count("query_server.in")
-                while not self._stopping.is_set():
-                    try:
-                        self.inbound.put(buf, timeout=0.1)
-                        break
-                    except _queue.Full:
-                        continue
+                if conn_tenant is not None:
+                    # per-frame meta wins; the hello tenant is the
+                    # per-connection fallback
+                    buf.meta.setdefault(_META_TENANT, conn_tenant)
+                metrics.count("query_server.in",
+                              tenant=buf.meta.get(_META_TENANT))
+                self._admit(buf)
         finally:
             self.drop_conn(cid)
+
+    # -- admission ---------------------------------------------------------
+    def backlog(self) -> int:
+        return self.inbound.qsize() + self.lowprio.qsize()
+
+    def _admit(self, buf: Buffer) -> None:
+        if self.admission == "block":
+            while not self._stopping.is_set():
+                try:
+                    self.inbound.put(buf, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
+            metrics.gauge("query_server.backlog", float(self.backlog()))
+            return
+        try:
+            self.inbound.put_nowait(buf)
+        except _queue.Full:
+            if self.admission == "downgrade":
+                try:
+                    self.lowprio.put_nowait(buf)
+                except _queue.Full:
+                    self._shed(buf)
+                else:
+                    metrics.count("query_server.downgraded",
+                                  tenant=buf.meta.get(_META_TENANT))
+                    if self.on_admit_event is not None:
+                        self.on_admit_event("downgrade", buf,
+                                            self.backlog())
+            else:
+                self._shed(buf)
+        metrics.gauge("query_server.backlog", float(self.backlog()))
+
+    def _shed(self, buf: Buffer) -> None:
+        """Drop one request at admission: count it per tenant, notify the
+        serversrc (span), and answer the client immediately with an empty
+        ``shed`` response so its slot never waits out the timeout."""
+        tenant = buf.meta.get(_META_TENANT)
+        metrics.count("query_server.shed", tenant=tenant)
+        if self.on_admit_event is not None:
+            self.on_admit_event("shed", buf, self.backlog())
+        cid = buf.meta.get(_META_CONN)
+        mid = buf.meta.get(_META_MSG)
+        if cid is None or mid is None:
+            return  # nothing to answer (not a query-framed request)
+        notice = Buffer([], meta={_META_MSG: mid, "shed": True})
+        if tenant is not None:
+            notice.meta[_META_TENANT] = tenant
+        self.send(int(cid), wire.encode_buffer(notice))
+
+    def pop_request(self, timeout: float) -> Optional[Buffer]:
+        """Next admitted request: the main queue strictly first, the
+        low-priority lane only when the main queue is empty."""
+        try:
+            return self.inbound.get(timeout=timeout)
+        except _queue.Empty:
+            try:
+                return self.lowprio.get_nowait()
+            except _queue.Empty:
+                return None
 
     def send(self, cid: int, payload: bytes) -> bool:
         with self._lock:
@@ -129,6 +224,7 @@ class _ServerCore:
         with self._lock:
             conn = self._conns.pop(cid, None)
             self._conn_locks.pop(cid, None)
+            self._conn_tenants.pop(cid, None)
         if conn is not None:
             try:
                 conn.close()
@@ -154,7 +250,11 @@ class TensorQueryServerSrc(SourceElement):
 
     Props: ``host`` (default 127.0.0.1), ``port`` (0 = OS-assigned; read the
     bound port via ``.bound_port``), ``id`` (pairs with the serversink of the
-    same id), ``topic`` (optional capability filter).
+    same id), ``topic`` (optional capability filter), ``admission``
+    (``block`` | ``shed`` | ``downgrade`` — what happens when the inbound
+    backlog reaches ``max-backlog``; see :class:`_ServerCore` and
+    docs/SERVING.md "Front door"), ``max-backlog`` (inbound queue bound,
+    default 256).
 
     **Dynamic batching** (TPU-first; no reference analog — the reference
     serves one request per invoke): ``max-batch=N`` with
@@ -187,14 +287,48 @@ class TensorQueryServerSrc(SourceElement):
         self.batch_pad = bool(self.props.get("batch_pad", True))
         if self.max_batch < 1:
             raise ElementError(f"{self.name}: max-batch must be >= 1")
+        self.admission = str(self.props.get("admission", "block")).lower()
+        if self.admission not in ("block", "shed", "downgrade"):
+            raise ElementError(
+                f"{self.name}: admission must be block|shed|downgrade, "
+                f"got {self.admission!r}")
+        self.max_backlog = int(self.props.get("max_backlog", 256))
+        if self.max_backlog < 1:
+            raise ElementError(f"{self.name}: max-backlog must be >= 1")
         self._core: Optional[_ServerCore] = None
         self._carry: Optional[Buffer] = None  # shape-mismatch pushback
+
+    def _on_admit_event(self, kind: str, buf: Buffer, backlog: int) -> None:
+        """Span-stamp one admission decision with the victim's trace id
+        (minted here when the client did not send one) — follows THIS
+        pipeline's trace mode via the element-pinned recorder."""
+        tracer = getattr(self, "_trace_rec", None)
+        if tracer is None:
+            return
+        tid = buf.meta.get("_tid")
+        if tid is None:
+            from ..utils import tracing as _tracing
+
+            # stamp the minted id back onto the buffer: a DOWNGRADED
+            # request flows on into the pipeline, and ingress reuses a
+            # pre-existing _tid — so the admission span and the request's
+            # later spans share one timeline
+            tid = buf.meta["_tid"] = _tracing.next_trace_id()
+        args = {"msg": buf.meta.get(_META_MSG), "backlog": backlog}
+        ten = buf.meta.get(_META_TENANT)
+        if ten is not None:
+            args["tenant"] = ten
+        tracer.record(f"admit.{kind}", self.name, tid,
+                      time.monotonic_ns(), 0, **args)
 
     def start(self) -> None:
         with _servers_lock:
             if self.sid in _servers:
                 raise ElementError(f"query server id={self.sid} already running")
-        core = _ServerCore(self.host, self.port, topic=self.topic)
+        core = _ServerCore(self.host, self.port, topic=self.topic,
+                           max_backlog=self.max_backlog,
+                           admission=self.admission,
+                           on_admit_event=self._on_admit_event)
         with _servers_lock:
             if self.sid in _servers:  # lost a construction race
                 core.close()
@@ -222,9 +356,8 @@ class TensorQueryServerSrc(SourceElement):
             first = self._carry
             self._carry = None
             if first is None:
-                try:
-                    first = self._core.inbound.get(timeout=0.1)
-                except _queue.Empty:
+                first = self._core.pop_request(timeout=0.1)
+                if first is None:
                     continue
             if self.max_batch <= 1:
                 yield first
@@ -251,9 +384,8 @@ class TensorQueryServerSrc(SourceElement):
             remaining = min(0.1, deadline - time.monotonic())
             if remaining <= 0:
                 break
-            try:
-                nxt = self._core.inbound.get(timeout=remaining)
-            except _queue.Empty:
+            nxt = self._core.pop_request(timeout=remaining)
+            if nxt is None:
                 continue
             if self._sig(nxt) != sig:
                 self._carry = nxt  # different shape: flush, regroup next
@@ -302,10 +434,13 @@ class TensorQueryServerSink(SinkElement):
             metrics.count(f"{self.name}.dropped")
             return []
         out = buf.to_host()
-        # Do not leak server-side routing meta back to the client.
+        # Do not leak server-side routing or tracer-internal meta back to
+        # the client (the queue-stamp map is this pipeline's plumbing).
         out.meta.pop(_META_CONN, None)
+        out.meta.pop("_tq", None)
         if core.send(int(cid), wire.encode_buffer(out)):
-            metrics.count("query_server.out")
+            metrics.count("query_server.out",
+                          tenant=out.meta.get(_META_TENANT))
         else:
             metrics.count(f"{self.name}.dropped")
         return []
@@ -326,7 +461,7 @@ class TensorQueryServerSink(SinkElement):
                     "— the served model must be batch-leading for "
                     "serversrc max-batch")
         resp_meta = {k: v for k, v in host.meta.items()
-                     if k not in (_META_BATCH, _META_CONN)}
+                     if k not in (_META_BATCH, _META_CONN, "_tq")}
         for i, m in enumerate(metas):
             cid = m.get(_META_CONN)
             if cid is None:
@@ -336,7 +471,8 @@ class TensorQueryServerSink(SinkElement):
                          meta={**{k: v for k, v in m.items()
                                   if k != _META_CONN}, **resp_meta})
             if core.send(int(cid), wire.encode_buffer(out)):
-                metrics.count("query_server.out")
+                metrics.count("query_server.out",
+                              tenant=out.meta.get(_META_TENANT))
             else:
                 metrics.count(f"{self.name}.dropped")
         return []
@@ -352,7 +488,15 @@ class TensorQueryClient(Element):
     data-parallel offload, SURVEY §2.9), ``timeout`` (seconds a response
     may take before the timeout policy fires), ``max-in-flight``
     (pipelining window: requests outstanding before ``process`` blocks),
-    ``topic``, ``on-timeout`` (``error`` | ``drop``).
+    ``topic``, ``on-timeout`` (``error`` | ``drop``), ``tenant`` (tenant
+    identity rides the hello handshake AND every request's wire meta, so
+    the server's per-tenant accounting and admission control attribute
+    this client's traffic — docs/SERVING.md "Front door").
+
+    A server under ``admission=shed`` may answer a request with an empty
+    ``meta["shed"]=True`` response instead of a result; it is delivered
+    downstream like any response (the app checks the flag) and counted in
+    ``<name>.sheds``.
 
     Responses arrive on a receiver thread, are re-ordered by message id (the
     reference pairs via GstMetaQuery msg ids), and are pushed downstream
@@ -384,6 +528,7 @@ class TensorQueryClient(Element):
         self.window = int(self.props.get("max_in_flight", 8))
         self.topic = str(self.props.get("topic", ""))
         self.on_timeout = str(self.props.get("on_timeout", "error"))
+        self.tenant = str(self.props.get("tenant", "") or "") or None
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._next_msg = 0
@@ -432,8 +577,10 @@ class TensorQueryClient(Element):
                     f"{self.name}: cannot connect {host}:{port}: {e}"
                 ) from e
             try:
-                client_handshake(sock, "hello", caps="other/tensors",
-                                 topic=self.topic)
+                hello_fields = dict(caps="other/tensors", topic=self.topic)
+                if self.tenant is not None:
+                    hello_fields["tenant"] = self.tenant
+                client_handshake(sock, "hello", **hello_fields)
             except (ConnectionError, OSError) as e:
                 # OSError covers a handshake-phase socket.timeout; close
                 # the half-open socket before tearing down the others.
@@ -553,6 +700,10 @@ class TensorQueryClient(Element):
             else:
                 self._pending.pop(mid)
                 self._done[mid] = buf
+            if buf.meta.get("shed"):
+                # the server's admission control dropped this request and
+                # answered immediately (docs/SERVING.md "Front door")
+                metrics.count(f"{self.name}.sheds")
             metrics.count(f"{self.name}.responses")
             self._cv.notify_all()
         if emit_now is not None:
@@ -638,6 +789,8 @@ class TensorQueryClient(Element):
     def process(self, pad, buf: Buffer):
         self._wait_outstanding(self.window)
         host_buf = buf.to_host()
+        if self.tenant is not None and _META_TENANT not in host_buf.meta:
+            host_buf.meta[_META_TENANT] = self.tenant
         with self._cv:
             mid = self._next_msg
             self._next_msg += 1

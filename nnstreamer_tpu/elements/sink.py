@@ -30,7 +30,7 @@ from ..core.log import STALL_FLOOR_S as _STALL_FLOOR_S
 from ..core.log import logger, metrics
 from ..core.registry import register_element
 from ..utils import tracing
-from ..utils.tracing import META_TRACE_ID
+from ..utils.tracing import META_TENANT, META_TRACE_ID
 from .base import SinkElement
 
 log = logger(__name__)
@@ -86,7 +86,11 @@ class TensorSink(SinkElement):
         self._callbacks.append(cb)
 
     def process(self, pad, buf: Buffer):
-        metrics.count(f"{self.name}.frames")
+        # frames split per tenant when the buffer carries one (wire meta /
+        # appsrc tenant= / traced pipeline default) — the trace-off
+        # throughput source for per-tenant accounting
+        metrics.count(f"{self.name}.frames",
+                      tenant=buf.meta.get(META_TENANT))
         # appsrc max-inflight credits release at POP (materialized
         # delivery), not here: stage dispatch is async, so a buffer
         # "arrives" as a device future milliseconds after admission
@@ -290,9 +294,11 @@ class TensorSink(SinkElement):
             # pop() pays (the last hop of the per-buffer timeline)
             t0 = _time.monotonic_ns()
             out = self._materialize_inner(item, timeout)
+            ten = out.meta.get(META_TENANT)
+            args = {} if ten is None else {"tenant": ten}
             tracer.record("fetch", self.name,
                           out.meta.get(META_TRACE_ID), t0,
-                          _time.monotonic_ns() - t0)
+                          _time.monotonic_ns() - t0, **args)
             return out
         return self._materialize_inner(item, timeout)
 
@@ -386,7 +392,8 @@ class FakeSink(SinkElement):
         _release_credit(buf)  # ready = really delivered for a fakesink
         self.count += 1
         self.last = buf
-        metrics.count(f"{self.name}.frames")
+        metrics.count(f"{self.name}.frames",
+                      tenant=buf.meta.get(META_TENANT))
         return []
 
 

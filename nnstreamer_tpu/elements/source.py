@@ -68,7 +68,11 @@ class AppSrc(SourceElement):
     per-stage queues bound memory, but on a transport-saturated pipeline
     they still let queue-depth x batch-time of latency build up ahead of
     every frame — the reference gets the same effect from short GStreamer
-    queues; here one credit spans the whole pipeline).
+    queues; here one credit spans the whole pipeline),
+    ``tenant`` (tenant identity stamped into every pushed buffer's meta —
+    rides the query wire so a remote server's per-tenant accounting and
+    admission control see it; an explicit prop is app DATA, stamped
+    regardless of trace mode — docs/SERVING.md "Front door").
     """
 
     kind = "appsrc"
@@ -77,6 +81,7 @@ class AppSrc(SourceElement):
         super().__init__(props, name)
         cap = self.props.get("caps")
         self._caps = parse_caps_string(str(cap)) if cap else Caps.any()
+        self.tenant = str(self.props.get("tenant", "") or "") or None
         self.block = bool(self.props.get("block", True))
         # block=false matches GStreamer appsrc semantics: push never blocks
         # and the feed queue grows unbounded (max-buffers is the bound only
@@ -108,6 +113,8 @@ class AppSrc(SourceElement):
             buf = Buffer([np.frombuffer(bytes(data), np.uint8)], pts=pts)
         else:
             buf = Buffer([np.asarray(data)], pts=pts)
+        if self.tenant is not None and "_tenant" not in buf.meta:
+            buf.meta["_tenant"] = self.tenant
         if self._inflight_sem is not None:
             stop = getattr(self, "_stop_event", None)
             t0 = _time.perf_counter()

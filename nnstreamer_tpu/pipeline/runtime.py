@@ -125,6 +125,23 @@ class _StageQueue:
         with self._lock:
             return len(self._dq)
 
+    def tenant_depths(self) -> Dict[str, int]:
+        """Queued-buffer count per tenant (``meta['_tenant']``) — the
+        sampler's per-tenant ``queue_depth`` source.  Cold path: scans a
+        snapshot of the deque (bounded by capacity) under the lock."""
+        with self._lock:
+            items = list(self._dq)
+        depths: Dict[str, int] = {}
+        for it in items:
+            if not (isinstance(it, tuple) and len(it) == 2):
+                continue
+            buf = it[1]
+            if isinstance(buf, Buffer):
+                ten = buf.meta.get(tracing.META_TENANT)
+                if ten is not None:
+                    depths[ten] = depths.get(ten, 0) + 1
+        return depths
+
 
 class _Port:
     """Destination of an edge: a stage's queue + the pad name inside it."""
@@ -199,6 +216,7 @@ class _Runner:
         self._is_sink = isinstance(self.element, SinkElement)
         self._last_sink_ns = 0  # sampler reads: staleness watermark
         self._max_pts = None  # watermark_pts gauge is a high-water mark
+        self._gauge_tenants: set = set()  # tenants with a depth gauge
 
     # -- wiring ------------------------------------------------------------
     def connect(self, out_pad: str, port: _Port) -> None:
@@ -209,11 +227,17 @@ class _Runner:
         """Blocking put (backpressure point); sheds the item when the
         pipeline is stopping."""
         if self._tr is not None and isinstance(item, Buffer):
-            # queue-wait span start (popped by the consuming runner).  A
-            # tee'd buffer shares one meta dict across branches, so the
-            # stamp reflects the LAST feed — per-branch waits of shared
-            # buffers are approximate by design (documented).
-            item.meta[tracing.META_ENQUEUE_NS] = time.monotonic_ns()
+            # Queue-wait span start, keyed by the CONSUMING stage so
+            # fan-out is exact: a tee'd buffer shares one meta dict
+            # across branches, but each branch's consumer pops only its
+            # own stamp.  The stamp map is rebuilt (copy + own entry)
+            # rather than mutated in place so two buffers that INHERITED
+            # one map (meta copies of a shared frame) fed into the same
+            # stage never overwrite each other's start time.
+            stamps = item.meta.get(tracing.META_ENQUEUE_NS)
+            base = stamps if isinstance(stamps, dict) else {}
+            item.meta[tracing.META_ENQUEUE_NS] = {
+                **base, self._nm: time.monotonic_ns()}
         self.queue.put((pad, item))
 
     def _emit(self, outs: List[Tuple[str, Union[Buffer, Event]]]) -> None:
@@ -281,7 +305,21 @@ class _Runner:
                         buf.meta[tracing.META_TRACE_ID] = tid
                     t = time.monotonic_ns()
                     buf.meta[tracing.META_INGRESS_NS] = t
-                    tr.record("ingress", self._nm, tid, t, 0, pts=buf.pts)
+                    # the pipeline's default tenant is stamped HERE —
+                    # inside the traced branch only, so the off path
+                    # stays stamp-free (an element-level tenant, e.g.
+                    # appsrc tenant= or the query wire meta, is app data
+                    # and rides regardless of trace mode)
+                    ten = buf.meta.get(tracing.META_TENANT)
+                    if ten is None and self.pipeline.tenant is not None:
+                        ten = self.pipeline.tenant
+                        buf.meta[tracing.META_TENANT] = ten
+                    if ten is None:
+                        tr.record("ingress", self._nm, tid, t, 0,
+                                  pts=buf.pts)
+                    else:
+                        tr.record("ingress", self._nm, tid, t, 0,
+                                  pts=buf.pts, tenant=ten)
             with Timer(self._m_push):
                 self._emit([(SRC, item)] if not isinstance(item, tuple) else [item])
             metrics.count(self._m_out)
@@ -322,10 +360,17 @@ class _Runner:
     def _emit_oldest_inflight(self) -> None:
         outs, n, t_disp = self._inflight.popleft()
         if self._tr is not None and t_disp:
-            tid = next((o.meta.get(tracing.META_TRACE_ID)
-                        for _, o in outs if isinstance(o, Buffer)), None)
+            first = next((o for _, o in outs if isinstance(o, Buffer)),
+                         None)
+            tid = first.meta.get(tracing.META_TRACE_ID) \
+                if first is not None else None
+            ten = first.meta.get(tracing.META_TENANT) \
+                if first is not None else None
+            args = {"rows": n}
+            if ten is not None:
+                args["tenant"] = ten
             self._tr.record("inflight", self._nm, tid, t_disp,
-                            time.monotonic_ns() - t_disp, rows=n)
+                            time.monotonic_ns() - t_disp, **args)
         self._emit(outs)
         metrics.count(self._m_out, n)
 
@@ -353,17 +398,33 @@ class _Runner:
 
     def _trace_queue_wait(self, buf: Buffer, end_ns: int) -> Optional[int]:
         """Record the queue-wait span for one consumed buffer; returns its
-        trace id.  Pops the enqueue stamp so a re-queued buffer (tee'd
-        branch) never double-counts."""
+        trace id.  Pops THIS stage's entry from the per-branch stamp map
+        (see :meth:`feed`), so fan-out branches each get their exact wait
+        and nothing double-counts."""
         tid = buf.meta.get(tracing.META_TRACE_ID)
-        tq = buf.meta.pop(tracing.META_ENQUEUE_NS, None)
+        stamps = buf.meta.get(tracing.META_ENQUEUE_NS)
+        tq = None
+        if isinstance(stamps, dict):
+            tq = stamps.pop(self._nm, None)
+            if not stamps:
+                # drained map: drop the key so delivered buffers (and
+                # wire-encoded responses) stay as clean as pre-fan-out
+                buf.meta.pop(tracing.META_ENQUEUE_NS, None)
         if tq is not None and end_ns >= tq:
-            self._tr.record("queue", self._nm, tid, tq, end_ns - tq)
-            metrics.observe_latency(self._m_qwait, (end_ns - tq) / 1e9)
+            ten = buf.meta.get(tracing.META_TENANT)
+            if ten is None:
+                self._tr.record("queue", self._nm, tid, tq, end_ns - tq)
+            else:
+                self._tr.record("queue", self._nm, tid, tq, end_ns - tq,
+                                tenant=ten)
+            metrics.observe_latency(self._m_qwait, (end_ns - tq) / 1e9,
+                                    tenant=ten)
         return tid
 
     def _trace_sink_delivery(self, buf: Buffer, end_ns: int) -> None:
-        """End-to-end span + staleness/watermark state at sink delivery."""
+        """End-to-end span + staleness/watermark state at sink delivery.
+        A tenant on the buffer splits the e2e histogram per tenant and
+        puts the span on the tenant's own Chrome-trace track."""
         self._last_sink_ns = end_ns
         if buf.pts is not None and (self._max_pts is None
                                     or buf.pts > self._max_pts):
@@ -373,10 +434,15 @@ class _Runner:
             metrics.gauge(f"{self._nm}.watermark_pts", float(buf.pts))
         ts0 = buf.meta.get(tracing.META_INGRESS_NS)
         if ts0 is not None and end_ns >= ts0:
-            metrics.observe_latency(self._m_e2e, (end_ns - ts0) / 1e9)
-            self._tr.record("e2e", self._nm,
-                            buf.meta.get(tracing.META_TRACE_ID),
-                            ts0, end_ns - ts0)
+            ten = buf.meta.get(tracing.META_TENANT)
+            metrics.observe_latency(self._m_e2e, (end_ns - ts0) / 1e9,
+                                    tenant=ten)
+            tid = buf.meta.get(tracing.META_TRACE_ID)
+            if ten is None:
+                self._tr.record("e2e", self._nm, tid, ts0, end_ns - ts0)
+            else:
+                self._tr.record("e2e", self._nm, tid, ts0, end_ns - ts0,
+                                tenant=ten)
 
     def _trace_batch(self, batch: List[Buffer], outs, tdr0: int,
                      dt: float) -> None:
@@ -390,13 +456,31 @@ class _Runner:
         n = len(batch)
         dur = int(dt * 1e9)
         disp0 = time.monotonic_ns() - dur
+        # per-tenant stage-latency split: each member row's tenant gets
+        # the amortized per-row time (the batch's base .proc observation
+        # already happened in the caller)
+        tens = [b.meta.get(tracing.META_TENANT) for b in batch]
+        for ten in tens:
+            if ten is not None:
+                metrics.observe_latency_labeled(self._m_proc, dt / n, ten)
         if n > 1:
+            # row-aligned tenants list (like trace_ids): dominant-span
+            # attribution credits each tenant its share of the span
+            extra = {"tenants": tens} if any(t is not None
+                                             for t in tens) else {}
             tr.record("batch", self._nm, tids[0], tdr0,
-                      max(0, disp0 - tdr0), trace_ids=tids, rows=n)
+                      max(0, disp0 - tdr0), trace_ids=tids, rows=n,
+                      **extra)
             tr.record("stage", self._nm, tids[0], disp0, dur,
-                      trace_ids=tids, rows=n, per_row_ns=dur // n)
+                      trace_ids=tids, rows=n, per_row_ns=dur // n,
+                      **extra)
         else:
-            tr.record("stage", self._nm, tids[0], disp0, dur)
+            ten = batch[0].meta.get(tracing.META_TENANT)
+            if ten is None:
+                tr.record("stage", self._nm, tids[0], disp0, dur)
+            else:
+                tr.record("stage", self._nm, tids[0], disp0, dur,
+                          tenant=ten)
         self._propagate_trace(batch, outs)
 
     def _flush_inflight(self) -> None:
@@ -497,12 +581,17 @@ class _Runner:
             else:
                 now0 = time.monotonic_ns()
                 tid = self._trace_queue_wait(item, now0)
+                ten = item.meta.get(tracing.META_TENANT)
                 t0 = time.perf_counter()
                 outs = el.process(pad, item)
                 dt = time.perf_counter() - t0
-                metrics.observe_latency(self._m_proc, dt)
+                metrics.observe_latency(self._m_proc, dt, tenant=ten)
                 dur = int(dt * 1e9)
-                tr.record("stage", self._nm, tid, now0, dur)
+                if ten is None:
+                    tr.record("stage", self._nm, tid, now0, dur)
+                else:
+                    tr.record("stage", self._nm, tid, now0, dur,
+                              tenant=ten)
                 self._propagate_trace([item], outs)
                 if self._is_sink:
                     self._trace_sink_delivery(item, now0 + dur)
@@ -588,6 +677,12 @@ class Pipeline:
     keyed by trace ids assigned at source ingress, dumped with
     :meth:`dump_trace` as Perfetto-loadable Chrome trace JSON and to the
     log on watchdog fires / stage errors — docs/OBSERVABILITY.md.
+    ``tenant`` sets a default tenant identity stamped at source ingress
+    (traced runs only) so latency histograms, queue-depth gauges, and
+    Chrome-trace tracks split per tenant; ``slo`` attaches a per-tenant
+    SLO policy (:mod:`nnstreamer_tpu.utils.slo`) evaluated continuously
+    while the pipeline runs, with :meth:`slo_report` as the on-demand
+    verdict — docs/SERVING.md "Front door".
     Defaults come from :func:`get_config`.
 
     ``validate=True`` runs the full static analyzer (caps propagation,
@@ -617,6 +712,8 @@ class Pipeline:
         donate_ingress: Optional[bool] = None,
         reduce_outputs: Optional[bool] = None,
         trace_mode: Optional[str] = None,
+        tenant: Optional[str] = None,
+        slo=None,
         validate: Union[bool, str] = False,
     ):
         if validate:
@@ -680,6 +777,22 @@ class Pipeline:
         if self.trace_mode not in ("off", "ring", "full"):
             raise PipelineError(
                 f"trace_mode must be off|ring|full, got {self.trace_mode!r}")
+        # default tenant: stamped onto buffers at source ingress when
+        # tracing is active (the off path stays stamp-free — see
+        # _Runner._run_source and docs/SERVING.md "Front door")
+        self.tenant = None if tenant is None else str(tenant)
+        # slo policy parsed HERE so a bad config fails at construction
+        # (a ValueError naming every schema problem), not inside start()
+        # after stage threads are already running
+        self._slo_policy = None
+        self._slo_engine = None
+        if slo is not None:
+            from ..utils.slo import load_policy
+
+            try:
+                self._slo_policy = load_policy(slo)
+            except (ValueError, OSError) as e:
+                raise PipelineError(str(e)) from e
         if self.trace_mode != "off":
             # the flight recorder is process-wide (like core.log.metrics);
             # an off pipeline never touches it
@@ -840,6 +953,11 @@ class Pipeline:
             self._sampler = threading.Thread(
                 target=self._sample_loop, name="nns-sampler", daemon=True)
             self._sampler.start()
+        if self._slo_policy is not None:
+            # continuous SLO evaluation off the live histograms: burn-rate
+            # / breach gauges per tenant (utils/slo.py).  Requires tracing
+            # (the e2e histograms only fill when trace_mode != off).
+            self._slo_loop().start()
         return self
 
     def _build_data_mesh(self):
@@ -870,6 +988,8 @@ class Pipeline:
 
     def stop(self) -> None:
         self._stopping.set()
+        if self._slo_engine is not None:
+            self._slo_engine.stop()
         runners = {id(r): r for r in self._runners.values()}.values()
         # Close every stage queue first: blocked getters receive _POISON
         # and blocked putters shed immediately, so join() below is not
@@ -919,6 +1039,16 @@ class Pipeline:
         now = time.monotonic_ns()
         for r in {id(r): r for r in self._runners.values()}.values():
             metrics.gauge(f"{r._nm}.queue_depth", float(r.queue.qsize()))
+            # per-tenant split of the same gauge; tenants seen on a
+            # previous tick but absent now are zeroed, so an idle
+            # tenant's labeled depth reads 0, not its last backlog
+            depths = r.queue.tenant_depths()
+            for ten in r._gauge_tenants.difference(depths):
+                metrics.gauge(f"{r._nm}.queue_depth", 0.0, tenant=ten)
+            for ten, depth in depths.items():
+                metrics.gauge(f"{r._nm}.queue_depth", float(depth),
+                              tenant=ten)
+            r._gauge_tenants.update(depths)
             if r.dispatch_depth > 1:
                 metrics.gauge(f"{r._nm}.inflight_window",
                               float(len(r._inflight)))
@@ -939,6 +1069,30 @@ class Pipeline:
         count.  See docs/OBSERVABILITY.md and
         ``python -m nnstreamer_tpu.tools.trace``."""
         return tracing.dump_chrome(tracing.recorder.events(), path)
+
+    def _slo_loop(self):
+        """Build (once) the SLO engine bound to this pipeline's sinks.
+        ``slo=`` accepts an :class:`~nnstreamer_tpu.utils.slo.SLOPolicy`,
+        a config dict, or a JSON file path (utils/slo.py) — parsed and
+        validated at construction."""
+        if self._slo_engine is None:
+            from ..utils.slo import SLOEngine, SLOPolicy
+
+            sinks = [el.name for el in self.elements.values()
+                     if isinstance(el, SinkElement)]
+            self._slo_engine = SLOEngine(
+                self._slo_policy or SLOPolicy(), sinks=sinks)
+        return self._slo_engine
+
+    def slo_report(self) -> dict:
+        """Per-tenant SLO verdict evaluated NOW off the live labeled
+        histograms (docs/SERVING.md "Front door"): measured p50/p99/fps
+        vs each tenant's objectives, shed counts, error-budget burn rate,
+        and — for breaching tenants — the dominant offending span kind
+        attributed from the flight-recorder ring.  Requires
+        ``trace_mode != off`` for latency/throughput objectives (the e2e
+        histograms are only fed when tracing is on)."""
+        return self._slo_loop().report()
 
     def __enter__(self) -> "Pipeline":
         return self.start()

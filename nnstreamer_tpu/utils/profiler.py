@@ -81,6 +81,18 @@ _SERIES_META = {
                     "gauge"),
     "watermark_pts": ("highest presentation timestamp delivered at this "
                       "sink (ns)", "gauge"),
+    # front-door series (docs/SERVING.md "Front door")
+    "shed": ("requests shed at query-server admission under backlog "
+             "(per-tenant labels when the request carried a tenant)",
+             "counter"),
+    "downgraded": ("requests moved to the low-priority lane under backlog "
+                   "(admission=downgrade)", "counter"),
+    "sheds": ("shed notices received by this query client", "counter"),
+    "backlog": ("query-server inbound backlog depth (gauge)", "gauge"),
+    "burn_rate": ("SLO error-budget burn rate: 1.0 = consuming exactly "
+                  "the budget (utils/slo.py)", "gauge"),
+    "breach": ("SLO breach flag: 1 = tenant currently out of SLO",
+               "gauge"),
 }
 
 #: HELP text for histogram series, by raw-name suffix (fallback generic)
@@ -134,25 +146,56 @@ def _dedup_prom_names(raws) -> dict:
     return out
 
 
+def _tenant_label_values(raws) -> dict:
+    """raw tenant value -> exposition label value.  Tenant label values go
+    through the SAME sanitization + deterministic sha1 collision
+    disambiguation as series names (``a:b`` and ``a/b`` must not merge
+    into one ``a_b`` tenant), so the same registry always renders the
+    same labels — scraping twice yields identical series."""
+    return _dedup_prom_names(raws)
+
+
+def _hist_series(lines: list, name: str, counts, total, n,
+                 label: str = "") -> None:
+    """One histogram's sample lines; ``label`` is a pre-rendered
+    ``tenant="x",`` prefix for labeled twins (empty for the base)."""
+    cum = 0
+    for bound, c in zip(LATENCY_BUCKETS, counts):
+        cum += c
+        lines.append(f'{name}_bucket{{{label}le="{bound:g}"}} {cum}')
+    cum += counts[-1]
+    lines.append(f'{name}_bucket{{{label}le="+Inf"}} {cum}')
+    suffix = f"{{{label[:-1]}}}" if label else ""
+    lines.append(f"{name}_sum{suffix} {total:.9g}")
+    lines.append(f"{name}_count{suffix} {n}")
+
+
 def _render_histograms(lines: list) -> None:
     """Cumulative ``_bucket``/``_sum``/``_count`` exposition for every
     observe_latency series (real Prometheus histograms — aggregatable
-    across scrapes, unlike the point-in-time quantile gauges)."""
+    across scrapes, unlike the point-in-time quantile gauges).  Labeled
+    (per-tenant) twins render under the SAME family — one
+    ``# HELP``/``# TYPE`` header, base sample first, then one sample set
+    per tenant."""
     hists = metrics.histograms()
-    names = _dedup_prom_names(hists)
-    for raw in sorted(hists):
-        counts, total, n = hists[raw]
+    labeled = metrics.labeled_histograms()
+    by_name: dict = {}
+    for (raw, ten), h in labeled.items():
+        by_name.setdefault(raw, {})[ten] = h
+    names = _dedup_prom_names(set(hists) | set(by_name))
+    tlabels = _tenant_label_values({t for (_, t) in labeled})
+    for raw in sorted(names):
         name = f"nnstpu_{names[raw]}"
         lines.append(f"# HELP {name} {_hist_help(raw)}")
         lines.append(f"# TYPE {name} histogram")
-        cum = 0
-        for bound, c in zip(LATENCY_BUCKETS, counts):
-            cum += c
-            lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
-        cum += counts[-1]
-        lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{name}_sum {total:.9g}")
-        lines.append(f"{name}_count {n}")
+        if raw in hists:
+            counts, total, n = hists[raw]
+            _hist_series(lines, name, counts, total, n)
+        for ten in sorted(by_name.get(raw, ()),
+                          key=lambda t: tlabels[t]):
+            counts, total, n = by_name[raw][ten]
+            _hist_series(lines, name, counts, total, n,
+                         label=f'tenant="{tlabels[ten]}",')
 
 
 def metrics_text() -> str:
@@ -164,29 +207,52 @@ def metrics_text() -> str:
     disambiguated deterministically: every colliding raw name gets a
     short hash of itself appended, so no sample silently shadows another
     and the same registry always renders the same text (scraping twice
-    yields identical series names).
+    yields identical series names).  Per-tenant labeled twins render
+    under the same family as ``{tenant="..."}`` samples, with tenant
+    label values passed through the SAME sanitize+hash rule.
     """
     lines: list = []
     _render_histograms(lines)
     gauges = metrics.gauges()
-    gnames = _dedup_prom_names(gauges)
-    for raw in sorted(gauges):
+    lgauges = metrics.labeled_gauges()
+    lg_by_name: dict = {}
+    for (raw, ten), v in lgauges.items():
+        lg_by_name.setdefault(raw, {})[ten] = v
+    gnames = _dedup_prom_names(set(gauges) | set(lg_by_name))
+    gtlabels = _tenant_label_values({t for (_, t) in lgauges})
+    for raw in sorted(gnames):
         name = f"nnstpu_{gnames[raw]}"
         meta = _series_meta(raw)
         lines.append(f"# HELP {name} "
                      f"{meta[0] if meta else 'instantaneous gauge'}")
         lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {gauges[raw]:.9g}")
+        if raw in gauges:
+            lines.append(f"{name} {gauges[raw]:.9g}")
+        for ten in sorted(lg_by_name.get(raw, ()),
+                          key=lambda t: gtlabels[t]):
+            lines.append(f'{name}{{tenant="{gtlabels[ten]}"}} '
+                         f"{lg_by_name[raw][ten]:.9g}")
     snap = metrics.snapshot()
-    counters = [raw for raw in snap if raw not in gauges]
+    lcounters = metrics.labeled_counters()
+    lc_by_name: dict = {}
+    for (raw, ten), v in lcounters.items():
+        lc_by_name.setdefault(raw, {})[ten] = v
+    counters = [raw for raw in set(snap) | set(lc_by_name)
+                if raw not in gauges and raw not in lg_by_name]
     cnames = _dedup_prom_names(counters)
+    ctlabels = _tenant_label_values({t for (_, t) in lcounters})
     for raw in sorted(counters):
         name = cnames[raw]
         meta = _series_meta(raw)
         if meta is not None:
             lines.append(f"# HELP nnstpu_{name} {meta[0]}")
             lines.append(f"# TYPE nnstpu_{name} {meta[1]}")
-        lines.append(f"nnstpu_{name} {snap[raw]:.9g}")
+        if raw in snap:
+            lines.append(f"nnstpu_{name} {snap[raw]:.9g}")
+        for ten in sorted(lc_by_name.get(raw, ()),
+                          key=lambda t: ctlabels[t]):
+            lines.append(f'nnstpu_{name}{{tenant="{ctlabels[ten]}"}} '
+                         f"{lc_by_name[raw][ten]:.9g}")
     return "\n".join(lines) + "\n"
 
 

@@ -65,12 +65,25 @@ SPAN_KINDS: Dict[str, str] = {
                     "the live slots (args: occupancy, chunk; closes at "
                     "chunk materialization, so it covers the device "
                     "time)",
+    "admit.shed": "query-server admission shed a request under backlog "
+                  "(instant; args: tenant, msg, backlog — the victim's "
+                  "trace id is the span tid, minted at shed when the "
+                  "client did not stamp one)",
+    "admit.downgrade": "query-server admission moved a request to the "
+                       "low-priority lane under backlog (instant; args: "
+                       "tenant, msg, backlog)",
 }
 
 #: buffer-meta keys the tracer owns (stamped only when tracing is active)
 META_TRACE_ID = "_tid"
 META_INGRESS_NS = "_ts0"
 META_ENQUEUE_NS = "_tq"
+#: tenant identity (docs/SERVING.md "Front door").  NOT tracer-owned in
+#: the off-path sense: an app/element that sets it explicitly (appsrc
+#: ``tenant=``, query client ``tenant=``, the wire meta) owns the key;
+#: the RUNTIME only stamps a pipeline-default tenant at ingress when
+#: tracing is active, so the trace_mode=off hot path stays stamp-free.
+META_TENANT = "_tenant"
 
 DEFAULT_RING_CAPACITY = 65536
 
@@ -186,7 +199,11 @@ def to_chrome(events: Sequence[Span]) -> Dict[str, Any]:
     """Render spans as a Chrome trace-event JSON object (Perfetto /
     chrome://tracing 'JSON array format' under ``traceEvents``).
 
-    * one track (tid) per stage, named via thread_name metadata;
+    * one track (tid) per stage, named via thread_name metadata; spans
+      whose args carry a ``tenant`` land on that tenant's OWN process
+      (pid) — Perfetto groups them as per-tenant track sets named
+      ``tenant:<name>``, the per-tenant timeline view of a multi-tenant
+      front door (untenanted spans stay on pid 1);
     * spans become complete events (``ph=X``, µs timebase), instants
       (dur 0) become ``ph=i``;
     * every span with linked ``trace_ids`` (a batched dispatch) gets flow
@@ -196,20 +213,28 @@ def to_chrome(events: Sequence[Span]) -> Dict[str, Any]:
       :func:`validate_chrome`).
     """
     evs = sorted(events, key=lambda e: (e.ts, e.dur))
-    track: Dict[str, int] = {}
+    track: Dict[Any, int] = {}
     out: List[Dict[str, Any]] = []
     meta: List[Dict[str, Any]] = [{
         "ph": "M", "pid": 1, "tid": 0, "ts": 0, "name": "process_name",
         "args": {"name": "nnstreamer_tpu"},
     }]
+    tenant_pid: Dict[Any, int] = {None: 1}
     last_by_tid: Dict[int, Dict[str, Any]] = {}
     flow_ids = itertools.count(1)
     flows: List[Dict[str, Any]] = []
     for e in evs:
-        t = track.get(e.stage)
+        tenant = (e.args or {}).get("tenant")
+        pid = tenant_pid.get(tenant)
+        if pid is None:
+            pid = tenant_pid[tenant] = len(tenant_pid) + 1
+            meta.append({"ph": "M", "pid": pid, "tid": 0, "ts": 0,
+                         "name": "process_name",
+                         "args": {"name": f"tenant:{tenant}"}})
+        t = track.get((pid, e.stage))
         if t is None:
-            t = track[e.stage] = len(track) + 1
-            meta.append({"ph": "M", "pid": 1, "tid": t, "ts": 0,
+            t = track[(pid, e.stage)] = len(track) + 1
+            meta.append({"ph": "M", "pid": pid, "tid": t, "ts": 0,
                          "name": "thread_name", "args": {"name": e.stage}})
         args: Dict[str, Any] = {}
         if e.tid is not None:
@@ -219,7 +244,7 @@ def to_chrome(events: Sequence[Span]) -> Dict[str, Any]:
         rec = {
             "name": e.kind, "cat": e.kind,
             "ph": "X" if e.dur > 0 else "i",
-            "ts": e.ts / 1e3, "pid": 1, "tid": t, "args": args,
+            "ts": e.ts / 1e3, "pid": pid, "tid": t, "args": args,
         }
         if e.dur > 0:
             rec["dur"] = e.dur / 1e3
@@ -235,12 +260,13 @@ def to_chrome(events: Sequence[Span]) -> Dict[str, Any]:
                     continue
                 fid = next(flow_ids)
                 flows.append({
-                    "ph": "s", "id": fid, "pid": 1, "tid": src["tid"],
+                    "ph": "s", "id": fid, "pid": src["pid"],
+                    "tid": src["tid"],
                     "ts": src["ts"] + src.get("dur", 0.0),
                     "name": "row", "cat": "row-link",
                 })
                 flows.append({
-                    "ph": "f", "bp": "e", "id": fid, "pid": 1,
+                    "ph": "f", "bp": "e", "id": fid, "pid": pid,
                     "tid": t, "ts": rec["ts"],
                     "name": "row", "cat": "row-link",
                 })

@@ -1,0 +1,370 @@
+"""tensor_query under concurrency + admission control (ISSUE 8).
+
+The satellite the query elements never had: N clients x one server with
+slow/failing clients, asserting the server-side backlog never grows past
+its bound and EOS stays clean — plus the admission-control policies
+(``shed`` / ``downgrade``) that turn backlog into an explicit decision
+instead of unbounded queue growth (docs/SERVING.md "Front door").
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.elements.query import _ServerCore
+from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+from nnstreamer_tpu.utils.tracing import recorder
+
+
+@pytest.fixture(autouse=True)
+def _state():
+    metrics.reset()
+    recorder.configure("off")
+    recorder.clear()
+    spec = TensorsSpec.from_string("4", "float32")
+    register_custom_easy(
+        "qs-double", lambda ins: [ins[0] * 2], in_spec=spec,
+        out_spec=spec)
+
+    def slow(ins):
+        time.sleep(0.02)
+        return [ins[0] * 2]
+
+    register_custom_easy("qs-slow", slow, in_spec=spec, out_spec=spec)
+    yield
+    recorder.configure("off")
+    recorder.clear()
+    metrics.reset()
+
+
+# -- core admission unit tests (deterministic, no races) --------------------
+
+class TestServerCoreAdmission:
+    def _core(self, admission, max_backlog=2):
+        events = []
+        core = _ServerCore("127.0.0.1", 0, max_backlog=max_backlog,
+                           admission=admission,
+                           on_admit_event=lambda k, b, n:
+                           events.append((k, b.meta.get("_tenant"), n)))
+        return core, events
+
+    @staticmethod
+    def _req(tenant=None, mid=0):
+        b = Buffer([np.zeros((4,), np.float32)])
+        b.meta["_query_msg"] = mid
+        if tenant:
+            b.meta["_tenant"] = tenant
+        return b
+
+    def test_shed_when_full_counts_per_tenant_and_notifies(self):
+        core, events = self._core("shed", max_backlog=2)
+        try:
+            for i in range(2):
+                core._admit(self._req("acme", i))
+            assert core.inbound.qsize() == 2
+            core._admit(self._req("acme", 2))  # full -> shed
+            core._admit(self._req("bob", 3))   # full -> shed
+            assert core.inbound.qsize() == 2  # bounded, never grew
+            snap = metrics.snapshot()
+            assert snap["query_server.shed"] == 2
+            lab = metrics.labeled_counters()
+            assert lab[("query_server.shed", "acme")] == 1
+            assert lab[("query_server.shed", "bob")] == 1
+            assert [e[0] for e in events] == ["shed", "shed"]
+            assert {e[1] for e in events} == {"acme", "bob"}
+        finally:
+            core.close()
+
+    def test_downgrade_uses_low_lane_then_sheds(self):
+        core, events = self._core("downgrade", max_backlog=2)
+        try:
+            for i in range(2):
+                core._admit(self._req("acme", i))
+            core._admit(self._req("acme", 2))  # -> low lane
+            core._admit(self._req("acme", 3))  # -> low lane
+            core._admit(self._req("acme", 4))  # both full -> shed
+            assert core.inbound.qsize() == 2
+            assert core.lowprio.qsize() == 2
+            snap = metrics.snapshot()
+            assert snap["query_server.downgraded"] == 2
+            assert snap["query_server.shed"] == 1
+            assert [e[0] for e in events] == \
+                ["downgrade", "downgrade", "shed"]
+            # backlog gauge reads main + low lane
+            assert metrics.gauges()["query_server.backlog"] == 4.0
+        finally:
+            core.close()
+
+    def test_pop_request_drains_main_before_low_lane(self):
+        core, _ = self._core("downgrade", max_backlog=1)
+        try:
+            core._admit(self._req("acme", 0))   # main
+            core._admit(self._req("acme", 1))   # low lane
+            first = core.pop_request(timeout=0.05)
+            second = core.pop_request(timeout=0.05)
+            assert first.meta["_query_msg"] == 0
+            assert second.meta["_query_msg"] == 1
+            assert core.pop_request(timeout=0.05) is None
+        finally:
+            core.close()
+
+    def test_bad_admission_prop_rejected(self):
+        from nnstreamer_tpu.elements.query import TensorQueryServerSrc
+
+        with pytest.raises(Exception, match="admission"):
+            TensorQueryServerSrc({"admission": "panic"})
+        with pytest.raises(Exception, match="max-backlog"):
+            TensorQueryServerSrc({"max_backlog": 0})
+
+
+# -- integration: N clients, slow/failing clients, bounded backlog ----------
+
+def test_many_clients_bounded_backlog_and_clean_eos():
+    """6 concurrent clients x 20 requests against one server whose
+    backlog is bounded at 8: every client gets every (correct, ordered)
+    answer, the inbound queue structurally cannot exceed its bound, and
+    every pipeline EOSes cleanly."""
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=60 max-backlog=8 ! "
+        "tensor_filter framework=custom-easy model=qs-double ! "
+        "tensor_query_serversink id=60")
+    with srv:
+        core = srv.element("ssrc")._core
+        assert core.inbound.maxsize == 8
+        port = srv.element("ssrc").bound_port
+        peak = {"backlog": 0}
+        stop_poll = threading.Event()
+
+        def poll():
+            while not stop_poll.wait(0.002):
+                peak["backlog"] = max(peak["backlog"], core.backlog())
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        results = {}
+        errors = []
+
+        def client(cid):
+            try:
+                cli = nt.Pipeline(
+                    f"appsrc name=src ! tensor_query_client port={port} "
+                    "max-in-flight=16 timeout=20 ! tensor_sink name=out")
+                with cli:
+                    for i in range(20):
+                        cli.push("src", np.full((4,), cid * 1000.0 + i,
+                                                np.float32))
+                    vals = [float(cli.pull("out", timeout=20).tensors[0][0])
+                            for _ in range(20)]
+                    cli.eos("src")
+                    cli.wait(timeout=20)
+                results[cid] = vals
+            except Exception as e:  # noqa: BLE001
+                errors.append((cid, e))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        stop_poll.set()
+        poller.join(timeout=2)
+        assert not errors, errors
+        for cid in range(6):
+            assert results[cid] == [2 * (cid * 1000.0 + i)
+                                    for i in range(20)]
+        assert peak["backlog"] <= 8
+
+
+def test_slow_client_does_not_stall_fast_client():
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=61 ! "
+        "tensor_filter framework=custom-easy model=qs-double ! "
+        "tensor_query_serversink id=61")
+    with srv:
+        port = srv.element("ssrc").bound_port
+        slow = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "max-in-flight=16 timeout=30 ! tensor_sink name=out")
+        fast = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "timeout=30 ! tensor_sink name=out")
+        with slow, fast:
+            for i in range(10):
+                slow.push("src", np.full((4,), float(i), np.float32))
+            t0 = time.monotonic()
+            for i in range(10):
+                fast.push("src", np.full((4,), 100.0 + i, np.float32))
+                out = fast.pull("out", timeout=10)
+                np.testing.assert_allclose(out.tensors[0],
+                                           np.full((4,), 2 * (100.0 + i)))
+            fast_done = time.monotonic() - t0
+            # the slow client now drains ITS responses, slowly
+            for i in range(10):
+                out = slow.pull("out", timeout=10)
+                np.testing.assert_allclose(out.tensors[0],
+                                           np.full((4,), 2.0 * i))
+                time.sleep(0.01)
+            assert fast_done < 8.0  # never waited behind the slow reader
+            for c in (slow, fast):
+                c.eos("src")
+                c.wait(timeout=15)
+
+
+def test_client_disconnect_under_load_isolated():
+    """One of three clients tears down mid-flight (pushed but never
+    pulled): survivors complete correctly and the server stays healthy
+    for a NEW client afterwards."""
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=62 ! "
+        "tensor_filter framework=custom-easy model=qs-slow ! "
+        "tensor_query_serversink name=ssink id=62")
+    with srv:
+        port = srv.element("ssrc").bound_port
+
+        def mk():
+            return nt.Pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "max-in-flight=8 timeout=30 ! tensor_sink name=out")
+
+        doomed, s1, s2 = mk(), mk(), mk()
+        doomed.start(), s1.start(), s2.start()
+        try:
+            for i in range(6):
+                doomed.push("src", np.full((4,), float(i), np.float32))
+                s1.push("src", np.full((4,), 100.0 + i, np.float32))
+                s2.push("src", np.full((4,), 200.0 + i, np.float32))
+            doomed.stop()  # vanishes without pulling anything
+            for i in range(6):
+                np.testing.assert_allclose(
+                    s1.pull("out", timeout=20).tensors[0],
+                    np.full((4,), 2 * (100.0 + i)))
+                np.testing.assert_allclose(
+                    s2.pull("out", timeout=20).tensors[0],
+                    np.full((4,), 2 * (200.0 + i)))
+            for c in (s1, s2):
+                c.eos("src")
+                c.wait(timeout=20)
+        finally:
+            for c in (s1, s2, doomed):
+                c.stop()
+        late = mk()
+        with late:
+            late.push("src", np.full((4,), 7.0, np.float32))
+            np.testing.assert_allclose(late.pull("out", timeout=20).tensors[0],
+                                       np.full((4,), 14.0))
+            late.eos("src")
+            late.wait(timeout=20)
+
+
+# -- integration: admission control over real sockets -----------------------
+
+def test_admission_shed_under_backlog_answers_every_request():
+    """A flooding client against admission=shed max-backlog=2: sheds
+    happen, are counted per tenant, reach the client as shed notices
+    (meta['shed']), completed+shed covers every request, and EOS is
+    clean — the queue never grew past its bound."""
+    n = 40
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=63 "
+        "admission=shed max-backlog=2 ! "
+        "tensor_filter framework=custom-easy model=qs-slow ! "
+        "tensor_query_serversink id=63")
+    with srv:
+        port = srv.element("ssrc").bound_port
+        core = srv.element("ssrc")._core
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client name=qc port={port} "
+            "tenant=acme max-in-flight=32 timeout=30 ! tensor_sink "
+            "name=out")
+        with cli:
+            for i in range(n):
+                cli.push("src", np.full((4,), float(i), np.float32))
+            served = shed = 0
+            for _ in range(n):
+                out = cli.pull("out", timeout=30)
+                if out.meta.get("shed"):
+                    shed += 1
+                    assert len(out.tensors) == 0
+                    assert out.meta.get("_tenant") == "acme"
+                else:
+                    served += 1
+            cli.eos("src")
+            cli.wait(timeout=30)
+        assert served + shed == n
+        assert shed >= 1  # overload really shed
+        assert served >= 1  # and really served what fit
+        assert core.inbound.qsize() == 0
+        snap = metrics.snapshot()
+        assert snap["query_server.shed"] == shed
+        assert metrics.labeled_counters()[("query_server.shed", "acme")] \
+            == shed
+        assert snap["qc.sheds"] == shed
+
+
+def test_admission_downgrade_still_answers_with_lane_capacity():
+    """admission=downgrade: overflow beyond the main backlog rides the
+    low-priority lane — downgraded requests are still ANSWERED (slower),
+    nothing is shed while the lane has room."""
+    n = 10
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=64 "
+        "admission=downgrade max-backlog=4 ! "
+        "tensor_filter framework=custom-easy model=qs-slow ! "
+        "tensor_query_serversink id=64")
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "tenant=acme max-in-flight=16 timeout=30 ! tensor_sink "
+            "name=out")
+        with cli:
+            for i in range(n):
+                cli.push("src", np.full((4,), float(i), np.float32))
+            outs = [cli.pull("out", timeout=30) for _ in range(n)]
+            cli.eos("src")
+            cli.wait(timeout=30)
+        assert all(not o.meta.get("shed") for o in outs)
+        # responses stay in request order (msg-id reorder) even when some
+        # requests took the low-priority lane
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o.tensors[0],
+                                       np.full((4,), 2.0 * i))
+        snap = metrics.snapshot()
+        assert snap.get("query_server.shed", 0) == 0
+
+
+def test_shed_span_recorded_with_tenant_and_trace_id():
+    """Every shed is span-stamped ``admit.shed`` carrying the victim's
+    tenant and a trace id, on the SERVER pipeline's ring."""
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=65 "
+        "admission=shed max-backlog=1 ! "
+        "tensor_filter framework=custom-easy model=qs-slow ! "
+        "tensor_query_serversink id=65", trace_mode="ring")
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "tenant=acme max-in-flight=32 timeout=30 ! tensor_sink "
+            "name=out")
+        with cli:
+            for i in range(30):
+                cli.push("src", np.full((4,), float(i), np.float32))
+            got = [cli.pull("out", timeout=30) for _ in range(30)]
+            cli.eos("src")
+            cli.wait(timeout=30)
+    sheds = [e for e in recorder.events() if e.kind == "admit.shed"]
+    assert sheds, "no admit.shed spans on the ring"
+    assert sum(1 for o in got if o.meta.get("shed")) == len(sheds)
+    for e in sheds:
+        assert e.stage == "ssrc"
+        assert e.tid is not None
+        assert e.args["tenant"] == "acme"
+        assert "backlog" in e.args

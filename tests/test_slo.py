@@ -1,0 +1,418 @@
+"""nns-slo (ISSUE 8 tentpole): per-tenant labeled metrics, the SLO
+engine, tenant identity threading, and the per-branch queue-stamp fix.
+
+The contract: a ``tenant`` born at ingress (appsrc ``tenant=`` prop /
+``Pipeline(tenant=...)`` default / the query wire meta) rides
+``Buffer.meta`` beside the trace id; labeled twins of the latency
+histograms / shed counters / queue-depth gauges split per tenant in
+``metrics_text`` (same sanitize+sha1 rule as series names); the SLO
+engine turns those series into per-tenant verdicts with error-budget
+burn rates and dominant-span attribution from the flight-recorder ring;
+and NONE of it touches the trace_mode=off hot path (no stamps).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import Metrics, metrics
+from nnstreamer_tpu.utils import tracing
+from nnstreamer_tpu.utils.profiler import metrics_text
+from nnstreamer_tpu.utils.slo import (SLOEngine, SLOPolicy, TenantSLO,
+                                      dominant_span, load_policy,
+                                      validate_policy)
+from nnstreamer_tpu.utils.tracing import FlightRecorder, recorder
+
+DESC = (
+    "appsrc name=src caps=other/tensors,dimensions=16,types=float32 ! "
+    "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:16 "
+    "name=f ! tensor_sink name=out"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    metrics.reset()
+    recorder.configure("off")
+    recorder.clear()
+    yield
+    recorder.configure("off")
+    recorder.clear()
+    metrics.reset()
+
+
+def _frames(n, dims=16):
+    return [np.full((dims,), float(i), np.float32) for i in range(n)]
+
+
+def _run(desc, frames, timeout=60, **kw):
+    p = nt.Pipeline(desc, **kw)
+    outs = []
+    with p:
+        for i, x in enumerate(frames):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in frames:
+            outs.append(p.pull("out", timeout=timeout))
+        p.eos()
+        p.wait(timeout=timeout)
+    return outs
+
+
+# -- labeled metrics registry ----------------------------------------------
+
+def test_labeled_series_update_base_and_twin():
+    m = Metrics()
+    m.observe_latency("s.e2e_latency", 0.002, tenant="a")
+    m.observe_latency("s.e2e_latency", 0.004, tenant="b")
+    m.observe_latency("s.e2e_latency", 0.008)  # untenanted
+    hists = m.histograms()
+    assert hists["s.e2e_latency"][2] == 3  # base aggregates everything
+    lab = m.labeled_histograms()
+    assert lab[("s.e2e_latency", "a")][2] == 1
+    assert lab[("s.e2e_latency", "b")][2] == 1
+    assert m.percentile("s.e2e_latency", 99, tenant="a") == 0.002
+    assert m.tenants("s.e2e_latency") == ["a", "b"]
+    m.count("q.shed", 2, tenant="a")
+    assert m.snapshot()["q.shed"] == 2.0  # base counter aggregates
+    assert m.labeled_counters()[("q.shed", "a")] == 2.0
+
+
+def test_labeled_only_observe_skips_base():
+    m = Metrics()
+    m.observe_latency("s.proc", 0.001)  # the per-dispatch base sample
+    m.observe_latency_labeled("s.proc", 0.0005, "a")
+    m.observe_latency_labeled("s.proc", 0.0005, "b")
+    assert m.histograms()["s.proc"][2] == 1  # no double count
+    assert m.labeled_histograms()[("s.proc", "a")][2] == 1
+
+
+def test_fraction_over():
+    m = Metrics()
+    for v in (0.001, 0.002, 0.040, 0.900):
+        m.observe_latency("s.e2e_latency", v, tenant="a")
+    frac, n = m.fraction_over("s.e2e_latency", 0.025, tenant="a")
+    assert n == 4
+    assert frac == pytest.approx(0.5)  # 0.040 and 0.900 are over
+    assert m.fraction_over("s.e2e_latency", 0.025, tenant="ghost") == \
+        (0.0, 0)
+
+
+def test_labeled_gauges_do_not_clobber_base():
+    m = Metrics()
+    m.gauge("f.queue_depth", 5.0)
+    m.gauge("f.queue_depth", 2.0, tenant="a")
+    assert m.gauges()["f.queue_depth"] == 5.0
+    assert m.labeled_gauges()[("f.queue_depth", "a")] == 2.0
+
+
+# -- labeled exposition -----------------------------------------------------
+
+def test_labeled_exposition_help_type_once_and_scrape_twice():
+    """Satellite: labeled histogram series emit ONE correct
+    ``# HELP``/``# TYPE`` header per family, tenant label values go
+    through the sanitize+sha1 rule, and scraping twice is identical."""
+    metrics.observe_latency("out.e2e_latency", 0.002)
+    metrics.observe_latency("out.e2e_latency", 0.004, tenant="acme")
+    # colliding tenant values: both sanitize to t_1
+    metrics.observe_latency("out.e2e_latency", 0.006, tenant="t:1")
+    metrics.observe_latency("out.e2e_latency", 0.008, tenant="t/1")
+    metrics.count("query_server.shed", 3, tenant="acme")
+    metrics.gauge("f.queue_depth", 2, tenant="acme")
+    one = metrics_text()
+    two = metrics_text()
+    assert one == two
+    # one header pair for the whole family, labeled rows included
+    assert one.count("# TYPE nnstpu_out_e2e_latency histogram") == 1
+    assert one.count("# HELP nnstpu_out_e2e_latency ") == 1
+    assert 'nnstpu_out_e2e_latency_bucket{tenant="acme",le="0.005"} 1' \
+        in one
+    assert 'nnstpu_out_e2e_latency_count{tenant="acme"} 1' in one
+    # colliding tenants disambiguated, not merged
+    tenant_vals = {line.split('tenant="')[1].split('"')[0]
+                   for line in one.splitlines() if 'tenant="' in line}
+    t1s = {v for v in tenant_vals if v.startswith("t_1")}
+    assert len(t1s) == 2 and "t_1" not in t1s
+    # no duplicate sample lines (the scrape-reject failure mode)
+    samples = [ln for ln in one.splitlines()
+               if ln and not ln.startswith("#")]
+    assert len(samples) == len(set(samples))
+    assert 'nnstpu_query_server_shed{tenant="acme"} 3' in one
+    assert 'nnstpu_f_queue_depth{tenant="acme"} 2' in one
+    assert "# TYPE nnstpu_query_server_shed counter" in one
+    assert "# TYPE nnstpu_f_queue_depth gauge" in one
+
+
+# -- policy ----------------------------------------------------------------
+
+def test_policy_validate_and_load(tmp_path):
+    good = {"tenants": [{"tenant": "a", "p99_ms": 50, "min_fps": 5}]}
+    assert validate_policy(good) == []
+    pol = load_policy(good)
+    assert pol.for_tenant("a").p99_ms == 50
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(good))
+    assert load_policy(str(path)).for_tenant("a").min_fps == 5
+    assert load_policy(None).tenants == []
+    assert load_policy(pol) is pol
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ({}, "tenants"),
+    ({"tenants": [{"p99_ms": 5}]}, "'tenant'"),
+    ({"tenants": [{"tenant": "a"}, {"tenant": "a"}]}, "duplicate"),
+    ({"tenants": [{"tenant": "a", "p99_ms": -1}]}, "p99_ms"),
+    ({"tenants": [{"tenant": "a", "error_budget": 2}]}, "error_budget"),
+    ({"tenants": [{"tenant": "a", "p99ms": 5}]}, "unknown"),
+    ({"tenants": [{"tenant": "a"}], "bogus": 1}, "unknown"),
+])
+def test_policy_validation_errors(bad, msg):
+    problems = validate_policy(bad)
+    assert problems and any(msg in p for p in problems)
+    with pytest.raises(ValueError, match="invalid SLO policy"):
+        load_policy(bad)
+
+
+# -- engine ----------------------------------------------------------------
+
+def _fed_metrics(tenant="a", sink="out", n_ok=8, n_bad=2, sheds=0):
+    m = Metrics()
+    for _ in range(n_ok):
+        m.observe_latency(f"{sink}.e2e_latency", 0.002, tenant=tenant)
+    for _ in range(n_bad):
+        m.observe_latency(f"{sink}.e2e_latency", 0.8, tenant=tenant)
+    if sheds:
+        m.count("query_server.shed", sheds, tenant=tenant)
+    return m
+
+
+def test_engine_breach_and_burn_rate():
+    m = _fed_metrics(n_ok=8, n_bad=2, sheds=10)
+    pol = SLOPolicy(tenants=[TenantSLO("a", p99_ms=50.0,
+                                       error_budget=0.1)])
+    eng = SLOEngine(pol, sinks=["out"], metrics=m)
+    rep = eng.evaluate()
+    v = rep["tenants"]["a"]
+    assert not rep["ok"] and rep["breaches"] == ["a"]
+    assert v["requests"] == 10 and v["sheds"] == 10
+    # bad = 2 latency violations + 10 sheds of 20 attempts; budget 0.1
+    assert v["burn_rate"] == pytest.approx((12 / 20) / 0.1)
+    assert any("p99" in viol for viol in v["violations"])
+    # burn gauges published into the SAME registry
+    lg = m.labeled_gauges()
+    assert lg[("slo.breach", "a")] == 1.0
+    assert lg[("slo.burn_rate", "a")] == pytest.approx(v["burn_rate"])
+
+
+def test_engine_ok_tenant_and_unknown_tenant_informational():
+    m = _fed_metrics(n_ok=10, n_bad=0)
+    m.observe_latency("out.e2e_latency", 0.001, tenant="stranger")
+    pol = SLOPolicy(tenants=[TenantSLO("a", p99_ms=500.0)])
+    eng = SLOEngine(pol, sinks=["out"], metrics=m)
+    rep = eng.evaluate()
+    assert rep["ok"]
+    assert rep["tenants"]["a"]["ok"]
+    # observed-but-unconfigured tenants report measurements, never breach
+    s = rep["tenants"]["stranger"]
+    assert s["ok"] and s["objectives"] is None and s["requests"] == 1
+
+
+def test_engine_min_fps_objective():
+    m = _fed_metrics(n_ok=4, n_bad=0)
+    pol = SLOPolicy(tenants=[TenantSLO("a", min_fps=1e9)])
+    eng = SLOEngine(pol, sinks=["out"], metrics=m)
+    rep = eng.evaluate()
+    assert any("throughput" in viol
+               for viol in rep["tenants"]["a"]["violations"])
+
+
+def test_dominant_span_attribution():
+    rec = FlightRecorder("ring", capacity=64)
+    rec.record("queue", "f", 1, 0, int(5e6), tenant="a")
+    rec.record("stage", "f", 1, int(5e6), int(30e6), tenant="a")
+    rec.record("stage", "f", 2, 0, int(99e6), tenant="b")  # other tenant
+    rec.record("e2e", "out", 1, 0, int(40e6), tenant="a")  # excluded
+    kind, ms = dominant_span("a", rec)
+    assert kind == "stage" and ms == pytest.approx(30.0)
+    assert dominant_span("ghost", rec) is None
+
+
+def test_dominant_span_credits_batched_row_share():
+    """Batched stage spans carry a row-aligned ``tenants`` list; each
+    tenant is credited its row share of the amortized duration — batch
+    compute is never invisible to attribution."""
+    rec = FlightRecorder("ring", capacity=64)
+    rec.record("stage", "f", 1, 0, int(40e6),
+               trace_ids=[1, 2, 3, 4], rows=4,
+               tenants=["a", "a", "b", None])
+    rec.record("queue", "f", 1, 0, int(5e6), tenant="a")
+    kind, ms = dominant_span("a", rec)
+    assert kind == "stage" and ms == pytest.approx(20.0)  # 2/4 of 40ms
+    kind_b, ms_b = dominant_span("b", rec)
+    assert kind_b == "stage" and ms_b == pytest.approx(10.0)
+
+
+def test_engine_fps_window_never_near_zero():
+    """An on-demand report milliseconds after a daemon tick must not
+    compute throughput over the tiny inter-call gap (the spurious
+    min_fps-breach failure mode) — the rate base is the newest snapshot
+    at least MIN_RATE_WINDOW_S old."""
+    m = _fed_metrics(n_ok=10, n_bad=0)
+    pol = SLOPolicy(tenants=[TenantSLO("a", min_fps=0.1)])
+    eng = SLOEngine(pol, sinks=["out"], metrics=m)
+    eng._t0 = time.monotonic() - 10.0  # 10 s of "run" behind us
+    first = eng.evaluate()
+    second = eng.evaluate()  # immediately after — old code: ~0 s window
+    assert second["window_s"] >= SLOEngine.MIN_RATE_WINDOW_S
+    assert second["tenants"]["a"]["ok"], second["tenants"]["a"]
+    assert first["tenants"]["a"]["fps"] == pytest.approx(1.0, rel=0.2)
+
+
+# -- pipeline integration ---------------------------------------------------
+
+def test_pipeline_tenant_splits_series_and_report_breaches():
+    pol = {"tenants": [{"tenant": "acme", "p99_ms": 1e-6},
+                       {"tenant": "idle", "p99_ms": 1e9}]}
+    p = nt.Pipeline(DESC, trace_mode="ring", tenant="acme", slo=pol)
+    with p:
+        for i, x in enumerate(_frames(6)):
+            p.push("src", nt.Buffer([x], pts=i))
+        outs = [p.pull("out", timeout=60) for _ in range(6)]
+        rep = p.slo_report()
+        p.eos()
+        p.wait(timeout=60)
+    assert all(o.meta[tracing.META_TENANT] == "acme" for o in outs)
+    assert metrics.labeled_histograms()[("out.e2e_latency", "acme")][2] \
+        == 6
+    assert "acme" in rep["breaches"] and "idle" not in rep["breaches"]
+    v = rep["tenants"]["acme"]
+    # the dominant offending span kind is attributed from the ring and
+    # names a real attributable kind present in the dump
+    assert v["dominant_span_kind"] in ("queue", "stage", "fetch",
+                                       "batch", "inflight")
+    assert any(e.kind == v["dominant_span_kind"]
+               and (e.args or {}).get("tenant") == "acme"
+               for e in recorder.events())
+    # per-tenant tracks in the Chrome export: the tenant's spans live on
+    # their own pid with a tenant:<name> process_name
+    chrome = tracing.to_chrome(recorder.events())
+    names = [e["args"]["name"] for e in chrome["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert "tenant:acme" in names
+
+
+def test_appsrc_tenant_prop_is_data_not_a_trace_stamp():
+    """An explicit appsrc tenant= prop stamps meta regardless of trace
+    mode (it must ride the wire for server-side accounting)."""
+    outs = _run(DESC.replace("appsrc name=src",
+                             "appsrc name=src tenant=acme"), _frames(3))
+    assert all(o.meta.get(tracing.META_TENANT) == "acme" for o in outs)
+    # trace off: the sink's labeled frames counter is the only split
+    assert metrics.labeled_counters()[("out.frames", "acme")] == 3.0
+
+
+def test_pipeline_default_tenant_off_path_writes_no_stamp():
+    """The acceptance pin: Pipeline(tenant=...) with trace_mode=off must
+    not stamp — tenant threading is part of the traced path only."""
+    outs = _run(DESC, _frames(3), tenant="acme")  # trace off (default)
+    for o in outs:
+        assert tracing.META_TENANT not in o.meta
+
+
+def test_bad_slo_policy_rejected_at_construction():
+    """A broken slo= config must fail while building the Pipeline (every
+    schema problem named), never inside start() with threads running."""
+    from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+    with pytest.raises(PipelineError, match="unknown keys"):
+        nt.Pipeline(DESC, slo={"tenants": [{"tenant": "a", "p99ms": 5}]})
+
+
+def test_slo_engine_runs_continuously_with_pipeline():
+    pol = {"tenants": [{"tenant": "acme", "p99_ms": 1e9}]}
+    p = nt.Pipeline(DESC, trace_mode="ring", tenant="acme", slo=pol)
+    with p:
+        for i, x in enumerate(_frames(4)):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in range(4):
+            p.pull("out", timeout=60)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if ("slo.breach", "acme") in metrics.labeled_gauges():
+                break
+            time.sleep(0.05)
+        p.eos()
+        p.wait(timeout=60)
+    # the continuous loop published breach/burn gauges on its own
+    assert metrics.labeled_gauges()[("slo.breach", "acme")] == 0.0
+
+
+def test_per_tenant_queue_depth_gauge_sampled():
+    from nnstreamer_tpu.pipeline.runtime import _StageQueue
+
+    q = _StageQueue(capacity=8)
+    b1 = nt.Buffer([np.zeros(2, np.float32)])
+    b1.meta[tracing.META_TENANT] = "a"
+    b2 = nt.Buffer([np.zeros(2, np.float32)])
+    b2.meta[tracing.META_TENANT] = "a"
+    b3 = nt.Buffer([np.zeros(2, np.float32)])  # untenanted
+    for b in (b1, b2, b3):
+        q.put(("sink", b))
+    assert q.tenant_depths() == {"a": 2}
+
+
+# -- per-branch queue stamps (tee fan-out satellite) ------------------------
+
+def test_tee_branches_each_get_exact_queue_spans():
+    """The OBSERVABILITY.md caveat is gone: per-branch queue stamps are
+    keyed by the CONSUMING stage, so BOTH tee branches record a queue
+    span for every frame (the old shared-scalar stamp was popped by
+    whichever branch consumed first — the other lost its span)."""
+    n = 4
+    p = nt.Pipeline(
+        f"videotestsrc num-buffers={n} width=4 height=4 ! "
+        "tensor_converter ! tee name=t "
+        "t. ! tensor_sink name=a t. ! tensor_sink name=b",
+        trace_mode="ring")
+    with p:
+        for _ in range(n):
+            p.pull("a", timeout=15)
+            p.pull("b", timeout=15)
+        p.wait(timeout=15)
+    spans = {}
+    for e in recorder.events():
+        if e.kind == "queue" and e.stage in ("a", "b"):
+            spans.setdefault(e.stage, []).append(e)
+    assert len(spans.get("a", [])) == n
+    assert len(spans.get("b", [])) == n
+    # exactness: each branch's span starts at ITS OWN feed time — the
+    # same frame's two spans are distinct records with sane durations
+    for e in spans["a"] + spans["b"]:
+        assert e.dur >= 0
+
+
+def test_cli_validate_and_report(tmp_path, capsys):
+    from nnstreamer_tpu.tools import slo as cli
+
+    pol = {"tenants": [{"tenant": "acme", "p99_ms": 3.0}]}
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(pol))
+    assert cli.main(["validate", str(path)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"tenants": []}))
+    assert cli.main(["validate", str(bad)]) == 1
+    capsys.readouterr()
+    # report over a saved exposition: acme's p99 lands in the 5ms bucket
+    # -> estimated 5ms > 3ms objective -> breach, exit 1
+    metrics.observe_latency("out.e2e_latency", 0.004, tenant="acme")
+    scrape = tmp_path / "scrape.txt"
+    scrape.write_text(metrics_text())
+    rc = cli.main(["report", str(path), "--text", str(scrape), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["breaches"] == ["acme"]
+    assert out["tenants"]["acme"]["p99_ms"] == pytest.approx(5.0)
+    assert out["tenants"]["acme"]["requests"] == 1

@@ -116,7 +116,9 @@ def test_trace_ids_assigned_and_unique():
 def test_off_mode_zero_events_and_clean_meta():
     """The instrumentation pin: with trace_mode=off the recorder must be
     STRUCTURALLY bypassed — record() monkeypatched to raise, pipeline
-    still completes, no meta stamps written."""
+    still completes, no meta stamps written.  Tenant threading (ISSUE 8)
+    rides the same pin: a Pipeline-level default tenant adds NO stamp on
+    the off path either."""
 
     def boom(*a, **k):
         raise AssertionError("record() ran with trace_mode=off")
@@ -124,13 +126,14 @@ def test_off_mode_zero_events_and_clean_meta():
     orig = FlightRecorder.record
     FlightRecorder.record = boom
     try:
-        outs = _run(DESC, _frames(8), queue_capacity=16, batch_max=4)
+        outs = _run(DESC, _frames(8), queue_capacity=16, batch_max=4,
+                    tenant="acme")
     finally:
         FlightRecorder.record = orig
     assert len(recorder.events()) == 0
     for o in outs:
         for key in (tracing.META_TRACE_ID, tracing.META_INGRESS_NS,
-                    tracing.META_ENQUEUE_NS):
+                    tracing.META_ENQUEUE_NS, tracing.META_TENANT):
             assert key not in o.meta
 
 
@@ -409,19 +412,28 @@ def test_metrics_server_scrape_twice_identical_and_stop():
     metrics.count("scrape.frames", 3)
     metrics.observe_latency("scrape.proc", 0.002)
     metrics.gauge("scrape.queue_depth", 1)
+    # labeled twins (ISSUE 8): tenant series must render identically
+    # across scrapes too, including hash-disambiguated tenant values
+    metrics.observe_latency("scrape.proc", 0.004, tenant="acme")
+    metrics.count("scrape.frames", 1, tenant="t:1")
+    metrics.count("scrape.frames", 1, tenant="t/1")
     srv = start_metrics_server(port=0)
     try:
         url = f"http://127.0.0.1:{srv.server_port}/metrics"
 
         def series_names(body):
-            return {line.split()[0].split("{")[0]
+            return {line.split()[0]
                     for line in body.splitlines()
                     if line and not line.startswith("#")}
 
         one = urllib.request.urlopen(url, timeout=5).read().decode()
         two = urllib.request.urlopen(url, timeout=5).read().decode()
-        assert series_names(one) == series_names(two)
-        assert "nnstpu_scrape_proc_bucket" in series_names(one)
+        assert one == two  # label values included, byte-identical
+        assert len(series_names(one)) == len(set(series_names(one)))
+        assert any(n.startswith("nnstpu_scrape_proc_bucket")
+                   for n in series_names(one))
+        assert 'nnstpu_scrape_proc_bucket{tenant="acme",le="0.005"} 1' \
+            in one
     finally:
         stop_metrics_server(srv)
     with pytest.raises(OSError):

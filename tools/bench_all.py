@@ -39,6 +39,13 @@ ROWS = [
     # fetch_overlap_ms and window depth; the appsrc/segmentation rows
     # above/below carry the same fields for their own paths
     ("async_fetch_ab", ["--config", "fetch"]),
+    # query front-door soak (ISSUE 8): tools/soak.py (NOT bench.py — the
+    # SOAK sentinel routes it), smoke shape: a steady low-load pass plus
+    # a deliberately overloaded pass; the row's "profiles"/"sheds_total"
+    # /"slo_ok" summarize the BENCH_SOAK schema, and the full artifact
+    # lands next to this sweep (see the row's "artifact" field)
+    ("soak_front_door", ["SOAK", "--smoke", "--out",
+                         "BENCH_SOAK_sweep.json"]),
     ("detection_ssd", ["--config", "detection"]),
     ("detection_yolov5s", ["--config", "detection",
                            "--detection-model", "yolov5s"]),
@@ -90,7 +97,13 @@ ROWS = [
 
 
 def run_row(label: str, argv, timeout: int) -> dict:
-    cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
+    # SOAK sentinel: the row runs tools/soak.py (its stdout tail is the
+    # same one-line {"metric": ...} JSON contract bench.py rows use)
+    if argv and argv[0] == "SOAK":
+        cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py")] \
+            + argv[1:]
+    else:
+        cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)
     try:
         proc = subprocess.run(cmd, cwd=REPO, capture_output=True,

@@ -47,6 +47,16 @@ calibrated link pinned (NNS_TPU_LINK_D2H_MBPS/NNS_TPU_LINK_RTT_MS),
 asserting the ``fetch-bound`` diagnostic fires, strict against
 tools/fetch_deep_baseline.txt.
 
+AND it runs the soak smoke gate (docs/SERVING.md "Front door"):
+``tools/soak.py --smoke`` — a seconds-long 2-tenant soak in two passes:
+a low-load steady profile that must shed NOTHING with a green SLO
+report, and a deliberately overloaded profile (offered load >> service
+capacity, tiny max-backlog) where admission control must shed >= 1
+request, the per-tenant SLO must breach naming a dominant span kind,
+and the flight-recorder ring dump must ride the report.  The report
+schema is asserted field-by-field — the shape BENCH_SOAK rows and
+``Pipeline.slo_report()`` consumers depend on.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -305,6 +315,91 @@ def run_fetch_gate(update: bool, timeout: int = 900) -> int:
     return 0
 
 
+#: slo_report schema the soak gate (and every BENCH_SOAK consumer)
+#: depends on — keys of the report root and of each tenant verdict
+SLO_REPORT_KEYS = {"window_s", "ok", "breaches", "tenants"}
+SLO_VERDICT_KEYS = {"tenant", "ok", "violations", "p50_ms", "p99_ms",
+                    "fps", "requests", "sheds", "burn_rate", "objectives"}
+
+
+def run_soak_gate(timeout: int = 600) -> int:
+    """Soak smoke gate (see module docstring): tools/soak.py --smoke in
+    its own process, then schema + shed/ring-dump assertions over the
+    written rows."""
+    import json
+    import tempfile
+
+    out = os.path.join(tempfile.gettempdir(), "nns_soak_gate.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+           "--smoke", "--out", out]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"soak gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    problems = []
+    if proc.returncode != 0:
+        problems.append(f"soak.py rc={proc.returncode}")
+    rows = {}
+    try:
+        with open(out) as f:
+            rows = {r["profile"]: r for r in json.load(f)["rows"]}
+    except (OSError, ValueError, KeyError) as e:
+        problems.append(f"unreadable soak artifact: {e}")
+    for profile in ("steady", "overload"):
+        if profile not in rows:
+            problems.append(f"missing {profile} row")
+            continue
+        r = rows[profile]
+        rep = r.get("slo_report") or {}
+        missing = SLO_REPORT_KEYS - set(rep)
+        if missing:
+            problems.append(f"{profile}: slo_report missing {missing}")
+            continue
+        for t, v in rep["tenants"].items():
+            mv = SLO_VERDICT_KEYS - set(v)
+            if mv:
+                problems.append(f"{profile}: verdict[{t}] missing {mv}")
+        if not r.get("tenants"):
+            problems.append(f"{profile}: no worker rows")
+        for t, w in (r.get("tenants") or {}).items():
+            for key in ("p50_ms", "p99_ms", "sustained_fps", "burst_fps",
+                        "requests", "completed", "sheds_seen"):
+                if key not in w:
+                    problems.append(f"{profile}: worker {t} missing "
+                                    f"{key}")
+    steady, overload = rows.get("steady", {}), rows.get("overload", {})
+    if steady and steady.get("server", {}).get("sheds_total", -1) != 0:
+        problems.append(
+            f"steady: expected 0 sheds at low load, got "
+            f"{steady.get('server', {}).get('sheds_total')}")
+    if overload:
+        srv = overload.get("server", {})
+        rep = overload.get("slo_report", {})
+        if srv.get("sheds_total", 0) < 1:
+            problems.append("overload: expected >= 1 shed")
+        if not srv.get("sheds_by_tenant"):
+            problems.append("overload: sheds not counted per tenant")
+        if rep.get("ok", True) or not rep.get("breaches"):
+            problems.append("overload: SLO did not breach")
+        for t in rep.get("breaches", []):
+            if not rep["tenants"][t].get("dominant_span_kind"):
+                problems.append(
+                    f"overload: breach {t} missing dominant_span_kind")
+        if not overload.get("ring_dump"):
+            problems.append("overload: ring dump not attached")
+    tag = "OK" if not problems else "FAILED"
+    print(f"soak gate: {tag}")
+    for p in problems:
+        print(f"  soak gate: {p}", file=sys.stderr)
+    if problems and proc.stdout:
+        for line in proc.stdout.strip().splitlines()[-8:]:
+            print(f"  {line}", file=sys.stderr)
+    return 1 if problems else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -321,8 +416,9 @@ def main() -> int:
     tracing_rc = run_tracing_gate()
     serving_rc = run_serving_gate(args.update)
     fetch_rc = run_fetch_gate(args.update)
+    soak_rc = run_soak_gate()
     lint_rc = (lint_rc or deep_rc or sharded_rc or tracing_rc or serving_rc
-               or fetch_rc)
+               or fetch_rc or soak_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:

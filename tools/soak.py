@@ -1,0 +1,450 @@
+#!/usr/bin/env python
+"""Soak harness for the query front door (ISSUE 8, docs/SERVING.md
+"Front door"): a multi-process load generator driving N tenants against
+ONE query-server pipeline for minutes, recording per-tenant tail latency
+and sustained-vs-burst throughput into BENCH_SOAK rows.
+
+    python tools/soak.py --out BENCH_SOAK_r01.json          # full run
+    python tools/soak.py --smoke --out /tmp/soak.json       # CI gate
+
+Per profile, the harness:
+
+1. builds a fresh server pipeline (``tensor_query_serversrc`` with the
+   requested admission policy ! a custom-easy work stage with a
+   configurable service time ! ``tensor_query_serversink``) with
+   ``trace_mode=ring`` and a per-tenant SLO policy attached;
+2. spawns one WORKER SUBPROCESS per tenant (own interpreter — the load
+   generation never shares the server's GIL), each driving a client
+   pipeline (``appsrc ! tensor_query_client tenant=... ! tensor_sink``)
+   at a profile-shaped request rate, measuring per-request wall latency
+   client-side (a ``t_send`` stamp rides the wire meta out and back);
+3. evaluates the server's SLO engine, collects worker stats, and writes
+   one row: per-tenant p50/p99/max latency, sustained fps (completions /
+   duration) vs burst fps (best 0.5 s window), request/shed counts, the
+   ``slo_report`` verdict, and — on any SLO breach or watchdog fire —
+   the flight-recorder ring dump.
+
+Profiles
+--------
+* ``steady``   — constant rate (the zero-shed low-load reference);
+* ``ramp``     — rate climbs linearly 0 → peak over the duration;
+* ``spike``    — 20% of peak baseline with full-peak bursts (20% duty);
+* ``churn``    — steady rate, but each client tears its connection down
+  and reconnects in 4 segments (admission/handshake churn);
+* ``overload`` — offered load far above service capacity with a small
+  ``max-backlog`` and slow service: admission control MUST shed, and
+  the tight SLO must breach (the post-mortem path the gate asserts).
+
+The stdout tail is one JSON line carrying ``"metric"`` so
+``tools/bench_all.py`` ingests the result as a sweep row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DIMS = 32
+BURST_WINDOW_S = 0.5
+
+#: per-profile shape: (baseline fraction of peak, description)
+PROFILES = ("steady", "ramp", "spike", "churn", "overload")
+
+
+# ---------------------------------------------------------------------------
+# worker (subprocess): one tenant's load generator
+# ---------------------------------------------------------------------------
+
+def _rate_at(profile: str, t: float, duration: float, peak: float) -> float:
+    """Offered request rate (req/s) at elapsed time ``t``."""
+    if profile == "ramp":
+        return peak * min(1.0, t / max(1e-9, duration))
+    if profile == "spike":
+        # 20% baseline; full peak during two bursts at 30-40% and
+        # 60-80% of the run
+        frac = t / max(1e-9, duration)
+        burst = 0.3 <= frac < 0.4 or 0.6 <= frac < 0.8
+        return peak if burst else 0.2 * peak
+    return peak  # steady / churn / overload
+
+
+def _worker_segment(port: int, tenant: str, profile: str,
+                    duration: float, peak: float, timeout: float,
+                    stats: dict) -> None:
+    """One client-pipeline lifetime: push at the profile rate, pull every
+    response, record latencies/sheds into ``stats``."""
+    import nnstreamer_tpu as nt
+
+    cli = nt.Pipeline(
+        f"appsrc name=src ! tensor_query_client port={port} "
+        f"tenant={tenant} timeout={timeout} on-timeout=drop ! "
+        "tensor_sink name=out")
+    done = threading.Event()
+
+    def puller():
+        # drain accounting is CUMULATIVE across churn segments: a
+        # per-segment counter would read "drained" the moment segment
+        # 2+ starts (earlier segments' completions already >= the new
+        # segment's pushes) and leak in-flight responses out of the row
+        while True:
+            try:
+                out = cli.pull("out", timeout=0.25)
+            except TimeoutError:
+                answered = (stats["completed"] + stats["sheds_seen"]
+                            + stats["lost"])
+                if done.is_set() and answered >= stats["requests"]:
+                    return
+                if done.is_set() and time.monotonic() > stats["_drain_by"]:
+                    stats["lost"] += stats["requests"] - answered
+                    return
+                continue
+            except Exception:  # noqa: BLE001 - pipeline died: stop pulling
+                return
+            now = time.time()
+            if out.meta.get("shed"):
+                stats["sheds_seen"] += 1
+            else:
+                t_send = out.meta.get("t_send")
+                if t_send is not None:
+                    stats["latencies_ms"].append((now - t_send) * 1e3)
+                stats["completed"] += 1
+                stats["completions"].append(time.monotonic())
+
+    with cli:
+        pull = threading.Thread(target=puller, daemon=True)
+        pull.start()
+        # rate integration, not per-request sleeps: accumulate "owed"
+        # requests from the instantaneous profile rate each tick, so a
+        # near-zero ramp start idles in 5 ms slices instead of sleeping
+        # out 1/rate (which at rate->0 would park the worker for the
+        # whole run)
+        t0 = t_prev = time.monotonic()
+        owed = 0.0
+        while True:
+            now = time.monotonic()
+            t = now - t0
+            if t >= duration:
+                break
+            owed += _rate_at(profile, t, duration, peak) * (now - t_prev)
+            t_prev = now
+            if owed < 1.0:
+                time.sleep(0.005)
+                continue
+            dead = False
+            while owed >= 1.0:
+                owed -= 1.0
+                buf = nt.Buffer([np.full((DIMS,), 1.0, np.float32)])
+                buf.meta["t_send"] = time.time()
+                try:
+                    cli.push("src", buf)
+                except Exception:  # noqa: BLE001 - server gone mid-churn
+                    dead = True
+                    break
+                stats["requests"] += 1
+            if dead:
+                break
+        stats["_drain_by"] = time.monotonic() + max(2.0, timeout)
+        done.set()
+        pull.join(timeout=max(5.0, timeout + 2.0))
+        cli.eos("src")
+        try:
+            cli.wait(timeout=10)
+        except Exception:  # noqa: BLE001 - drop-mode stragglers are fine
+            pass
+
+
+def run_worker(args) -> int:
+    stats = {"requests": 0, "completed": 0, "sheds_seen": 0, "lost": 0,
+             "latencies_ms": [], "completions": [],
+             "_drain_by": float("inf")}
+    segments = 4 if args.profile == "churn" else 1
+    seg_dur = args.duration / segments
+    for _ in range(segments):
+        _worker_segment(args.port, args.tenant, args.profile, seg_dur,
+                        args.rate, args.timeout, stats)
+    lats = sorted(stats["latencies_ms"])
+
+    def pct(q):
+        if not lats:
+            return None
+        return lats[min(len(lats) - 1,
+                        max(0, int(len(lats) * q / 100.0 + 0.999999) - 1))]
+
+    # burst fps: the best BURST_WINDOW_S completion window; sustained
+    # fps: completions over the actual first-to-last completion span
+    # (NOT the nominal duration — under overload the drain tail would
+    # otherwise inflate it past the burst number)
+    comps = stats["completions"]
+    burst = 0
+    j = 0
+    for i in range(len(comps)):
+        while comps[i] - comps[j] > BURST_WINDOW_S:
+            j += 1
+        burst = max(burst, i - j + 1)
+    span = (comps[-1] - comps[0]) if len(comps) > 1 else 0.0
+    sustained = (stats["completed"] / span if span > 1.0
+                 else stats["completed"] / args.duration)
+    out = {
+        "tenant": args.tenant,
+        "profile": args.profile,
+        "requests": stats["requests"],
+        "completed": stats["completed"],
+        "sheds_seen": stats["sheds_seen"],
+        "lost": stats["lost"],
+        "p50_ms": pct(50), "p99_ms": pct(99), "max_ms": pct(100),
+        "sustained_fps": sustained,
+        "burst_fps": burst / BURST_WINDOW_S,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator: one profile = one fresh server + N tenant workers
+# ---------------------------------------------------------------------------
+
+def _register_work(service_ms: float) -> None:
+    from nnstreamer_tpu.core.types import TensorsSpec
+    from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+    spec = TensorsSpec.from_string(str(DIMS), "float32")
+    service_s = service_ms / 1e3
+
+    def work(ins):
+        if service_s > 0:
+            time.sleep(service_s)
+        return [ins[0] * 2.0]
+
+    register_custom_easy("soak-work", work, in_spec=spec, out_spec=spec)
+
+
+def run_profile(profile: str, *, tenants: int, duration: float,
+                rate: float, service_ms: float, admission: str,
+                max_backlog: int, p99_ms: float, sid: int,
+                watchdog_s: float = 5.0) -> dict:
+    """One soak row: fresh server pipeline + metrics/ring state, N worker
+    subprocesses, SLO verdict, ring dump on breach/watchdog."""
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics
+    from nnstreamer_tpu.utils import tracing
+    from nnstreamer_tpu.utils.watchdog import Watchdog
+
+    metrics.reset()
+    tracing.recorder.clear()
+    tenant_names = [f"t{i}" for i in range(tenants)]
+    _register_work(service_ms)
+    policy = {
+        "tenants": [{"tenant": t, "p99_ms": p99_ms, "error_budget": 0.01}
+                    for t in tenant_names],
+    }
+    srv = nt.Pipeline(
+        f"tensor_query_serversrc name=ssrc port=0 id={sid} "
+        f"admission={admission} max-backlog={max_backlog} ! "
+        f"tensor_filter framework=custom-easy model=soak-work ! "
+        f"tensor_query_serversink name=ssink id={sid}",
+        trace_mode="ring", slo=policy)
+    row: dict = {"profile": profile, "tenants_n": tenants,
+                 "duration_s": duration, "offered_rate_per_tenant": rate,
+                 "service_ms": service_ms, "admission": admission,
+                 "max_backlog": max_backlog, "p99_objective_ms": p99_ms}
+    wd_fired = threading.Event()
+    with srv:
+        port = srv.element("ssrc").bound_port
+        wd = Watchdog(watchdog_s, wd_fired.set)
+        stop_mon = threading.Event()
+
+        def monitor():
+            # feed the watchdog while the server is healthy: either it
+            # made progress since the last tick (responses/sheds
+            # advanced) or it has nothing pending (idle is not hung —
+            # worker subprocesses take seconds to spawn, and the drain
+            # tail after the last request is quiet by design).  A wedged
+            # pipeline — requests admitted, nothing answered — stops
+            # feeding and the dog fires -> ring dump attached below.
+            last = -1.0
+            while not stop_mon.wait(0.25):
+                snap = metrics.snapshot()
+                answered = (snap.get("query_server.out", 0.0)
+                            + snap.get("query_server.shed", 0.0))
+                pending = snap.get("query_server.in", 0.0) - answered
+                if answered != last or pending <= 0:
+                    wd.feed()
+                last = answered
+
+        mon = threading.Thread(target=monitor, daemon=True)
+        workers = []
+        outs = []
+        with wd:
+            mon.start()
+            for t in tenant_names:
+                fd, path = tempfile.mkstemp(prefix=f"soak-{t}-",
+                                            suffix=".json")
+                os.close(fd)
+                outs.append(path)
+                workers.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--worker", "--port", str(port), "--tenant", t,
+                     "--profile", profile, "--duration", str(duration),
+                     "--rate", str(rate), "--timeout", "10",
+                     "--out", path],
+                    cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu")))
+            deadline = time.monotonic() + duration * 4 + 60
+            stragglers = 0
+            for w in workers:
+                try:
+                    w.wait(timeout=max(5.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.kill()
+                    stragglers += 1
+            row["worker_stragglers"] = stragglers
+            stop_mon.set()
+            mon.join(timeout=2.0)
+        report = srv.slo_report()
+        row["tenants"] = {}
+        for path in outs:
+            try:
+                with open(path) as f:
+                    w = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            finally:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            row["tenants"][w["tenant"]] = w
+        snap = metrics.snapshot()
+        lab = metrics.labeled_counters()
+        row["server"] = {
+            "requests_in": snap.get("query_server.in", 0.0),
+            "responses_out": snap.get("query_server.out", 0.0),
+            "sheds_total": snap.get("query_server.shed", 0.0),
+            "downgraded_total": snap.get("query_server.downgraded", 0.0),
+            "sheds_by_tenant": {
+                t: v for (name, t), v in lab.items()
+                if name == "query_server.shed"},
+        }
+        row["slo_report"] = report
+        row["watchdog_fired"] = wd_fired.is_set()
+        if wd_fired.is_set() or not report["ok"]:
+            # the post-mortem contract: a degraded soak run ships with
+            # its own flight-recorder timeline attached
+            row["ring_dump"] = tracing.format_recent(5.0)[-120:]
+        else:
+            row["ring_dump"] = None
+    return row
+
+
+def default_profiles(smoke: bool) -> list:
+    """(profile, kwargs) rows.  Smoke = the seconds-long CI shape: a
+    low-load steady pass that must shed nothing, and a deliberately
+    overloaded pass that must shed and breach."""
+    if smoke:
+        return [
+            ("steady", dict(tenants=2, duration=2.5, rate=25.0,
+                            service_ms=1.0, admission="shed",
+                            max_backlog=64, p99_ms=2000.0)),
+            ("overload", dict(tenants=2, duration=2.5, rate=250.0,
+                              service_ms=15.0, admission="shed",
+                              max_backlog=4, p99_ms=30.0)),
+        ]
+    full = dict(tenants=3, service_ms=2.0, admission="shed",
+                max_backlog=64, p99_ms=500.0)
+    return [
+        ("ramp", dict(full, duration=30.0, rate=60.0)),
+        ("spike", dict(full, duration=30.0, rate=80.0)),
+        ("churn", dict(full, duration=30.0, rate=40.0)),
+        ("overload", dict(tenants=3, duration=15.0, rate=300.0,
+                          service_ms=15.0, admission="shed",
+                          max_backlog=8, p99_ms=50.0)),
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_SOAK_r01.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-long 2-tenant CI shape (steady + "
+                         "overload)")
+    ap.add_argument("--profiles", default=None,
+                    help=f"comma-separated subset of {PROFILES}")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="override per-profile duration (s)")
+    # worker mode (internal): one tenant's load generator
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--tenant", default="t0", help=argparse.SUPPRESS)
+    ap.add_argument("--profile", default="steady", help=argparse.SUPPRESS)
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.worker:
+        return run_worker(args)
+
+    rows = []
+    plan = default_profiles(args.smoke)
+    if args.profiles:
+        want = set(args.profiles.split(","))
+        unknown = want - set(PROFILES)
+        if unknown:
+            ap.error(f"unknown profile(s): {sorted(unknown)}")
+        plan = [(p, kw) for p, kw in plan if p in want]
+    t_start = time.time()
+    for i, (profile, kw) in enumerate(plan):
+        if args.duration:
+            kw = dict(kw, duration=args.duration)
+        print(f"== soak {profile}: {kw}", flush=True)
+        row = run_profile(profile, sid=900 + i, **kw)
+        srv = row["server"]
+        print(f"   in={srv['requests_in']:.0f} out={srv['responses_out']:.0f} "
+              f"sheds={srv['sheds_total']:.0f} "
+              f"slo_ok={row['slo_report']['ok']} "
+              f"watchdog={row['watchdog_fired']}", flush=True)
+        rows.append(row)
+    doc = {
+        "note": "query front-door soak (tools/soak.py): N tenant worker "
+                "subprocesses per profile against one fresh "
+                "serversrc!custom-easy!serversink pipeline, "
+                "trace_mode=ring, per-tenant SLO engine live.  Client "
+                "latencies are wall-clock push->pull (t_send meta rides "
+                "the wire); burst fps = best 0.5 s completion window.",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                     time.gmtime(t_start)),
+        "smoke": bool(args.smoke),
+        "rows": rows,
+    }
+    out_path = args.out if os.path.isabs(args.out) \
+        else os.path.join(os.getcwd(), args.out)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    total_fps = sum(t.get("sustained_fps", 0.0)
+                    for r in rows for t in r.get("tenants", {}).values())
+    # the bench_all-ingestable summary line (last JSON line with "metric")
+    print(json.dumps({
+        "metric": "soak_sustained_fps_sum", "value": round(total_fps, 2),
+        "unit": "fps",
+        "profiles": [r["profile"] for r in rows],
+        "sheds_total": sum(r["server"]["sheds_total"] for r in rows),
+        "slo_ok": all(r["slo_report"]["ok"] for r in rows),
+        "artifact": os.path.basename(out_path),
+    }))
+    print(f"wrote {out_path} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

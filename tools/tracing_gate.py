@@ -77,12 +77,12 @@ def _warm(p) -> None:
         p.pull("out", timeout=120)
 
 
-def run_phase(trace_mode: str, reps: int = 5) -> float:
+def run_phase(trace_mode: str, reps: int = 5, tenant=None) -> float:
     """Best-of-``reps`` wall of the backlogged phase in one pipeline."""
     import nnstreamer_tpu as nt
 
     p = nt.Pipeline(DESC, queue_capacity=64, batch_max=8,
-                    trace_mode=trace_mode)
+                    trace_mode=trace_mode, tenant=tenant)
     with p:
         _warm(p)
         walls = [_window(p) for _ in range(reps)]
@@ -161,7 +161,9 @@ def gate_off_pin() -> list:
     orig = FlightRecorder.record
     FlightRecorder.record = boom
     try:
-        run_phase("off", reps=1)
+        # tenant= set deliberately: tenant threading (ISSUE 8) must add
+        # no stamps and touch no recorder on the off path
+        run_phase("off", reps=1, tenant="gate")
     except Exception as e:  # noqa: BLE001 - report, don't crash the gate
         return [f"off-mode instrumentation pin: {e!r}"]
     finally:
