@@ -1098,6 +1098,207 @@ def bench_sharded(batches: int, warmup: int, replicas: int = 4,
     }
 
 
+def bench_tp(batches: int, warmup: int, model: str = "llama_small",
+             ways: int = 2, max_new: int = 32, prompt_len: int = 16) -> dict:
+    """2-D placement A/B row (ISSUE 9): tokens/sec of the llm decode
+    under ``Pipeline(model_parallel=M)`` vs ``model_parallel=1`` on the
+    SAME prompt — the filter rides the pipeline's shared ``(data x
+    model)`` mesh, params + KV sharded per ``param_pspecs``.  On the CPU
+    host-device proxy TP buys no wall-clock (the "chips" share one
+    socket's caches), so like the fetch row this records the MECHANISM's
+    ratio for the next chip sweep, where the decode's weight-bandwidth
+    bound is what an M-way split actually divides.  The row decodes at
+    the serving dtype (bf16): GSPMD's reduced collective order can flip
+    a near-tie bf16 argmax, so ``greedy_ids_identical`` is informational
+    here — the bitwise identity contract is pinned at f32 by
+    tests/test_model_parallel.py (the mesh gate)."""
+    import jax
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+
+    if len(jax.devices()) < ways:
+        raise SystemExit(
+            f"--config tp needs {ways} local devices, have "
+            f"{len(jax.devices())} (CPU proxy: XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8)")
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 400, (1, prompt_len), dtype=np.int32)
+    desc = (
+        "appsrc name=src ! "
+        f"tensor_filter framework=llm model={model} "
+        f"custom=max_new:{max_new},temperature:0.0,stream_chunk:8 "
+        "invoke-dynamic=true ! tensor_sink name=out"
+    )
+
+    def run(mp: int):
+        p = nt.Pipeline(desc, model_parallel=mp)
+        ids = []
+        toks = 0
+        with p:
+            for _ in range(max(1, warmup)):
+                p.push("src", prompt)
+                for _ in range(max_new):
+                    p.pull("out", timeout=900)
+            t0 = time.perf_counter()
+            for _ in range(batches):
+                p.push("src", prompt)
+                for _ in range(max_new):
+                    ids.append(int(p.pull("out", timeout=900)
+                                   .tensors[0][0]))
+                    toks += 1
+            wall = time.perf_counter() - t0
+            p.eos()
+            p.wait(timeout=60)
+        assert p.mesh_shape == (1, mp)
+        return toks / wall, ids
+
+    tps_tp, ids_tp = run(ways)
+    tps_1, ids_1 = run(1)
+    ratio = tps_tp / tps_1
+    return {
+        "metric": f"{model}_decode_tp{ways}_vs_tp1_tokens_per_sec",
+        "value": round(tps_tp, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(ratio, 3),
+        "speedup_vs_tp1": round(ratio, 3),
+        "tokens_per_sec_tp1": round(tps_1, 1),
+        "model_parallel": ways,
+        "greedy_ids_identical_bf16": ids_tp == ids_1,
+        "max_new": max_new,
+        "prompt_len": prompt_len,
+        "batches": batches,
+        "methodology": (
+            "same prompt/pipeline both runs at the serving dtype (bf16; "
+            "near-tie argmax may flip under GSPMD reduction order — f32 "
+            "bit-identity is pinned by tests/test_model_parallel.py); "
+            "CPU host-device proxy when JAX_PLATFORMS=cpu "
+            "(xla_force_host_platform_device_count=8); the chip sweep "
+            "measures the real weight-bandwidth split"),
+    }
+
+
+def bench_tp_grid(batches: int, warmup: int, dp: int = 2, mp: int = 2,
+                  dims: int = 512, layers: int = 12,
+                  batch_max: int = 32) -> dict:
+    """dp x tp grid row (ISSUE 9): the backlogged sharded-micro-batching
+    pipeline of ``--config sharded``, but with a ``param_pspecs``-carrying
+    MLP so the 2-D mesh places weights over ``model`` WHILE the batch dim
+    shards over ``data`` — (dp=2, model=2) vs dp-only (dp=4) on the same
+    4 chips.  The per-chip param bytes drop ~2x on the 2-D run (the
+    placement counters prove it); fps ratio is the grid tradeoff the
+    next chip sweep reads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics as _metrics
+    from nnstreamer_tpu.core.types import TensorsSpec
+    from nnstreamer_tpu.models.zoo import ModelBundle, register_model
+
+    need = dp * mp
+    if len(jax.devices()) < need:
+        raise SystemExit(
+            f"--config tp_grid needs {need} local devices, have "
+            f"{len(jax.devices())} (CPU proxy: XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8)")
+
+    rng = np.random.default_rng(3)
+    w1 = (rng.standard_normal((layers, dims, dims)).astype(np.float32)
+          * (0.9 / np.sqrt(dims)))
+
+    @register_model("bench-tp-grid-mlp")
+    def _build(opts):
+        from jax.sharding import PartitionSpec as P
+
+        params = {"w": jnp.asarray(w1)}
+
+        def apply_fn(p, x):
+            def body(x, wl):
+                return jnp.tanh(x @ wl), None
+            x, _ = jax.lax.scan(body, x, p["w"])
+            return x
+
+        spec = TensorsSpec.from_string(str(dims), "float32")
+        # layer-stacked mat: OUT dim shards over model (Megatron column
+        # split; XLA re-gathers between layers — the grid row's point is
+        # placement, not a tuned TP block)
+        return ModelBundle(apply_fn, params, spec, spec,
+                           param_pspecs={"w": P(None, None, "model")})
+
+    desc = (
+        f"appsrc name=src caps=other/tensors,dimensions={dims},"
+        "types=float32 ! "
+        "tensor_filter framework=jax model=bench-tp-grid-mlp name=f ! "
+        "tensor_sink name=out"
+    )
+    n = max(256, 2 * batches)
+    frames = [np.full((dims,), float(i % 5) * 0.2, np.float32)
+              for i in range(8)]
+
+    def run(run_dp: int, run_mp: int):
+        _metrics.reset()
+        p = nt.Pipeline(desc, queue_capacity=64, batch_max=batch_max,
+                        data_parallel=run_dp, model_parallel=run_mp,
+                        dispatch_depth=2)
+        walls = []
+        with p:
+            for i in range(max(64, 8 * warmup)):
+                p.push("src", frames[i % len(frames)])
+            for _ in range(max(64, 8 * warmup)):
+                p.pull("out", timeout=300)
+            for _ in range(3):
+                def pusher():
+                    for i in range(n):
+                        p.push("src", frames[i % len(frames)])
+
+                t = threading.Thread(target=pusher, daemon=True)
+                t0 = time.perf_counter()
+                t.start()
+                for _ in range(n):
+                    p.pull("out", timeout=300)
+                walls.append(time.perf_counter() - t0)
+                t.join()
+            p.eos()
+            p.wait(timeout=60)
+        snap = _metrics.snapshot()
+        return n / min(walls), {
+            "shards": snap.get("f.param_shards", 0.0),
+            "replicas": snap.get("f.param_replicas", 0.0),
+            "rows": {k.rsplit(".", 1)[1]: round(v, 1)
+                     for k, v in snap.items()
+                     if k.startswith("f.shard_rows.")},
+        }
+
+    fps_grid, place_grid = run(dp, mp)
+    fps_dp, place_dp = run(dp * mp, 1)
+    ratio = fps_grid / fps_dp
+    return {
+        "metric": f"sharded_grid_dp{dp}xtp{mp}_vs_dp{dp * mp}_fps",
+        "value": round(fps_grid, 1),
+        "unit": "frames/sec",
+        "vs_baseline": round(ratio, 3),
+        "fps_dp_only": round(fps_dp, 1),
+        "speedup_vs_dp_only": round(ratio, 3),
+        "data_parallel": dp,
+        "model_parallel": mp,
+        "param_leaves_sharded": place_grid["shards"],
+        "per_chip_rows_grid": place_grid["rows"],
+        "per_chip_rows_dp_only": place_dp["rows"],
+        "batch_max": batch_max,
+        "dims": dims,
+        "mlp_layers": layers,
+        "buffers": n,
+        "methodology": (
+            "same 4 chips both runs: (data=2, model=2) with weights "
+            "sharded over model vs (data=4) with weights replicated; "
+            "identical input/queue/batch_max; CPU host-device proxy when "
+            "JAX_PLATFORMS=cpu — per-chip weight HBM halves on the grid "
+            "run, fps ratio is the tradeoff the chip sweep reads"),
+    }
+
+
 def bench_fetch(batches: int, warmup: int, dims: int = 1 << 16) -> dict:
     """Async-fetch-engine A/B row (ISSUE 7): a host-fed pipeline whose
     sink payload is LARGE (``dims`` float32 = 256 KB/buffer each way), so
@@ -1306,7 +1507,7 @@ def main() -> int:
                     choices=["classification", "classification_quant",
                              "detection", "pose", "segmentation", "audio",
                              "llm", "llm7b", "link", "batching", "sharded",
-                             "fetch", "all"])
+                             "tp", "tp_grid", "fetch", "all"])
     # classification defaults to 256: the r3 on-chip session measured 2x
     # the fps AND 2x the MFU of batch 64 (30,137 fps / 0.175 MFU vs
     # 15,116 / 0.088) at a still-interactive 5.4 ms p50 — deeper batches
@@ -1332,6 +1533,9 @@ def main() -> int:
     ap.add_argument("--llm-text", action="store_true",
                     help="text-in/text-out contract: SentencePiece encode "
                          "+ per-piece decode in the measured loop")
+    ap.add_argument("--tp-ways", type=int, default=2,
+                    help="tp config: model_parallel ways for the A/B "
+                         "(vs model_parallel=1)")
     ap.add_argument("--source", default="videotestsrc",
                     choices=["videotestsrc", "appsrc"],
                     help="classification config: device-generated test "
@@ -1355,7 +1559,7 @@ def main() -> int:
                          "artifact next to the BENCH json — load in "
                          "Perfetto (docs/OBSERVABILITY.md)")
     args = ap.parse_args()
-    if (args.config == "sharded"
+    if (args.config in ("sharded", "tp", "tp_grid")
             and os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
             and "xla_force_host_platform_device_count"
             not in os.environ.get("XLA_FLAGS", "")):
@@ -1388,6 +1592,9 @@ def main() -> int:
             "link": ("link_calibration_d2h_mbps", "MB/s"),
             "batching": ("adaptive_batching_speedup_batch8_vs_1", "x"),
             "sharded": ("mesh_sharded_batching_speedup_dp4_vs_1", "x"),
+            "tp": (f"{args.llm_model}_decode_tp{args.tp_ways}_vs_tp1_"
+                   "tokens_per_sec", "tokens/sec"),
+            "tp_grid": ("sharded_grid_dp2xtp2_vs_dp4_fps", "frames/sec"),
             "fetch": ("async_fetch_speedup_depth2_donate_vs_serial", "x"),
         }
         todo = (["classification", "detection", "pose", "segmentation",
@@ -1447,12 +1654,17 @@ def main() -> int:
         "link": bench_link,
         "batching": lambda: bench_batching(args.batches, args.warmup),
         "sharded": lambda: bench_sharded(args.batches, args.warmup),
+        "tp": lambda: bench_tp(max(1, args.batches // 16), args.warmup,
+                               model=args.llm_model, ways=args.tp_ways),
+        "tp_grid": lambda: bench_tp_grid(args.batches, args.warmup),
         "fetch": lambda: bench_fetch(args.batches, args.warmup),
     }
     todo = list(runners) if args.config == "all" else [args.config]
     if args.config == "all":
         todo.remove("llm7b")  # 7B needs ~14 GB HBM free; run explicitly
         todo.remove("sharded")  # needs >=4 local devices; run explicitly
+        todo.remove("tp")  # needs >=2 local devices; run explicitly
+        todo.remove("tp_grid")  # needs >=4 local devices; run explicitly
     guard_ns = round(_trace_off_guard_ns(), 2)
     if args.trace:
         # Pipelines built inside the rows read the shared config, so the

@@ -50,6 +50,7 @@ def analyze(
     batch_max: Optional[int] = None,
     batch_buckets: Optional[list] = None,
     data_parallel: Optional[int] = None,
+    model_parallel: Optional[int] = None,
     dispatch_depth: Optional[int] = None,
     hbm_budget_bytes: Optional[int] = None,
     max_compiled_variants: Optional[int] = None,
@@ -112,7 +113,8 @@ def analyze(
         try:
             ddiags, resources = deep_check(
                 graph, batch_max=batch_max, batch_buckets=batch_buckets,
-                data_parallel=data_parallel, dispatch_depth=dispatch_depth,
+                data_parallel=data_parallel, model_parallel=model_parallel,
+                dispatch_depth=dispatch_depth,
                 hbm_budget_bytes=hbm_budget_bytes,
                 max_compiled_variants=max_compiled_variants,
                 out_caps=caps_state.get("out_caps"))

@@ -43,7 +43,7 @@ from ..core.types import TensorFormat, TensorSpec, TensorsSpec
 from ..elements.base import Element, SINK, SRC
 from ..pipeline.batching import ladder as bucket_ladder, shard_bucket_for
 from ..pipeline.graph import PipelineGraph
-from ..pipeline.plan import replication_plan
+from ..pipeline.plan import mesh_plan, replication_plan
 from ..pipeline.residency import FetchEdge, compute_floor_ms, fetch_ms
 from .capsflow import SAFE_CONFIGURE, _element_class, _kahn_order, propagate
 from .diagnostics import Diagnostic, ERROR, WARNING, node_label
@@ -59,6 +59,9 @@ class StageResource:
     element, or a maximal linear chain the planner would fuse)."""
 
     label: str  # "a+b" for chains, mirroring FusedElement naming
+    #: PER-CHIP planned param bytes: under a >1 ``model`` axis, leaves
+    #: whose pspecs shard over ``model`` are already divided by M here
+    #: (param_bytes/M for sheared leaves; replicated leaves full-size)
     param_bytes: int
     #: peak abstract activation bytes for ONE row (batch entry): max over
     #: the chain's links of input+output bytes
@@ -92,8 +95,12 @@ class ResourceReport:
     stages: List[StageResource]
     batch_max: int
     data_parallel: int  # resolved replicas (1 = unsharded)
-    dispatch_depth: int
-    ladder: Tuple[int, ...]
+    #: resolved ``model``-axis width of the pipeline mesh (the
+    #: ``pipeline/plan.mesh_plan`` arithmetic the runtime shares);
+    #: param/pool bytes above are PER CHIP under this plan
+    model_parallel: int = 1
+    dispatch_depth: int = 1
+    ladder: Tuple[int, ...] = ()
     hbm_budget_bytes: int = 0
     max_compiled_variants: int = 0
     #: planned D2H per sink edge (pipeline/residency.py): what actually
@@ -126,6 +133,7 @@ class ResourceReport:
             f"(batch_max={self.batch_max}, "
             f"buckets={','.join(map(str, self.ladder))}, "
             f"data_parallel={self.data_parallel}, "
+            f"model_parallel={self.model_parallel}, "
             f"dispatch_depth={self.dispatch_depth})"
         ]
         if not self.stages:
@@ -173,6 +181,9 @@ class _NodeTrace:
     batchable: bool
     host_post: bool
     linear: bool  # single default-pad in/out edges (fusion-chain eligible)
+    #: bytes of param leaves whose pspecs shard over the ``model`` axis
+    #: (0 = no pspecs / nothing model-sharded): divided by M per chip
+    param_shard_bytes: int = 0
 
 
 def _trace_msg(e: BaseException) -> str:
@@ -194,6 +205,7 @@ def deep_check(
     batch_max: Optional[int] = None,
     batch_buckets: Optional[List[int]] = None,
     data_parallel: Optional[int] = None,
+    model_parallel: Optional[int] = None,
     dispatch_depth: Optional[int] = None,
     hbm_budget_bytes: Optional[int] = None,
     max_compiled_variants: Optional[int] = None,
@@ -217,6 +229,8 @@ def deep_check(
         buckets = sorted(set(buckets))
     dp_knob = max(0, data_parallel if data_parallel is not None
                   else cfg.data_parallel)
+    mp_knob = max(0, model_parallel if model_parallel is not None
+                  else cfg.model_parallel)
     dispatch_depth = max(1, dispatch_depth if dispatch_depth is not None
                          else cfg.dispatch_depth)
     hbm_budget = (hbm_budget_bytes if hbm_budget_bytes is not None
@@ -230,10 +244,12 @@ def deep_check(
 
     import jax  # backend init only — the pass never dispatches
 
-    n_devices = len(jax.devices())  # what _build_data_mesh sizes against
-    requested = replication_plan(dp_knob, batch_max, n_devices)
-    replicas = min(requested, n_devices)  # model what COULD run; the
-    # over-ask itself becomes a diagnostic below
+    n_devices = len(jax.devices())  # what Pipeline._shared_mesh sizes against
+    req_dp, req_mp = mesh_plan(dp_knob, mp_knob, batch_max, n_devices)
+    # model what COULD run; the over-ask itself becomes a diagnostic below
+    model_par = min(req_mp, n_devices)
+    replicas = min(req_dp, max(1, n_devices // model_par))
+    requested = req_dp  # the data-axis over-ask, kept for the diag below
     diags: List[Diagnostic] = []
     if out_caps is None:
         # capsflow's own diagnostics are the syntactic pass's to report;
@@ -243,7 +259,7 @@ def deep_check(
     traces: Dict[int, _NodeTrace] = {}
     serving_stages: List[StageResource] = []
     for node in _kahn_order(graph):
-        serving = _llm_serving_stage(node, diags)
+        serving = _llm_serving_stage(node, diags, model_par)
         if serving is not None:
             # continuous LLM serving is priced STATICALLY (building the
             # element would materialize the full parameter set); True =
@@ -251,12 +267,13 @@ def deep_check(
             if isinstance(serving, StageResource):
                 serving_stages.append(serving)
             continue
-        got = _trace_node(graph, node, out_caps, diags)
+        got = _trace_node(graph, node, out_caps, diags, model_par)
         if got is not None:
             traces[node.id] = got
 
     report = _resources(graph, traces, batch_max=batch_max, buckets=buckets,
-                        replicas=replicas, dispatch_depth=dispatch_depth,
+                        replicas=replicas, model_par=model_par,
+                        dispatch_depth=dispatch_depth,
                         hbm_budget=hbm_budget, max_variants=max_variants)
     report.stages.extend(serving_stages)
     report.link_d2h_mbps = d2h_mbps
@@ -269,16 +286,29 @@ def deep_check(
             t.element.stop()
         except Exception:  # noqa: BLE001 - best-effort cleanup
             pass
-    if requested > n_devices and batch_max > 1 \
-            and any(s.shard_eligible for s in report.stages):
-        # exactly the config the runtime's _build_data_mesh refuses: a
-        # shard-eligible stage + an explicit dp the host cannot supply
-        top = next(s for s in report.stages if s.shard_eligible)
+    # Exactly when the runtime builds the mesh — model_parallel
+    # configured (knob != 1: _build_mesh's mp_wanted, no shard-eligible
+    # stage needed), or a shard-eligible stage with batching on — an
+    # over-asked (data x model) plan fails start() (or the llm filter's
+    # open()) with this same arithmetic; with model_parallel left at 1
+    # and nothing shardable, the dp knob stays inert like it always was.
+    if requested * req_mp > n_devices and (
+            mp_knob != 1
+            or (batch_max > 1
+                and any(s.shard_eligible for s in report.stages))):
+        top = next((s for s in report.stages if s.shard_eligible), None)
+        if requested > 1 and req_mp > 1:
+            plan = f"data_parallel={requested} x model_parallel={req_mp}"
+        elif req_mp > 1:
+            plan = f"model_parallel={req_mp}"
+        else:
+            plan = f"data_parallel={requested}"
         diags.append(Diagnostic(
             "data-parallel-devices", ERROR,
-            f"data_parallel={requested} needs {requested} local devices, "
+            f"{plan} needs {requested * req_mp} local devices, "
             f"have {n_devices} — start() will fail with PipelineError",
-            path=top.label, pos=top.pos))
+            path=top.label if top else "",
+            pos=top.pos if top else None))
     diags.extend(_budget_diags(report))
     return diags, report
 
@@ -287,7 +317,7 @@ def deep_check(
 _LLM_FRAMEWORKS = ("llm", "llamacpp", "llama.cpp")
 
 
-def _llm_serving_stage(node, diags):
+def _llm_serving_stage(node, diags, model_par: int = 1):
     """Price a ``serve:continuous`` llm filter statically.
 
     Returns ``None`` when the node is not a continuous-serving llm
@@ -351,9 +381,39 @@ def _llm_serving_stage(node, diags):
 
     dtype = str(opts.get("dtype", "bfloat16"))
     plan = serving_plan(cfg, dtype=dtype, **plan_kw)
-    params = llama.param_bytes_estimate(
-        cfg, quant=str(opts.get("quant", "")).lower(),
-        param_dtype=str(opts.get("param_dtype", "float32")))
+    quant = str(opts.get("quant", "")).lower()
+    param_dtype = str(opts.get("param_dtype", "float32"))
+    # Tensor parallelism: the pipeline's resolved model axis, with the
+    # deprecated custom=tp: alias honored when the pipeline knob is off
+    # (Pipeline promotes the alias the same way at construction).
+    ways = model_par
+    if ways <= 1:
+        try:
+            ways = max(1, int(opts.get("tp", 1)))
+        except (TypeError, ValueError):
+            ways = 1
+    params = llama.param_bytes_estimate(cfg, quant=quant,
+                                        param_dtype=param_dtype)
+    pool = plan["pool_bytes"]
+    if ways > 1:
+        problems = llama.tp_divisibility_problems(cfg, ways)
+        if problems:
+            # open() raises the same arithmetic at runtime — surface it
+            # statically with the dims named
+            diags.append(Diagnostic(
+                "model-divisibility", ERROR,
+                f"model geometry does not divide model_parallel={ways}: "
+                + "; ".join(problems)
+                + " — the llm filter's open() will fail",
+                path=label, pos=node.pos))
+        else:
+            # per-chip pricing: sheared leaves (the big mats + lm_head)
+            # divide by M, embed/norms replicate; the paged KV pool
+            # shards its head dim, so pool bytes divide too
+            shard, repl = llama.param_bytes_split(cfg, quant=quant,
+                                                  param_dtype=param_dtype)
+            params = shard // ways + repl
+            pool = pool // ways
     # Per-slot in-flight activations of the decode step: the f32 logits
     # row dominates ([vocab] per slot per scan step), plus the hidden
     # state at a couple of residencies — a deliberate over-estimate that
@@ -362,11 +422,69 @@ def _llm_serving_stage(node, diags):
     return StageResource(
         label=label, param_bytes=params, act_row_bytes=act_row,
         rows_per_device=slots, variants=plan["programs"],
-        batchable=False, shard_eligible=False, sharded=False,
-        pos=node.pos, pool_bytes=plan["pool_bytes"])
+        batchable=False, shard_eligible=False, sharded=ways > 1,
+        pos=node.pos, pool_bytes=pool)
 
 
-def _trace_node(graph, node, out_caps, diags) -> Optional[_NodeTrace]:
+def _pspec_audit(params, pspecs, model_par: int, label, pos,
+                 diags: List[Diagnostic]) -> int:
+    """Statically audit a bundle's ``param_pspecs`` against its param
+    leaves under a ``model_parallel=model_par`` plan: returns the bytes
+    of leaves that shard over ``model`` (for per-chip pricing) and
+    appends
+
+    * ``mesh-axis-missing`` — a pspec names an axis the pipeline's 2-D
+      ``(data x model)`` mesh does not carry (seq/expert/pipe or a typo):
+      placement would fail at the first sharded dispatch;
+    * ``model-divisibility`` — a ``model``-sharded dim does not divide
+      the model axis: ``device_put`` would reject the uneven shard.
+
+    Both only fire when the plan actually places over ``model``
+    (``model_par > 1``); a 1-wide model axis replicates and never reads
+    the pspecs.  Leaf pairing and axis extraction ride the SAME walk
+    the runtime places by (``parallel.sharding.iter_param_specs`` /
+    ``spec_entry_axes``) so the audit can never drift from what
+    ``shard_params`` would actually do."""
+    from ..parallel.sharding import iter_param_specs, spec_entry_axes
+
+    shard_bytes = 0
+    bad_axes: set = set()
+    bad_dims: List[str] = []
+
+    for path, p, s in iter_param_specs(params, pspecs):
+        shape = tuple(getattr(p, "shape", ()) or ())
+        sharded = False
+        for i, entry in enumerate(s or ()):
+            for a in spec_entry_axes(entry):
+                if a == "model":
+                    sharded = True
+                    if i < len(shape) and shape[i] % model_par:
+                        bad_dims.append(f"{path}[{i}]={shape[i]}")
+                elif a != "data":
+                    bad_axes.add(str(a))
+        if sharded:
+            shard_bytes += int(getattr(p, "nbytes", 0) or 0)
+    if model_par > 1 and bad_axes:
+        diags.append(Diagnostic(
+            "mesh-axis-missing", WARNING,
+            f"param_pspecs name mesh axes {sorted(bad_axes)} that the "
+            "pipeline's (data x model) mesh does not carry — those "
+            "leaves cannot place at the first sharded dispatch "
+            "(valid placement axes: 'data', 'model')",
+            path=label, pos=pos))
+    if model_par > 1 and bad_dims:
+        shown = ", ".join(bad_dims[:4]) + (", ..." if len(bad_dims) > 4
+                                           else "")
+        diags.append(Diagnostic(
+            "model-divisibility", ERROR,
+            f"param dims sharded over 'model' do not divide "
+            f"model_parallel={model_par}: {shown} — placement will fail",
+            path=label, pos=pos))
+    return shard_bytes
+
+
+def _trace_node(graph, node, out_caps, diags,
+                model_par: int = 1) -> Optional[_NodeTrace]:
     """Abstractly execute one node's device path; returns its trace record
     (for resource accounting) or None when the node has no device path."""
     if node.kind == "capsfilter":
@@ -442,6 +560,18 @@ def _trace_node(graph, node, out_caps, diags) -> Optional[_NodeTrace]:
         params = int(el.param_bytes())
     except Exception:  # noqa: BLE001 - accounting probe only
         params = 0
+    # 2-D placement audit: what the bundle's pspecs would shard over
+    # `model` (priced per chip), plus the static axis/divisibility
+    # diagnostics — zero device work, the params are already built.
+    shard_bytes = 0
+    try:
+        bundle = getattr(getattr(el, "fw", None), "bundle", None)
+        pspecs = getattr(bundle, "param_pspecs", None)
+        if pspecs is not None and bundle.params is not None:
+            shard_bytes = _pspec_audit(bundle.params, pspecs, model_par,
+                                       node_label(node), node.pos, diags)
+    except Exception:  # noqa: BLE001 - accounting probe only
+        shard_bytes = 0
     try:
         batchable = bool(el.batch_capable())
     except Exception:  # noqa: BLE001 - capability probe only
@@ -451,11 +581,12 @@ def _trace_node(graph, node, out_caps, diags) -> Optional[_NodeTrace]:
     return _NodeTrace(
         node=node, element=el, in_bytes=spec.nbytes, out_bytes=traced.nbytes,
         param_bytes=params, batchable=batchable,
-        host_post=getattr(el, "host_post", None) is not None, linear=linear)
+        host_post=getattr(el, "host_post", None) is not None, linear=linear,
+        param_shard_bytes=min(shard_bytes, params))
 
 
 def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
-               replicas, dispatch_depth, hbm_budget, max_variants
+               replicas, model_par, dispatch_depth, hbm_budget, max_variants
                ) -> ResourceReport:
     """Merge traced nodes into planner-shaped stages (maximal linear chains
     fuse into ONE program, exactly the plan_stages rule) and multiply the
@@ -497,13 +628,16 @@ def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
         batchable = fused or chain[0].batchable
         host_post = chain[-1].host_post
         shard_eligible = batchable and not host_post
-        sharded = shard_eligible and replicas > 1
+        # a >1 model axis only reaches batchable stages when batching is
+        # on (the runtime attaches the mesh to runners with batch_max>1)
+        sharded = shard_eligible and (
+            replicas > 1 or (model_par > 1 and batch_max > 1))
         n_buckets = 1
         rows = 1
         window = 1
         if batchable and batch_max > 1:
             window = dispatch_depth  # in-flight micro-batches per runner
-            if sharded:
+            if sharded and replicas > 1:
                 sb = sorted({shard_bucket_for(b, replicas, buckets)
                              for b in lad})
                 n_buckets = len(sb)
@@ -511,9 +645,17 @@ def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
             else:
                 n_buckets = len(lad)
                 rows = lad[-1]
+        # per-chip params: leaves the pspecs shard over `model` divide by
+        # M when the stage actually places on a >1 model axis; the rest
+        # (and every leaf of an unsharded stage) replicate full-size
+        param_total = sum(t.param_bytes for t in chain)
+        if sharded and model_par > 1:
+            shard_part = sum(t.param_shard_bytes for t in chain)
+            param_total = shard_part // model_par \
+                + (param_total - shard_part)
         stages.append(StageResource(
             label="+".join(t.element.name for t in chain),
-            param_bytes=sum(t.param_bytes for t in chain),
+            param_bytes=param_total,
             act_row_bytes=max(t.in_bytes + t.out_bytes for t in chain),
             rows_per_device=rows * window,
             variants=n_buckets,
@@ -521,7 +663,7 @@ def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
             sharded=sharded, pos=chain[0].node.pos))
     return ResourceReport(
         stages=stages, batch_max=batch_max, data_parallel=replicas,
-        dispatch_depth=dispatch_depth, ladder=lad,
+        model_parallel=model_par, dispatch_depth=dispatch_depth, ladder=lad,
         hbm_budget_bytes=int(hbm_budget or 0),
         max_compiled_variants=int(max_variants or 0))
 

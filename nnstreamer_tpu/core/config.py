@@ -25,6 +25,7 @@ _ENV_FW_PRIORITY = "NNS_TPU_FILTER_PRIORITY"
 _ENV_BUCKETING = "NNS_TPU_SHAPE_BUCKETING"
 _ENV_BATCH_MAX = "NNS_TPU_BATCH_MAX"
 _ENV_DATA_PARALLEL = "NNS_TPU_DATA_PARALLEL"
+_ENV_MODEL_PARALLEL = "NNS_TPU_MODEL_PARALLEL"
 _ENV_DISPATCH_DEPTH = "NNS_TPU_DISPATCH_DEPTH"
 _ENV_HBM_BUDGET = "NNS_TPU_HBM_BUDGET"
 _ENV_MAX_VARIANTS = "NNS_TPU_MAX_COMPILED_VARIANTS"
@@ -62,6 +63,14 @@ class Config:
     #: local devices.  Only shard-eligible stages (see pipeline/plan.py)
     #: ever see the mesh.
     data_parallel: int = 0
+    #: tensor-parallel ways over the pipeline mesh's ``model`` axis
+    #: (pipeline/plan.mesh_plan): 1 = off (the dp-only legacy path,
+    #: bit-identical), N = exactly N ways (shardable stages place params
+    #: per their ``param_pspecs``; the llm filter runs TP on the SAME
+    #: mesh), 0 = auto — absorb every local device the ``data`` axis
+    #: doesn't claim.  Unlike data_parallel this is NOT gated on
+    #: batch_max: TP-only pipelines shard weights without micro-batching.
+    model_parallel: int = 1
     #: in-flight dispatch window for batching device stages: how many
     #: micro-batches a runner may have dispatched-but-not-yet-emitted, so
     #: the next drain overlaps the previous dispatch (1 = the lockstep
@@ -145,6 +154,8 @@ class Config:
                                                    "batch_linger_ms")
             if ini.has_option("common", "data_parallel"):
                 cfg.data_parallel = ini.getint("common", "data_parallel")
+            if ini.has_option("common", "model_parallel"):
+                cfg.model_parallel = ini.getint("common", "model_parallel")
             if ini.has_option("common", "dispatch_depth"):
                 cfg.dispatch_depth = ini.getint("common", "dispatch_depth")
             if ini.has_option("common", "shape_bucketing"):
@@ -186,6 +197,8 @@ class Config:
             cfg.batch_max = int(os.environ[_ENV_BATCH_MAX])
         if os.environ.get(_ENV_DATA_PARALLEL):
             cfg.data_parallel = int(os.environ[_ENV_DATA_PARALLEL])
+        if os.environ.get(_ENV_MODEL_PARALLEL):
+            cfg.model_parallel = int(os.environ[_ENV_MODEL_PARALLEL])
         if os.environ.get(_ENV_DISPATCH_DEPTH):
             cfg.dispatch_depth = int(os.environ[_ENV_DISPATCH_DEPTH])
         if os.environ.get(_ENV_HBM_BUDGET):

@@ -190,15 +190,25 @@ class Element:
         by device stages); the planner only marks such stages batchable."""
         return False
 
-    def replicate_params(self, mesh) -> bool:
+    def place_params(self, mesh) -> bool:
         """Place this element's device-resident parameters onto ``mesh``
-        (replicated — every chip holds a copy) so sharded micro-batch
-        dispatches never re-broadcast weights per call.  Called at most
-        ONCE per stage, from the stage thread, before the first sharded
-        dispatch.  Returns True when anything was moved.  Default: no
-        parameters (closure constants are baked into the compiled program
-        and replicated by XLA at compile time)."""
+        per its model's ``param_pspecs``: leaves whose PartitionSpec names
+        the ``model`` axis SHARD over it (tensor parallelism — per-chip
+        weight HBM drops by the axis size), everything else replicates —
+        so sharded micro-batch dispatches never re-broadcast weights per
+        call.  Called at most ONCE per stage, from the stage thread,
+        before the first sharded dispatch.  Returns True when anything
+        was moved.  Default: no parameters (closure constants are baked
+        into the compiled program and placed by XLA at compile time).
+
+        Overriders implement THIS hook; :meth:`replicate_params` is the
+        pre-2-D name kept as an alias for callers."""
         return False
+
+    def replicate_params(self, mesh) -> bool:
+        """Deprecated alias of :meth:`place_params` (the dp-only era name:
+        with a 1-wide ``model`` axis, placement IS replication)."""
+        return self.place_params(mesh)
 
     def process_group(self, bufs: Dict[str, Buffer]) -> Out:
         """Handle one collated buffer-per-pad group (sync_policy == "all")."""

@@ -59,8 +59,14 @@ def _parse_output_combination(s: str) -> Optional[List[Tuple[str, int]]]:
     return combo
 
 
-def _load_framework(props: Dict[str, object]) -> Framework:
-    """framework= name or 'auto' (priority list from config)."""
+def _load_framework(props: Dict[str, object],
+                    mesh_provider=None) -> Framework:
+    """framework= name or 'auto' (priority list from config).
+
+    ``mesh_provider`` is the owning pipeline's shared-mesh accessor
+    (``Pipeline._model_mesh``), attached BEFORE open() so a framework
+    with a tensor-parallel path (the llm filter) lands on the pipeline's
+    ``(data x model)`` mesh instead of minting a private one."""
     fw_name = str(props.get("framework", "auto")).lower()
     candidates = (
         get_config().filter_priority if fw_name in ("auto", "") else [fw_name]
@@ -72,6 +78,8 @@ def _load_framework(props: Dict[str, object]) -> Framework:
             last_err = KeyError(f"framework {cand!r} not registered")
             continue
         fw: Framework = cls()
+        if mesh_provider is not None:
+            fw._mesh_provider = mesh_provider
         try:
             fw.open(props)
             return fw
@@ -126,7 +134,9 @@ class TensorFilter(Element):
 
     def _ensure_fw(self) -> Framework:
         if self.fw is None:
-            self.fw = _load_framework(self.props)
+            self.fw = _load_framework(
+                self.props,
+                mesh_provider=getattr(self, "_mesh_provider", None))
         return self.fw
 
     def stop(self) -> None:
@@ -325,22 +335,38 @@ class TensorFilter(Element):
         except Exception:  # noqa: BLE001 - capability probe only
             return False
 
-    def replicate_params(self, mesh) -> bool:
-        """Replicate the framework's model params onto ``mesh`` once (the
-        sharded-dispatch prepare contract, elements/base.py).  Deliberately
-        lock-free: callers either run on the stage thread that serializes
-        with process()/process_batch() (the fused-chain path) or already
-        hold ``_fw_lock`` (the prepare hook below)."""
-        return self._replicate_fw_params(self.fw or self._ensure_fw(), mesh)
+    def place_params(self, mesh) -> bool:
+        """Place the framework's model params onto ``mesh`` once (the
+        sharded-dispatch prepare contract, elements/base.py): with a >1
+        ``model`` axis, leaves the bundle's ``param_pspecs`` shard over
+        ``model`` are sharded (per-chip weight HBM drops by the axis
+        size), the rest replicate; a 1-wide model axis is the exact
+        pre-2-D replicate path.  Deliberately lock-free: callers either
+        run on the stage thread that serializes with
+        process()/process_batch() (the fused-chain path) or already hold
+        ``_fw_lock`` (the prepare hook below)."""
+        return self._place_fw_params(self.fw or self._ensure_fw(), mesh)
 
-    def _replicate_fw_params(self, fw, mesh) -> bool:
+    def _place_fw_params(self, fw, mesh) -> bool:
         bundle = getattr(fw, "bundle", None)
         params = getattr(bundle, "params", None)
         if params is None:
             return False
-        from ..parallel.sharding import replicate
+        from ..parallel.mesh import mesh_axis_size
+        from ..parallel.sharding import (placement_split, replicate,
+                                         shard_params)
 
-        bundle.params = replicate(mesh, params)
+        pspecs = getattr(bundle, "param_pspecs", None)
+        if mesh_axis_size(mesh, "model") > 1 and pspecs is not None:
+            bundle.params = shard_params(mesh, params, pspecs)
+            n_shard, n_rep = placement_split(params, pspecs)
+            # shard-vs-replica split: proof of model-axis placement the
+            # 2-D tests/operators read next to .param_replications
+            metrics.count(f"{self.name}.param_shards", n_shard)
+            metrics.count(f"{self.name}.param_replicas", n_rep)
+        else:
+            # dp-only (or no pspecs): the exact legacy replicate path
+            bundle.params = replicate(mesh, params)
         metrics.count(f"{self.name}.param_replications")
         return True
 
@@ -370,14 +396,15 @@ class TensorFilter(Element):
                     mesh = getattr(self, "_shard_mesh", None)
                     prep = None
                     if mesh is not None:
-                        # Replicate THIS framework's params once, then hand
-                        # the runner a fresh closure capturing the
-                        # replicated tree.  fw is bound here: a reload mid-
-                        # stream swaps the instance AND the batcher entry,
-                        # so the new framework replicates again (its params
-                        # are new arrays).
+                        # Place THIS framework's params once (shard over
+                        # the model axis per pspecs, replicate the rest),
+                        # then hand the runner a fresh closure capturing
+                        # the placed tree.  fw is bound here: a reload
+                        # mid-stream swaps the instance AND the batcher
+                        # entry, so the new framework places again (its
+                        # params are new arrays).
                         def prep(m, fw=fw):
-                            self._replicate_fw_params(fw, m)
+                            self._place_fw_params(fw, m)
                             return self._batchable_fn(fw)
                     entry = (fw, BatchRunner(
                         fn, getattr(self, "_batch_buckets", None),
@@ -516,7 +543,8 @@ class TensorFilter(Element):
         props = dict(self.props)
         if model is not None:
             props["model"] = model
-        new_fw = _load_framework(props)
+        new_fw = _load_framework(
+            props, mesh_provider=getattr(self, "_mesh_provider", None))
         new_in, new_out = new_fw.get_model_info()
         for have, new, what in ((self._in_spec, new_in, "input"),
                                 (self._out_spec, new_out, "output")):

@@ -9,9 +9,13 @@ runtime is JAX, not a wrapped C++ library:
 * prefill and per-token decode are TWO jitted XLA programs (same function,
   two sequence lengths — see models/llama.py ``forward_cached``); weights
   and KV cache never leave HBM between tokens;
-* multi-chip: ``custom=tp:N`` builds/uses a ``model``-axis mesh and jits
-  with NamedShardings from the model's ``param_pspecs`` — XLA places the
-  TP all-reduces on ICI (config #5's multi-chip token streaming);
+* multi-chip: ``Pipeline(model_parallel=N)`` hands the filter the
+  pipeline's shared ``(data x model)`` mesh and params/KV shard over the
+  ``model`` axis per the model's ``param_pspecs`` — XLA places the TP
+  all-reduces on ICI (config #5's multi-chip token streaming).
+  ``custom=tp:N`` is the deprecated pre-2-D alias: inside a pipeline it
+  is promoted to ``model_parallel=N`` at construction; a standalone
+  framework still builds a private ``(model=tp, data=1)`` mesh;
 * tokens are pushed downstream from a generator in bursts of
   ``stream_chunk`` (default 8): each burst is ONE jitted lax.scan over the
   device (one host roundtrip per burst — over a remote chip this is the
@@ -137,7 +141,10 @@ class LLMFramework(Framework):
     byte-level otherwise),
     ``stream_chunk:N`` (tokens decoded per device roundtrip, default 8;
     1 = strict per-token streaming),
-    ``tp:N`` (tensor-parallel ways over a ``model`` mesh axis),
+    ``tp:N`` (DEPRECATED alias of ``Pipeline(model_parallel=N)`` —
+    promoted to the pipeline knob at construction so the filter runs on
+    the shared ``(data x model)`` mesh; kept for standalone frameworks,
+    which build a private ``model``-axis mesh),
     ``serve:continuous`` + ``slots:N`` (continuous batching: a standing
     decode loop over a block-paged KV cache that admits queued prompts
     into free slots via chunked prefill — see :class:`_ContinuousLoop`),
@@ -252,32 +259,71 @@ class LLMFramework(Framework):
     def _setup(self, tp: int) -> None:
         import jax
 
-        from ..parallel.mesh import make_mesh
+        from ..parallel.mesh import make_mesh, mesh_axis_size
         from ..parallel.sharding import shard_params
 
         cfg = self.cfg
         params = self.bundle.params
 
-        if tp > 1:
+        mesh = None
+        provider = getattr(self, "_mesh_provider", None)
+        if provider is not None:
+            # Pipeline-owned 2-D mesh (runtime.Pipeline._model_mesh): a
+            # configured model_parallel — or the deprecated custom=tp:
+            # alias, promoted at Pipeline construction — resolves to ONE
+            # shared (data x model) mesh for the whole pipeline; None
+            # when the pipeline runs model_parallel=1.
+            try:
+                mesh = provider()
+            except Exception as e:
+                from ..pipeline.runtime import PipelineError
+
+                if isinstance(e, PipelineError):
+                    # a pipeline-level placement error (over-asked
+                    # dp x mp, non-divisible plan): propagate as-is —
+                    # wrapping it in FrameworkError would make
+                    # _load_framework try other frameworks and report
+                    # "no framework could open", burying the real cause
+                    raise
+                raise FrameworkError(str(e)) from e
+            if mesh is not None and mesh_axis_size(mesh, "model") <= 1:
+                mesh = None
+        if mesh is None and tp > 1:
+            # standalone/legacy path (framework embedded outside a
+            # pipeline): a private (model=tp, data=1) mesh, kept so
+            # direct LLMFramework users keep working
             if len(jax.devices()) < tp:
                 raise FrameworkError(
                     f"tp:{tp} needs {tp} devices, have {len(jax.devices())}")
-            self.mesh = make_mesh(model=tp, data=1,
-                                  devices=jax.devices()[:tp])
+            mesh = make_mesh(model=tp, data=1,
+                             devices=jax.devices()[:tp])
+        if mesh is not None:
+            ways = mesh_axis_size(mesh, "model")
+            problems = llama.tp_divisibility_problems(cfg, ways)
+            if problems:
+                # fail with the dims named instead of a GSPMD/device_put
+                # reshape error mid-shard (the deep lint reports the same
+                # arithmetic statically — model-divisibility)
+                raise FrameworkError(
+                    f"model geometry does not divide model_parallel="
+                    f"{ways}: " + "; ".join(problems))
+            self.mesh = mesh
             # the bundle's pspecs match ITS pytree (quantized trees have
             # different leaves than llama.param_pspecs()'s default)
             pspecs = self.bundle.param_pspecs or llama.param_pspecs()
-            params = shard_params(self.mesh, params, pspecs)
+            params = shard_params(mesh, params, pspecs)
             self.bundle.params = params
-            # pallas_call has no GSPMD partitioning rule: int4 programs
-            # traced for this sharded mesh must take the shardable XLA
-            # reference path.  Refcounted disable, taken LAST in the TP
-            # block (nothing after it throws) and released in close(),
-            # so a failed open can't leak a disabled kernel and two TP
-            # filters don't clobber each other.
+            # pallas_call has no GSPMD partitioning rule: int4 and paged-
+            # attention programs traced for this sharded mesh must take
+            # their shardable XLA reference paths.  Refcounted disables,
+            # taken LAST in the TP block (nothing after them throws) and
+            # released in close(), so a failed open can't leak a disabled
+            # kernel and two TP filters don't clobber each other.
+            from ..ops import attention as _attn
             from ..ops import int4_matmul as _i4
 
             _i4.disable_kernel()
+            _attn.disable_paged_kernel()
             self._int4_disabled = True
 
         def fwd(params, tokens, cache, pos):
@@ -324,9 +370,11 @@ class LLMFramework(Framework):
             self._serve.shutdown()
             self._serve = None
         if getattr(self, "_int4_disabled", False):
+            from ..ops import attention as _attn
             from ..ops import int4_matmul as _i4
 
             _i4.enable_kernel()
+            _attn.enable_paged_kernel()
             self._int4_disabled = False
         self.bundle = None
         self._fwd = None
@@ -683,6 +731,19 @@ class _ContinuousLoop:
         params = fw.bundle.params
         pool = llama.init_paged_cache(cfg, self.n_blocks, bs,
                                       dtype=fw.dtype)
+        if fw.mesh is not None:
+            # Tensor parallelism: the block pool shards over `model` on
+            # the K/V head dim exactly like the dense cache, so a
+            # model_parallel=M loop holds pool_bytes/M per chip and the
+            # pool composes with the same allocator/tables (host-side
+            # ints, replicated).  Geometry was validated at _setup
+            # (n_kv_heads % M == 0, tp_divisibility_problems).
+            from ..parallel.sharding import shard_params as _sp
+
+            pool = _sp(fw.mesh, pool, llama.paged_cache_pspecs())
+        # published like the allocator bookkeeping below: tests and
+        # post-mortems read the pool's actual placement off the loop
+        self._pool_sharding = getattr(pool["k"], "sharding", None)
         # Device carries tok/pool/key between chunks (r4: materializing
         # them per chunk cost tunnel roundtrips).  EVERYTHING ELSE is
         # host bookkeeping: positions advance deterministically (+length
@@ -691,6 +752,16 @@ class _ContinuousLoop:
         # the device as tiny async H2D args — never a fetch.
         tok = jnp.zeros((B,), jnp.int32)
         key = jax.random.PRNGKey(fw.seed)
+        if fw.mesh is not None:
+            # Commit the carried device state to the mesh UP FRONT: the
+            # first decode otherwise traces against single-device inputs
+            # while every later call sees mesh-replicated outputs — one
+            # avoidable extra signature that would break the 3-program
+            # census TP must preserve (the compile-counter pin).
+            from ..parallel.sharding import replicate as _rep
+
+            tok = _rep(fw.mesh, tok)
+            key = _rep(fw.mesh, key)
         pos = np.full((B,), self.park, np.int32)  # parked = idle
         tables = np.full((B, self.max_blocks), self.sentinel, np.int32)
         free = list(range(self.n_blocks))  # host free list (block ids)

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -999,6 +999,52 @@ def init_paged_cache(cfg: LlamaConfig, n_blocks: int, block_size: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def paged_cache_pspecs() -> Dict:
+    """TP sharding of the block pool over the ``model`` mesh axis: the
+    K/V head dim (axis 3 of ``[L, n_blocks, block_size, H_kv, hd]``)
+    splits exactly like the dense cache's (:func:`cache_pspecs`), so a
+    ``model_parallel=M`` serving loop holds ``pool_bytes / M`` per chip
+    and each chip's attention reads only its own heads' blocks.
+    Requires ``n_kv_heads % M == 0``
+    (:func:`tp_divisibility_problems` reports the violation; the deep
+    lint surfaces it statically).
+
+    The spec deliberately omits the trailing ``None``: GSPMD normalizes
+    output specs by trimming trailing unsharded dims, and the serving
+    loop DONATES the pool through its programs — an untrimmed input spec
+    would compare unequal to the donated output's and cost one spurious
+    recompile, breaking the 3-program census the compile-counter pin
+    protects."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"k": P(None, None, None, "model"),
+            "v": P(None, None, None, "model")}
+
+
+def tp_divisibility_problems(cfg: LlamaConfig, tp: int) -> List[str]:
+    """Dims tensor parallelism over ``tp`` ways cannot split evenly —
+    empty when the geometry is TP-clean.  ONE home for the arithmetic
+    the runtime's setup error (filters/llm.py) and the deep lint's
+    static ``model-divisibility`` diagnostic must agree on."""
+    if tp <= 1:
+        return []
+    probs: List[str] = []
+    if (cfg.n_heads * cfg.head_dim) % tp:
+        probs.append(f"attention out dim n_heads*head_dim="
+                     f"{cfg.n_heads * cfg.head_dim}")
+    if (cfg.n_kv_heads * cfg.head_dim) % tp:
+        probs.append(f"kv out dim n_kv_heads*head_dim="
+                     f"{cfg.n_kv_heads * cfg.head_dim}")
+    if cfg.ffn_hidden % tp:
+        probs.append(f"ffn_hidden={cfg.ffn_hidden}")
+    if cfg.vocab % tp:
+        probs.append(f"vocab={cfg.vocab} (lm_head out)")
+    if cfg.n_kv_heads % tp:
+        probs.append(f"n_kv_heads={cfg.n_kv_heads} "
+                     "(the KV cache/pool shards the head axis)")
+    return probs
+
+
 def paged_cache_bytes(cfg: LlamaConfig, n_blocks: int, block_size: int,
                       dtype="bfloat16") -> int:
     """Static HBM footprint of :func:`init_paged_cache` (k + v), without
@@ -1053,6 +1099,22 @@ def param_bytes_estimate(cfg: LlamaConfig, quant: str = "",
     embed = cfg.vocab * D * itemsize
     norms = 4 * (2 * L * D + D)
     return mats + scales + embed + norms
+
+
+def param_bytes_split(cfg: LlamaConfig, quant: str = "",
+                      param_dtype: str = "float32") -> Tuple[int, int]:
+    """Static ``(sharded, replicated)`` byte split of
+    :func:`param_bytes_estimate` under the :func:`param_pspecs` TP
+    layout: the big layer mats + lm_head (and their scales) carry a
+    ``model`` axis and divide by the mesh's model size per chip; embed
+    and the norms replicate.  The deep lint prices a
+    ``model_parallel=M`` pipeline's per-chip params as
+    ``sharded / M + replicated``."""
+    total = param_bytes_estimate(cfg, quant=quant, param_dtype=param_dtype)
+    itemsize = 2 if str(param_dtype) in ("bfloat16", "float16") else 4
+    replicated = cfg.vocab * cfg.dim * itemsize \
+        + 4 * (2 * cfg.n_layers * cfg.dim + cfg.dim)
+    return total - replicated, replicated
 
 
 def forward_paged(params, tokens, pool, block_tables, pos,

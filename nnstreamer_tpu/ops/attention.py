@@ -39,6 +39,36 @@ except ImportError:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
+# pallas_call has no GSPMD partitioning rule, so a paged-attention
+# program traced for a sharded (tensor-parallel) mesh must take the
+# shardable XLA reference path instead — sharding is invisible at trace
+# time, so the caller that builds TP programs (filters/llm.py) disables
+# the kernel for the lifetime of its filter.  Same REFCOUNTED contract
+# as ops/int4_matmul.py: concurrent TP filters must not clobber each
+# other's save/restore, and a filter that dies mid-open must not leak a
+# disabled kernel process-wide.
+import threading as _threading
+
+_disable_lock = _threading.Lock()
+_disable_count = 0
+
+
+def disable_paged_kernel() -> None:
+    global _disable_count
+    with _disable_lock:
+        _disable_count += 1
+
+
+def enable_paged_kernel() -> None:
+    global _disable_count
+    with _disable_lock:
+        _disable_count = max(0, _disable_count - 1)
+
+
+def paged_kernel_enabled() -> bool:
+    return _disable_count == 0
+
+
 def attention_reference(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
     """Plain-XLA attention (the flash kernel's semantics, materialized)."""
     d = q.shape[-1]
@@ -370,6 +400,7 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
                 q, k_pool, v_pool, block_tables, context_lens, scale=scale_v)
     if (
         not _HAVE_PALLAS
+        or not paged_kernel_enabled()  # TP traces need the shardable path
         or T != 1
         or H % hkv
         or k_pool.shape != v_pool.shape

@@ -51,21 +51,53 @@ def make_mesh(
             raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXES}")
         sizes.update(axis_sizes)
 
+    # Validate the plan BEFORE touching numpy: a zero/negative axis used
+    # to surface as an opaque numpy reshape error ("cannot reshape array
+    # of size 8 into shape (8,0,...)").  Only ``data`` may be 0 (= auto:
+    # absorb every device the fixed axes don't claim).
+    for name in AXES:
+        v = sizes[name]
+        if name == "data" and (v is None or v == 0):
+            continue  # auto-absorb; resolved below
+        if not isinstance(v, int) or isinstance(v, bool):
+            raise ValueError(
+                f"mesh axis {name!r} size must be an int, got {v!r}")
+        if v < 1:
+            raise ValueError(
+                f"mesh axis {name!r} must be >= 1, got {v} "
+                "(only 'data' supports 0/None = auto-absorb)")
+
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
     fixed = 1
     for name in AXES:
         if name != "data" and sizes[name] > 1:
             fixed *= sizes[name]
+    requested = {a: sizes[a] for a in AXES if sizes[a] not in (0, 1, None)}
     if sizes["data"] in (0, None):
         if n % fixed:
-            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+            # name the axis whose size breaks divisibility, not just the
+            # product — the caller needs to know WHICH knob to fix
+            bad = next((a for a in AXES
+                        if a != "data" and sizes[a] > 1 and n % sizes[a]),
+                       None)
+            detail = (f"axis {bad!r} = {sizes[bad]} does not divide the "
+                      f"device count" if bad else
+                      f"the fixed axes {requested} multiply to {fixed}, "
+                      "which does not divide the device count")
+            raise ValueError(
+                f"cannot auto-size the 'data' axis over {n} device(s): "
+                f"{detail} (requested {requested or '{}'}, "
+                f"{n} device(s) available)")
         sizes["data"] = n // fixed
     total = sizes["data"] * fixed
     if total != n:
+        bad = next((a for a in AXES if sizes[a] > 1 and n % sizes[a]), None)
+        hint = (f"; axis {bad!r} = {sizes[bad]} does not divide "
+                f"{n}" if bad else "")
         raise ValueError(
-            f"mesh {sizes} needs {total} devices, have {n}"
-        )
+            f"mesh plan {requested or dict(sizes)} needs {total} device(s), "
+            f"have {n}{hint}: axis sizes must multiply to the device count")
 
     shape = tuple(sizes[a] for a in AXES)
     arr = np.asarray(devs).reshape(shape)
@@ -82,6 +114,21 @@ def single_device_mesh(device=None):
 
 def mesh_axis_size(mesh, name: str) -> int:
     return int(mesh.shape.get(name, 1))
+
+
+def device_coords(mesh) -> Dict[int, Tuple[int, int]]:
+    """Map ``device.id`` -> its ``(data, model)`` coordinate in the mesh —
+    how per-replica counters and trace spans name a chip's position in a
+    2-D placement (docs/BATCHING.md "2-D sharded dispatch")."""
+    import numpy as np
+
+    coords: Dict[int, Tuple[int, int]] = {}
+    arr = np.asarray(mesh.devices)
+    di_axis = AXES.index("data")
+    mi_axis = AXES.index("model")
+    for idx in np.ndindex(arr.shape):
+        coords[arr[idx].id] = (int(idx[di_axis]), int(idx[mi_axis]))
+    return coords
 
 
 def local_batch(mesh, global_batch: int) -> int:

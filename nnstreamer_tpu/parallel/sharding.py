@@ -83,3 +83,56 @@ def out_shardings_like(mesh, tree_pspecs):
     import jax
 
     return jax.tree_util.tree_map(lambda s: _ns(mesh, s), tree_pspecs)
+
+
+def iter_param_specs(params, pspecs):
+    """Yield ``(path, leaf, spec)`` for every param leaf, pairing a
+    (possibly partial) pspec tree with the SAME walk
+    :func:`shard_params` places by — the one traversal the placement
+    metrics (:func:`placement_split`) and the deep lint's static pspec
+    audit (analysis/tracecheck.py) both ride, so the pairing rules can
+    never diverge between runtime placement and static pricing."""
+    def walk(p, s, path):
+        if isinstance(p, dict):
+            for k, v in p.items():
+                yield from walk(
+                    v, (s or {}).get(k) if isinstance(s, dict) else None,
+                    f"{path}.{k}" if path else str(k))
+        else:
+            yield path, p, s
+
+    yield from walk(params, pspecs, "")
+
+
+def spec_entry_axes(entry) -> tuple:
+    """Mesh-axis names one PartitionSpec entry maps a dim over (an entry
+    is None, an axis name, or a tuple of axis names)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def spec_axes(spec) -> set:
+    """Every mesh-axis name a leaf's PartitionSpec mentions."""
+    out = set()
+    for entry in (spec or ()):
+        out.update(spec_entry_axes(entry))
+    return out
+
+
+def placement_split(params, pspecs, axis: str = "model"):
+    """Count how :func:`shard_params` would place a pytree: returns
+    ``(n_sharded, n_replicated)`` leaves, where "sharded" means the
+    leaf's PartitionSpec names ``axis``.  The shard-vs-replica split the
+    2-D placement metrics report (``<stage>.param_shards`` /
+    ``.param_replicas``) and tests assert against — rides
+    :func:`iter_param_specs`, zero device work."""
+    sharded = replicated = 0
+    for _, _, spec in iter_param_specs(params, pspecs):
+        if axis in spec_axes(spec):
+            sharded += 1
+        else:
+            replicated += 1
+    return sharded, replicated

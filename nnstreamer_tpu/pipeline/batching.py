@@ -84,10 +84,13 @@ class BatchRunner:
     handles input shape/dtype changes; this cache keys only the bucket
     size (which is baked into the program's split).
 
-    ``mesh`` (with a ``data`` axis > 1) switches on sharded dispatch;
-    ``prepare(mesh) -> Optional[new_fn]`` runs exactly once before the
-    first sharded dispatch so the stage can replicate its parameters onto
-    the mesh and hand back a fresh closure capturing the replicated tree.
+    ``mesh`` (with a ``data`` OR ``model`` axis > 1) switches on sharded
+    dispatch: the batch dim shards over ``data`` while stage parameters
+    are PLACED per their ``param_pspecs`` — sharded over ``model``,
+    replicated otherwise.  ``prepare(mesh) -> Optional[new_fn]`` runs
+    exactly once before the first sharded dispatch so the stage can place
+    its parameters onto the mesh and hand back a fresh closure capturing
+    the placed tree.
     """
 
     def __init__(self, fn: Callable, buckets: Optional[Sequence[int]] = None,
@@ -105,19 +108,31 @@ class BatchRunner:
         self._dispatch_metric = f"{name}.shard_dispatch" if name else None
         self.mesh = None
         self.replicas = 1
+        self.model_axis = 1
         self._sharding = None
+        self._dev_coords = None
         if mesh is not None:
-            from ..parallel.mesh import mesh_axis_size
+            from ..parallel.mesh import device_coords, mesh_axis_size
 
             d = mesh_axis_size(mesh, "data")
-            if d > 1:  # a 1-wide data axis is exactly the unsharded path
+            m = mesh_axis_size(mesh, "model")
+            # a (1, 1) mesh is exactly the unsharded path; a >1 model
+            # axis engages the sharded path even at data=1 so the
+            # prepare hook can SHARD stage params over `model` (2-D
+            # placement, docs/BATCHING.md "2-D sharded dispatch")
+            if d > 1 or m > 1:
                 from ..parallel.sharding import data_sharding
 
                 self.mesh = mesh
                 self.replicas = d
+                self.model_axis = m
                 # invariant per runner: built once, reused by every
                 # dispatch's device_put AND the program's in/out_shardings
                 self._sharding = data_sharding(mesh)
+                if m > 1:
+                    # device id -> (data, model) coordinate: 2-D runs name
+                    # per-replica counters by mesh position, not raw id
+                    self._dev_coords = device_coords(mesh)
         self._prepare = prepare
         self._prepared = False
 
@@ -191,10 +206,17 @@ class BatchRunner:
             metrics.count(self._dispatch_metric)
             # Per-replica placement counters: read the real shard layout
             # off the first output (proof of N-way placement, not an
-            # assumption about what XLA did).
+            # assumption about what XLA did).  dp-only keeps the legacy
+            # `.d<device-id>` names; a 2-D mesh names each chip by its
+            # (data, model) coordinate — `.d<di>m<mi>` — so the counters
+            # stay truthful when the output is replicated over `model`.
             for s in outs[0].addressable_shards:
-                metrics.count(f"{self._shard_metric}.d{s.device.id}",
-                              s.data.shape[0])
+                if self._dev_coords is None:
+                    key = f"{self._shard_metric}.d{s.device.id}"
+                else:
+                    di, mi = self._dev_coords[s.device.id]
+                    key = f"{self._shard_metric}.d{di}m{mi}"
+                metrics.count(key, s.data.shape[0])
         # Reassemble each output with ONE host fetch per tensor, then
         # split into numpy views (free).  Per-row slicing of a
         # data-sharded array is catastrophic — every row becomes a
@@ -213,11 +235,15 @@ class BatchRunner:
         if t_trace0:
             # the sharded-dispatch window: stack+device_put+program+fetch
             # as one span (per-row trace ids live one layer up, in the
-            # runner's batch span — this is the device-side cost bucket)
+            # runner's batch span — this is the device-side cost bucket).
+            # 2-D runs additionally carry the model-axis width so the
+            # span names its full (data, model) placement.
+            extra = ({"model": self.model_axis}
+                     if self.model_axis > 1 else {})
             self._tracer.record("shard", self._name, None, t_trace0,
                                 _time.monotonic_ns() - t_trace0,
                                 rows=n, bucket=bucket,
-                                replicas=self.replicas)
+                                replicas=self.replicas, **extra)
         return [tuple(h[i] for h in host) for i in range(n)]
 
     @staticmethod

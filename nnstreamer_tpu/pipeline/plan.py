@@ -202,23 +202,25 @@ class FusedElement(Element):
     def batch_capable(self) -> bool:
         return True
 
-    def replicate_params(self, mesh) -> bool:
-        """Replicate every chain element's params onto ``mesh``, then
-        rebuild the composed function so its device_fn closures capture
-        the replicated trees (a stale closure would keep dragging the
-        original single-device arrays into every sharded dispatch)."""
+    def place_params(self, mesh) -> bool:
+        """Place every chain element's params onto ``mesh`` (shard over
+        the ``model`` axis per each element's pspecs, replicate the
+        rest), then rebuild the composed function so its device_fn
+        closures capture the placed trees (a stale closure would keep
+        dragging the original single-device arrays into every sharded
+        dispatch)."""
         moved = False
         for el in self.chain:
-            moved = el.replicate_params(mesh) or moved
+            moved = el.place_params(mesh) or moved
         if moved:
             self._fn = None  # re-jit from the recaptured closures
             self._build(self._specs[0], self._donate)
         return moved
 
     def _shard_prepare(self, mesh):
-        """BatchRunner prepare hook: replicate once, hand back the
-        rebuilt composed fn."""
-        self.replicate_params(mesh)
+        """BatchRunner prepare hook: place once, hand back the rebuilt
+        composed fn."""
+        self.place_params(mesh)
         return self._composed
 
     def process_batch(self, pad: str, bufs):
@@ -306,11 +308,48 @@ def replication_plan(data_parallel: int, batch_max: int,
     ``n_devices`` is the local device count (the caller queries it so this
     stays importable without initializing a backend).  Returns 1 whenever
     sharding would be skipped (batch_max=1, dp=1, or a 1-wide mesh); the
-    dp > n_devices startup error is the caller's to raise/report."""
+    dp > n_devices startup error is the caller's to raise/report.
+
+    2-D placements resolve through :func:`mesh_plan`, which calls this
+    for the ``data`` axis after carving out the ``model`` axis."""
     if batch_max <= 1 or data_parallel == 1:
         return 1
     dp = data_parallel or n_devices
     return max(1, dp)
+
+
+def mesh_plan(data_parallel: int, model_parallel: int, batch_max: int,
+              n_devices: int) -> Tuple[int, int]:
+    """Resolve the 2-D placement knobs to the ``(data, model)`` axis sizes
+    ONE pipeline mesh would be built with — the single home for the
+    0=auto / 1=off / N=exact semantics of BOTH axes, shared by the
+    runtime's mesh builder (``Pipeline._shared_mesh``) and the deep
+    analyzer's static HBM/recompile budgeting.
+
+    * ``model_parallel`` — 1 = off (dp-only, the bit-identical legacy
+      path), N = exactly N ways tensor-parallel, 0 = auto: absorb every
+      local device the ``data`` axis doesn't claim.  Unlike ``data``,
+      the model axis is NOT gated on ``batch_max``: a TP-only pipeline
+      (the llm filter) shards weights with no micro-batching at all.
+    * ``data_parallel`` — exactly :func:`replication_plan`, sized
+      against the devices LEFT after the model axis took its share.
+    * both auto (``data=0, model=0`` with batching on) — data wins: the
+      historical ``data_parallel=0`` auto-absorb stays what it was.
+
+    Over-asks (dp * mp > n_devices) are returned as requested; raising
+    the startup error (or the static diagnostic) is the caller's job."""
+    mp_knob = int(model_parallel)
+    dp_knob = int(data_parallel)
+    if mp_knob == 0:
+        dp_res = replication_plan(dp_knob, batch_max, n_devices)
+        if dp_knob == 0 and dp_res > 1:
+            mp = 1  # both axes auto: data absorbs, dp-only semantics hold
+        else:
+            mp = max(1, n_devices // max(1, dp_res))
+    else:
+        mp = max(1, mp_knob)
+    dp = replication_plan(dp_knob, batch_max, max(1, n_devices // mp))
+    return dp, mp
 
 
 def _element_batchable(el: Element) -> bool:

@@ -646,6 +646,34 @@ class _Runner:
             metrics.count(self._m_out)
 
 
+#: tensor_filter ``framework=`` names that resolve to the llm framework
+#: (mirrors analysis/tracecheck.py; kept literal so the hot import path
+#: stays free of filters/llm.py)
+_LLM_FRAMEWORKS = ("llm", "llamacpp", "llama.cpp")
+
+
+def _llm_tp_alias(graph: PipelineGraph) -> int:
+    """Largest deprecated ``custom=tp:N`` option on any llm tensor_filter
+    in the graph (1 = none).  The alias is promoted to
+    ``Pipeline(model_parallel=N)`` at construction so the filter runs on
+    the pipeline's shared mesh instead of minting a private one."""
+    tp = 1
+    for node in graph.nodes.values():
+        if node.kind != "tensor_filter":
+            continue
+        if str(node.props.get("framework", "")).lower() \
+                not in _LLM_FRAMEWORKS:
+            continue
+        from ..filters.base import parse_custom_options
+
+        opts = parse_custom_options(str(node.props.get("custom", "")))
+        try:
+            tp = max(tp, int(opts.get("tp", 1)))
+        except (TypeError, ValueError):
+            pass  # non-literal tp: the filter's own open() will reject it
+    return tp
+
+
 class Pipeline:
     """Build + run a pipeline graph.
 
@@ -663,6 +691,16 @@ class Pipeline:
     shard-eligible stages see it), and ``dispatch_depth`` opens an
     in-flight window so a runner drains the next micro-batch while the
     previous one is still executing — see BATCHING.md "Sharded dispatch".
+    ``model_parallel`` adds the second mesh axis: the SAME pipeline mesh
+    grows a ``model`` dimension (1 = off, N = exactly N ways, 0 = absorb
+    every local device ``data`` doesn't claim — see
+    ``pipeline/plan.mesh_plan``), shardable stages place their parameters
+    per their models' ``param_pspecs`` (sharded over ``model``, replicated
+    otherwise), and the llm filter runs tensor-parallel on the shared mesh
+    — including its paged KV block pool, sharded over ``model`` on the
+    head dim (``custom=tp:N`` is a deprecated alias promoted to this
+    knob).  ``NNS_TPU_MODEL_PARALLEL`` / ini ``model_parallel`` configure
+    it globally; see docs/BATCHING.md "2-D sharded dispatch".
     ``fetch_depth`` is the OUTPUT-side twin: up to that many sink buffers
     resolve D2H / deferred host_post concurrently on a background pool, so
     fetches overlap the next dispatch instead of serializing in ``pop()``;
@@ -707,6 +745,7 @@ class Pipeline:
         batch_buckets: Optional[List[int]] = None,
         batch_linger_ms: Optional[float] = None,
         data_parallel: Optional[int] = None,
+        model_parallel: Optional[int] = None,
         dispatch_depth: Optional[int] = None,
         fetch_depth: Optional[int] = None,
         donate_ingress: Optional[bool] = None,
@@ -730,6 +769,7 @@ class Pipeline:
                 # just the global config defaults
                 kw.update(batch_max=batch_max, batch_buckets=batch_buckets,
                           data_parallel=data_parallel,
+                          model_parallel=model_parallel,
                           dispatch_depth=dispatch_depth)
             if isinstance(graph, str):
                 source = graph
@@ -761,6 +801,9 @@ class Pipeline:
         self.data_parallel = max(0, int(
             data_parallel if data_parallel is not None
             else cfg.data_parallel))
+        self.model_parallel = max(0, int(
+            model_parallel if model_parallel is not None
+            else cfg.model_parallel))
         self.dispatch_depth = max(1, int(
             dispatch_depth if dispatch_depth is not None
             else cfg.dispatch_depth))
@@ -803,6 +846,35 @@ class Pipeline:
         self._err_lock = threading.Lock()
         self._started = False
 
+        # Deprecated ``custom=tp:N`` alias (the llm filter's pre-2-D
+        # private-mesh knob): promote it to the pipeline-owned
+        # model_parallel BEFORE any element opens, so the filter lands on
+        # the shared mesh instead of minting its own.  An explicit
+        # pipeline model_parallel (0 or >1) wins over the alias.
+        tp_alias = _llm_tp_alias(graph)
+        if tp_alias > 1:
+            if self.model_parallel == 1:
+                log.warning(
+                    "tensor_filter llm custom=tp:%d is deprecated — "
+                    "promoted to Pipeline(model_parallel=%d); the filter "
+                    "now runs tensor-parallel on the pipeline's shared "
+                    "(data x model) mesh", tp_alias, tp_alias)
+                self.model_parallel = tp_alias
+            else:
+                log.warning(
+                    "custom=tp:%d ignored: the pipeline's explicit "
+                    "model_parallel=%d wins (tp: is a deprecated alias)",
+                    tp_alias, self.model_parallel)
+
+        # THE pipeline mesh (2-D placement): built lazily, at most once,
+        # by _shared_mesh() — from start() for sharded micro-batching, or
+        # earlier from a TP consumer's _mesh_provider call during open().
+        self._mesh_obj = None
+        self._mesh_built = False
+        self._mesh_lock = threading.Lock()
+        #: resolved (data, model) axis sizes once the mesh is built
+        self.mesh_shape: Tuple[int, int] = (1, 1)
+
         # 1. instantiate elements
         self.elements: Dict[int, Element] = {}
         for node in graph.nodes.values():
@@ -812,6 +884,12 @@ class Pipeline:
                 cls = registry_get(KIND_ELEMENT, node.kind)
                 el = cls(dict(node.props), name=node.name or f"{node.kind}{node.id}")
             self.elements[node.id] = el
+            # 2-D placement: every element gets a lazy accessor to the
+            # shared mesh BEFORE negotiation opens any framework — the
+            # llm filter's TP path reads it at open() (None unless
+            # model_parallel is configured, so dp-only/single-device
+            # pipelines stay backend-free here)
+            el._mesh_provider = self._model_mesh
 
         # 2. HBM-residency pre-pass: mark filters whose downstream
         # consumers ALL admit reduced output geometry, so negotiation
@@ -928,7 +1006,7 @@ class Pipeline:
             raise PipelineError(
                 f"unknown element properties (typo?): {unknown}")
         try:
-            mesh = self._build_data_mesh()
+            mesh = self._build_mesh()
         except Exception:
             # Same contract as the unknown-props failure above: elements
             # already started, so a half-started pipeline must be torn
@@ -960,31 +1038,72 @@ class Pipeline:
             self._slo_loop().start()
         return self
 
-    def _build_data_mesh(self):
-        """Resolve ``data_parallel`` to a ``data``-axis mesh, or None for
+    @property
+    def mesh(self):
+        """THE pipeline mesh (None before start()/first TP open, or when
+        the plan resolves to a single device)."""
+        return self._mesh_obj
+
+    def _model_mesh(self):
+        """Mesh provider handed to elements (the llm filter's TP path):
+        the shared pipeline mesh when a >1 ``model`` axis is configured,
+        else None — dp-only and single-device pipelines never touch the
+        device backend through this accessor."""
+        if self.model_parallel == 1:
+            return None
+        return self._shared_mesh()
+
+    def _shared_mesh(self):
+        """Build (at most once) THE pipeline mesh from the resolved
+        ``(data, model)`` plan (``pipeline/plan.mesh_plan`` — the same
+        arithmetic the deep lint budgets with).  Returns None when the
+        plan degenerates to a single device; raises
+        :class:`PipelineError` on an over-ask the host cannot supply."""
+        with self._mesh_lock:
+            if self._mesh_built:
+                return self._mesh_obj
+            import jax
+
+            from ..parallel.mesh import make_mesh
+            from .plan import mesh_plan
+
+            devs = jax.devices()
+            dp, mp = mesh_plan(self.data_parallel, self.model_parallel,
+                               self.batch_max, len(devs))
+            if dp * mp > len(devs):
+                if mp == 1:
+                    raise PipelineError(
+                        f"data_parallel={dp} needs {dp} local devices, "
+                        f"have {len(devs)}")
+                raise PipelineError(
+                    f"data_parallel={dp} x model_parallel={mp} needs "
+                    f"{dp * mp} local devices, have {len(devs)}")
+            self.mesh_shape = (dp, mp)
+            if dp == 1 and mp == 1:
+                self._mesh_obj = None
+            else:
+                try:
+                    self._mesh_obj = make_mesh(
+                        data=dp, model=mp, devices=devs[:dp * mp])
+                except ValueError as e:
+                    raise PipelineError(str(e)) from e
+            self._mesh_built = True
+            return self._mesh_obj
+
+    def _build_mesh(self):
+        """Resolve the 2-D placement to the pipeline mesh, or None for
         single-device dispatch.  Built HERE — on the app thread driving
         start(), never a streaming thread — and lazily: a pipeline with
-        no shard-eligible stage (or batch_max=1, or data_parallel=1)
-        never touches the device backend for this feature."""
-        if self.batch_max <= 1 or self.data_parallel == 1:
+        no shard-eligible stage (or batch_max=1, or data_parallel=1) and
+        no model_parallel config never touches the device backend for
+        this feature.  (A TP llm filter may have forced the build
+        earlier, at open() — the memoized mesh is reused.)"""
+        dp_wanted = (self.batch_max > 1 and self.data_parallel != 1
+                     and any(s.shardable for s in self.stages))
+        mp_wanted = self.model_parallel != 1
+        if not (dp_wanted or mp_wanted or self._mesh_built):
             return None
-        if not any(s.shardable for s in self.stages):
-            return None
-        import jax
-
-        from .plan import replication_plan
-
-        devs = jax.devices()
-        dp = replication_plan(self.data_parallel, self.batch_max, len(devs))
-        if dp > len(devs):
-            raise PipelineError(
-                f"data_parallel={dp} needs {dp} local devices, "
-                f"have {len(devs)}")
-        if dp <= 1:
-            return None
-        from ..parallel.mesh import make_mesh
-
-        return make_mesh(data=dp, devices=devs[:dp])
+        return self._shared_mesh()
 
     def stop(self) -> None:
         self._stopping.set()

@@ -71,8 +71,13 @@ _SERIES_META = {
     "shard_rows": ("rows placed on each mesh device by sharded dispatches",
                    "counter"),
     "shard_dispatch": ("sharded micro-batch dispatches", "counter"),
-    "param_replications": ("one-time stage parameter replications onto "
+    "param_replications": ("one-time stage parameter placements onto "
                            "the mesh", "counter"),
+    "param_shards": ("param leaves SHARDED over the mesh's `model` axis "
+                     "at placement (2-D placement, docs/BATCHING.md)",
+                     "counter"),
+    "param_replicas": ("param leaves replicated (no `model`-axis pspec) "
+                       "at placement", "counter"),
     "queue_depth": ("stage input queue depth (sampler gauge)", "gauge"),
     "inflight_window": ("dispatched-but-unemitted micro-batches held in "
                         "the dispatch window (sampler gauge)", "gauge"),

@@ -47,7 +47,10 @@ SPAN_KINDS: Dict[str, str] = {
     "stage": "element process()/process_batch()/process_group() execution"
              " (batch spans LINK member trace ids; per_row_ns amortizes)",
     "inflight": "dispatched-but-unemitted window (dispatch_depth > 1)",
-    "shard": "sharded bucketed dispatch incl. the assembled host fetch",
+    "shard": "sharded bucketed dispatch incl. the assembled host fetch "
+             "(args: rows, bucket, replicas = data-axis width; 2-D runs "
+             "add model = model-axis width, and per-replica counters "
+             "carry (data, model) coordinates as .d<di>m<mi>)",
     "fetch": "sink host materialization (D2H / deferred host_post)",
     "fetch.window": "buffer submitted into a sink's async fetch window "
                     "(instant; args: depth = submitted-but-unmaterialized "

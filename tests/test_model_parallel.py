@@ -1,0 +1,616 @@
+"""2-D (data x model) placement semantics (ISSUE 9 tentpole).
+
+The contract: ``Pipeline(model_parallel=M, data_parallel=N)`` builds ONE
+``(data=N, model=M)`` mesh at start(); the sharded BatchRunner shards the
+batch dim over ``data`` while placing each shardable stage's params per
+its ``param_pspecs`` over ``model``; the llm filter's TP path (and its
+paged KV block pool, sharded on the head dim) rides the SAME mesh — and
+dp-only behavior (``model_parallel=1``) stays bit-identical to the
+pre-2-D path, programs and metric names included.
+
+Runs on the suite's virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``, set by conftest.py before
+jax initializes).  ``tools/check_tier1.py`` additionally runs this file
+as its own pytest process (the mesh gate) so the flag can never arrive
+too late.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.models import llama
+from nnstreamer_tpu.models.zoo import ModelBundle, register_model
+from nnstreamer_tpu.pipeline.batching import BatchRunner
+from nnstreamer_tpu.pipeline.plan import mesh_plan, replication_plan
+from nnstreamer_tpu.parallel.mesh import (device_coords, make_mesh,
+                                          mesh_axis_size)
+
+
+def _mesh(data=1, model=1):
+    import jax
+
+    need = data * model
+    if len(jax.devices()) < need:
+        pytest.skip(f"needs {need} local devices")
+    return make_mesh(data=data, model=model, devices=jax.devices()[:need])
+
+
+# -- a tiny zoo model with REAL model-axis pspecs ---------------------------
+
+_D, _H = 16, 8
+_rng = np.random.default_rng(11)
+_W1 = (_rng.standard_normal((_D, _H)).astype(np.float32)
+       * (1.0 / np.sqrt(_D)))
+_W2 = (_rng.standard_normal((_H, _D)).astype(np.float32)
+       * (1.0 / np.sqrt(_H)))
+
+
+@register_model("tp-test-mlp")
+def _build_tp_mlp(opts):
+    """Megatron-style 2-mat MLP: w1 splits its OUT dim over `model`, w2
+    its IN dim — XLA all-reduces the block output once."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    params = {"w1": jnp.asarray(_W1), "w2": jnp.asarray(_W2)}
+
+    def apply_fn(p, x):
+        return jnp.tanh(x @ p["w1"]) @ p["w2"]
+
+    spec = TensorsSpec.from_string(str(_D), "float32")
+    return ModelBundle(apply_fn, params, spec, spec,
+                       param_pspecs={"w1": P(None, "model"),
+                                     "w2": P("model", None)})
+
+
+DESC = (
+    f"appsrc name=src caps=other/tensors,dimensions={_D},types=float32 ! "
+    "tensor_filter framework=jax model=tp-test-mlp name=f ! "
+    "tensor_sink name=out"
+)
+
+
+def _frames(n, dims=(_D,)):
+    return [np.full(dims, float(i % 9) * 0.25, np.float32)
+            for i in range(n)]
+
+
+def _run(desc, frames, timeout=60, **kw):
+    p = nt.Pipeline(desc, **kw)
+    outs = []
+    with p:
+        for i, x in enumerate(frames):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in frames:
+            outs.append(p.pull("out", timeout=timeout))
+        p.eos()
+        p.wait(timeout=timeout)
+    return outs
+
+
+def _assert_rows_bitwise(got, want):
+    assert len(got) == len(want)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert a.pts == b.pts
+        for x, y in zip(a.tensors, b.tensors):
+            assert bytes(np.asarray(x)) == bytes(np.asarray(y)), f"row {i}"
+
+
+# -- make_mesh validation (satellite: clear divisibility errors) -----------
+
+def test_make_mesh_names_non_divisible_axis():
+    import jax
+
+    n = len(jax.devices())
+    with pytest.raises(ValueError) as e:
+        make_mesh(model=3)  # 3 does not divide 8
+    msg = str(e.value)
+    assert "'model'" in msg and "3" in msg and str(n) in msg
+
+
+def test_make_mesh_rejects_zero_and_negative_axes():
+    with pytest.raises(ValueError, match="'model' must be >= 1"):
+        make_mesh(model=0)
+    with pytest.raises(ValueError, match="'seq' must be >= 1"):
+        make_mesh(seq=-2)
+
+
+def test_make_mesh_explicit_plan_mismatch_names_axis():
+    with pytest.raises(ValueError) as e:
+        make_mesh(data=2, model=3)
+    msg = str(e.value)
+    assert "'model'" in msg and "needs 6" in msg
+
+
+def test_make_mesh_data_none_still_auto_absorbs():
+    import jax
+
+    n = len(jax.devices())
+    m = make_mesh(data=None, model=2)
+    assert mesh_axis_size(m, "data") == n // 2
+
+
+def test_make_mesh_degenerate_1x1():
+    import jax
+
+    m = make_mesh(data=1, model=1, devices=[jax.devices()[0]])
+    assert mesh_axis_size(m, "data") == 1
+    assert mesh_axis_size(m, "model") == 1
+
+
+def test_make_mesh_model_only_and_auto_absorb():
+    import jax
+
+    n = len(jax.devices())
+    m = make_mesh(model=n)  # model-only: data auto-absorbs to 1
+    assert mesh_axis_size(m, "model") == n
+    assert mesh_axis_size(m, "data") == 1
+    m = make_mesh(model=2)  # auto-absorb: data takes the rest
+    assert mesh_axis_size(m, "data") == n // 2
+    assert mesh_axis_size(m, "model") == 2
+
+
+def test_device_coords_covers_the_grid():
+    m = _mesh(data=2, model=2)
+    coords = device_coords(m)
+    assert sorted(coords.values()) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+# -- mesh_plan resolution ---------------------------------------------------
+
+def test_mesh_plan_semantics():
+    # dp-only stays replication_plan exactly
+    assert mesh_plan(0, 1, 8, 8) == (replication_plan(0, 8, 8), 1)
+    assert mesh_plan(0, 1, 1, 8) == (1, 1)      # batching off, mp off
+    assert mesh_plan(0, 1, 8, 8) == (8, 1)      # dp auto absorbs all
+    assert mesh_plan(4, 1, 8, 8) == (4, 1)      # dp exact
+    # model exact, data auto absorbs the remainder
+    assert mesh_plan(0, 2, 8, 8) == (4, 2)
+    # model exact, batching off: TP-only
+    assert mesh_plan(0, 4, 1, 8) == (1, 4)
+    # model auto absorbs what data leaves (explicit dp)
+    assert mesh_plan(4, 0, 8, 8) == (4, 2)
+    # model auto with batching off: all devices go to model
+    assert mesh_plan(0, 0, 1, 8) == (1, 8)
+    assert mesh_plan(1, 0, 8, 8) == (1, 8)      # dp explicitly off
+    # both auto with batching on: data wins (dp-only compatibility)
+    assert mesh_plan(0, 0, 8, 8) == (8, 1)
+    # degenerate single device
+    assert mesh_plan(0, 0, 8, 1) == (1, 1)
+
+
+# -- 2-D sharded dispatch ---------------------------------------------------
+
+def test_2d_runner_rows_bit_identical_every_occupancy(rng):
+    """Every occupancy 1..9 (crossing a bucket boundary): rows through a
+    (data=2, model=2) mesh are byte-equal to the plain BatchRunner's."""
+    import jax.numpy as jnp
+
+    fn = lambda arrays: (jnp.tanh(arrays[0] * 1.5 + 0.25),)  # noqa: E731
+    single = BatchRunner(fn)
+    sharded = BatchRunner(fn, mesh=_mesh(data=2, model=2))
+    assert sharded.replicas == 2 and sharded.model_axis == 2
+    for n in range(1, 10):
+        rows = [(rng.standard_normal((24,)).astype(np.float32),)
+                for _ in range(n)]
+        a = single.run(list(rows))
+        b = sharded.run(list(rows))
+        assert len(a) == len(b) == n
+        for (x,), (y,) in zip(a, b):
+            assert bytes(np.asarray(x)) == bytes(np.asarray(y)), f"n={n}"
+
+
+def test_model_only_mesh_engages_sharded_path():
+    """A (data=1, model=2) mesh must still engage the sharded path — the
+    point is placing params over `model` even without data parallelism —
+    with rows byte-equal to the plain path."""
+    br = BatchRunner(lambda arrays: (arrays[0] * 2.0,),
+                     mesh=_mesh(data=1, model=2))
+    assert br.mesh is not None
+    assert br.replicas == 1 and br.model_axis == 2
+    rows = [(np.full((8,), float(i), np.float32),) for i in range(3)]
+    plain = BatchRunner(lambda arrays: (arrays[0] * 2.0,))
+    for (x,), (y,) in zip(plain.run(list(rows)), br.run(list(rows))):
+        assert bytes(np.asarray(x)) == bytes(np.asarray(y))
+
+
+def test_pipeline_2d_bit_identical_vs_dp_only_every_occupancy():
+    """The acceptance bit-identity: a (data=2, model=2) pipeline delivers
+    byte-equal rows to the dp-only run at every backlog occupancy."""
+    for n in (1, 3, 8, 13):
+        frames = _frames(n)
+        sharded = _run(DESC, frames, queue_capacity=16, batch_max=8,
+                       data_parallel=2, model_parallel=2)
+        reference = _run(DESC, frames, queue_capacity=16, batch_max=8,
+                         data_parallel=1, model_parallel=1)
+        _assert_rows_bitwise(sharded, reference)
+
+
+def test_placement_counters_prove_model_axis_shards():
+    """param_shards/param_replicas split the placement; shard-rows
+    counters carry (data, model) coordinates covering the full grid."""
+    metrics.reset()
+    frames = _frames(32)
+    _run(DESC, frames, queue_capacity=64, batch_max=8,
+         data_parallel=2, model_parallel=2)
+    snap = metrics.snapshot()
+    assert snap.get("f.param_replications") == 1.0
+    assert snap.get("f.param_shards") == 2.0  # w1 AND w2 carry 'model'
+    assert snap.get("f.param_replicas") == 0.0
+    rows = {k: v for k, v in snap.items() if k.startswith("f.shard_rows.")}
+    if not rows:
+        pytest.skip("backlog never coalesced (single-buffer dispatches)")
+    # every chip named by its (data, model) coordinate, whole grid seen
+    assert set(rows) == {f"f.shard_rows.d{d}m{m}"
+                        for d in range(2) for m in range(2)}, rows
+    assert all(v > 0 for v in rows.values())
+
+
+def test_dp_only_keeps_legacy_counter_names():
+    """model_parallel=1 must keep the exact pre-2-D path: legacy
+    .d<device-id> counter names, no param_shards split."""
+    metrics.reset()
+    frames = _frames(24)
+    _run(DESC, frames, queue_capacity=64, batch_max=8, data_parallel=4,
+         model_parallel=1)
+    snap = metrics.snapshot()
+    assert "f.param_shards" not in snap
+    rows = {k for k in snap if k.startswith("f.shard_rows.")}
+    if rows:
+        assert all("m" not in k.rsplit(".", 1)[1] for k in rows), rows
+
+
+def test_mesh_shape_exposed_and_lazy():
+    p = nt.Pipeline(DESC, batch_max=8, data_parallel=2, model_parallel=2)
+    assert p.mesh is None  # lazily built at start(), not construction
+    with p:
+        assert p.mesh_shape == (2, 2)
+        assert p.mesh is not None
+        p.eos()
+        p.wait(timeout=60)
+
+
+def test_2d_over_ask_fails_start_cleanly():
+    from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+    p = nt.Pipeline(DESC, batch_max=8, data_parallel=4, model_parallel=4)
+    with pytest.raises(PipelineError, match="model_parallel"):
+        p.start()
+    runners = {id(r): r for r in p._runners.values()}.values()
+    assert not any(r.thread.is_alive() for r in runners)
+
+
+def test_replicate_params_alias_still_routes():
+    """Back-compat: Element.replicate_params delegates to place_params."""
+    from nnstreamer_tpu.elements.base import Element
+
+    calls = []
+
+    class El(Element):
+        kind = "x"
+
+        def place_params(self, mesh):
+            calls.append(mesh)
+            return True
+
+    assert El({}, name="x").replicate_params("MESH") is True
+    assert calls == ["MESH"]
+
+
+# -- llm filter on the shared mesh ------------------------------------------
+
+LLM_BASE = "max_new:5,temperature:0.0,dtype:float32"
+
+
+def _llm_pipeline_ids(custom, **kw):
+    desc = ("appsrc name=src ! "
+            f"tensor_filter framework=llm model=llama_tiny custom={custom} "
+            "invoke-dynamic=true name=f ! tensor_sink name=out")
+    p = nt.Pipeline(desc, **kw)
+    with p:
+        p.push("src", "the quick brown fox")
+        outs = [p.pull("out", timeout=180) for _ in range(5)]
+        p.eos("src")
+        p.wait(timeout=60)
+    return p, [int(b.tensors[0][0]) for b in outs]
+
+
+def test_llm_model_parallel_streams_identical_ids():
+    _, ref = _llm_pipeline_ids(LLM_BASE, model_parallel=1)
+    desc = ("appsrc name=src ! tensor_filter framework=llm "
+            f"model=llama_tiny custom={LLM_BASE} invoke-dynamic=true "
+            "name=f ! tensor_sink name=out")
+    p2 = nt.Pipeline(desc, model_parallel=2)
+    with p2:
+        # the filter rode the PIPELINE's mesh, params sharded over model
+        fw = p2.element("f").fw
+        assert fw.mesh is p2.mesh
+        spec = str(fw.bundle.params["layers"]["wq"].sharding.spec)
+        assert "model" in spec
+        p2.push("src", "the quick brown fox")
+        tp2 = [int(p2.pull("out", timeout=180).tensors[0][0])
+               for _ in range(5)]
+        p2.eos("src")
+        p2.wait(timeout=60)
+    assert p2.mesh_shape == (1, 2)
+    assert tp2 == ref
+
+
+def test_llm_tp_alias_promoted_to_pipeline_mesh():
+    """Deprecation shim: custom=tp:2 inside a pipeline lands on the
+    pipeline-owned mesh (model_parallel promoted), identical ids."""
+    _, ref = _llm_pipeline_ids(LLM_BASE)
+    p, ids = _llm_pipeline_ids(LLM_BASE + ",tp:2")
+    assert p.model_parallel == 2
+    assert p.mesh_shape == (1, 2)
+    assert ids == ref
+
+
+def test_llm_explicit_model_parallel_wins_over_alias():
+    p, ids = _llm_pipeline_ids(LLM_BASE + ",tp:4", model_parallel=2)
+    assert p.mesh_shape == (1, 2)
+    _, ref = _llm_pipeline_ids(LLM_BASE)
+    assert ids == ref
+
+
+def test_llm_int4_kernel_refcount_survives_tp_move():
+    """The int4 disable_kernel refcount must be taken by the shared-mesh
+    TP path and released at close — exactly the old private-mesh
+    contract."""
+    from nnstreamer_tpu.ops import int4_matmul as i4
+
+    assert i4.kernel_enabled()
+    desc = ("appsrc name=src ! tensor_filter framework=llm "
+            f"model=llama_tiny custom={LLM_BASE},quant:int4 "
+            "invoke-dynamic=true name=f ! tensor_sink name=out")
+    p = nt.Pipeline(desc, model_parallel=2)
+    with p:
+        assert not i4.kernel_enabled()  # taken while the TP filter lives
+        p.push("src", "hi")
+        for _ in range(5):
+            p.pull("out", timeout=180)
+        p.eos("src")
+        p.wait(timeout=60)
+    assert i4.kernel_enabled()  # released at close
+
+
+# -- TP continuous serving (paged pool sharded over model) ------------------
+
+SERVE = (LLM_BASE + ",stream_chunk:2,serve:continuous,slots:3,"
+         "block_size:8")
+
+
+def _fw(custom, provider=None):
+    from nnstreamer_tpu.filters.llm import LLMFramework
+
+    fw = LLMFramework()
+    if provider is not None:
+        fw._mesh_provider = provider
+    fw.open({"model": "llama_tiny", "custom": custom})
+    return fw
+
+
+def _serve_tokens(fw, prompts, timeout=300.0):
+    got = {i: [] for i in range(len(prompts))}
+    lock = threading.Lock()
+
+    def emit_for(i):
+        def emit(tensors, meta):
+            with lock:
+                got[i].append(int(tensors[0][0]))
+        return emit
+
+    for i, p in enumerate(prompts):
+        fw.submit([p], {}, emit_for(i))
+    assert fw.drain(timeout=timeout)
+    return got
+
+
+def test_tp_paged_decode_matches_dense_and_dp_only():
+    """TP paged decode vs dense-cache identity: every stream's greedy ids
+    under model_parallel=2 equal the per-request dense-cache path's AND
+    the unsharded continuous loop's."""
+    from nnstreamer_tpu.filters.llm import LLMFramework
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 500, (t,), dtype=np.int32)
+               for t in (3, 7, 5)]
+    # dense-cache per-request reference
+    dense = []
+    for prompt in prompts:
+        fw = LLMFramework()
+        fw.open({"model": "llama_tiny",
+                 "custom": LLM_BASE + ",stream_chunk:2"})
+        dense.append([int(ids[0]) for ids, *_ in fw.invoke_stream([prompt])])
+        fw.close()
+
+    fw1 = _fw(SERVE)
+    ref = _serve_tokens(fw1, prompts)
+    fw1.close()
+    fw2 = _fw(SERVE, provider=lambda: _mesh(data=1, model=2))
+    try:
+        got = _serve_tokens(fw2, prompts)
+        spec = fw2._serve._pool_sharding
+        assert spec is not None and "model" in str(spec.spec)
+    finally:
+        fw2.close()
+    for i in range(3):
+        assert got[i] == ref[i] == dense[i], f"stream {i}"
+
+
+def test_tp_zero_recompile_churn_pin():
+    """The 3-program census must survive TP: join/leave/complete over a
+    sharded pool changes VALUES only — zero recompiles once warm."""
+    fw = _fw(SERVE + ",prefill_chunk:4",
+             provider=lambda: _mesh(data=1, model=2))
+    rng = np.random.default_rng(5)
+    try:
+        _serve_tokens(fw, [rng.integers(1, 500, (3,), np.int32)])
+        serve = fw._serve
+        warm = {
+            "decode": serve._decode._cache_size(),
+            "prefill": serve._prefill._cache_size(),
+            "set_tok": serve._set_tok._cache_size(),
+        }
+        assert warm == {"decode": 1, "prefill": 1, "set_tok": 1}
+        _serve_tokens(fw, [rng.integers(1, 500, (t,), np.int32)
+                           for t in (1, 7, 13)])
+        _serve_tokens(fw, [rng.integers(1, 500, (9,), np.int32)])
+        after = {
+            "decode": serve._decode._cache_size(),
+            "prefill": serve._prefill._cache_size(),
+            "set_tok": serve._set_tok._cache_size(),
+        }
+    finally:
+        fw.close()
+    assert after == warm, f"recompile on churn: {warm} -> {after}"
+
+
+def test_tp_geometry_rejected_with_named_dims():
+    """llama_tiny has n_kv_heads=2: model_parallel=4 must fail open()
+    with the offending dims named, not a GSPMD reshape error."""
+    from nnstreamer_tpu.filters.base import FrameworkError
+
+    with pytest.raises(FrameworkError, match="n_kv_heads=2"):
+        _fw(SERVE, provider=lambda: _mesh(data=1, model=4))
+
+
+# -- deep lint: mesh plan + per-chip pricing + goldens ----------------------
+
+LLM_SERVE_DESC = (
+    "appsrc name=src ! tensor_filter framework=llm model=llama_small "
+    "custom=max_new:16,serve:continuous,slots:4,block_size:16 "
+    "invoke-dynamic=true ! tensor_sink name=out"
+)
+
+
+def test_deep_lint_prices_tp_params_and_pool_per_chip():
+    r1 = nt.analyze(LLM_SERVE_DESC, deep=True, model_parallel=1)
+    r4 = nt.analyze(LLM_SERVE_DESC, deep=True, model_parallel=4)
+    assert not r1.errors and not r4.errors
+    s1 = r1.resources.stages[0]
+    s4 = r4.resources.stages[0]
+    assert r4.resources.model_parallel == 4
+    # pool shards the head dim: exactly 1/M per chip
+    assert s4.pool_bytes * 4 == s1.pool_bytes
+    # params: sheared leaves /M, embed+norms replicated — the exact split
+    cfg = llama.PRESETS["llama_small"]
+    shard, repl = llama.param_bytes_split(cfg)
+    assert shard + repl == llama.param_bytes_estimate(cfg)
+    assert s4.param_bytes == shard // 4 + repl
+    assert s4.param_bytes < s1.param_bytes / 2
+    # the census stays the closed 3 programs under TP
+    assert s4.variants == 3
+    assert "model_parallel=4" in r4.resources.render()
+
+
+def test_deep_lint_model_divisibility_golden():
+    bad = ("appsrc name=src ! tensor_filter framework=llm "
+           "model=llama_tiny custom=max_new:4,serve:continuous,slots:2 "
+           "invoke-dynamic=true ! tensor_sink name=out")
+    r = nt.analyze(bad, deep=True, model_parallel=4)
+    codes = [d.code for d in r.diagnostics]
+    assert "model-divisibility" in codes
+    msg = next(d.message for d in r.diagnostics
+               if d.code == "model-divisibility")
+    assert "n_kv_heads=2" in msg and "model_parallel=4" in msg
+
+
+def test_deep_lint_tp_alias_priced_like_model_parallel():
+    """custom=tp:4 with the pipeline knob off prices per-chip the same
+    way (the deep pass honors the deprecated alias the runtime does)."""
+    desc = LLM_SERVE_DESC.replace("slots:4", "slots:4,tp:4")
+    r = nt.analyze(desc, deep=True, model_parallel=1)
+    r4 = nt.analyze(LLM_SERVE_DESC, deep=True, model_parallel=4)
+    assert r.resources.stages[0].param_bytes \
+        == r4.resources.stages[0].param_bytes
+    assert r.resources.stages[0].pool_bytes \
+        == r4.resources.stages[0].pool_bytes
+
+
+def test_deep_lint_mesh_axis_missing_golden():
+    """A pspec naming an axis the 2-D pipeline mesh does not carry must
+    be flagged statically."""
+    from jax.sharding import PartitionSpec as P
+
+    @register_model("tp-test-badaxis")
+    def _build(opts):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.asarray(_W1)}
+        spec = TensorsSpec.from_string(str(_D), "float32")
+        return ModelBundle(lambda p, x: x @ p["w"] @ p["w"].T, params,
+                           spec, spec,
+                           param_pspecs={"w": P("seq", None)})
+
+    desc = (f"appsrc name=src caps=other/tensors,dimensions={_D},"
+            "types=float32 ! "
+            "tensor_filter framework=jax model=tp-test-badaxis name=f ! "
+            "tensor_sink name=out")
+    r = nt.analyze(desc, deep=True, batch_max=4, model_parallel=2)
+    codes = [d.code for d in r.diagnostics]
+    assert "mesh-axis-missing" in codes
+    msg = next(d.message for d in r.diagnostics
+               if d.code == "mesh-axis-missing")
+    assert "seq" in msg
+    # dp-only never places pspecs: the same pipeline is clean at mp=1
+    r1 = nt.analyze(desc, deep=True, batch_max=4, model_parallel=1)
+    assert "mesh-axis-missing" not in [d.code for d in r1.diagnostics]
+
+
+def test_deep_lint_generic_stage_divisibility_golden():
+    """A jax-framework stage whose model-sharded dim does not divide M is
+    flagged with the leaf path and dim size."""
+    from jax.sharding import PartitionSpec as P
+
+    @register_model("tp-test-odd")
+    def _build(opts):
+        import jax.numpy as jnp
+
+        w = np.ones((_D, 6), np.float32)  # 6 % 4 != 0
+        params = {"w": jnp.asarray(w)}
+        in_spec = TensorsSpec.from_string(str(_D), "float32")
+        out_spec = TensorsSpec.from_string("6", "float32")
+        return ModelBundle(lambda p, x: x @ p["w"], params, in_spec,
+                           out_spec, param_pspecs={"w": P(None, "model")})
+
+    desc = (f"appsrc name=src caps=other/tensors,dimensions={_D},"
+            "types=float32 ! "
+            "tensor_filter framework=jax model=tp-test-odd name=f ! "
+            "tensor_sink name=out")
+    r = nt.analyze(desc, deep=True, batch_max=4, model_parallel=4)
+    hits = [d for d in r.diagnostics if d.code == "model-divisibility"]
+    assert hits and "w[1]=6" in hits[0].message
+
+
+def test_deep_lint_model_parallel_over_ask():
+    r = nt.analyze(LLM_SERVE_DESC, deep=True, model_parallel=16)
+    codes = [d.code for d in r.diagnostics]
+    assert "data-parallel-devices" in codes
+
+
+def test_deep_lint_combined_over_ask_without_shardable_stage():
+    """An llm-only pipeline (no shard-eligible stage) with an explicit
+    dp x mp plan the host cannot supply must lint dirty — the runtime
+    builds the mesh whenever model_parallel is configured, so start()
+    WILL fail; the lint has to predict it."""
+    r = nt.analyze(LLM_SERVE_DESC, deep=True, batch_max=8,
+                   data_parallel=8, model_parallel=2)
+    hits = [d for d in r.diagnostics if d.code == "data-parallel-devices"]
+    assert hits and "data_parallel=8 x model_parallel=2" in hits[0].message
+    # the same knobs really do fail at runtime with the same arithmetic
+    from nnstreamer_tpu.pipeline.runtime import PipelineError
+
+    with pytest.raises(PipelineError, match="model_parallel=2"):
+        nt.Pipeline(LLM_SERVE_DESC, batch_max=8, data_parallel=8,
+                    model_parallel=2)
+    # and with model_parallel left OFF the dp knob stays inert for an
+    # llm-only pipeline, exactly the pre-2-D behavior: clean lint
+    r1 = nt.analyze(LLM_SERVE_DESC, deep=True, batch_max=8,
+                    data_parallel=8, model_parallel=1)
+    assert "data-parallel-devices" not in [d.code for d in r1.diagnostics]

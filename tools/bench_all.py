@@ -93,10 +93,31 @@ ROWS = [
     ("llm7b_int4_continuous_x32", ["--config", "llm7b", "--llm-quant",
                                    "int4", "--llm-serve", "continuous",
                                    "--llm-streams", "32"]),
+    # 2-D placement rows (ISSUE 9): tensor-parallel llama decode on the
+    # pipeline's shared (data x model) mesh — per-chip weight + KV HBM
+    # divide by M; the tp A/B pins greedy-id identity and records the
+    # ratio, the dp x tp grid row records the 2-D batching tradeoff.
+    # On the single-chip tunnel these run the CPU host-device proxy
+    # (bench.py pins the 8-virtual-device flag); a multi-chip sweep
+    # measures the real split.
+    # The CPU sentinel pins JAX_PLATFORMS=cpu for the row: on the
+    # single-chip tunnel the proxy is the only way these produce a
+    # number (bench.py then forces the 8-virtual-device flag); drop the
+    # sentinel on a real multi-chip host to measure the actual split.
+    ("llama_decode_tp2", ["CPU", "--config", "tp", "--tp-ways", "2"]),
+    ("llama_decode_tp4", ["CPU", "--config", "tp", "--tp-ways", "4"]),
+    ("sharded_grid_dp2xtp2", ["CPU", "--config", "tp_grid"]),
 ]
 
 
 def run_row(label: str, argv, timeout: int) -> dict:
+    env = None
+    # CPU sentinel: run the row on the CPU host-device proxy (the 2-D
+    # placement rows need >1 local device; bench.py pins the virtual
+    # device count once JAX_PLATFORMS=cpu)
+    if argv and argv[0] == "CPU":
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        argv = argv[1:]
     # SOAK sentinel: the row runs tools/soak.py (its stdout tail is the
     # same one-line {"metric": ...} JSON contract bench.py rows use)
     if argv and argv[0] == "SOAK":
@@ -106,7 +127,7 @@ def run_row(label: str, argv, timeout: int) -> dict:
         cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)
     try:
-        proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                               text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return {"row": label, "error": f"timeout after {timeout}s"}

@@ -31,6 +31,14 @@ flag must be set before jax initializes, and a separate process
 guarantees it can never arrive too late (or leak a forced device count
 into anything else).
 
+AND it runs the mesh gate (docs/BATCHING.md "2-D sharded dispatch"):
+tests/test_model_parallel.py as its own pytest process with the same
+pinned XLA flag — 2-D (data x model) dispatch bit-identity vs dp-only,
+model-axis placement counters, TP paged decode identity, and the
+zero-recompile pin under TP — then a deep-lint assertion that a
+``model_parallel=4`` llama-7B serving pipeline prices per-chip params
+and KV-pool bytes at ~1/4 (sheared leaves /M, embed+norms replicated).
+
 AND it runs the tracing gate (tools/tracing_gate.py, see
 docs/OBSERVABILITY.md): a backlogged batching run with
 ``trace_mode=ring`` must dump schema-valid Chrome trace JSON whose
@@ -191,6 +199,85 @@ def run_sharded_gate(timeout: int = 600) -> int:
         for line in proc.stdout.strip().splitlines()[-15:]:
             print(f"  {line}", file=sys.stderr)
     return proc.returncode
+
+
+#: the mesh gate's deep-lint assertion pipeline: a REAL 7B-shaped TP
+#: serving config, priced statically (resolve_config — no params ever
+#: materialize).  model_parallel=4 must price per-chip params + KV pool
+#: at ~1/4: sheared leaves (the big mats + lm_head) divide by M, embed +
+#: norms replicate, the paged pool shards its head dim.
+MESH_GATE_SNIPPET = r"""
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.models import llama
+
+DESC = ("appsrc name=src ! tensor_filter framework=llm model=llama2_7b "
+        "custom=max_new:32,serve:continuous,slots:4,param_dtype:bfloat16 "
+        "invoke-dynamic=true ! tensor_sink name=out")
+M = 4
+r1 = nt.analyze(DESC, deep=True, model_parallel=1)
+rM = nt.analyze(DESC, deep=True, model_parallel=M)
+assert not r1.errors and not rM.errors, (r1.render(), rM.render())
+s1, sM = r1.resources.stages[0], rM.resources.stages[0]
+assert rM.resources.model_parallel == M
+assert sM.pool_bytes * M == s1.pool_bytes, (sM.pool_bytes, s1.pool_bytes)
+ratio = sM.param_bytes / s1.param_bytes
+# ~1/M per chip: the bf16 embed (vocab*dim) replicates, everything big
+# shards — for 7B that bounds the ratio just above 0.25
+assert 1.0 / M <= ratio <= 1.1 / M, f"per-chip param ratio {ratio:.4f}"
+assert sM.variants == 3, sM.variants  # the census stays closed under TP
+print(f"mesh gate lint: per-chip params ratio {ratio:.4f} (~1/{M}), "
+      f"pool /{M}, 3-program census")
+"""
+
+
+def run_mesh_gate(timeout: int = 900) -> int:
+    """2-D placement gate (docs/BATCHING.md "2-D sharded dispatch"):
+    tests/test_model_parallel.py as its own pytest process with the
+    8-host-device XLA flag pinned (bit-identity of 2-D dispatch vs
+    dp-only, model-axis placement counters, TP paged decode identity,
+    the zero-recompile pin under TP, make_mesh/mesh_plan semantics,
+    divisibility/missing-axis lint goldens), then the deep-lint pricing
+    assertion: a model_parallel=4 llama-7B serving pipeline must price
+    per-chip params + KV pool at ~1/4 (MESH_GATE_SNIPPET)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_model_parallel.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"mesh gate: TIMED OUT after {timeout}s", file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"mesh gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    try:
+        lint = subprocess.run([sys.executable, "-c", MESH_GATE_SNIPPET],
+                              cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("mesh gate: deep lint TIMED OUT after 300s", file=sys.stderr)
+        return 2
+    ok = lint.returncode == 0
+    tag = "OK" if ok else "TP NOT PRICED PER CHIP"
+    print(f"mesh gate: {tag} ({passed} tests passed)")
+    for line in lint.stdout.strip().splitlines():
+        if line.startswith("mesh gate lint:"):
+            print(f"  {line}")
+    if not ok:
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def run_tracing_gate(timeout: int = 600) -> int:
@@ -413,12 +500,13 @@ def main() -> int:
     lint_rc = run_lint_gate(args.update)
     deep_rc = run_deep_gate(args.update)
     sharded_rc = run_sharded_gate()
+    mesh_rc = run_mesh_gate()
     tracing_rc = run_tracing_gate()
     serving_rc = run_serving_gate(args.update)
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
-    lint_rc = (lint_rc or deep_rc or sharded_rc or tracing_rc or serving_rc
-               or fetch_rc or soak_rc)
+    lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
+               or serving_rc or fetch_rc or soak_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
