@@ -49,6 +49,7 @@ def analyze(
     deep: bool = False,
     batch_max: Optional[int] = None,
     batch_buckets: Optional[list] = None,
+    adaptive_buckets: Optional[bool] = None,
     data_parallel: Optional[int] = None,
     model_parallel: Optional[int] = None,
     dispatch_depth: Optional[int] = None,
@@ -113,6 +114,7 @@ def analyze(
         try:
             ddiags, resources = deep_check(
                 graph, batch_max=batch_max, batch_buckets=batch_buckets,
+                adaptive_buckets=adaptive_buckets,
                 data_parallel=data_parallel, model_parallel=model_parallel,
                 dispatch_depth=dispatch_depth,
                 hbm_budget_bytes=hbm_budget_bytes,

@@ -43,7 +43,8 @@ from ..core.types import TensorFormat, TensorSpec, TensorsSpec
 from ..elements.base import Element, SINK, SRC
 from ..pipeline.batching import ladder as bucket_ladder, shard_bucket_for
 from ..pipeline.graph import PipelineGraph
-from ..pipeline.plan import mesh_plan, replication_plan
+from ..pipeline.plan import (adaptive_variant_budget, mesh_plan,
+                             replication_plan)
 from ..pipeline.residency import FetchEdge, compute_floor_ms, fetch_ms
 from .capsflow import SAFE_CONFIGURE, _element_class, _kahn_order, propagate
 from .diagnostics import Diagnostic, ERROR, WARNING, node_label
@@ -78,13 +79,17 @@ class StageResource:
     #: paged KV block pool resident for the stage's lifetime (continuous
     #: LLM serving — filters/llm.py serving_plan)
     pool_bytes: int = 0
+    #: device-resident aggregator ring carried between window dispatches
+    #: (elements/aggregator.py device mode) — like the KV pool, resident
+    #: for the stage's lifetime
+    ring_bytes: int = 0
 
     @property
     def hbm_bytes(self) -> int:
         """Per-device HBM this stage plans for: resident params + KV pool
-        + in-flight activations (dispatch window already multiplied into
-        rows)."""
-        return (self.param_bytes + self.pool_bytes
+        + aggregator ring + in-flight activations (dispatch window
+        already multiplied into rows)."""
+        return (self.param_bytes + self.pool_bytes + self.ring_bytes
                 + self.act_row_bytes * self.rows_per_device)
 
 
@@ -103,6 +108,12 @@ class ResourceReport:
     ladder: Tuple[int, ...] = ()
     hbm_budget_bytes: int = 0
     max_compiled_variants: int = 0
+    #: adaptive bucket ladder enabled: batchable stages are priced at
+    #: their full mint budget (``ladder_budget`` programs each — the
+    #: worst case the runtime's AdaptiveLadder can ever compile), so the
+    #: census stays closed by construction
+    adaptive_buckets: bool = False
+    ladder_budget: int = 0
     #: planned D2H per sink edge (pipeline/residency.py): what actually
     #: crosses to host per buffer, priced against the calibrated link
     #: when one is configured (Config.link_d2h_mbps)
@@ -132,7 +143,9 @@ class ResourceReport:
             "deep resource report "
             f"(batch_max={self.batch_max}, "
             f"buckets={','.join(map(str, self.ladder))}, "
-            f"data_parallel={self.data_parallel}, "
+            + (f"adaptive (budget {self.ladder_budget}/stage), "
+               if self.adaptive_buckets else "")
+            + f"data_parallel={self.data_parallel}, "
             f"model_parallel={self.model_parallel}, "
             f"dispatch_depth={self.dispatch_depth})"
         ]
@@ -144,6 +157,8 @@ class ResourceReport:
             lines.append(
                 f"  {s.label}: params {_mib(s.param_bytes)}, "
                 + (f"kv pool {_mib(s.pool_bytes)}, " if s.pool_bytes
+                   else "")
+                + (f"agg ring {_mib(s.ring_bytes)}, " if s.ring_bytes
                    else "")
                 + f"act/row {_mib(s.act_row_bytes)}, "
                 f"rows/dev {s.rows_per_device}, "
@@ -204,6 +219,7 @@ def deep_check(
     *,
     batch_max: Optional[int] = None,
     batch_buckets: Optional[List[int]] = None,
+    adaptive_buckets: Optional[bool] = None,
     data_parallel: Optional[int] = None,
     model_parallel: Optional[int] = None,
     dispatch_depth: Optional[int] = None,
@@ -227,6 +243,8 @@ def deep_check(
                    else cfg.batch_buckets) or None
     if buckets:
         buckets = sorted(set(buckets))
+    adaptive = bool(adaptive_buckets if adaptive_buckets is not None
+                    else cfg.adaptive_buckets)
     dp_knob = max(0, data_parallel if data_parallel is not None
                   else cfg.data_parallel)
     mp_knob = max(0, model_parallel if model_parallel is not None
@@ -267,6 +285,15 @@ def deep_check(
             if isinstance(serving, StageResource):
                 serving_stages.append(serving)
             continue
+        ring = _aggregator_stage(graph, node, out_caps, diags)
+        if ring is not None:
+            # device-resident aggregator (elements/aggregator.py device
+            # mode): its HBM ring + 3-program census are priced here; the
+            # element itself is stateful, so the generic (stateless)
+            # trace walk must skip it either way
+            if isinstance(ring, StageResource):
+                serving_stages.append(ring)
+            continue
         got = _trace_node(graph, node, out_caps, diags, model_par)
         if got is not None:
             traces[node.id] = got
@@ -274,7 +301,8 @@ def deep_check(
     report = _resources(graph, traces, batch_max=batch_max, buckets=buckets,
                         replicas=replicas, model_par=model_par,
                         dispatch_depth=dispatch_depth,
-                        hbm_budget=hbm_budget, max_variants=max_variants)
+                        hbm_budget=hbm_budget, max_variants=max_variants,
+                        adaptive=adaptive)
     report.stages.extend(serving_stages)
     report.link_d2h_mbps = d2h_mbps
     report.link_rtt_ms = rtt_ms
@@ -426,6 +454,59 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
         pos=node.pos, pool_bytes=pool)
 
 
+#: compiled programs a device-mode aggregator runs for its LIFETIME (the
+#: fixed-signature pin, elements/aggregator.py: ring init, append,
+#: window+advance) — mirrored by tests/test_aggregator_device.py's
+#: zero-recompile pin, the same discipline as PR 6's 3-program serving loop
+AGGREGATOR_PROGRAMS = 3
+
+
+def _aggregator_stage(graph, node, out_caps, diags):
+    """Price a ``tensor_aggregator device=true`` stage statically.
+
+    Returns ``None`` when the node is not a device-mode aggregator, a
+    :class:`StageResource` when priced, or ``True`` when it is one but
+    the upstream spec is unknown/flexible (diagnosed: the device ring
+    needs a static window signature).  The ring is HBM-resident for the
+    stage's lifetime — ``(frames_out + frames_in)`` frames of carry state
+    written in-program (roll + dynamic-update-slice), so window advances
+    never round-trip through host and never recompile: the census is the
+    fixed :data:`AGGREGATOR_PROGRAMS`."""
+    if node.kind != "tensor_aggregator":
+        return None
+    if str(node.props.get("device", "")).lower() not in ("true", "1", "yes"):
+        return None
+    label = node_label(node)
+    ins = graph.in_edges(node.id)
+    up = out_caps.get((ins[0].src, ins[0].src_pad)) if len(ins) == 1 else None
+    spec = up.spec if up is not None else None
+    if spec is None or spec.is_flexible or len(spec) != 1:
+        diags.append(Diagnostic(
+            "recompile-unbounded", WARNING,
+            "tensor_aggregator device=true needs ONE static upstream "
+            "tensor spec: the HBM ring's shape (and its zero-recompile "
+            "pin) derive from it — a flexible stream would re-specialize "
+            "the ring programs per signature",
+            path=label, pos=node.pos))
+        return True
+    try:
+        frames_in = max(1, int(node.props.get("frames_in", 1)))
+        frames_out = max(1, int(node.props.get("frames_out", 1)))
+    except (TypeError, ValueError):
+        frames_in = frames_out = 1
+    in_bytes = int(spec.nbytes)
+    frame_bytes = in_bytes // frames_in
+    # carry capacity is need + step frames (elements/aggregator.py):
+    # valid can reach need-1 before an append of step more
+    ring = (frames_out + frames_in) * frame_bytes
+    out_bytes = frames_out * frame_bytes
+    return StageResource(
+        label=label, param_bytes=0, act_row_bytes=in_bytes + out_bytes,
+        rows_per_device=1, variants=AGGREGATOR_PROGRAMS,
+        batchable=False, shard_eligible=False, sharded=False,
+        pos=node.pos, ring_bytes=ring)
+
+
 def _pspec_audit(params, pspecs, model_par: int, label, pos,
                  diags: List[Diagnostic]) -> int:
     """Statically audit a bundle's ``param_pspecs`` against its param
@@ -483,12 +564,49 @@ def _pspec_audit(params, pspecs, model_par: int, label, pos,
     return shard_bytes
 
 
+class _CapsIdentity:
+    """Stand-in element for a fused-through capsfilter in the census walk
+    (the runtime's ``_CapsFilter.device_fn`` identity, mirrored so chain
+    merging — and therefore the recompile census and HBM estimate —
+    agrees with what ``plan_stages`` actually fuses)."""
+
+    name = "capsfilter"
+    host_post = None
+
+    def stop(self) -> None:
+        pass
+
+
+def _capsfilter_trace(graph, node, out_caps) -> Optional[_NodeTrace]:
+    """Transparent-identity trace for a mid-chain caps pin: the planner
+    fuses THROUGH capsfilters on static tensor streams (they are
+    negotiation-time constraints, not runtime transforms), so the census
+    walk must see them as zero-param, zero-new-activation chain links —
+    not as chain breaks that would split one fused program into two and
+    double-count its bucket ladder."""
+    ins = graph.in_edges(node.id)
+    outs = graph.out_edges(node.id)
+    if len(ins) != 1 or ins[0].dst_pad != SINK:
+        return None
+    up = out_caps.get((ins[0].src, ins[0].src_pad))
+    spec = up.spec if up is not None else None
+    if spec is None or spec.is_flexible:
+        return None  # nothing static to pin: stays a host pass-through
+    down = out_caps.get((node.id, SRC))
+    out_spec = (down.spec if down is not None else None) or spec
+    linear = (len(outs) <= 1 and all(e.src_pad == SRC for e in outs))
+    return _NodeTrace(
+        node=node, element=_CapsIdentity(), in_bytes=spec.nbytes,
+        out_bytes=int(out_spec.nbytes), param_bytes=0, batchable=False,
+        host_post=False, linear=linear)
+
+
 def _trace_node(graph, node, out_caps, diags,
                 model_par: int = 1) -> Optional[_NodeTrace]:
     """Abstractly execute one node's device path; returns its trace record
     (for resource accounting) or None when the node has no device path."""
     if node.kind == "capsfilter":
-        return None
+        return _capsfilter_trace(graph, node, out_caps)
     cls = _element_class(node.kind)
     if cls is None or cls.device_fn is Element.device_fn:
         return None
@@ -586,8 +704,8 @@ def _trace_node(graph, node, out_caps, diags,
 
 
 def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
-               replicas, model_par, dispatch_depth, hbm_budget, max_variants
-               ) -> ResourceReport:
+               replicas, model_par, dispatch_depth, hbm_budget, max_variants,
+               adaptive: bool = False) -> ResourceReport:
     """Merge traced nodes into planner-shaped stages (maximal linear chains
     fuse into ONE program, exactly the plan_stages rule) and multiply the
     per-stage estimates over the bucket ladder / replication plan."""
@@ -661,11 +779,25 @@ def _resources(graph, traces: Dict[int, _NodeTrace], *, batch_max, buckets,
             variants=n_buckets,
             batchable=batchable, shard_eligible=shard_eligible,
             sharded=sharded, pos=chain[0].node.pos))
+    ladder_budget = 0
+    if adaptive and batch_max > 1:
+        # Worst-case census under the adaptive ladder: every batchable
+        # stage priced at its full mint budget — the SAME arithmetic the
+        # runtime hands each stage's AdaptiveLadder (plan.py), so minting
+        # can never compile past what this report charged.  Minted sizes
+        # never exceed the ladder top, so rows/HBM are unchanged.
+        ladder_budget = adaptive_variant_budget(
+            len(lad), sum(1 for s in stages if s.batchable),
+            int(max_variants or 0))
+        for s in stages:
+            if s.batchable:
+                s.variants = max(s.variants, ladder_budget)
     return ResourceReport(
         stages=stages, batch_max=batch_max, data_parallel=replicas,
         model_parallel=model_par, dispatch_depth=dispatch_depth, ladder=lad,
         hbm_budget_bytes=int(hbm_budget or 0),
-        max_compiled_variants=int(max_variants or 0))
+        max_compiled_variants=int(max_variants or 0),
+        adaptive_buckets=adaptive, ladder_budget=ladder_budget)
 
 
 def _fetch_check(graph, traces: Dict[int, _NodeTrace], out_caps,

@@ -23,6 +23,8 @@ _ENV_CONF = "NNS_TPU_CONF"
 _ENV_PLUGINS = "NNS_TPU_PLUGINS"
 _ENV_FW_PRIORITY = "NNS_TPU_FILTER_PRIORITY"
 _ENV_BUCKETING = "NNS_TPU_SHAPE_BUCKETING"
+_ENV_ADAPTIVE = "NNS_TPU_ADAPTIVE_BUCKETS"
+_ENV_LADDERS = "NNS_TPU_BUCKET_LADDERS"
 _ENV_BATCH_MAX = "NNS_TPU_BATCH_MAX"
 _ENV_DATA_PARALLEL = "NNS_TPU_DATA_PARALLEL"
 _ENV_MODEL_PARALLEL = "NNS_TPU_MODEL_PARALLEL"
@@ -57,6 +59,22 @@ class Config:
     #: optional wait (ms) for more buffers once one is in hand; 0 = never
     #: trade latency for occupancy (drain only what is already queued)
     batch_linger_ms: float = 0.0
+    #: adaptive bucket ladder (docs/BATCHING.md "Adaptive ladder"): each
+    #: batchable stage refines its ladder online from observed drain
+    #: occupancies — persistent skew mints an exact bucket instead of
+    #: padding to the next power of two — bounded per stage by
+    #: ``pipeline/plan.adaptive_variant_budget`` against
+    #: ``max_compiled_variants`` so the deep-lint recompile census stays
+    #: closed.  False = the static ladder, bit-identical behavior.
+    adaptive_buckets: bool = False
+    #: warm-start ladders per stage name (the export of a previous run's
+    #: ``Pipeline.ladder_snapshot()``): ``{"f": [1, 2, 4, 6, 8]}``.  Ini
+    #: ``[ladders]`` section (``f = 1,2,4,6,8``) or env
+    #: ``NNS_TPU_BUCKET_LADDERS=f:1|2|4|6|8;g:...``.  Minted sizes
+    #: compile at warmup, so steady-state deployments skip the online
+    #: learning phase entirely.
+    bucket_ladders: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict)
     #: data-parallel replicas a bucketed micro-batch is sharded over (the
     #: ``data`` mesh axis): 0 = all local devices once batch_max > 1,
     #: 1 = single-device dispatch (the pre-mesh behavior), N = exactly N
@@ -152,6 +170,21 @@ class Config:
             if ini.has_option("common", "batch_linger_ms"):
                 cfg.batch_linger_ms = ini.getfloat("common",
                                                    "batch_linger_ms")
+            if ini.has_option("common", "adaptive_buckets"):
+                cfg.adaptive_buckets = ini.getboolean("common",
+                                                      "adaptive_buckets")
+            if ini.has_section("ladders"):
+                # case-preserving re-read: configparser lowercases option
+                # keys by default, but stage names are case-sensitive
+                # (ladder_snapshot() exports them verbatim) — a lowercased
+                # key would silently miss the warm-start lookup
+                cased = configparser.ConfigParser()
+                cased.optionxform = str
+                cased.read(path)
+                cfg.bucket_ladders = {
+                    stage: [int(v) for v in _split(sizes)]
+                    for stage, sizes in cased.items("ladders")
+                }
             if ini.has_option("common", "data_parallel"):
                 cfg.data_parallel = ini.getint("common", "data_parallel")
             if ini.has_option("common", "model_parallel"):
@@ -224,7 +257,26 @@ class Config:
         if os.environ.get(_ENV_BUCKETING):
             cfg.shape_bucketing = os.environ[_ENV_BUCKETING].lower() in (
                 "1", "true", "yes", "on")
+        if os.environ.get(_ENV_ADAPTIVE):
+            cfg.adaptive_buckets = os.environ[_ENV_ADAPTIVE].lower() in (
+                "1", "true", "yes", "on")
+        if os.environ.get(_ENV_LADDERS):
+            cfg.bucket_ladders = parse_ladders(os.environ[_ENV_LADDERS])
         return cfg
+
+
+def parse_ladders(s: str) -> Dict[str, List[int]]:
+    """``"f:1|2|4|6;g:1|2|8"`` -> ``{"f": [1,2,4,6], "g": [1,2,8]}`` (the
+    env encoding of a ladder snapshot; ':' splits stage from sizes, '|'
+    splits sizes — both survive shells unquoted)."""
+    out: Dict[str, List[int]] = {}
+    for part in s.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        stage, _, sizes = part.partition(":")
+        out[stage.strip()] = [int(v) for v in sizes.split("|") if v.strip()]
+    return out
 
 
 def _split(s: str) -> List[str]:

@@ -68,6 +68,15 @@ LATENCY_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: histogram bucket upper bounds for occupancy/size distributions fed by
+#: :meth:`Metrics.observe_bucketed` — the static bucket ladder's shape
+#: (powers of two), so the ``<stage>.batch_occupancy`` exposition and the
+#: adaptive ladder (pipeline/batching.AdaptiveLadder) describe the same
+#: per-dispatch occupancy stream in the same units (final implicit
+#: bucket: +Inf)
+OCCUPANCY_BUCKETS: Tuple[float, ...] = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0)
+
 #: an admission (h2d) or materialization (d2h) wait above this is a real
 #: transport/backlog stall, not a lock hop — ONE threshold for both
 #: halves of the fetch-engine stall split (``<src>.h2d_stalls`` in
@@ -92,6 +101,9 @@ class Metrics:
         # name -> [bucket_counts(len(LATENCY_BUCKETS)+1 incl +Inf),
         #          sum, count]
         self._hist: Dict[str, list] = {}
+        # value histograms with their own bounds (occupancy families):
+        # name -> [bounds, bucket_counts(len(bounds)+1 incl +Inf), sum, n]
+        self._vhist: Dict[str, list] = {}
         # labeled twins, keyed (name, tenant) — populated only when a
         # caller passes tenant= (docs/SERVING.md "Front door")
         self._lcounters: Dict[Tuple[str, str], float] = \
@@ -139,6 +151,35 @@ class Metrics:
         h[0][i] += 1
         h[1] += seconds
         h[2] += 1
+
+    def observe_bucketed(self, name: str, value: float,
+                         bounds: Tuple[float, ...] = OCCUPANCY_BUCKETS
+                         ) -> None:
+        """observe() + a cumulative fixed-bucket histogram with
+        ``bounds`` as the explicit ``le`` labels — the occupancy twin of
+        :meth:`observe_latency`, so batch-occupancy distributions render
+        as real aggregatable ``_bucket``/``_sum``/``_count`` series
+        (docs/BATCHING.md "Metrics") instead of point-in-time quantile
+        gauges only."""
+        with self._lock:
+            self._observe_locked(self._lat, name, value)
+            h = self._vhist.get(name)
+            if h is None:
+                h = self._vhist[name] = [tuple(bounds),
+                                         [0] * (len(bounds) + 1), 0.0, 0]
+            # first-writer-wins bounds: a series' exposition must keep one
+            # bucket layout for its lifetime (Prometheus contract)
+            h[1][bisect.bisect_left(h[0], value)] += 1
+            h[2] += value
+            h[3] += 1
+
+    def value_histograms(self) -> Dict[str, Tuple[Tuple[float, ...],
+                                                  List[int], float, int]]:
+        """Copy of every bucketed value histogram: name -> (bounds,
+        per-bucket counts incl. the final +Inf bucket, sum, count)."""
+        with self._lock:
+            return {name: (h[0], list(h[1]), h[2], h[3])
+                    for name, h in self._vhist.items()}
 
     def observe_latency(self, name: str, seconds: float,
                         tenant: Optional[str] = None) -> None:
@@ -262,6 +303,7 @@ class Metrics:
             self._gauges.clear()
             self._lat.clear()
             self._hist.clear()
+            self._vhist.clear()
             self._lcounters.clear()
             self._lgauges.clear()
             self._llat.clear()

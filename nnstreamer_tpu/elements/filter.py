@@ -409,7 +409,8 @@ class TensorFilter(Element):
                     entry = (fw, BatchRunner(
                         fn, getattr(self, "_batch_buckets", None),
                         name=self.name, mesh=mesh, prepare=prep,
-                        tracer=getattr(self, "_trace_rec", None)))
+                        tracer=getattr(self, "_trace_rec", None),
+                        ladder=getattr(self, "_batch_ladder", None)))
                     self._batchers = {id(fw): entry}  # drop stale programs
                 rows = entry[1].run(
                     [tuple(self._select_inputs(b.tensors)) for b in bufs])

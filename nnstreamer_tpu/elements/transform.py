@@ -58,18 +58,48 @@ def _promotes_to_float(op: "_ArithOp") -> bool:
     return False
 
 
+def _saturate_cast(xp, x, dtype: np.dtype):
+    """Float -> integer cast with ONE pinned semantic on both paths:
+    SATURATE at the target's range (what a dtype-quantized boundary
+    wants).  Raw ``astype`` diverges between the host and fused paths —
+    numpy WRAPS out-of-range values (300.2 -> uint8 44) while XLA's
+    ConvertElementType saturates (-> 255) — and with the planner now
+    fusing typecast transforms across quantized caps pins, the same
+    pipeline could emit different bytes depending on where the cast ran.
+    Clamping before the cast makes both backends saturate identically:
+    the clamp bounds are exact for <=16-bit targets in float32 and for
+    32-bit targets the backend cast saturates at the same edge the
+    clamp rounds to.  (NaN stays out of the contract: garbage at a
+    quantized boundary either way.)  Pinned by tests/test_transform.py.
+    """
+    dt = np.dtype(dtype)
+    if dt.kind in "iu" and np.dtype(x.dtype).kind == "f" \
+            and dt.itemsize <= 4:
+        info = np.iinfo(dt)
+        if xp is np and dt.itemsize == 4:
+            # 32-bit bounds are not exact in float32: numpy would wrap at
+            # the rounded edge where XLA saturates — clip in float64
+            # (exact for +-2^31/2^32) so both land on the same integer
+            x = x.astype(np.float64)
+        x = xp.clip(x, info.min, info.max)
+    return x.astype(dt)
+
+
 class Ops:
     """Mode implementations, parameterized by array namespace ``xp``."""
 
     @staticmethod
     def typecast(xp, x, dtype: np.dtype):
-        return x.astype(dtype)
+        return _saturate_cast(xp, x, dtype)
 
     @staticmethod
     def arithmetic(xp, x, ops: Sequence[_ArithOp]):
         for op in ops:
             if op.name == "typecast":
-                x = x.astype(op.value)
+                # same saturating float->int semantics as mode=typecast:
+                # an arith chain's trailing requantize (`...,typecast:uint8`)
+                # must emit the same bytes fused or on host
+                x = _saturate_cast(xp, x, op.value)
                 continue
             v = op.value
             # Deterministic promotion shared by host/device paths: float

@@ -167,7 +167,17 @@ class JaxFramework(Framework):
     def invoke(self, inputs) -> List:
         import jax.numpy as jnp
 
-        arrays = [jnp.asarray(x) for x in inputs]
+        if self._device is not None:
+            # accelerator= selected a non-default device: params were
+            # placed there at open(), so inputs must follow — a bare
+            # asarray lands on the default device and the invoke pays a
+            # cross-device transfer (or fails outright on backends
+            # without implicit transfers) per buffer
+            import jax
+
+            arrays = [jax.device_put(x, self._device) for x in inputs]
+        else:
+            arrays = [jnp.asarray(x) for x in inputs]
         outs = self._jitted(*arrays)
         return list(outs)
 

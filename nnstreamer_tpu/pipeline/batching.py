@@ -36,35 +36,50 @@ bits — matches the single-device program.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.buffer import pad_rows, split_rows, stack_tensors
 from ..core.log import metrics
 
-#: default bucket ladder; bucket_for() falls back to the exact size above it
+#: default bucket ladder; bucket_for() LADDER-ROUNDS above it (multiples
+#: of the top bucket), so programs stay bounded at any batch_max
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def bucket_for(n: int, buckets: Optional[Sequence[int]] = None) -> int:
-    """Smallest allowed batch size >= n (exact n when above the ladder)."""
-    for b in buckets or DEFAULT_BUCKETS:
+    """Smallest allowed batch size >= n.  Above the ladder top the size is
+    LADDER-ROUNDED — the next multiple of the top bucket — never the exact
+    occupancy: an exact fallback minted one compiled program PER OCCUPANCY
+    once ``batch_max`` exceeded the top (a 1000-deep drain could compile
+    hundreds of signatures), which is precisely the recompile storm the
+    ladder exists to prevent.  Rounding bounds the census at
+    ``len(ladder) + batch_max // top`` programs (see :func:`ladder`)."""
+    bs = buckets or DEFAULT_BUCKETS
+    for b in bs:
         if b >= n:
             return b
-    return n
+    top = bs[-1]
+    return top * (-(-n // top))
 
 
 def ladder(batch_max: int, buckets: Optional[Sequence[int]] = None
            ) -> Tuple[int, ...]:
     """Every bucket size a runner with this ``batch_max`` can ever dispatch
-    (ascending).  Mirrors the runner exactly: ``batch_max`` above the top
-    bucket is CLAMPED to it (runtime._Runner caps the drain at the ladder
-    top precisely so recompiles stay bounded), so the set never contains a
-    size the runtime cannot produce.  This is the compiled-signature
-    ladder the deep analyzer multiplies out for its recompile census and
-    HBM high-water estimate — one compiled program per entry, per stage."""
+    (ascending).  Mirrors :func:`bucket_for` exactly: sizes above the top
+    bucket appear as multiples of the top (the ladder-rounded fallback) up
+    to the rounded ``batch_max``, so the set never contains a size the
+    runtime cannot produce — and never misses one it can.  This is the
+    compiled-signature ladder the deep analyzer multiplies out for its
+    recompile census and HBM high-water estimate — one compiled program
+    per entry, per stage."""
     bs = tuple(sorted(set(buckets))) if buckets else DEFAULT_BUCKETS
-    top = bucket_for(min(max(1, batch_max), bs[-1]), bs)
-    return tuple(b for b in bs if b <= top)
+    bm = max(1, batch_max)
+    top = bucket_for(bm, bs)
+    out = [b for b in bs if b <= top]
+    if top > bs[-1]:
+        out.extend(range(2 * bs[-1], top + 1, bs[-1]))
+    return tuple(out)
 
 
 def shard_bucket_for(n: int, replicas: int,
@@ -75,6 +90,133 @@ def shard_bucket_for(n: int, replicas: int,
     a ragged split would be a different program per remainder)."""
     b = bucket_for(n, buckets)
     return b + (-b) % max(1, replicas)
+
+
+#: occupancy observations of one size before the adaptive ladder mints a
+#: bucket for it: high enough that a transient burst shape never costs a
+#: compile, low enough that a persistent drain pattern refines within the
+#: first seconds of a backlogged run
+MINT_AFTER = 24
+
+
+class AdaptiveLadder:
+    """Per-stage bucket ladder refined ONLINE from observed occupancies.
+
+    The static powers-of-two ladder pads every drain up to the next power
+    of two — a runner that persistently drains 5–7 rows pays bucket-8
+    compute forever (pad-waste is a measured counter:
+    ``<stage>.batch_pad_waste``).  This ladder watches the same occupancy
+    stream the Prometheus histogram renders (``<stage>.batch_occupancy``,
+    cumulative ``_bucket{le=}`` exposition) and MINTS an exact bucket for
+    any occupancy observed :data:`MINT_AFTER` times that the current
+    ladder would pad — so steady-state skew compiles one right-sized
+    program instead of padding into a bigger one.
+
+    Two hard bounds keep the deep-lint recompile census CLOSED:
+
+    * ``budget`` — max ladder entries (base + minted), resolved by
+      ``pipeline/plan.adaptive_variant_budget`` from
+      ``Config.max_compiled_variants`` so the census the deep pass prices
+      is the worst case this ladder can ever reach;
+    * ``align`` — minted sizes round up to a multiple of the mesh's
+      ``data``-axis width, so :func:`shard_bucket_for`'s replica rounding
+      still applies bucket-for-bucket under 2-D placement.
+
+    ``warm`` pre-seeds minted sizes (the export/warm-start path:
+    ``Pipeline.ladder_snapshot()`` -> ``Config.bucket_ladders`` /
+    ``Pipeline(bucket_ladders=...)``), so a steady-state deployment
+    compiles its refined ladder at warmup instead of re-learning it.
+
+    Thread-safety: ``bucket_for``/``observe`` run on the owning stage
+    thread; ``sizes``/``export`` may be read from the app thread — the
+    ladder tuple is swapped atomically under a small lock.
+    """
+
+    def __init__(self, base: Optional[Sequence[int]] = None, *,
+                 budget: int = 0, align: int = 1,
+                 warm: Optional[Sequence[int]] = None,
+                 mint_after: int = MINT_AFTER, name: Optional[str] = None):
+        self.base: Tuple[int, ...] = (tuple(sorted(set(base))) if base
+                                      else DEFAULT_BUCKETS)
+        self._align = max(1, align)
+        self.budget = max(len(self.base), budget) if budget else 0
+        self.mint_after = max(1, mint_after)
+        self.name = name
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+        self._minted: set = set()
+        self._sizes = self.base
+        self._minted_metric = f"{name}.ladder_minted" if name else None
+        if warm:
+            for s in warm:
+                self._mint(int(s))
+
+    @property
+    def align(self) -> int:
+        return self._align
+
+    @align.setter
+    def align(self, value: int) -> None:
+        """Re-align every already-minted size to the new replica count.
+        Warm-start sizes are minted at construction (align=1 — the mesh
+        does not exist yet), and the runtime assigns the real ``data``
+        width at start(): a dp=1 snapshot's minted 6 warm-started into a
+        dp=4 deployment re-rounds to 8 here (deduping against the base),
+        instead of sitting in the ladder as a never-dispatchable entry
+        that burns a census budget slot."""
+        self._align = max(1, int(value))
+        with self._lock:
+            self._minted = {self._aligned(s) for s in self._minted}
+            self._minted.difference_update(self.base)
+            self._sizes = tuple(sorted(set(self.base) | self._minted))
+
+    def sizes(self) -> Tuple[int, ...]:
+        """The current ladder (base + minted, ascending) — what
+        :func:`bucket_for`/:func:`shard_bucket_for` round against and
+        what the deep census would count if it could see this run."""
+        return self._sizes
+
+    def export(self) -> List[int]:
+        """The ladder as a warm-startable list (``Config.bucket_ladders``
+        value; feed back via ``Pipeline(bucket_ladders={stage: [...]})``)."""
+        return list(self._sizes)
+
+    def _aligned(self, n: int) -> int:
+        return n + (-n) % self.align
+
+    def _room(self) -> bool:
+        return self.budget <= 0 or len(self._sizes) < self.budget
+
+    def _mint(self, n: int) -> None:
+        n = self._aligned(n)
+        if n in self._sizes or n <= 0 or not self._room():
+            return
+        with self._lock:
+            self._minted.add(n)
+            self._sizes = tuple(sorted(set(self.base) | self._minted))
+        if self._minted_metric:
+            metrics.count(self._minted_metric)
+
+    def observe(self, n: int) -> None:
+        """Record one drain's occupancy; mint an exact (aligned) bucket
+        once the same padded occupancy repeats ``mint_after`` times."""
+        want = self._aligned(n)
+        if want in self._sizes:
+            return  # no pad at this occupancy: nothing to refine
+        c = self._counts.get(want, 0) + 1
+        self._counts[want] = c
+        if c >= self.mint_after:
+            del self._counts[want]
+            self._mint(want)
+
+    def bucket_for(self, n: int) -> int:
+        """Observe ``n`` and return its bucket under the CURRENT ladder
+        (refinement applies from the next drain on — the dispatch that
+        triggered a mint still pads, so bucket choice never races the
+        ladder swap)."""
+        sizes = self._sizes
+        self.observe(n)
+        return bucket_for(n, sizes)
 
 
 class BatchRunner:
@@ -95,9 +237,13 @@ class BatchRunner:
 
     def __init__(self, fn: Callable, buckets: Optional[Sequence[int]] = None,
                  name: Optional[str] = None, mesh=None,
-                 prepare: Optional[Callable] = None, tracer=None):
+                 prepare: Optional[Callable] = None, tracer=None,
+                 ladder: Optional[AdaptiveLadder] = None):
         self.fn = fn
         self.buckets = tuple(sorted(set(buckets))) if buckets else None
+        # adaptive mode: the per-stage AdaptiveLadder replaces the static
+        # bucket list for rounding decisions (and observes every drain)
+        self.ladder = ladder
         self._name = name or "batch"
         # the owning pipeline's flight recorder (None = that pipeline runs
         # trace_mode=off, even if another pipeline enabled the global one)
@@ -142,7 +288,8 @@ class BatchRunner:
         if self.mesh is not None:
             return self._run_sharded(rows)
         n = len(rows)
-        bucket = bucket_for(n, self.buckets)
+        bucket = (self.ladder.bucket_for(n) if self.ladder is not None
+                  else bucket_for(n, self.buckets))
         prog = self._progs.get(bucket)
         if prog is None:
             prog = self._progs[bucket] = self._build(bucket)
@@ -188,7 +335,14 @@ class BatchRunner:
                 if new_fn is not None:
                     self.fn = new_fn
                     self._progs.clear()
-        bucket = shard_bucket_for(n, self.replicas, self.buckets)
+        if self.ladder is not None:
+            # minted sizes are replica-aligned (AdaptiveLadder.align), so
+            # the replica rounding below is a no-op on them — static base
+            # buckets still round up exactly as before
+            self.ladder.observe(n)
+            bucket = shard_bucket_for(n, self.replicas, self.ladder.sizes())
+        else:
+            bucket = shard_bucket_for(n, self.replicas, self.buckets)
         if bucket > n:
             rows = pad_rows(rows, bucket)
             if self._pad_metric:

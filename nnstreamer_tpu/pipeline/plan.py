@@ -237,7 +237,8 @@ class FusedElement(Element):
                 self._composed, getattr(self, "_batch_buckets", None),
                 name=self.name, mesh=mesh,
                 prepare=self._shard_prepare if mesh is not None else None,
-                tracer=getattr(self, "_trace_rec", None))
+                tracer=getattr(self, "_trace_rec", None),
+                ladder=getattr(self, "_batch_ladder", None))
         rows = self._batcher.run([tuple(b.tensors) for b in bufs])
         return [(SRC, self._finish(buf, row)) for buf, row in zip(bufs, rows)]
 
@@ -297,6 +298,31 @@ class FusedSourceElement(SourceElement):
 
     def finalize(self):
         return self.source.finalize() + self.fused.finalize()
+
+
+#: minted buckets an adaptive ladder may add per stage when no
+#: ``max_compiled_variants`` budget is configured (0 = uncapped would
+#: leave the recompile census open — never allowed)
+ADAPTIVE_EXTRA_DEFAULT = 4
+
+
+def adaptive_variant_budget(base_len: int, n_batchable: int,
+                            max_compiled_variants: int) -> int:
+    """Max ladder entries (base + minted) ONE adaptive stage may compile —
+    the single home for the arithmetic shared by the runtime (each
+    stage's ``AdaptiveLadder.budget``) and the deep analyzer's recompile
+    census (which prices the WORST CASE: every adaptive stage at its full
+    budget), so the census stays closed by construction: the ladders can
+    never mint past what the static report already charged.
+
+    With ``max_compiled_variants`` configured, the budget splits it
+    evenly across the pipeline's batchable stages (never below the base
+    ladder — refinement may be squeezed out entirely, the census may
+    not).  Unconfigured, each stage gets the base ladder plus
+    :data:`ADAPTIVE_EXTRA_DEFAULT` minted sizes."""
+    if max_compiled_variants > 0:
+        return max(base_len, max_compiled_variants // max(1, n_batchable))
+    return base_len + ADAPTIVE_EXTRA_DEFAULT
 
 
 def replication_plan(data_parallel: int, batch_max: int,

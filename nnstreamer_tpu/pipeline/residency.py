@@ -189,7 +189,11 @@ def plan_residency(graph, elements: Dict[int, Element],
 
     def _device_stage(st) -> bool:
         el = st.element
+        # device_resident: stateful device elements (the aggregator's HBM
+        # ring) that expose no fusable device_fn but still emit device
+        # arrays — their downstream edges stay in HBM
         return (st.batchable or getattr(el, "kind", "") == "fused"
+                or getattr(el, "device_resident", False)
                 or type(el).device_fn is not Element.device_fn)
 
     fetch: List[FetchEdge] = []

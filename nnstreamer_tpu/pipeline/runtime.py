@@ -172,19 +172,28 @@ class _Runner:
         self._pending: Dict[str, List[Buffer]] = {}
         # Adaptive micro-batching: only device stages the planner marked
         # batchable drain >1 buffer; batch_max=1 keeps the exact seed path.
-        # Clamped to the bucket ladder's top: a drain larger than any
-        # bucket would fall back to exact-size programs and unbound the
-        # recompiles the ladder exists to prevent.
-        from .batching import DEFAULT_BUCKETS
-
-        ladder_top = max(pipeline.batch_buckets or DEFAULT_BUCKETS)
-        self.batch_max = (min(pipeline.batch_max, ladder_top)
-                          if stage.batchable else 1)
+        # No ladder-top clamp anymore: bucket_for() LADDER-ROUNDS above
+        # the top bucket (multiples of it), so a batch_max past the top
+        # drains bigger dispatches with a still-bounded program census —
+        # pipeline/batching.ladder() mirrors the exact compiled set.
+        self.batch_max = pipeline.batch_max if stage.batchable else 1
         self.batch_linger_s = pipeline.batch_linger_ms / 1e3
         if stage.batchable:
             # elements build their BatchRunner lazily; hand them the
             # pipeline's bucket ladder the same way _async_emit is attached
             self.element._batch_buckets = pipeline.batch_buckets
+            if pipeline.adaptive_buckets and self.batch_max > 1:
+                # Adaptive ladder (docs/BATCHING.md "Adaptive ladder"):
+                # per-stage, warm-startable, budget-closed.  Attached to
+                # the ELEMENT like _batch_buckets; the lazy BatchRunner
+                # reads it at first batched dispatch.
+                from .batching import AdaptiveLadder, ladder as _ladder
+
+                self.element._batch_ladder = AdaptiveLadder(
+                    _ladder(self.batch_max, pipeline.batch_buckets),
+                    budget=pipeline._ladder_budget,
+                    warm=pipeline.bucket_ladders.get(self.element.name),
+                    name=self.element.name)
         # In-flight dispatch window: a batching device stage may hold this
         # many dispatched-but-unemitted micro-batches, so the next drain
         # overlaps the previous (async) dispatch instead of waiting behind
@@ -545,7 +554,10 @@ class _Runner:
                 batch, carry = self._drain_batch(pad, item)
                 n = len(batch)
                 metrics.count(self._m_in, n)
-                metrics.observe(self._m_occupancy, float(n))
+                # real cumulative histogram (ladder-shaped buckets), not
+                # just the quantile reservoir: the adaptive ladder and
+                # Prometheus read the same occupancy stream
+                metrics.observe_bucketed(self._m_occupancy, float(n))
                 t0 = time.perf_counter()
                 outs = (el.process_batch(pad, batch) if n > 1
                         else el.process(pad, batch[0]))
@@ -684,7 +696,13 @@ class Pipeline:
     stages drain up to that many already-queued same-spec buffers into ONE
     bucketed XLA dispatch (``batch_buckets`` bounds the compiled batch
     sizes, ``batch_linger_ms`` optionally waits for stragglers — see
-    docs/BATCHING.md).  ``data_parallel`` shards those bucketed dispatches
+    docs/BATCHING.md).  ``adaptive_buckets`` lets each batchable stage
+    refine its OWN ladder online from observed drain occupancies
+    (persistent skew mints an exact bucket under a hard census budget —
+    docs/BATCHING.md "Adaptive ladder"), and ``bucket_ladders`` warm-
+    starts those ladders from a previous run's :meth:`ladder_snapshot`
+    export so steady-state deployments compile the refined ladder at
+    warmup.  ``data_parallel`` shards those bucketed dispatches
     over the ``data`` axis of a local device mesh (0 = every local device,
     1 = single-device dispatch, N = exactly N chips; the mesh is built
     lazily at :meth:`start`, off the streaming threads, and only
@@ -744,6 +762,8 @@ class Pipeline:
         batch_max: Optional[int] = None,
         batch_buckets: Optional[List[int]] = None,
         batch_linger_ms: Optional[float] = None,
+        adaptive_buckets: Optional[bool] = None,
+        bucket_ladders: Optional[Dict[str, List[int]]] = None,
         data_parallel: Optional[int] = None,
         model_parallel: Optional[int] = None,
         dispatch_depth: Optional[int] = None,
@@ -768,6 +788,7 @@ class Pipeline:
                 # the deep pass budgets with THIS pipeline's knobs, not
                 # just the global config defaults
                 kw.update(batch_max=batch_max, batch_buckets=batch_buckets,
+                          adaptive_buckets=adaptive_buckets,
                           data_parallel=data_parallel,
                           model_parallel=model_parallel,
                           dispatch_depth=dispatch_depth)
@@ -798,6 +819,15 @@ class Pipeline:
         self.batch_linger_ms = float(
             batch_linger_ms if batch_linger_ms is not None
             else cfg.batch_linger_ms)
+        # Adaptive bucket ladder (docs/BATCHING.md "Adaptive ladder"):
+        # per-stage ladders refined from observed occupancies, warm-started
+        # from a previous run's ladder_snapshot() export.
+        self.adaptive_buckets = bool(
+            adaptive_buckets if adaptive_buckets is not None
+            else cfg.adaptive_buckets)
+        self.bucket_ladders: Dict[str, List[int]] = dict(
+            bucket_ladders if bucket_ladders is not None
+            else cfg.bucket_ladders)
         self.data_parallel = max(0, int(
             data_parallel if data_parallel is not None
             else cfg.data_parallel))
@@ -909,6 +939,18 @@ class Pipeline:
             graph, self.elements, fuse=fuse,
             donate_ingress=self.donate_ingress)
 
+        # 4b. adaptive-ladder variant budget: the SAME arithmetic the deep
+        # analyzer prices the worst-case census with (plan.py), resolved
+        # against the planned batchable-stage count — so runtime minting
+        # can never exceed what the static report already charged.
+        from .batching import ladder as _ladder_fn
+        from .plan import adaptive_variant_budget
+
+        self._ladder_budget = adaptive_variant_budget(
+            len(_ladder_fn(self.batch_max, self.batch_buckets)),
+            sum(1 for s in self.stages if s.batchable),
+            cfg.max_compiled_variants)
+
         # 5. residency plan: what crosses to host per sink edge (logged;
         # exposed as Pipeline.residency for apps/bench/tests)
         self.residency = _residency.plan_residency(
@@ -1019,9 +1061,18 @@ class Pipeline:
             # Attached to the ELEMENT the same way _batch_buckets is: the
             # element's lazy BatchRunner reads it at first batched
             # dispatch.  Only shard-eligible stages ever see it.
+            from ..parallel.mesh import mesh_axis_size
+
+            replicas = mesh_axis_size(mesh, "data")
             for r in {id(r): r for r in self._runners.values()}.values():
                 if r.stage.shardable and r.batch_max > 1:
                     r.element._shard_mesh = mesh
+                    lad = getattr(r.element, "_batch_ladder", None)
+                    if lad is not None:
+                        # minted sizes must stay replica-aligned so
+                        # shard_bucket_for's rounding is a no-op on them
+                        # (2-D mesh rounding still applies)
+                        lad.align = max(1, replicas)
         for r in {id(r): r for r in self._runners.values()}.values():
             r.thread.start()
         if self.trace_mode != "off":
@@ -1150,6 +1201,20 @@ class Pipeline:
             log, reason=f"stage {name} failed: {exc!r}")
 
     # -- observability -----------------------------------------------------
+    def ladder_snapshot(self) -> Dict[str, List[int]]:
+        """Export every adaptive stage's CURRENT bucket ladder (base +
+        minted sizes) keyed by stage name — feed it back via
+        ``Pipeline(bucket_ladders=...)`` / ``Config.bucket_ladders``
+        (``NNS_TPU_BUCKET_LADDERS``, ini ``[ladders]``) so a steady-state
+        run compiles the refined ladder at warmup instead of re-learning
+        it.  Empty when ``adaptive_buckets`` is off."""
+        out: Dict[str, List[int]] = {}
+        for r in {id(r): r for r in self._runners.values()}.values():
+            lad = getattr(r.element, "_batch_ladder", None)
+            if lad is not None:
+                out[r.element.name] = lad.export()
+        return out
+
     def sample_queues(self) -> None:
         """One sampler tick: queue-depth / in-flight-window gauges per
         stage, staleness watermark per sink (seconds since last delivery).
@@ -1251,7 +1316,21 @@ class Pipeline:
 
 
 class _CapsFilter(Element):
-    """Pseudo-element for inline caps constraints (``video/x-raw,width=...``)."""
+    """Pseudo-element for inline caps constraints (``video/x-raw,width=...``).
+
+    A capsfilter is a negotiation-time CONSTRAINT, not a runtime
+    transform: once :meth:`configure` proved the intersection, every
+    buffer passes through untouched.  It therefore exposes the identity
+    as its :meth:`device_fn` — so the planner fuses straight THROUGH
+    dtype/shape pins instead of splitting the chain on them.  Before
+    this, the idiomatic quantized-boundary pin
+    (``transform ! other/tensors,types=uint8 ! tensor_filter``) left the
+    transform (and any decoder tail behind a post-filter pin) OUTSIDE
+    the fused filter dispatch: three stages, two queue hops, and the
+    quant row ran 0.2217 MFU against 0.247 for the identical fused graph
+    (BENCH_ALL_r5).  The fused identity costs nothing — XLA folds it
+    away — and bit-identity with the split path is pinned by tests.
+    """
 
     kind = "capsfilter"
 
@@ -1272,3 +1351,12 @@ class _CapsFilter(Element):
 
     def process(self, pad, buf):
         return [(SRC, buf)]
+
+    def device_fn(self, in_spec):
+        # Identity, provable at plan time: the constraint was enforced at
+        # negotiation, so inside a fused program this element is a no-op.
+        # The out spec is the MERGED caps' spec when one was negotiated
+        # (it may be more specific than upstream's), else the input spec.
+        caps = self.out_caps.get(SRC) if self.out_caps else None
+        spec = getattr(caps, "spec", None)
+        return (lambda arrays: arrays), (spec or in_spec)

@@ -102,6 +102,10 @@ _SERIES_META = {
 
 #: HELP text for histogram series, by raw-name suffix (fallback generic)
 _HIST_HELP = {
+    "batch_occupancy": "buffers drained per micro-batch dispatch "
+                       "(cumulative histogram; bucket bounds mirror the "
+                       "static ladder — the adaptive ladder refines from "
+                       "this same occupancy stream, docs/BATCHING.md)",
     "proc": "per-buffer stage process latency, seconds (histogram)",
     "invoke": "model invocation latency, seconds (histogram)",
     "push": "source push latency, seconds (histogram)",
@@ -161,11 +165,13 @@ def _tenant_label_values(raws) -> dict:
 
 
 def _hist_series(lines: list, name: str, counts, total, n,
-                 label: str = "") -> None:
+                 label: str = "", bounds=LATENCY_BUCKETS) -> None:
     """One histogram's sample lines; ``label`` is a pre-rendered
-    ``tenant="x",`` prefix for labeled twins (empty for the base)."""
+    ``tenant="x",`` prefix for labeled twins (empty for the base).
+    ``bounds`` defaults to the latency family's; bucketed value series
+    (occupancy) carry their own."""
     cum = 0
-    for bound, c in zip(LATENCY_BUCKETS, counts):
+    for bound, c in zip(bounds, counts):
         cum += c
         lines.append(f'{name}_bucket{{{label}le="{bound:g}"}} {cum}')
     cum += counts[-1]
@@ -183,11 +189,12 @@ def _render_histograms(lines: list) -> None:
     ``# HELP``/``# TYPE`` header, base sample first, then one sample set
     per tenant."""
     hists = metrics.histograms()
+    vhists = metrics.value_histograms()
     labeled = metrics.labeled_histograms()
     by_name: dict = {}
     for (raw, ten), h in labeled.items():
         by_name.setdefault(raw, {})[ten] = h
-    names = _dedup_prom_names(set(hists) | set(by_name))
+    names = _dedup_prom_names(set(hists) | set(by_name) | set(vhists))
     tlabels = _tenant_label_values({t for (_, t) in labeled})
     for raw in sorted(names):
         name = f"nnstpu_{names[raw]}"
@@ -196,6 +203,11 @@ def _render_histograms(lines: list) -> None:
         if raw in hists:
             counts, total, n = hists[raw]
             _hist_series(lines, name, counts, total, n)
+        if raw in vhists:
+            # bucketed value series (occupancy): own bounds, same
+            # cumulative _bucket/_sum/_count exposition family
+            bounds, counts, total, n = vhists[raw]
+            _hist_series(lines, name, counts, total, n, bounds=bounds)
         for ten in sorted(by_name.get(raw, ()),
                           key=lambda t: tlabels[t]):
             counts, total, n = by_name[raw][ten]

@@ -1,0 +1,293 @@
+"""Adaptive bucket ladder (ISSUE 10 tentpole, docs/BATCHING.md "Adaptive
+ladder").
+
+The contract: with ``adaptive_buckets=True`` a batchable stage refines its
+bucket ladder online from the drain occupancies it actually observes —
+persistent skew mints an exact bucket instead of padding to the next power
+of two — while every observable semantic (output values, ordering, pts)
+stays bit-identical to the static ladder, the mint budget keeps the
+deep-lint recompile census CLOSED, and a previous run's ladder snapshot
+warm-starts the refined ladder at construction.
+"""
+
+import numpy as np
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.pipeline.batching import (
+    AdaptiveLadder, BatchRunner, bucket_for, ladder, shard_bucket_for)
+from nnstreamer_tpu.pipeline.plan import (ADAPTIVE_EXTRA_DEFAULT,
+                                          adaptive_variant_budget)
+
+DESC = (
+    "appsrc name=src caps=other/tensors,dimensions=16,types=float32 ! "
+    "tensor_filter framework=jax model=scaler custom=scale:2.0,dims:16 "
+    "name=f ! tensor_sink name=out"
+)
+
+
+def _frames(n):
+    return [np.full((16,), float(i), np.float32) for i in range(n)]
+
+
+def _run(frames, **kw):
+    p = nt.Pipeline(DESC, **kw)
+    outs = []
+    with p:
+        for i, x in enumerate(frames):
+            p.push("src", nt.Buffer([x], pts=i))
+        for _ in frames:
+            outs.append(p.pull("out", timeout=60))
+        p.eos()
+        p.wait(timeout=60)
+    return outs, p
+
+
+# -- ladder primitives ------------------------------------------------------
+
+def test_mint_after_persistent_skew():
+    """An occupancy the ladder would pad, observed persistently, mints an
+    exact bucket; one-off shapes never do."""
+    lad = AdaptiveLadder((1, 2, 4, 8), budget=6, mint_after=4)
+    lad.observe(3)  # transient: below mint_after
+    assert lad.sizes() == (1, 2, 4, 8)
+    for _ in range(4):
+        lad.observe(6)
+    assert lad.sizes() == (1, 2, 4, 6, 8)
+    assert lad.bucket_for(5) == 6  # refined: no longer pads to 8
+    assert lad.bucket_for(6) == 6
+
+
+def test_exact_occupancies_never_mint():
+    lad = AdaptiveLadder((1, 2, 4, 8), budget=8, mint_after=1)
+    for n in (1, 2, 4, 8):
+        lad.observe(n)
+    assert lad.sizes() == (1, 2, 4, 8)
+
+
+def test_budget_clamps_minting():
+    """The ladder can NEVER grow past its budget — the census the deep
+    pass priced is a hard ceiling, not advisory."""
+    lad = AdaptiveLadder((1, 2, 4, 8), budget=5, mint_after=1)
+    lad.observe(6)
+    assert lad.sizes() == (1, 2, 4, 6, 8)
+    lad.observe(5)
+    lad.observe(3)
+    assert lad.sizes() == (1, 2, 4, 6, 8)  # budget 5: no room left
+
+
+def test_warm_start_pre_mints():
+    lad = AdaptiveLadder((1, 2, 4, 8), budget=8, warm=[6, 3])
+    assert lad.sizes() == (1, 2, 3, 4, 6, 8)
+    assert lad.export() == [1, 2, 3, 4, 6, 8]
+
+
+def test_sharded_rounding_still_applies():
+    """Minted sizes are replica-aligned, so shard_bucket_for's rounding
+    is a no-op on them — every replica still gets equal rows."""
+    lad = AdaptiveLadder((1, 2, 4, 8), budget=8, align=4, mint_after=1)
+    lad.observe(6)  # aligned up to 8: already a bucket, nothing minted
+    assert lad.sizes() == (1, 2, 4, 8)
+    lad = AdaptiveLadder((1, 2, 4, 16), budget=8, align=4, mint_after=1)
+    lad.observe(6)
+    assert 8 in lad.sizes()  # minted AS the aligned size
+    assert shard_bucket_for(6, 4, lad.sizes()) == 8
+
+
+def test_variant_budget_arithmetic():
+    """plan.adaptive_variant_budget: the single home shared by runtime
+    ladders and the deep census."""
+    assert adaptive_variant_budget(9, 1, 0) == 9 + ADAPTIVE_EXTRA_DEFAULT
+    assert adaptive_variant_budget(9, 2, 24) == 12
+    # squeezed below the base ladder: refinement off, census intact
+    assert adaptive_variant_budget(9, 4, 8) == 9
+
+
+# -- ladder-rounded fallback (the recompile-unbounded regression) -----------
+
+def test_bucket_for_above_top_is_ladder_rounded():
+    """batch_max above the ladder top used to mint one program PER
+    OCCUPANCY (the exact-size fallback); now sizes round to multiples of
+    the top bucket and the census enumerates exactly them."""
+    assert bucket_for(257) == 512
+    assert bucket_for(513) == 768
+    assert ladder(1000) == (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 768,
+                            1024)
+
+
+def test_runner_above_top_occupancies_share_rounded_programs():
+    """Two different above-top occupancies land in the SAME rounded
+    bucket -> one compiled program, not two."""
+    br = BatchRunner(lambda arrays: (arrays[0] * 2.0,), buckets=[2, 4])
+    rows5 = [(np.full((4,), float(i), np.float32),) for i in range(5)]
+    rows7 = [(np.full((4,), float(i), np.float32),) for i in range(7)]
+    out5 = br.run(rows5)
+    out7 = br.run(rows7)
+    assert len(out5) == 5 and len(out7) == 7
+    assert set(br._progs) == {8}  # both ladder-rounded to 2*top
+
+
+def test_deep_census_closed_above_ladder_top():
+    """The recompile-unbounded regression: batch_max=1000 must price a
+    FINITE census that exactly matches the runtime's rounded program set
+    (no recompile-unbounded, no per-occupancy blowup)."""
+    desc = ("appsrc name=src caps=other/tensors,dimensions=16,"
+            "types=float32,format=static ! "
+            "tensor_filter framework=jax model=scaler "
+            "custom=scale:2.0,dims:16 name=f ! tensor_sink name=out")
+    report = nt.analyze(desc, deep=True, batch_max=1000, data_parallel=1)
+    assert not report.errors, report.render()
+    assert not any(d.code == "recompile-unbounded" for d in report)
+    [stage] = [s for s in report.resources.stages if s.batchable]
+    assert stage.variants == len(ladder(1000))
+    assert report.resources.ladder == ladder(1000)
+
+
+# -- pipeline semantics -----------------------------------------------------
+
+def _push_bursts(p, burst, bursts):
+    """Drive a SKEWED steady state: bursts of ``burst`` same-spec buffers,
+    each pulled to completion before the next, so every drain observes
+    exactly ``burst`` rows (batch_linger collects the stragglers)."""
+    outs = []
+    k = 0
+    for _ in range(bursts):
+        for _ in range(burst):
+            p.push("src", nt.Buffer([np.full((16,), float(k), np.float32)],
+                                    pts=k))
+            k += 1
+        for _ in range(burst):
+            outs.append(p.pull("out", timeout=60))
+    return outs
+
+
+def test_skewed_occupancy_refines_and_cuts_pad_waste():
+    """A runner persistently draining 6 rows grows a 6-bucket: the ladder
+    snapshot shows the mint and steady-state pad-waste stops growing."""
+    metrics.reset()
+    # data_parallel=1: the conftest's 8 virtual devices would otherwise
+    # auto-shard the stage, and sharded minting aligns 6 up to the
+    # replica count (see test_sharded_rounding_still_applies)
+    p = nt.Pipeline(DESC, queue_capacity=32, batch_max=8,
+                    batch_linger_ms=60.0, adaptive_buckets=True,
+                    data_parallel=1)
+    with p:
+        _push_bursts(p, 6, 40)
+        snap_mid = metrics.snapshot().get("f.batch_pad_waste", 0.0)
+        assert 6 in p.element("f")._batch_ladder.sizes(), \
+            p.element("f")._batch_ladder.sizes()
+        _push_bursts(p, 6, 10)
+        snap_end = metrics.snapshot().get("f.batch_pad_waste", 0.0)
+        p.eos()
+        p.wait(timeout=60)
+    assert p.ladder_snapshot()["f"].count(6) == 1
+    # refined steady state: 6-drains stopped padding entirely
+    assert snap_end == snap_mid, (snap_mid, snap_end)
+    assert metrics.snapshot().get("f.ladder_minted", 0) >= 1
+
+
+def test_adaptive_bit_identical_to_static_ladder():
+    """Refinement changes WHICH bucket a drain pads to, never the math:
+    outputs byte-identical to the static ladder on identical input."""
+    frames = _frames(36)
+    a, _ = _run(frames, queue_capacity=48, batch_max=8,
+                adaptive_buckets=True, batch_linger_ms=5.0)
+    b, _ = _run(frames, queue_capacity=48, batch_max=8,
+                adaptive_buckets=False, batch_linger_ms=5.0)
+    for x, y in zip(a, b):
+        assert bytes(np.asarray(x.tensors[0])) == bytes(
+            np.asarray(y.tensors[0]))
+        assert x.pts == y.pts
+
+
+def test_warm_started_pipeline_compiles_refined_ladder():
+    """A ladder snapshot fed back via bucket_ladders= pre-mints at
+    construction — the first 6-drain already has its exact bucket (zero
+    pad waste at that occupancy from buffer one)."""
+    metrics.reset()
+    p = nt.Pipeline(DESC, queue_capacity=32, batch_max=8,
+                    batch_linger_ms=60.0, adaptive_buckets=True,
+                    data_parallel=1,
+                    bucket_ladders={"f": [1, 2, 4, 6, 8]})
+    with p:
+        assert p.element("f")._batch_ladder.sizes() == (1, 2, 4, 6, 8)
+        _push_bursts(p, 6, 3)
+        p.eos()
+        p.wait(timeout=60)
+    occ = metrics.snapshot().get("f.batch_occupancy.p99", 0)
+    waste = metrics.snapshot().get("f.batch_pad_waste", 0.0)
+    if occ >= 6.0:  # drains actually coalesced to the skewed size
+        assert waste == 0.0, waste
+
+
+def test_occupancy_histogram_in_prometheus_text():
+    """The occupancy series renders as a REAL cumulative histogram
+    (_bucket{le=}) in ladder-shaped buckets — the same exposition family
+    as the PR 5 latency histograms, fed by the same stream the adaptive
+    ladder refines from."""
+    from nnstreamer_tpu.utils.profiler import metrics_text
+
+    metrics.reset()
+    frames = _frames(24)
+    _run(frames, queue_capacity=32, batch_max=8)
+    text = metrics_text()
+    assert 'nnstpu_f_batch_occupancy_bucket{le="8"}' in text
+    assert 'nnstpu_f_batch_occupancy_bucket{le="+Inf"}' in text
+    assert "nnstpu_f_batch_occupancy_count" in text
+
+
+def test_deep_census_prices_adaptive_budget():
+    """With adaptive on, the deep pass prices every batchable stage at
+    its full mint budget — the worst case the runtime can compile — and
+    the report says so."""
+    desc = ("appsrc name=src caps=other/tensors,dimensions=16,"
+            "types=float32,format=static ! "
+            "tensor_filter framework=jax model=scaler "
+            "custom=scale:2.0,dims:16 name=f ! tensor_sink name=out")
+    r = nt.analyze(desc, deep=True, batch_max=8, adaptive_buckets=True,
+                   max_compiled_variants=10)
+    assert not r.errors, r.render()
+    [stage] = [s for s in r.resources.stages if s.batchable]
+    base = len(ladder(8))
+    assert stage.variants == adaptive_variant_budget(base, 1, 10)
+    assert r.resources.compiled_variants <= 10
+    assert r.resources.adaptive_buckets
+    assert "adaptive" in r.resources.render()
+    # and the budget is EXACTLY what the runtime would hand the stage
+    from nnstreamer_tpu.core.config import get_config
+
+    p = nt.Pipeline(desc, batch_max=8, adaptive_buckets=True)
+    assert p._ladder_budget == adaptive_variant_budget(
+        base, 1, get_config().max_compiled_variants)
+
+
+def test_align_assignment_reruns_warm_mints():
+    """Warm-start sizes are minted before the mesh exists (align=1); the
+    runtime assigns the real data width at start() — assigning align must
+    RE-ROUND already-minted sizes so a dp=1 snapshot warm-started into a
+    sharded deployment never leaves an undispatchable entry burning a
+    budget slot."""
+    lad = AdaptiveLadder((1, 2, 4, 8, 16), budget=8, warm=[6, 10])
+    assert lad.sizes() == (1, 2, 4, 6, 8, 10, 16)
+    lad.align = 4
+    # 6 -> 8 (dedups into base), 10 -> 12: the freed slot is mintable again
+    assert lad.sizes() == (1, 2, 4, 8, 12, 16)
+    for _ in range(AdaptiveLadder((1,), budget=0).mint_after):
+        lad.observe(5)  # aligned -> 8: already a bucket, nothing minted
+    assert lad.sizes() == (1, 2, 4, 8, 12, 16)
+
+
+def test_ini_ladders_preserve_stage_name_case(tmp_path, monkeypatch):
+    """[ladders] stage keys are case-sensitive (ladder_snapshot() exports
+    element names verbatim) — the ini reader must not lowercase them or
+    the warm-start lookup silently misses."""
+    from nnstreamer_tpu.core.config import Config, parse_ladders
+
+    ini = tmp_path / "nns.ini"
+    ini.write_text("[ladders]\nMyFilter = 1,2,6\nsrc+t+F = 1,4\n")
+    monkeypatch.setenv("NNS_TPU_CONF", str(ini))
+    monkeypatch.delenv("NNS_TPU_BUCKET_LADDERS", raising=False)
+    cfg = Config.load()
+    assert cfg.bucket_ladders == {"MyFilter": [1, 2, 6], "src+t+F": [1, 4]}
+    # env path already preserved case; the two must agree
+    assert parse_ladders("MyFilter:1|2|6") == {"MyFilter": [1, 2, 6]}

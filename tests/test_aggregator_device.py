@@ -1,0 +1,196 @@
+"""Device-resident tensor_aggregator state (ISSUE 10 tentpole,
+docs/ARCHITECTURE.md "Streaming state").
+
+The contract: with ``device=true`` the window carry lives as an HBM ring
+written in-program (roll + dynamic-update-slice at a traced offset), so
+
+* window outputs are BIT-IDENTICAL to the host concatenate path;
+* exactly 3 programs compile for the stage's lifetime and window advances
+  never recompile (occupancy/offset are values, not shapes);
+* nothing crosses to host between window dispatches (transfer trap, the
+  PR 7 zero-d2h technique) — ``aggregator ! tensor_filter`` chains hand
+  windows filter-ward as device arrays;
+* EOS drops partial windows exactly like the host path (and frees the
+  ring).
+"""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.elements.aggregator import TensorAggregator
+from nnstreamer_tpu.elements.base import ElementError
+
+#: 12 x 4000-sample device-generated audio buffers -> 16000-sample windows
+#: advancing by 4000 (75% overlap): 9 complete windows
+DESC = ("audiotestsrc device=true num-buffers=12 samplesperbuffer=4000 "
+        "rate=16000 freq=880 name=src ! "
+        "tensor_aggregator frames_in=4000 frames_out=16000 "
+        "frames_flush=4000 frames_dim=0 name=agg {dev}! "
+        "tensor_sink name=out{sink}")
+N_WINDOWS = 9
+
+
+def _run(dev="", sink="", n=N_WINDOWS, **kw):
+    p = nt.Pipeline(DESC.format(dev=dev, sink=sink), **kw)
+    outs = []
+    with p:
+        for _ in range(n):
+            outs.append(p.pull("out", timeout=120))
+        p.wait(timeout=60)
+    return outs, p
+
+
+# -- bit-identity -----------------------------------------------------------
+
+def test_device_windows_bit_identical_to_host():
+    """Overlapping windows, device ring vs host concatenate: pure data
+    movement, so the bytes must match exactly."""
+    host, _ = _run("")
+    dev, _ = _run("device=true ")
+    assert len(host) == len(dev) == N_WINDOWS
+    for h, d in zip(host, dev):
+        xh, xd = np.asarray(h.tensors[0]), np.asarray(d.tensors[0])
+        assert xh.shape == xd.shape == (1, 16000)
+        assert bytes(xh) == bytes(xd)
+        assert h.pts == d.pts
+
+
+def test_non_overlapping_windows_bit_identical():
+    desc = ("audiotestsrc device=true num-buffers=8 samplesperbuffer=1000 "
+            "rate=16000 name=src ! "
+            "tensor_aggregator frames_in=1000 frames_out=4000 "
+            "frames_flush=4000 frames_dim=0 name=agg {dev}! "
+            "tensor_sink name=out")
+
+    def run(dev):
+        p = nt.Pipeline(desc.format(dev=dev))
+        with p:
+            outs = [p.pull("out", timeout=60) for _ in range(2)]
+            p.wait(timeout=60)
+        return outs
+
+    for h, d in zip(run(""), run("device=true ")):
+        assert bytes(np.asarray(h.tensors[0])) == bytes(
+            np.asarray(d.tensors[0]))
+
+
+# -- the 3-program zero-recompile pin ---------------------------------------
+
+def test_zero_recompile_across_window_advances():
+    """Once the ring programs are warm, pushing more buffers and emitting
+    more windows must compile NOTHING: the write offset and the valid
+    watermark are program VALUES."""
+    el = TensorAggregator({"frames_in": 100, "frames_out": 400,
+                           "frames_flush": 100, "frames_dim": 0,
+                           "device": "true"}, name="agg")
+    rng = np.random.default_rng(7)
+
+    def push(i):
+        return el.process("sink", Buffer(
+            [rng.standard_normal((1, 100)).astype(np.float32)], pts=i))
+
+    outs = [push(i) for i in range(6)]  # warm: ring init + append + window
+    assert el._progs is not None and len(el._progs) == 3
+    warm = {k: fn._cache_size() for k, fn in el._progs.items()}
+    assert warm == {"init": 1, "append": 1, "window": 1}
+    outs += [push(i) for i in range(6, 40)]  # many advances, varied phase
+    after = {k: fn._cache_size() for k, fn in el._progs.items()}
+    assert after == warm, f"recompile on window advance: {warm} -> {after}"
+    assert sum(len(o) for o in outs) == 37  # (40*100 - 400)/100 + 1
+
+
+# -- zero d2h between window dispatches -------------------------------------
+
+def test_aggregator_chain_zero_d2h(monkeypatch):
+    """From the device source through the ring to a to_host=false sink,
+    NOTHING may cross to host: the fetch chokepoints are trapped (the
+    PR 7 technique) and every delivered window is still a device array."""
+    def trap(self):
+        raise AssertionError("D2H on the aggregator's device-resident path")
+
+    monkeypatch.setattr(Buffer, "to_host", trap)
+    monkeypatch.setattr(Buffer, "resolve", trap)
+    outs, p = _run("device=true ", sink=" to_host=false")
+    assert all(o.on_device for o in outs)
+    # and the planner knew: the agg -> sink edge aside, agg's downstream
+    # edges count device-resident in the residency plan
+    desc = DESC.format(dev="device=true ", sink="")
+    p2 = nt.Pipeline(
+        desc.replace("tensor_sink name=out",
+                     "tensor_filter framework=jax model=speech_commands "
+                     "custom=dtype:float32 name=f ! tensor_sink name=out"))
+    assert p2.residency.resident_edges >= 1
+
+
+def test_windows_flow_into_filter_unchanged():
+    """aggregator(device) ! tensor_filter end to end: same scores as the
+    host aggregator feeding the same filter."""
+    tail = (" ! tensor_filter framework=jax model=speech_commands "
+            "custom=dtype:float32 name=f")
+    desc = DESC.replace("! tensor_sink", tail + " ! tensor_sink")
+
+    def run(dev):
+        p = nt.Pipeline(desc.format(dev=dev, sink=""))
+        with p:
+            outs = [p.pull("out", timeout=120) for _ in range(N_WINDOWS)]
+            p.wait(timeout=60)
+        return outs
+
+    for h, d in zip(run(""), run("device=true ")):
+        np.testing.assert_array_equal(np.asarray(h.tensors[0]),
+                                      np.asarray(d.tensors[0]))
+
+
+# -- EOS / lifecycle --------------------------------------------------------
+
+def test_eos_partial_window_flushes_like_host():
+    """A stream shorter than one window: both paths drop the partial at
+    EOS (no output, clean completion), and the device path frees its
+    ring."""
+    desc = ("audiotestsrc device=true num-buffers=2 samplesperbuffer=1000 "
+            "rate=16000 name=src ! "
+            "tensor_aggregator frames_in=1000 frames_out=4000 "
+            "frames_flush=4000 frames_dim=0 name=agg {dev}! "
+            "tensor_sink name=out")
+    for dev in ("", "device=true "):
+        p = nt.Pipeline(desc.format(dev=dev))
+        with p:
+            p.wait(timeout=60)
+        agg = p.element("agg")
+        assert agg._window is None and agg._ring is None
+        with pytest.raises(Exception):
+            p.pull("out", timeout=0.2)
+
+
+def test_device_mode_rejects_multi_tensor_windows():
+    with pytest.raises(ElementError):
+        TensorAggregator({"device": "true", "concat": "false"}, name="agg")
+
+
+# -- analysis stays truthful ------------------------------------------------
+
+def test_deep_lint_prices_ring_bytes():
+    """The deep pass prices the HBM ring (frames_out + frames_in frames)
+    and the fixed 3-program census for a device-mode aggregator."""
+    desc = DESC.format(dev="device=true ", sink="")
+    r = nt.analyze(desc, deep=True)
+    assert not r.errors, r.render()
+    [agg] = [s for s in r.resources.stages if s.label.startswith("agg")]
+    # (16000 + 4000) samples x f32, batch 1
+    assert agg.ring_bytes == (16000 + 4000) * 4
+    assert agg.variants == 3
+    assert "agg ring" in r.resources.render()
+    # ring bytes land in the HBM high-water estimate (budgetable)
+    assert agg.hbm_bytes >= agg.ring_bytes
+
+
+def test_deep_lint_flags_flexible_upstream():
+    """device=true behind a flexible stream cannot pin its ring shape:
+    the census flags it instead of silently mispricing."""
+    desc = ("appsrc name=src ! "
+            "tensor_aggregator frames_in=1 frames_out=4 device=true "
+            "name=agg ! tensor_sink name=out")
+    r = nt.analyze(desc, deep=True)
+    assert any(d.code == "recompile-unbounded" for d in r)

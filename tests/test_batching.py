@@ -50,7 +50,12 @@ def test_bucket_for_ladder():
     assert bucket_for(8) == 8
     assert bucket_for(9) == 16
     assert bucket_for(5, [2, 6]) == 6
-    assert bucket_for(7, [2, 6]) == 7  # above the ladder: exact
+    # above the ladder: LADDER-ROUNDED to a multiple of the top bucket —
+    # an exact fallback minted one program per occupancy (the
+    # recompile-unbounded regression, tests/test_adaptive_batching.py)
+    assert bucket_for(7, [2, 6]) == 12
+    assert bucket_for(300) == 512
+    assert bucket_for(1000) == 1024
 
 
 def test_stack_split_roundtrip(rng):

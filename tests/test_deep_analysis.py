@@ -486,10 +486,11 @@ def test_bucket_ladder():
     assert ladder(8) == (1, 2, 4, 8)
     assert ladder(6) == (1, 2, 4, 8)  # bucket_for(6) tops the ladder
     assert ladder(3, buckets=[2, 4]) == (2, 4)
-    # the runtime CLAMPS batch_max to the ladder top — the census must
-    # never model a dispatch size the runner cannot produce
-    assert ladder(500) == (1, 2, 4, 8, 16, 32, 64, 128, 256)
-    assert ladder(9, buckets=[2, 4]) == (2, 4)
+    # above the top bucket the runtime LADDER-ROUNDS (multiples of the
+    # top) instead of clamping the drain — the census models exactly the
+    # rounded sizes the runner can now produce, still bounded
+    assert ladder(500) == (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+    assert ladder(9, buckets=[2, 4]) == (2, 4, 8, 12)
 
 
 def test_data_parallel_over_local_devices_is_an_error():

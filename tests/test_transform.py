@@ -129,3 +129,53 @@ class TestGolden:
     def test_unknown_mode(self):
         with pytest.raises(Exception):
             TensorTransform({"mode": "nope"})
+
+
+class TestSaturatingCast:
+    """Float -> integer typecasts SATURATE identically on the host and
+    fused (device) paths (ISSUE 10): raw astype diverged — numpy wraps
+    out-of-range values where XLA clamps — and with the planner fusing
+    typecast transforms across dtype-quantized caps pins, the same graph
+    must emit the same bytes wherever the cast runs."""
+
+    CASES = [
+        ("uint8", np.array([-1.5, 0.4, 255.0, 300.2, 99.9], np.float32),
+         [0, 0, 255, 255, 99]),
+        ("int8", np.array([-200.0, -128.9, 127.2, 500.0], np.float32),
+         [-128, -128, 127, 127]),
+        ("int16", np.array([-4e4, 4e4, 123.7], np.float32),
+         [-32768, 32767, 123]),
+        ("int32", np.array([-3e9, 3e9, 7.9], np.float32),
+         [-2147483648, 2147483647, 7]),
+    ]
+
+    @pytest.mark.parametrize("dtype,arr,want", CASES)
+    def test_host_saturates(self, dtype, arr, want):
+        np.testing.assert_array_equal(run("typecast", dtype, arr), want)
+
+    @pytest.mark.parametrize("dtype,arr,want", CASES)
+    def test_device_matches_host_bitwise(self, dtype, arr, want):
+        host = run("typecast", dtype, arr)
+        dev = run_device("typecast", dtype, arr)
+        assert bytes(host) == bytes(dev)
+        np.testing.assert_array_equal(dev, want)
+
+    def test_arith_requantize_tail_saturates_both_paths(self):
+        """The quant-boundary shape: normalize in float, requantize to
+        uint8 at the tail — fused and host bytes must match even when
+        the float math leaves the u8 range."""
+        arr = np.linspace(-80, 80, 33, dtype=np.float32)
+        opt = "mul:4.0,add:128.0,typecast:uint8"
+        host = run("arithmetic", opt, arr)
+        dev = run_device("arithmetic", opt, arr)
+        assert host.dtype == np.uint8
+        assert bytes(host) == bytes(dev)
+        assert host.min() == 0 and host.max() == 255  # saturated, no wrap
+
+    def test_int_to_int_and_float_to_float_unchanged(self):
+        a = np.array([300, -5, 7], np.int32)
+        np.testing.assert_array_equal(
+            run("typecast", "uint8", a), a.astype(np.uint8))  # wraps: not a float boundary
+        f = np.array([1.5, -2.5], np.float64)
+        np.testing.assert_array_equal(
+            run("typecast", "float32", f), f.astype(np.float32))

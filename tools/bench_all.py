@@ -30,6 +30,14 @@ ROWS = [
     # backend-agnostic: the micro-batching speedup row measures dispatch
     # amortization, meaningful on CPU and TPU alike
     ("adaptive_batching", ["--config", "batching"]),
+    # adaptive bucket ladder A/B (ISSUE 10): skewed-occupancy backlog,
+    # static powers-of-two ladder vs online-refined ladder (pad-waste
+    # counters + refined-ladder snapshot ride the row)
+    ("adaptive_ladder_ab", ["--config", "adaptive"]),
+    # windowed streaming ASR (ISSUE 10): host tensor_aggregator (one
+    # d2h+concat+h2d round trip per window) vs the device-resident HBM
+    # ring (zero d2h between window dispatches, 3-program census)
+    ("asr_streaming_window", ["--config", "asr_stream"]),
     ("classification", ["--config", "classification"]),
     ("classification_quant", ["--config", "classification_quant"]),
     ("classification_appsrc", ["--config", "classification",

@@ -65,6 +65,17 @@ and the flight-recorder ring dump must ride the report.  The report
 schema is asserted field-by-field — the shape BENCH_SOAK rows and
 ``Pipeline.slo_report()`` consumers depend on.
 
+AND it runs the MXU gate (ISSUE 10, docs/BATCHING.md "Adaptive ladder" +
+docs/ARCHITECTURE.md "Streaming state"): tests/test_adaptive_batching.py
+and tests/test_aggregator_device.py each as their OWN pytest process
+(ladder refinement/budget/warm-start/bit-identity + the ladder-rounded
+recompile-unbounded regression; aggregator device-vs-host bit-identity,
+3-program zero-recompile pin, zero-d2h transfer trap, EOS flush), then
+``lint --deep`` over examples/asr_streaming_window.py with
+``NNS_TPU_HBM_BUDGET`` pinned below the estimate — the resource report
+must PRICE the aggregator ring ("agg ring" bytes + the 3-program census)
+— strict against tools/asr_deep_baseline.txt.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -90,6 +101,13 @@ LINT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
 DEEP_BASELINE = os.path.join(REPO, "tools", "deep_baseline.txt")
 SERVING_BASELINE = os.path.join(REPO, "tools", "serving_deep_baseline.txt")
 FETCH_BASELINE = os.path.join(REPO, "tools", "fetch_deep_baseline.txt")
+ASR_BASELINE = os.path.join(REPO, "tools", "asr_deep_baseline.txt")
+
+#: HBM budget the MXU gate pins for the streaming-ASR example's deep
+#: lint: below the estimate, so the hbm-budget warning fires with the
+#: aggregator ring priced INSIDE the estimate — proving ring bytes feed
+#: Config.hbm_budget_bytes, not just the report text.
+ASR_GATE_BUDGET = str(1 << 16)
 
 #: calibrated link the fetch gate pins for the deliberately fetch-bound
 #: example (the BENCH_ALL_r5 ``link_calibration`` row: 38.2 MB/s d2h,
@@ -302,6 +320,60 @@ def run_tracing_gate(timeout: int = 600) -> int:
     return proc.returncode
 
 
+def run_mxu_gate(update: bool, timeout: int = 900) -> int:
+    """MXU-feeding gate (ISSUE 10, see module docstring): the adaptive
+    ladder and device-aggregator test files each as their own pytest
+    process, then ``lint --deep`` over the streaming-ASR example with a
+    sub-estimate HBM budget pinned — the report must price the
+    aggregator ring, strict against tools/asr_deep_baseline.txt."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    passed = 0
+    for test_file in ("tests/test_adaptive_batching.py",
+                      "tests/test_aggregator_device.py"):
+        cmd = [sys.executable, "-m", "pytest", test_file, "-q",
+               "-p", "no:cacheprovider", "-p", "no:xdist",
+               "-p", "no:randomly"]
+        try:
+            proc = subprocess.run(cmd, cwd=REPO, env=env,
+                                  capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print(f"mxu gate: {test_file} TIMED OUT after {timeout}s",
+                  file=sys.stderr)
+            return 2
+        passed += count_dots(proc.stdout)
+        if proc.returncode != 0:
+            print(f"mxu gate: {test_file} FAILED ({passed} passed)")
+            for line in proc.stdout.strip().splitlines()[-15:]:
+                print(f"  {line}", file=sys.stderr)
+            return proc.returncode
+
+    env["NNS_TPU_HBM_BUDGET"] = ASR_GATE_BUDGET
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--deep", "-v", "--strict",
+           "--files", os.path.join("examples", "asr_streaming_window.py"),
+           "--baseline", ASR_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("mxu gate: deep lint TIMED OUT after 300s", file=sys.stderr)
+        return 2
+    priced = "agg ring" in lint.stdout
+    ok = lint.returncode == 0 and priced
+    tag = ("updated" if update else
+           "OK" if ok else
+           "RING NOT PRICED" if not priced else "NEW DIAGNOSTICS")
+    print(f"mxu gate: {tag} ({passed} tests passed)")
+    if not ok and not update:
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_serving_gate(update: bool, timeout: int = 900) -> int:
     """Continuous-serving gate (see module docstring): the paged-KV test
     file as its own pytest process (compile-counter pin included), then
@@ -502,11 +574,12 @@ def main() -> int:
     sharded_rc = run_sharded_gate()
     mesh_rc = run_mesh_gate()
     tracing_rc = run_tracing_gate()
+    mxu_rc = run_mxu_gate(args.update)
     serving_rc = run_serving_gate(args.update)
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
-               or serving_rc or fetch_rc or soak_rc)
+               or mxu_rc or serving_rc or fetch_rc or soak_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
