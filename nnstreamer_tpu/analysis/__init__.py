@@ -55,6 +55,7 @@ def analyze(
     dispatch_depth: Optional[int] = None,
     hbm_budget_bytes: Optional[int] = None,
     max_compiled_variants: Optional[int] = None,
+    reconfig: Optional[dict] = None,
 ) -> Report:
     """Run the static passes; always returns a :class:`Report` (a syntax
     error becomes a single ``parse-error`` diagnostic rather than an
@@ -119,6 +120,7 @@ def analyze(
                 dispatch_depth=dispatch_depth,
                 hbm_budget_bytes=hbm_budget_bytes,
                 max_compiled_variants=max_compiled_variants,
+                reconfig=reconfig,
                 out_caps=caps_state.get("out_caps"))
             report.extend(ddiags)
             report.resources = resources

@@ -227,6 +227,7 @@ def deep_check(
     max_compiled_variants: Optional[int] = None,
     link_d2h_mbps: Optional[float] = None,
     link_rtt_ms: Optional[float] = None,
+    reconfig: Optional[Dict] = None,
     out_caps: Optional[Dict] = None,
 ) -> Tuple[List[Diagnostic], ResourceReport]:
     """Run the deep pass over a parsed graph.  Knobs default to the global
@@ -306,6 +307,8 @@ def deep_check(
     report.stages.extend(serving_stages)
     report.link_d2h_mbps = d2h_mbps
     report.link_rtt_ms = rtt_ms
+    if reconfig:
+        diags.extend(_reconfig_check(graph, reconfig))
     diags.extend(_fetch_check(graph, traces, out_caps, report))
     for t in traces.values():
         # Throwaway trace elements may hold real checkpoints (configure()
@@ -343,6 +346,60 @@ def deep_check(
 
 #: tensor_filter ``framework=`` names that resolve to the llm framework
 _LLM_FRAMEWORKS = ("llm", "llamacpp", "llama.cpp")
+
+
+def _reconfig_check(graph, reconfig: Dict) -> List[Diagnostic]:
+    """``recompile-on-reconfig``: given a proposed runtime config change
+    (``analyze(..., reconfig={"slots": 8})`` / ``lint --reconfig``),
+    warn for every continuous-serving knob whose change would actually
+    change a COMPILED program signature — the table lives in
+    ``utils/elastic.SERVE_KNOB_SIGNATURE`` (slots is the decode
+    program's row count, kv_blocks the pool's static shape, temperature
+    a compiled-in sampler constant, ...).  Host-value knobs (max_new,
+    prefill_budget, quotas, timeouts) pass silently: they are safe to
+    mutate on a running loop.  The remediation for a flagged knob is the
+    elastic drain path: ``Pipeline.drain_stream()`` every live stream →
+    restart with the new (versioned) config → ``adopt_stream()`` —
+    docs/SERVING.md "Elastic serving"."""
+    from ..filters.base import parse_custom_options
+    from ..utils.elastic import SERVE_KNOB_SIGNATURE, signature_changes
+
+    diags: List[Diagnostic] = []
+    first_serving = None
+    for node in graph.nodes.values():
+        if node.kind != "tensor_filter":
+            continue
+        if str(node.props.get("framework", "")).lower() \
+                not in _LLM_FRAMEWORKS:
+            continue
+        opts = parse_custom_options(str(node.props.get("custom", "")))
+        if str(opts.get("serve", "")).lower() != "continuous":
+            continue
+        if first_serving is None:
+            first_serving = node
+        for knob, old, new in signature_changes(opts, reconfig):
+            diags.append(Diagnostic(
+                "recompile-on-reconfig", WARNING,
+                f"changing {knob}: "
+                f"{'<default>' if old is None else old} -> {new} changes "
+                "a compiled program signature (the standing loop's "
+                "census is static in it) — a live mutation would "
+                "recompile mid-serve; apply it behind a drain instead: "
+                "Pipeline.drain_stream() each live stream, restart with "
+                "the versioned config, adopt_stream() them back "
+                "(docs/SERVING.md 'Elastic serving')",
+                path=node_label(node), pos=node.pos))
+    # node-independent: one finding per run, not one per serving filter
+    unknown = [k for k in reconfig if k not in SERVE_KNOB_SIGNATURE]
+    if unknown and first_serving is not None:
+        diags.append(Diagnostic(
+            "recompile-on-reconfig", WARNING,
+            f"reconfig knob(s) {sorted(unknown)} are not in the "
+            "documented runtime-mutable table "
+            "(utils/elastic.SERVE_KNOB_SIGNATURE) — signature "
+            "impact unknown, treat as recompile-requiring",
+            path=node_label(first_serving), pos=first_serving.pos))
+    return diags
 
 
 def _llm_serving_stage(node, diags, model_par: int = 1):

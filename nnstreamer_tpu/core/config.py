@@ -38,6 +38,7 @@ _ENV_DONATE_INGRESS = "NNS_TPU_DONATE_INGRESS"
 _ENV_REDUCE_OUTPUTS = "NNS_TPU_REDUCE_OUTPUTS"
 _ENV_LINK_D2H_MBPS = "NNS_TPU_LINK_D2H_MBPS"
 _ENV_LINK_RTT_MS = "NNS_TPU_LINK_RTT_MS"
+_ENV_STAGE_RESTARTS = "NNS_TPU_MAX_STAGE_RESTARTS"
 
 
 @dataclasses.dataclass
@@ -135,6 +136,13 @@ class Config:
     #: signatures (buckets x spec variants across device stages) before
     #: the deep pass warns of a recompile storm (0 = no budget)
     max_compiled_variants: int = 0
+    #: elastic stage restarts (docs/SERVING.md "Elastic serving"): how
+    #: many times a PURE/STATELESS stage's runner thread may be
+    #: restarted in place after an exception before the pipeline fails
+    #: for real (with the flight-recorder ring dumped).  0 = off (the
+    #: pre-elastic fail-fast behavior); restarts are counted in
+    #: ``<stage>.restarts``.
+    max_stage_restarts: int = 0
     #: flight-recorder trace mode (utils/tracing.py, docs/OBSERVABILITY.md):
     #: ``off`` = no recorder installed (hot paths pay one pointer check),
     #: ``ring`` = always-on bounded ring of span events (post-mortem mode;
@@ -213,6 +221,9 @@ class Config:
             if ini.has_option("common", "link_fetch_rtt_ms"):
                 cfg.link_fetch_rtt_ms = ini.getfloat(
                     "common", "link_fetch_rtt_ms")
+            if ini.has_option("common", "max_stage_restarts"):
+                cfg.max_stage_restarts = ini.getint(
+                    "common", "max_stage_restarts")
             if ini.has_option("common", "trace_mode"):
                 cfg.trace_mode = ini.get("common",
                                          "trace_mode").strip().lower()
@@ -250,6 +261,8 @@ class Config:
             cfg.link_d2h_mbps = float(os.environ[_ENV_LINK_D2H_MBPS])
         if os.environ.get(_ENV_LINK_RTT_MS):
             cfg.link_fetch_rtt_ms = float(os.environ[_ENV_LINK_RTT_MS])
+        if os.environ.get(_ENV_STAGE_RESTARTS):
+            cfg.max_stage_restarts = int(os.environ[_ENV_STAGE_RESTARTS])
         if os.environ.get(_ENV_TRACE):
             cfg.trace_mode = os.environ[_ENV_TRACE].strip().lower()
         if os.environ.get(_ENV_TRACE_RING):

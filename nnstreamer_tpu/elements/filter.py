@@ -450,6 +450,47 @@ class TensorFilter(Element):
         out.meta = dict(meta)
         emit([(SRC, out)])
 
+    # -- elastic serving (docs/SERVING.md "Elastic serving") ---------------
+    def serve_streams(self) -> Dict[int, dict]:
+        """Live/queued continuous-serving streams of this element's
+        framework (empty for non-continuous filters)."""
+        fw = self.fw
+        if fw is None or not getattr(fw, "continuous", False):
+            return {}
+        return fw.serve_streams()
+
+    def drain_serve_stream(self, stream_id: int,
+                           timeout: float = 30.0) -> dict:
+        """Serialize one live stream off the standing serve loop (its KV
+        blocks + slot state become a host snapshot; the slot frees) —
+        the :meth:`Pipeline.drain_stream` element hop."""
+        with self._fw_lock:
+            fw = self._ensure_fw()
+        if not getattr(fw, "continuous", False):
+            raise ElementError(
+                f"{self.name}: not a continuous-serving filter")
+        return fw.drain_stream(stream_id, timeout)
+
+    def adopt_serve_stream(self, snapshot: dict,
+                           timeout: float = 30.0) -> int:
+        """Re-admit a drained stream into THIS element's serve loop;
+        remaining tokens flow downstream exactly like locally admitted
+        streams (same async-emit path, the serve meta wins)."""
+        import functools as _ft
+
+        with self._fw_lock:
+            fw = self._ensure_fw()
+        if not getattr(fw, "continuous", False):
+            raise ElementError(
+                f"{self.name}: not a continuous-serving filter")
+        fw._trace_rec = getattr(self, "_trace_rec", None)
+        prompt = snapshot.get("prompt")
+        src_buf = Buffer([np.asarray(prompt, np.int32) if prompt
+                          is not None else np.zeros((1, 0), np.int32)])
+        return fw.adopt_stream(
+            snapshot, _ft.partial(self._emit_serve_token, src_buf),
+            timeout)
+
     def finalize(self):
         fw = self.fw
         if fw is not None and getattr(fw, "continuous", False):

@@ -26,6 +26,7 @@ Protocol (all frames length-prefixed, utils/wire.read_frame/write_frame):
 from __future__ import annotations
 
 import queue as _queue
+import random
 import socket
 import threading
 import time
@@ -37,7 +38,7 @@ from ..core.buffer import Buffer, Event
 from ..core.caps import Caps
 from ..core.log import logger, metrics
 from ..core.registry import register_element
-from ..utils import wire
+from ..utils import elastic, wire
 from ..utils.net import TcpListener, client_handshake, server_handshake
 from .base import Element, ElementError, SourceElement, SinkElement, SRC
 
@@ -90,10 +91,21 @@ class _ServerCore:
 
     def __init__(self, host: str, port: int, topic: str = "",
                  max_backlog: int = 256, admission: str = "block",
-                 on_admit_event=None):
+                 on_admit_event=None, send_buf: int = 0):
         self.topic = topic
         self.admission = admission
         self.max_backlog = max_backlog
+        #: per-tenant admission OVERRIDE (tenant -> "shed"|"downgrade"):
+        #: the autoscaler's host-value lever (utils/elastic.Autoscaler
+        #: ``admission:`` action) — a burning tenant class can be
+        #: flipped to shed while everyone else keeps the configured
+        #: policy, and flipped back when its burn rate recovers
+        self.tenant_admission: Dict[str, str] = {}
+        #: per-connection SO_SNDBUF (0 = OS default).  Bounds how much
+        #: of a wedged client's unread response stream the kernel
+        #: absorbs before sends hit the socket timeout and the
+        #: connection is dropped (the wedge_tenant chaos profile).
+        self.send_buf = int(send_buf)
         self.inbound: _queue.Queue = _queue.Queue(maxsize=max_backlog)
         self.lowprio: _queue.Queue = _queue.Queue(maxsize=max_backlog)
         #: serversrc hook: called as (kind, buf, backlog) for every
@@ -118,6 +130,12 @@ class _ServerCore:
             log.warning("query: connection rejected at handshake")
             return
         conn.settimeout(0.2)
+        if self.send_buf > 0:
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                self.send_buf)
+            except OSError:
+                pass
         conn_tenant = str(hello.get("tenant", "") or "") or None
         with self._lock:
             cid = self._next_conn
@@ -136,6 +154,11 @@ class _ServerCore:
                     return
                 buf, _flags = wire.decode_buffer(raw)
                 buf.meta[_META_CONN] = cid
+                # stream ids are SERVER-minted (filters/llm.py submit
+                # overwrites them): a client-supplied value would let one
+                # tenant cancel another's live stream through the
+                # dead-connection backchannel
+                buf.meta.pop(elastic.META_STREAM_ID, None)
                 if conn_tenant is not None:
                     # per-frame meta wins; the hello tenant is the
                     # per-connection fallback
@@ -151,7 +174,13 @@ class _ServerCore:
         return self.inbound.qsize() + self.lowprio.qsize()
 
     def _admit(self, buf: Buffer) -> None:
-        if self.admission == "block":
+        # per-tenant override first (the autoscaler's admission action),
+        # then the element-configured policy
+        policy = self.admission
+        tenant = buf.meta.get(_META_TENANT)
+        if tenant is not None and self.tenant_admission:
+            policy = self.tenant_admission.get(tenant, policy)
+        if policy == "block":
             while not self._stopping.is_set():
                 try:
                     self.inbound.put(buf, timeout=0.1)
@@ -163,7 +192,7 @@ class _ServerCore:
         try:
             self.inbound.put_nowait(buf)
         except _queue.Full:
-            if self.admission == "downgrade":
+            if policy == "downgrade":
                 try:
                     self.lowprio.put_nowait(buf)
                 except _queue.Full:
@@ -295,6 +324,9 @@ class TensorQueryServerSrc(SourceElement):
         self.max_backlog = int(self.props.get("max_backlog", 256))
         if self.max_backlog < 1:
             raise ElementError(f"{self.name}: max-backlog must be >= 1")
+        # ``send-buf`` bounds per-connection kernel send buffering (0 =
+        # OS default); see _ServerCore.send_buf
+        self.send_buf = int(self.props.get("send_buf", 0))
         self._core: Optional[_ServerCore] = None
         self._carry: Optional[Buffer] = None  # shape-mismatch pushback
 
@@ -328,7 +360,8 @@ class TensorQueryServerSrc(SourceElement):
         core = _ServerCore(self.host, self.port, topic=self.topic,
                            max_backlog=self.max_backlog,
                            admission=self.admission,
-                           on_admit_event=self._on_admit_event)
+                           on_admit_event=self._on_admit_event,
+                           send_buf=self.send_buf)
         with _servers_lock:
             if self.sid in _servers:  # lost a construction race
                 core.close()
@@ -337,8 +370,12 @@ class TensorQueryServerSrc(SourceElement):
         self._core = core
 
     def stop(self) -> None:
+        # Idempotent: after the first stop ``self._core`` is None, and
+        # ``_servers.get(sid) is None`` must NOT match it (that del
+        # raised KeyError on double-stop before the elastic PR).
         with _servers_lock:
-            if _servers.get(self.sid) is self._core:
+            if self._core is not None \
+                    and _servers.get(self.sid) is self._core:
                 del _servers[self.sid]
         if self._core is not None:
             self._core.close()
@@ -414,13 +451,35 @@ class TensorQueryServerSrc(SourceElement):
 @register_element("tensor_query_serversink")
 class TensorQueryServerSink(SinkElement):
     """Return each result buffer to the client connection recorded in its
-    meta.  Props: ``id`` (matches the serversrc)."""
+    meta.  Props: ``id`` (matches the serversrc).
+
+    **Dead-connection backchannel** (docs/SERVING.md "Elastic
+    serving"): when a send fails because the client connection died and
+    the buffer belongs to a continuous-serving token stream (it carries
+    ``stream_index`` + ``stream_id`` meta), the sink cancels the stream
+    through :func:`nnstreamer_tpu.utils.elastic.cancel_stream` — the
+    serve loop reaps the orphaned slot and its KV blocks back to the
+    free list after its ``stream_idle_timeout`` grace instead of
+    decoding (and leaking pool capacity) until ``max_new`` runs out."""
 
     kind = "tensor_query_serversink"
 
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
         self.sid = int(self.props.get("id", 0))
+        self._cancelled_sids: set = set()  # dedupe per-token failures
+
+    def _send_failed(self, meta: Dict) -> None:
+        metrics.count(f"{self.name}.dropped")
+        stream_id = meta.get(elastic.META_STREAM_ID)
+        if "stream_index" not in meta or stream_id is None \
+                or stream_id in self._cancelled_sids:
+            return
+        if elastic.cancel_stream(stream_id, "dead-connection"):
+            self._cancelled_sids.add(stream_id)
+            if len(self._cancelled_sids) > 4096:  # bounded memory
+                self._cancelled_sids.clear()
+            metrics.count(f"{self.name}.streams_cancelled")
 
     def process(self, pad, buf: Buffer):
         core = _get_server(self.sid)
@@ -442,7 +501,7 @@ class TensorQueryServerSink(SinkElement):
             metrics.count("query_server.out",
                           tenant=out.meta.get(_META_TENANT))
         else:
-            metrics.count(f"{self.name}.dropped")
+            self._send_failed(out.meta)
         return []
 
     def _send_batched(self, core, buf: Buffer):
@@ -474,7 +533,7 @@ class TensorQueryServerSink(SinkElement):
                 metrics.count("query_server.out",
                               tenant=out.meta.get(_META_TENANT))
             else:
-                metrics.count(f"{self.name}.dropped")
+                self._send_failed(out.meta)
         return []
 
 
@@ -529,6 +588,24 @@ class TensorQueryClient(Element):
         self.topic = str(self.props.get("topic", ""))
         self.on_timeout = str(self.props.get("on_timeout", "error"))
         self.tenant = str(self.props.get("tenant", "") or "") or None
+        # Reconnect policy (docs/SERVING.md "Elastic serving"):
+        # ``reconnect=N`` (default 0 = legacy fail-fast) retries a lost
+        # connection up to N times with CAPPED EXPONENTIAL BACKOFF +
+        # FULL JITTER — delay_k ~ U(0, min(cap, base * 2^k)) — so a
+        # churned server is not hit by a synchronized thundering herd
+        # (the BENCH_SOAK_r01 churn profile's reconnect tail).  The same
+        # policy retries the initial connect.  On a successful
+        # reconnect, outstanding PLAIN requests are resent (the wire
+        # protocol is stateless request/response); partially streamed
+        # requests cannot resume and are terminated downstream with
+        # ``stream_aborted``.  Counters: ``<name>.reconnects``,
+        # ``<name>.reconnect_backoff_ms`` (cumulative backoff),
+        # ``<name>.resends``.
+        self.reconnect = max(0, int(self.props.get("reconnect", 0)))
+        self.reconnect_base_ms = float(
+            self.props.get("reconnect_base_ms", 20.0))
+        self.reconnect_cap_ms = float(
+            self.props.get("reconnect_cap_ms", 1000.0))
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._next_msg = 0
@@ -565,17 +642,37 @@ class TensorQueryClient(Element):
                     "(expected host:port)") from None
         return dests
 
-    def start(self) -> None:
-        self._socks = []
-        self._readers = []
-        for host, port in self._destinations():
+    def _backoff_sleep(self, attempt: int) -> bool:
+        """One capped-exponential full-jitter backoff slice; returns
+        False when the pipeline is stopping (abort the retry loop)."""
+        delay = random.uniform(0.0, min(
+            self.reconnect_cap_ms,
+            self.reconnect_base_ms * (1 << min(attempt, 16)))) / 1e3
+        metrics.count(f"{self.name}.reconnect_backoff_ms", delay * 1e3)
+        stop = getattr(self, "_stop_event", None)
+        if stop is not None:
+            return not stop.wait(delay)
+        time.sleep(delay)
+        return True
+
+    def _connect_one(self, host: str, port: int, retries: int,
+                     backoff_first: bool = False):
+        """``create_connection`` + handshake with the backoff policy;
+        returns the connected socket or raises the last error (returns
+        None only when the pipeline started stopping mid-backoff)."""
+        last: Optional[Exception] = None
+        for attempt in range(retries + 1):
+            if (attempt or backoff_first) and \
+                    not self._backoff_sleep(attempt - (0 if backoff_first
+                                                       else 1)):
+                return None
+            if backoff_first and self._sock is None:
+                return None  # stop() ran mid-outage
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
             except OSError as e:
-                self.stop()
-                raise ElementError(
-                    f"{self.name}: cannot connect {host}:{port}: {e}"
-                ) from e
+                last = e
+                continue
             try:
                 hello_fields = dict(caps="other/tensors", topic=self.topic)
                 if self.tenant is not None:
@@ -583,14 +680,32 @@ class TensorQueryClient(Element):
                 client_handshake(sock, "hello", **hello_fields)
             except (ConnectionError, OSError) as e:
                 # OSError covers a handshake-phase socket.timeout; close
-                # the half-open socket before tearing down the others.
+                # the half-open socket before retrying.
                 try:
                     sock.close()
                 except OSError:
                     pass
-                self.stop()
-                raise ElementError(f"{self.name}: {e}") from e
+                last = e
+                continue
             sock.settimeout(0.2)
+            return sock
+        raise last if last is not None else ElementError(
+            f"{self.name}: cannot connect {host}:{port}")
+
+    def start(self) -> None:
+        self._socks = []
+        self._readers = []
+        for host, port in self._destinations():
+            try:
+                sock = self._connect_one(host, port, self.reconnect)
+            except (OSError, ConnectionError) as e:
+                self.stop()
+                raise ElementError(
+                    f"{self.name}: cannot connect {host}:{port}: {e}"
+                ) from e
+            if sock is None:  # stopping mid-backoff
+                self.stop()
+                return
             self._socks.append(sock)
         self._sock = self._socks[0]  # back-compat for single-dest callers
         for i, sock in enumerate(self._socks):
@@ -629,13 +744,25 @@ class TensorQueryClient(Element):
                     self._cv.notify_all()
                 return
             if raw is None:
+                stop = getattr(self, "_stop_event", None)
+                if (self.reconnect > 0 and self._sock is not None
+                        and (stop is None or not stop.is_set())):
+                    nsock = self._try_reconnect(idx)
+                    if nsock is not None:
+                        sock = nsock
+                        continue
                 with self._cv:
                     # Only requests ROUTED TO THIS SOCKET are lost when a
                     # server closes: a fan-out peer going away must not
-                    # poison requests pending on healthy servers.
+                    # poison requests pending on healthy servers.  With
+                    # reconnect enabled, a reader that EXHAUSTED its
+                    # retries is gone for good — record the error even
+                    # with nothing pending, or a later send would park
+                    # its request forever waiting on a dead reader.
                     n = max(1, len(self._socks))
                     mine = any(m % n == idx for m in self._pending)
-                    if mine and self._rx_error is None:
+                    if (mine or self.reconnect > 0) \
+                            and self._rx_error is None:
                         self._rx_error = ConnectionError("query server closed connection")
                     self._cv.notify_all()
                 return
@@ -658,6 +785,82 @@ class TensorQueryClient(Element):
                         self._rx_error = e
                     self._cv.notify_all()
                 return
+
+    def _try_reconnect(self, idx: int):
+        """Replace socket ``idx`` after an outage: capped-exponential
+        full-jitter backoff (see __init__), then resend this socket's
+        outstanding plain requests and terminate its partial streams.
+        Returns the new socket, or None when attempts are exhausted or
+        the pipeline is stopping (caller falls through to the legacy
+        connection-error path)."""
+        dests = self._destinations()
+        host, port = dests[idx % len(dests)]
+        try:
+            sock = self._connect_one(host, port, self.reconnect - 1,
+                                     backoff_first=True)
+        except (OSError, ConnectionError):
+            return None
+        if sock is None:
+            return None
+        with self._send_lock:
+            if not self._socks:  # stop() ran while reconnecting
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return None
+            old = self._socks[idx]
+            self._socks[idx] = sock
+            if idx == 0:
+                self._sock = sock
+        try:
+            old.close()
+        except OSError:
+            pass
+        metrics.count(f"{self.name}.reconnects")
+        log.info("%s: reconnected to %s:%d", self.name, host, port)
+        self._resend_pending(idx)
+        return sock
+
+    def _resend_pending(self, idx: int) -> None:
+        """The died socket's outstanding requests: plain requests are
+        RESENT on the fresh connection (stateless request/response — the
+        server treats them as new; their timeout clock restarts), while
+        partially streamed requests cannot resume server-side state and
+        are terminated downstream exactly like the timeout-drop path."""
+        with self._cv:
+            n = max(1, len(self._socks))
+            resend = []
+            for mid in sorted(m for m in self._pending if m % n == idx):
+                orig, _t = self._pending[mid]
+                if mid in self._streaming:
+                    self._pending.pop(mid)
+                    self._streaming.discard(mid)
+                    term = orig.with_tensors([])
+                    term.meta.update(stream_last=True,
+                                     stream_aborted=True)
+                    self._done[mid] = term
+                else:
+                    self._pending[mid] = (orig, time.monotonic())
+                    resend.append((mid, orig))
+            self._cv.notify_all()
+        for mid, orig in resend:
+            orig.meta[_META_MSG] = mid
+            payload = wire.encode_buffer(orig)
+            orig.meta.pop(_META_MSG, None)
+            try:
+                with self._send_lock:
+                    socks = self._socks
+                    if not socks:
+                        return
+                    wire.write_frame(socks[mid % len(socks)], payload)
+            except OSError:
+                # the replacement died too: the rx loop will notice and
+                # run the backoff again (or give up and surface the
+                # connection error)
+                return
+            metrics.count(f"{self.name}.resends")
+        self._drain_ready()
 
     def _handle_response(self, buf: Buffer) -> None:
         """Pair one received response with its request and deliver it.
@@ -808,7 +1011,16 @@ class TensorQueryClient(Element):
                     raise ElementError(f"{self.name}: not connected")
                 wire.write_frame(socks[mid % len(socks)], payload)
         except (OSError, AttributeError) as e:
-            raise ElementError(f"{self.name}: send failed: {e}") from e
+            if self.reconnect > 0:
+                # leave the request pending: the rx loop detects the
+                # dead socket, reconnects with backoff, and resends it
+                # (_resend_pending); only if reconnection exhausts does
+                # the connection error surface via _wait_outstanding
+                log.warning("%s: send failed (%s); awaiting reconnect",
+                            self.name, e)
+                metrics.count(f"{self.name}.send_failures")
+            else:
+                raise ElementError(f"{self.name}: send failed: {e}") from e
         metrics.count(f"{self.name}.requests")
         return []
 

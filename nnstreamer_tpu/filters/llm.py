@@ -51,7 +51,18 @@ from ..core.registry import register_filter
 from ..core.types import TensorFormat, TensorsSpec
 from ..models import llama
 from ..models.zoo import build as build_model
+from ..utils import elastic
+from ..utils.tracing import META_TENANT as _META_TENANT
 from .base import Framework, FrameworkError, parse_custom_options
+
+#: buffer-meta keys that must NOT ride a drain snapshot: the queue-stamp
+#: map is the source pipeline's tracer plumbing, and the query
+#: connection id routes sends on the SOURCE pipeline's server core — a
+#: stale cid on the adopting side would deliver the stream's tokens to
+#: whatever client holds that id there (the adopting deployment's front
+#: door re-associates delivery; callers may re-stamp snapshot["meta"]
+#: before adopt_stream).
+_SNAPSHOT_META_DROP = ("_tq", "_query_conn")
 
 log = logger(__name__)
 
@@ -213,6 +224,18 @@ class LLMFramework(Framework):
         self.prefill_chunk = max(1, int(opts.pop("prefill_chunk", 32)))
         self.prefill_budget = max(
             1, int(opts.pop("prefill_budget", self.prefill_chunk)))
+        # Elastic-serving knobs (docs/SERVING.md "Elastic serving"):
+        # admit_timeout bounds how long a prompt may sit at the
+        # admission queue's head waiting for capacity before it is
+        # rejected with a typed abort (0 = wait forever, the pre-elastic
+        # behavior); stream_idle_timeout is the grace between a stream
+        # being marked orphaned (its connection died —
+        # utils/elastic.cancel_stream) and its slot + KV blocks being
+        # reaped back to the free list.
+        self.admit_timeout = max(0.0, float(opts.pop("admit_timeout",
+                                                     30.0)))
+        self.stream_idle_timeout = max(
+            0.0, float(opts.pop("stream_idle_timeout", 5.0)))
         self.dtype = opts.get("dtype", "bfloat16")
         try:
             self.bundle = build_model(model, opts)
@@ -399,6 +422,73 @@ class LLMFramework(Framework):
     def drain(self, timeout: float = 600.0) -> bool:
         """Block until every admitted stream has finished (EOS path)."""
         return self._serve is None or self._serve.drain(timeout)
+
+    # -- elastic serving: drain/adopt (docs/SERVING.md "Elastic serving")
+    def serve_streams(self) -> Dict[int, Dict]:
+        """Live/queued continuous-serving streams of THIS framework:
+        ``stream_id -> {"state", "tenant", "slot", "blocks"}``."""
+        if self._serve is None:
+            return {}
+        return self._serve.stream_table()
+
+    def drain_stream(self, stream_id: int, timeout: float = 30.0) -> Dict:
+        """Serialize one live (or still-queued) stream OFF the standing
+        loop: its paged KV blocks, slot state, and request meta become a
+        host-value snapshot (trainer/checkpoint.py's serialization
+        substrate), and its slot + blocks return to the free list.
+        Greedy continuation after :meth:`adopt_stream` is bit-identical
+        to an undrained run; sampled (temperature > 0) streams continue
+        from a fresh RNG key (the snapshot records ``greedy``)."""
+        if self._serve is None:
+            raise FrameworkError("no continuous serve loop is running")
+        return self._serve.drain_stream(int(stream_id), timeout)
+
+    def snapshot_problems(self, snapshot: Dict) -> List[str]:
+        """Compatibility problems adopting ``snapshot`` here (empty =
+        adoptable).  The drain/adopt contract: same model geometry,
+        compute dtype, and block size — everything else (slots,
+        kv_blocks, prefill knobs) may differ between the pipelines."""
+        import dataclasses as _dc
+
+        problems: List[str] = []
+        if not isinstance(snapshot, dict):
+            return ["snapshot must be a dict (drain_stream's return)"]
+        if snapshot.get("version") != 1:
+            problems.append(
+                f"snapshot version {snapshot.get('version')!r} "
+                "unsupported (expected 1)")
+            return problems
+        if snapshot.get("cfg") != _dc.asdict(self.cfg):
+            problems.append("model geometry differs from the snapshot's")
+        if snapshot.get("kind") == "live":
+            if str(snapshot.get("dtype")) != str(self.dtype):
+                problems.append(
+                    f"compute dtype {snapshot.get('dtype')!r} != "
+                    f"{self.dtype!r} (KV block contents are dtype-exact)")
+            if int(snapshot.get("block_size", -1)) != self.block_size:
+                problems.append(
+                    f"block_size {snapshot.get('block_size')!r} != "
+                    f"{self.block_size} (block contents do not re-chunk)")
+        return problems
+
+    def adopt_stream(self, snapshot: Dict, emit,
+                     timeout: float = 30.0) -> int:
+        """Re-admit a drained stream into THIS framework's standing loop
+        (creating it on first use, exactly like :meth:`submit`): its KV
+        blocks are copied back into the pool, its slot state restored,
+        and decode continues — ``emit(tensors, meta)`` receives the
+        remaining tokens with ``stream_index`` continuing where the
+        drained pipeline stopped.  Returns the stream id (stable across
+        the handover unless it collides with a live local id)."""
+        problems = self.snapshot_problems(snapshot)
+        if problems:
+            raise FrameworkError(
+                "cannot adopt stream snapshot: " + "; ".join(problems))
+        if self._serve is None:
+            with self._serve_lock:
+                if self._serve is None:
+                    self._serve = _ContinuousLoop(self)
+        return self._serve.adopt_stream(snapshot, emit, timeout)
 
     def get_model_info(self):
         flex_in = TensorsSpec.from_string("1", "uint8").replace(
@@ -588,12 +678,35 @@ class _ContinuousLoop:
         # return with a live request pending and EOS would cut it off.
         self._idle_lock = threading.Lock()
         self._error: Optional[BaseException] = None
-        #: admission-order queue (drained from _pending) + per-slot
-        #: prefill-in-progress states; BOTH crash-visible: a request in
-        #: either is in neither _pending nor a live slot, and a loop
-        #: failure must abort it instead of stranding its client
+        #: admission-order queue (drained from _pending; entries are
+        #: ``(prompt, meta, emit, t_enqueued)``) + per-slot prefill-in-
+        #: progress states; BOTH crash-visible: a request in either is
+        #: in neither _pending nor a live slot, and a loop failure must
+        #: abort it instead of stranding its client
         self._waiting: list = []
         self._admitting: list = []
+        # -- elastic serving state (docs/SERVING.md "Elastic serving") --
+        #: control commands (drain/adopt) from app threads, processed at
+        #: chunk boundaries; each is a dict with an Event the caller
+        #: waits on.  deque append/popleft are GIL-atomic.
+        import collections as _collections
+
+        self._ctl: "_collections.deque" = _collections.deque()
+        #: stream_id -> (reason, reap_deadline): marked dead by
+        #: utils/elastic.cancel_stream (the serversink's dead-connection
+        #: backchannel); the slot + blocks are reaped at the first chunk
+        #: boundary past the deadline (stream_idle_timeout grace, so a
+        #: drain/handover can still pick the stream up)
+        self._cancelled: Dict[int, tuple] = {}
+        #: per-tenant cap on total reserved KV blocks (None = uncapped);
+        #: a host-value quota the autoscaler raises/lowers at runtime —
+        #: admission SKIPS (not blocks) over-quota tenants so one capped
+        #: tenant never head-of-line-blocks the rest
+        self._tenant_quota: Dict[str, Optional[int]] = {}
+        #: stream ids this loop registered with utils/elastic (cleaned
+        #: up on retire/abort/shutdown so the process-wide registry
+        #: never leaks entries)
+        self._owned_sids: set = set()
 
         def decode_chunk(params, tok, pool, tables, pos, key, length):
             """``length`` paged decode steps as ONE program (lax.scan):
@@ -637,7 +750,17 @@ class _ContinuousLoop:
         self._thread.start()
 
     # -- producer side -----------------------------------------------------
-    def submit(self, prompt, meta: Dict, emit) -> None:
+    def submit(self, prompt, meta: Dict, emit) -> int:
+        # Every stream gets a process-unique id minted HERE (server-
+        # authoritative: a client-supplied meta value is overwritten) and
+        # registered with utils/elastic so downstream failure detectors
+        # (the query serversink's dead-connection path) can cancel it by
+        # value.  The id rides every emitted token's meta.
+        import functools as _ft
+
+        meta = dict(meta)
+        sid = elastic.next_stream_id()
+        meta[elastic.META_STREAM_ID] = sid
         # The error check lives INSIDE the lock: the crash handler drains
         # _pending and sets _idle under the same lock, so a submit cannot
         # slip a request into a dead loop's queue between its own error
@@ -648,8 +771,12 @@ class _ContinuousLoop:
                 raise FrameworkError(
                     f"continuous serve loop died: {self._error!r}")
             self._idle.clear()
-            self._pending.put((prompt, meta, emit))
+            self._owned_sids.add(sid)
+            elastic.register_stream(
+                sid, _ft.partial(self._mark_cancel, sid))
+            self._pending.put((prompt, meta, emit, time.monotonic()))
         self._wake.set()
+        return sid
 
     def drain(self, timeout: float) -> bool:
         return self._idle.wait(timeout)
@@ -658,6 +785,114 @@ class _ContinuousLoop:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=30)
+        # control callers blocked on a drain/adopt that raced the stop
+        # get a prompt named error instead of riding out their timeout
+        while self._ctl:
+            cmd = self._ctl.popleft()
+            cmd["error"] = "serve loop stopped"
+            cmd["ev"].set()
+        # the process-wide stream registry must not keep pointing at a
+        # dead loop (stale cancel callbacks); owned ids are whatever
+        # retire/abort did not already clean up
+        for sid in list(self._owned_sids):
+            elastic.unregister_stream(sid)
+        self._owned_sids.clear()
+
+    # -- elastic control surface -------------------------------------------
+    def _mark_cancel(self, sid: int, reason: str = "cancelled",
+                     force: bool = False) -> None:
+        """The utils/elastic backchannel: mark one stream dead.  Reaped
+        at the first chunk boundary past the ``stream_idle_timeout``
+        grace (``force=True`` skips the grace).  Idempotent: an earlier
+        (sooner) deadline is never extended."""
+        grace = 0.0 if force else self.fw.stream_idle_timeout
+        deadline = time.monotonic() + grace
+        prev = self._cancelled.get(sid)
+        if prev is None or deadline < prev[1]:
+            self._cancelled[sid] = (reason, deadline)
+            metrics.count("llm.serve.cancelled")
+        self._wake.set()
+
+    def set_tenant_quota(self, tenant: str,
+                         max_blocks: Optional[int]) -> None:
+        """Cap (or uncap, with None) a tenant's total reserved KV
+        blocks.  A host-value move: admission enforces it on the next
+        iteration, nothing recompiles — this is the autoscaler's
+        ``kv_quota`` action."""
+        if max_blocks is None:
+            self._tenant_quota.pop(tenant, None)
+        else:
+            self._tenant_quota[tenant] = max(0, int(max_blocks))
+        self._wake.set()
+
+    def pool_stats(self) -> Dict[str, int]:
+        """Allocator accounting snapshot (soak/chaos assertions): free
+        and total block counts plus live stream count.  Reads host-side
+        ints the serve thread mutates — values are a consistent-enough
+        snapshot for accounting at quiesce points (post-drain)."""
+        free = getattr(self, "_free", None)
+        slots = getattr(self, "_live_slots", None) or []
+        return {
+            "blocks_total": self.n_blocks,
+            "blocks_free": self.n_blocks if free is None else len(free),
+            "live_streams": sum(1 for s in slots if s is not None),
+        }
+
+    def stream_table(self) -> Dict[int, Dict]:
+        """``stream_id -> {"state", "tenant", "slot", "blocks"}`` for
+        every stream this loop owns (queued, admitting, or live)."""
+        out: Dict[int, Dict] = {}
+        for ent in list(self._waiting):
+            sid = ent[1].get(elastic.META_STREAM_ID)
+            if sid is not None:
+                out[sid] = {"state": "queued", "slot": None, "blocks": 0,
+                            "tenant": ent[1].get(_META_TENANT)}
+        for st in list(self._admitting):
+            sid = st["meta"].get(elastic.META_STREAM_ID)
+            if sid is not None:
+                out[sid] = {"state": "admitting", "slot": st["slot"],
+                            "blocks": len(
+                                getattr(self, "_slot_blocks",
+                                        [[]])[st["slot"]]),
+                            "tenant": st["meta"].get(_META_TENANT)}
+        slots = getattr(self, "_live_slots", None) or []
+        sids = getattr(self, "_slot_sid", None) or []
+        for s, slot in enumerate(slots):
+            if slot is None or s >= len(sids) or sids[s] is None:
+                continue
+            out[sids[s]] = {"state": "live", "slot": s,
+                            "blocks": len(self._slot_blocks[s]),
+                            "tenant": slot[0].get(_META_TENANT)}
+        return out
+
+    def _ctl_call(self, cmd: Dict, timeout: float):
+        """Enqueue one control command and wait for the serve thread to
+        execute it at a chunk boundary."""
+        cmd["ev"] = threading.Event()
+        cmd["deadline"] = time.monotonic() + timeout
+        with self._idle_lock:
+            if self._error is not None:
+                raise FrameworkError(
+                    f"continuous serve loop died: {self._error!r}")
+            self._idle.clear()
+            self._ctl.append(cmd)
+        self._wake.set()
+        if not cmd["ev"].wait(timeout + 1.0):
+            raise FrameworkError(
+                f"serve-loop {cmd['kind']} command timed out "
+                f"after {timeout}s")
+        if cmd.get("error"):
+            raise FrameworkError(cmd["error"])
+        return cmd.get("result")
+
+    def drain_stream(self, sid: int, timeout: float = 30.0) -> Dict:
+        return self._ctl_call({"kind": "drain", "sid": int(sid)}, timeout)
+
+    def adopt_stream(self, snapshot: Dict, emit,
+                     timeout: float = 30.0) -> int:
+        return self._ctl_call(
+            {"kind": "adopt", "snapshot": snapshot, "emit": emit},
+            timeout)
 
     # -- serve thread ------------------------------------------------------
     def _emit_token(self, emit, meta: Dict, token_id: int, index: int,
@@ -689,6 +924,10 @@ class _ContinuousLoop:
                         True)
                 except Exception:  # noqa: BLE001
                     pass
+                sid = meta.get(elastic.META_STREAM_ID)
+                if sid is not None:
+                    elastic.unregister_stream(sid)
+                    self._owned_sids.discard(sid)
 
             # Terminate every live, mid-prefill, waiting, and queued
             # stream so no client hangs to its timeout waiting on a dead
@@ -702,16 +941,22 @@ class _ContinuousLoop:
                     abort(slot[0], slot[1], 1 << 30)
             for st in list(self._admitting):
                 abort(st["meta"], st["emit"])
-            for _, meta, emit in list(self._waiting):
-                abort(meta, emit)
+            for ent in list(self._waiting):
+                abort(ent[1], ent[2])
             with self._idle_lock:
                 self._error = e
                 while True:
                     try:
-                        _, meta, emit = self._pending.get_nowait()
+                        ent = self._pending.get_nowait()
                     except _q.Empty:
                         break
-                    abort(meta, emit)
+                    abort(ent[1], ent[2])
+                # control callers (drain/adopt) blocked on their events
+                # must see the crash, not their timeout
+                while self._ctl:
+                    cmd = self._ctl.popleft()
+                    cmd["error"] = f"continuous serve loop died: {e!r}"
+                    cmd["ev"].set()
                 self._idle.set()
 
     def _span(self, rec, kind: str, t0_ns: int, **args) -> None:
@@ -720,6 +965,8 @@ class _ContinuousLoop:
             rec.record(kind, "llm.serve", None, t0_ns, now - t0_ns, **args)
 
     def _run_inner(self) -> None:
+        import dataclasses as _dc
+        import functools as _ft
         import math
         import queue as _q
 
@@ -775,6 +1022,12 @@ class _ContinuousLoop:
         sidx = np.zeros((B,), np.int64)
         slots: list = [None] * B  # (meta, emit) per live slot
         self._live_slots = slots  # visible to the crash terminator
+        #: per-slot stream id / tenant / original prompt tokens — the
+        #: elastic surface (cancel lookup, quota accounting, drain
+        #: snapshots); set at admission, cleared by retire()
+        self._slot_sid: list = [None] * B
+        self._slot_tenant: list = [None] * B
+        self._slot_prompt: list = [None] * B
         eos = getattr(fw.tokenizer, "eos", -1) if fw.stop_eos else -1
 
         import os as _os
@@ -800,7 +1053,44 @@ class _ContinuousLoop:
             pos[s] = self.park
             slots[s] = None
             remaining[s] = 0
+            sidx[s] = 0
+            sid = self._slot_sid[s]
+            if sid is not None:
+                elastic.unregister_stream(sid)
+                self._owned_sids.discard(sid)
+                self._cancelled.pop(sid, None)
+            self._slot_sid[s] = None
+            self._slot_tenant[s] = None
+            self._slot_prompt[s] = None
             metrics.gauge(f"llm.serve.slot{s}.occupied", 0.0)
+
+        def slot_of(sid) -> Optional[int]:
+            if sid is None:
+                return None
+            for s in range(B):
+                if self._slot_sid[s] == sid:
+                    return s
+            return None
+
+        def reject(meta: Dict, emit, reason: str, idx: int = 0) -> None:
+            """Typed stream abort: a ``stream_aborted`` terminator whose
+            ``abort_reason`` names the policy that fired, plus registry
+            cleanup — the elastic twin of the crash terminator."""
+            try:
+                self._emit_token(
+                    emit, {**meta, "stream_aborted": True,
+                           "abort_reason": reason}, 0, idx, True)
+            except Exception:  # noqa: BLE001 - downstream may be gone too
+                pass
+            sid = meta.get(elastic.META_STREAM_ID)
+            if sid is not None:
+                elastic.unregister_stream(sid)
+                self._owned_sids.discard(sid)
+                self._cancelled.pop(sid, None)
+
+        def tenant_blocks(tenant) -> int:
+            return sum(len(slot_blocks[s]) for s in range(B)
+                       if self._slot_tenant[s] == tenant)
 
         # Warm EVERY program the loop uses before admitting real work:
         # over a tunneled device, first-use costs (trace + compile +
@@ -836,25 +1126,259 @@ class _ContinuousLoop:
                 except _q.Empty:
                     break
 
+            # 0b. control commands (Pipeline.drain_stream/adopt_stream):
+            # executed HERE, at a chunk boundary, where every slot's
+            # host bookkeeping is consistent.  Both are host-side value
+            # moves plus eager gather/scatter on the pool — none of the
+            # three compiled loop programs is touched, so the census pin
+            # holds across drain/adopt (tests/test_elastic.py).
+            deferred_cmds = []
+            while self._ctl:
+                cmd = self._ctl.popleft()
+                if time.monotonic() > cmd["deadline"]:
+                    cmd["error"] = (f"{cmd['kind']} timed out inside the "
+                                    "serve loop")
+                    cmd["ev"].set()
+                    continue
+                if cmd["kind"] == "drain":
+                    sid = cmd["sid"]
+                    s = slot_of(sid)
+                    if s is not None and slots[s] is None:
+                        s = None  # mid-prefill: not drainable yet
+                    wi = next(
+                        (i for i, ent in enumerate(self._waiting)
+                         if ent[1].get(elastic.META_STREAM_ID) == sid),
+                        None)
+                    if s is not None:
+                        t0 = time.monotonic_ns()
+                        n_used = math.ceil(int(pos[s]) / bs)
+                        ids = np.asarray(slot_blocks[s][:n_used],
+                                         np.int32)
+                        meta, _emit_cb = slots[s]
+                        cmd["result"] = {
+                            "version": 1, "kind": "live",
+                            "stream_id": sid,
+                            "cfg": _dc.asdict(cfg), "dtype": fw.dtype,
+                            "block_size": bs, "pos": int(pos[s]),
+                            "remaining": int(remaining[s]),
+                            "sidx": int(sidx[s]),
+                            "tok": int(np.asarray(tok)[s]),
+                            "greedy": fw.temperature == 0.0,
+                            "meta": {k: v for k, v in meta.items()
+                                     if k not in _SNAPSHOT_META_DROP},
+                            "prompt": np.asarray(self._slot_prompt[s]),
+                            # valid cache rows [0, pos) gathered to
+                            # host, whole blocks at a time
+                            "blocks_k": np.asarray(pool["k"][:, ids]),
+                            "blocks_v": np.asarray(pool["v"][:, ids]),
+                        }
+                        nb = len(slot_blocks[s])
+                        retire(s)
+                        self._span(rec, "elastic.drain", t0,
+                                   stream_id=sid, state="live",
+                                   blocks=nb)
+                        _tr(f"drained slot {s} (stream {sid})")
+                        progressed = True
+                        cmd["ev"].set()
+                    elif wi is not None:
+                        t0 = time.monotonic_ns()
+                        ent = self._waiting.pop(wi)
+                        cmd["result"] = {
+                            "version": 1, "kind": "queued",
+                            "stream_id": sid,
+                            "cfg": _dc.asdict(cfg), "dtype": fw.dtype,
+                            "block_size": bs,
+                            "greedy": fw.temperature == 0.0,
+                            "meta": {k: v for k, v in ent[1].items()
+                                     if k not in _SNAPSHOT_META_DROP},
+                            "prompt": np.asarray(ent[0]),
+                        }
+                        elastic.unregister_stream(sid)
+                        self._owned_sids.discard(sid)
+                        self._cancelled.pop(sid, None)
+                        self._span(rec, "elastic.drain", t0,
+                                   stream_id=sid, state="queued",
+                                   blocks=0)
+                        progressed = True
+                        cmd["ev"].set()
+                    elif any(st["meta"].get(elastic.META_STREAM_ID)
+                             == sid for st in self._admitting):
+                        # mid-prefill: goes live within a few
+                        # iterations — re-check then
+                        deferred_cmds.append(cmd)
+                    else:
+                        cmd["error"] = (f"unknown or already-finished "
+                                        f"stream {sid}")
+                        cmd["ev"].set()
+                elif cmd["kind"] == "adopt":
+                    snap = cmd["snapshot"]
+                    t0 = time.monotonic_ns()
+                    sid = int(snap.get("stream_id", 0))
+                    if sid <= 0 or sid in elastic.live_stream_ids():
+                        # cross-process snapshots may collide with a
+                        # live local id — remint, the snapshot id is
+                        # only a continuity hint
+                        sid = elastic.next_stream_id()
+                    meta = dict(snap.get("meta") or {})
+                    meta[elastic.META_STREAM_ID] = sid
+                    if snap.get("kind") == "queued":
+                        self._owned_sids.add(sid)
+                        elastic.register_stream(
+                            sid, _ft.partial(self._mark_cancel, sid))
+                        self._waiting.append(
+                            (np.asarray(snap["prompt"], np.int32), meta,
+                             cmd["emit"], time.monotonic()))
+                        self._span(rec, "elastic.adopt", t0,
+                                   stream_id=sid, state="queued",
+                                   blocks=0)
+                        cmd["result"] = sid
+                        progressed = True
+                        cmd["ev"].set()
+                        continue
+                    p_next = int(snap["pos"])
+                    rem = int(snap["remaining"])
+                    need_tok = p_next + rem
+                    freeslots = [
+                        s for s in range(B)
+                        if slots[s] is None and remaining[s] == 0
+                        and not any(st["slot"] == s
+                                    for st in self._admitting)]
+                    if not freeslots:
+                        cmd["error"] = "no free slot to adopt into"
+                    elif math.ceil(need_tok / bs) > self.max_blocks:
+                        cmd["error"] = (
+                            f"stream needs {math.ceil(need_tok / bs)} "
+                            f"blocks > table span {self.max_blocks}")
+                    elif len(free) * bs < need_tok:
+                        cmd["error"] = (
+                            f"insufficient free KV blocks "
+                            f"({len(free)} free, "
+                            f"{math.ceil(need_tok / bs)} needed)")
+                    else:
+                        s = freeslots[0]
+                        blocks = alloc(need_tok)
+                        slot_blocks[s] = blocks
+                        tables[s, :len(blocks)] = blocks
+                        n_used = math.ceil(p_next / bs)
+                        ids = np.asarray(blocks[:n_used], np.int32)
+                        # eager scatter of the snapshot's cache rows
+                        # into the newly reserved pool blocks (a value
+                        # move — the compiled census is untouched)
+                        pool["k"] = pool["k"].at[:, ids].set(
+                            jnp.asarray(np.asarray(snap["blocks_k"])))
+                        pool["v"] = pool["v"].at[:, ids].set(
+                            jnp.asarray(np.asarray(snap["blocks_v"])))
+                        # jnp.asarray: the jit fast path keys on arg
+                        # TYPE, not just aval — a raw numpy scalar here
+                        # would mint a 4th signature and break the
+                        # 3-program census pin
+                        tok = self._set_tok(tok, np.int32(s),
+                                            jnp.asarray(
+                                                np.int32(snap["tok"])))
+                        pos[s] = p_next
+                        remaining[s] = rem
+                        sidx[s] = int(snap["sidx"])
+                        slots[s] = (meta, cmd["emit"])
+                        self._slot_sid[s] = sid
+                        self._slot_tenant[s] = meta.get(_META_TENANT)
+                        self._slot_prompt[s] = (
+                            np.asarray(snap["prompt"], np.int32)
+                            if snap.get("prompt") is not None else
+                            np.zeros((1, 0), np.int32))
+                        self._owned_sids.add(sid)
+                        elastic.register_stream(
+                            sid, _ft.partial(self._mark_cancel, sid))
+                        metrics.gauge(f"llm.serve.slot{s}.occupied", 1.0)
+                        self._span(rec, "elastic.adopt", t0,
+                                   stream_id=sid, state="live", slot=s,
+                                   blocks=len(blocks))
+                        _tr(f"adopted stream {sid} into slot {s}")
+                        cmd["result"] = sid
+                        progressed = True
+                    cmd["ev"].set()
+                else:
+                    cmd["error"] = f"unknown command {cmd['kind']!r}"
+                    cmd["ev"].set()
+            if deferred_cmds:
+                self._ctl.extend(deferred_cmds)
+
+            # 0c. reap orphaned streams: a stream marked dead
+            # (utils/elastic.cancel_stream — the serversink's dead-
+            # connection backchannel) gets stream_idle_timeout of grace
+            # (a drain/handover may still pick it up), then its slot +
+            # KV blocks return to the free list and a typed terminator
+            # goes downstream instead of the pool leaking capacity
+            # until max_new runs out.  Queued marks are consumed by the
+            # admission scan below.
+            if self._cancelled:
+                now_m = time.monotonic()
+                for sid, (reason, deadline) in list(
+                        self._cancelled.items()):
+                    if now_m < deadline:
+                        continue
+                    s = slot_of(sid)
+                    st = next(
+                        (st for st in self._admitting
+                         if st["meta"].get(elastic.META_STREAM_ID)
+                         == sid), None)
+                    if st is not None:
+                        # mid-prefill: drop the prefill state first so
+                        # step 2 cannot keep writing into freed blocks
+                        self._admitting.remove(st)
+                        s = st["slot"]
+                    if s is not None:
+                        t0 = time.monotonic_ns()
+                        nb = len(slot_blocks[s])
+                        live_slot = slots[s] is not None
+                        meta, emit_cb = (slots[s] if live_slot
+                                         else (st["meta"], st["emit"]))
+                        metrics.count("llm.serve.reaped")
+                        metrics.count("llm.serve.reaped_blocks", nb)
+                        self._span(rec, "serve.reap", t0, slot=s,
+                                   stream_id=sid, blocks=nb,
+                                   reason=reason)
+                        _tr(f"reaped slot {s} (stream {sid}: {reason})")
+                        # mid-prefill streams emitted nothing: their
+                        # terminator is index 0, not the slot's stale
+                        # previous-occupant counter
+                        reject(meta, emit_cb, reason,
+                               idx=int(sidx[s]) if live_slot else 0)
+                        retire(s)
+                        progressed = True
+                    elif not any(
+                            ent[1].get(elastic.META_STREAM_ID) == sid
+                            for ent in self._waiting):
+                        # already finished/unknown: clear the mark
+                        self._cancelled.pop(sid, None)
+
             # 1. admission: move waiting prompts into free slots while a
             # slot AND the stream's full block reservation are available.
-            # Host-only bookkeeping — no device work yet.  Head-of-line
-            # deferral keeps FIFO fairness: a huge prompt waits for
-            # capacity rather than being overtaken forever.
-            while self._waiting:
-                freeslots = np.flatnonzero(remaining == 0)
-                freeslots = [int(s) for s in freeslots
-                             if slots[s] is None and not any(
-                                 st["slot"] == s for st in self._admitting)]
-                if not freeslots:
-                    break
-                prompt, meta, emit = self._waiting[0]
+            # Host-only bookkeeping — no device work yet.  Strict FIFO
+            # for capacity deferral (a huge prompt waits rather than
+            # being overtaken forever) with two elastic carve-outs: an
+            # entry stuck past admit_timeout is rejected with a TYPED
+            # abort instead of wedging every tenant queued behind it,
+            # and a tenant over its kv-block quota is SKIPPED — tenant-
+            # attributed deferral must not head-of-line-block the rest.
+            wi = 0
+            while wi < len(self._waiting):
+                prompt, meta, emit, t_enq = self._waiting[wi]
+                sid = meta.get(elastic.META_STREAM_ID)
+                mark = self._cancelled.get(sid)
+                if mark is not None and time.monotonic() >= mark[1]:
+                    # grace expired (same deadline the reap path honors
+                    # — a drain/handover may still claim the stream
+                    # inside it, queued or live)
+                    self._waiting.pop(wi)
+                    reject(meta, emit, mark[0])
+                    progressed = True
+                    continue
                 T = prompt.shape[1]
                 if T >= cfg.max_seq:
                     # reject oversize prompts with a terminated stream
-                    self._waiting.pop(0)
-                    self._emit_token(emit, {**meta, "stream_aborted": True},
-                                     0, 0, True)
+                    self._waiting.pop(wi)
+                    reject(meta, emit, "prompt-oversize")
+                    progressed = True
                     continue
                 n = max(1, min(fw.max_new, cfg.max_seq - T))
                 if T + n > self.n_blocks * bs:
@@ -862,18 +1386,52 @@ class _ContinuousLoop:
                     # of retiring ever satisfies it, so deferring would
                     # wedge the loop (head-of-line FIFO) — reject like
                     # the oversize case instead
-                    self._waiting.pop(0)
-                    self._emit_token(emit, {**meta, "stream_aborted": True},
-                                     0, 0, True)
+                    self._waiting.pop(wi)
+                    reject(meta, emit, "reservation-impossible")
+                    progressed = True
                     continue
-                if len(free) * bs < T + n:
+                overdue = (fw.admit_timeout > 0 and
+                           time.monotonic() - t_enq > fw.admit_timeout)
+                tenant = meta.get(_META_TENANT)
+                quota = (self._tenant_quota.get(tenant)
+                         if tenant is not None else None)
+                need = math.ceil((T + n) / bs)
+                if quota is not None and \
+                        tenant_blocks(tenant) + need > quota:
+                    if overdue:
+                        self._waiting.pop(wi)
+                        metrics.count("llm.serve.admit_timeouts")
+                        reject(meta, emit, "admit-timeout")
+                        progressed = True
+                        continue
+                    metrics.count("llm.serve.quota_deferred")
+                    wi += 1  # skip: quota deferral is tenant-scoped
+                    continue
+                freeslots = np.flatnonzero(remaining == 0)
+                freeslots = [int(s) for s in freeslots
+                             if slots[s] is None and not any(
+                                 st["slot"] == s
+                                 for st in self._admitting)]
+                if not freeslots or len(free) * bs < T + n:
+                    if overdue:
+                        # head-of-line fix: a wedged/dead/huge stream at
+                        # the queue head times out instead of blocking
+                        # every tenant behind it forever
+                        self._waiting.pop(wi)
+                        metrics.count("llm.serve.admit_timeouts")
+                        reject(meta, emit, "admit-timeout")
+                        progressed = True
+                        continue
                     break  # pool full: defer admission, never overflow
                 t_admit = time.monotonic_ns()
-                self._waiting.pop(0)
+                self._waiting.pop(wi)
                 s = freeslots[0]
                 blocks = alloc(T + n)
                 slot_blocks[s] = blocks
                 tables[s, :len(blocks)] = blocks
+                self._slot_sid[s] = sid
+                self._slot_tenant[s] = tenant
+                self._slot_prompt[s] = prompt[:, :T].copy()
                 # chunk-multiple padding (replaces the old power-of-two
                 # prompt bucketing on this path: waste < one chunk)
                 P = math.ceil(T / C) * C
@@ -957,6 +1515,8 @@ class _ContinuousLoop:
                 progressed = True
             metrics.gauge("llm.serve.occupancy", float(live.sum()))
             metrics.gauge("llm.serve.free_blocks", float(len(free)))
+            metrics.gauge("llm.serve.waiting",
+                          float(len(self._waiting) + len(self._admitting)))
 
             # 4. materialize + emit the admitted first tokens — the
             # device is already computing the chunk, so this sync rides
@@ -1003,7 +1563,7 @@ class _ContinuousLoop:
             if not progressed:
                 with self._idle_lock:
                     if self._pending.empty() and not self._waiting \
-                            and not self._admitting \
+                            and not self._admitting and not self._ctl \
                             and not (remaining > 0).any():
                         self._idle.set()
                 self._wake.wait(0.02)

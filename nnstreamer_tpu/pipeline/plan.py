@@ -50,6 +50,15 @@ class Stage:
     #: ordering is tuned for single-device rows).  The runtime
     #: additionally requires ``data_parallel`` to resolve to > 1.
     shardable: bool = False
+    #: PURE/STATELESS stage whose runner thread may be restarted in
+    #: place after an exception instead of failing the pipeline (the
+    #: elastic stage-restart path, bounded by the pipeline's
+    #: ``max_stage_restarts`` — docs/SERVING.md "Elastic serving").
+    #: True for fused device chains and single elements whose work is a
+    #: pure device fn (the batchable predicate); sources, sinks, and
+    #: elements with cross-buffer state (aggregators, async emitters)
+    #: stay fail-fast.
+    restartable: bool = False
 
     def external_out_pad(self, edge: Edge) -> str:
         return edge.src_pad
@@ -421,7 +430,8 @@ def plan_stages(
             b = _element_batchable(elements[n.id])
             stages.append(Stage(
                 elements[n.id], [n.id], n.id, n.id, batchable=b,
-                shardable=_element_shardable(elements[n.id], b)))
+                shardable=_element_shardable(elements[n.id], b),
+                restartable=b))
         return stages
 
     def linear(nid: int) -> bool:
@@ -511,7 +521,8 @@ def plan_stages(
             b = _element_batchable(elements[node.id])
             stages.append(Stage(
                 elements[node.id], [node.id], node.id, node.id, batchable=b,
-                shardable=_element_shardable(elements[node.id], b)))
+                shardable=_element_shardable(elements[node.id], b),
+                restartable=b))
             consumed.add(node.id)
             continue
         chain, specs = grown
@@ -535,6 +546,7 @@ def plan_stages(
         # Fused chains negotiated a static spec by construction (fusable()
         # requires it); only a deferred host_post gates sharding.
         stages.append(Stage(fe, chain, chain[0], chain[-1], batchable=True,
-                            shardable=fe._host_post is None))
+                            shardable=fe._host_post is None,
+                            restartable=True))
         consumed.update(chain)
     return stages

@@ -213,6 +213,16 @@ class _Runner:
         self._m_occupancy = f"{name}.batch_occupancy"
         self._m_qwait = f"{name}.queue_wait"
         self._m_e2e = f"{name}.e2e_latency"
+        self._m_restarts = f"{name}.restarts"
+        self._restarts = 0  # elastic in-place restarts taken so far
+        #: _drain_batch pushback held ACROSS a restart: a carried item
+        #: (often the EOS event) popped before the fault must survive
+        #: re-entry, or a restarted stage would drop it and hang the
+        #: pipeline waiting for an EOS nobody holds anymore
+        self._carry = None
+        #: buffers in the hands of process()/process_batch() right now —
+        #: what a restart actually loses (counted into .dropped)
+        self._proc_n = 0
         # Flight recorder (docs/OBSERVABILITY.md): None when trace_mode is
         # off — every instrumentation site below reduces to one pointer
         # check, and no meta stamps are written (the untraced code path).
@@ -275,25 +285,61 @@ class _Runner:
     # -- main loop ---------------------------------------------------------
     def _run(self) -> None:
         el = self.element
-        try:
-            if isinstance(el, SourceElement):
-                self._run_source()
-            else:
-                self._run_stream()
-        except Exception as e:  # noqa: BLE001 - must not kill the process
-            log.exception("stage %s failed", el.name)
-            self.pipeline._record_error(el.name, e)
+        while True:
             try:
-                # Batches dispatched BEFORE the failing one completed
-                # fine and are still held in the in-flight window —
-                # deliver them (downstream queues are open on this path)
-                # before the error/EOS, exactly what dispatch_depth=1
-                # would have done.
-                self._flush_inflight()
-            except Exception:  # noqa: BLE001 - error path must broadcast
-                log.exception("in-flight flush failed for %s", el.name)
-            self._broadcast(Event.error(e))
-            self._broadcast(Event.eos())
+                if isinstance(el, SourceElement):
+                    self._run_source()
+                else:
+                    self._run_stream()
+                return
+            except Exception as e:  # noqa: BLE001 - must not kill process
+                if (self.stage.restartable
+                        and not isinstance(el, SourceElement)
+                        # restart ONLY faults raised inside process()/
+                        # process_batch() (_proc_n is set around exactly
+                        # those calls): an exception while handling an
+                        # already-consumed EVENT (EOS -> finalize) has
+                        # irreversibly eaten it, and re-entering the
+                        # loop would block on an empty queue forever
+                        # instead of broadcasting EOS
+                        and self._proc_n > 0
+                        and self._restarts
+                        < self.pipeline.max_stage_restarts
+                        and not self.pipeline._stopping.is_set()):
+                    # Elastic stage restart (docs/SERVING.md "Elastic
+                    # serving"): a pure/stateless stage holds no cross-
+                    # buffer state, so re-entering its loop after an
+                    # exception loses exactly the one buffer that
+                    # triggered it.  Prior in-flight batches completed
+                    # fine — deliver them first so ordering holds.
+                    self._restarts += 1
+                    metrics.count(self._m_restarts)
+                    metrics.count(self._m_dropped, max(1, self._proc_n))
+                    self._proc_n = 0
+                    log.warning(
+                        "stage %s failed (%r); restarting in place "
+                        "(%d/%d)", el.name, e, self._restarts,
+                        self.pipeline.max_stage_restarts)
+                    try:
+                        self._flush_inflight()
+                    except Exception:  # noqa: BLE001
+                        log.exception(
+                            "in-flight flush failed for %s", el.name)
+                    continue
+                log.exception("stage %s failed", el.name)
+                self.pipeline._record_error(el.name, e)
+                try:
+                    # Batches dispatched BEFORE the failing one completed
+                    # fine and are still held in the in-flight window —
+                    # deliver them (downstream queues are open on this
+                    # path) before the error/EOS, exactly what
+                    # dispatch_depth=1 would have done.
+                    self._flush_inflight()
+                except Exception:  # noqa: BLE001 - must still broadcast
+                    log.exception("in-flight flush failed for %s", el.name)
+                self._broadcast(Event.error(e))
+                self._broadcast(Event.eos())
+                return
 
     def _run_source(self) -> None:
         el = self.element
@@ -501,11 +547,12 @@ class _Runner:
         all_policy = el.sync_policy == "all" and len(self.in_pads) > 1
         batching = self.batch_max > 1 and not all_policy
         depth = self.dispatch_depth if batching else 1
-        carry = None
+        # pushback lives on self (not a local) so an elastic restart
+        # re-enters with the carried item — losing it would lose an EOS
         while True:
-            if carry is not None:
-                pad, item = carry
-                carry = None
+            if self._carry is not None:
+                pad, item = self._carry
+                self._carry = None
             else:
                 nxt = None
                 if self._inflight:
@@ -551,7 +598,7 @@ class _Runner:
             tr = self._tr
             if batching:
                 tdr0 = time.monotonic_ns() if tr is not None else 0
-                batch, carry = self._drain_batch(pad, item)
+                batch, self._carry = self._drain_batch(pad, item)
                 n = len(batch)
                 metrics.count(self._m_in, n)
                 # real cumulative histogram (ladder-shaped buckets), not
@@ -559,8 +606,10 @@ class _Runner:
                 # Prometheus read the same occupancy stream
                 metrics.observe_bucketed(self._m_occupancy, float(n))
                 t0 = time.perf_counter()
+                self._proc_n = n
                 outs = (el.process_batch(pad, batch) if n > 1
                         else el.process(pad, batch[0]))
+                self._proc_n = 0
                 # PER-BUFFER proc time: the .proc series must keep one
                 # meaning whether batching is on or off (same rule the
                 # filter applies to its .invoke series)
@@ -582,11 +631,12 @@ class _Runner:
                 else:
                     self._emit(outs)
                     metrics.count(self._m_out, n)
-                if carry is not None and carry[1] is _POISON:
+                if self._carry is not None and self._carry[1] is _POISON:
                     self._flush_inflight()
                     return
                 continue
             metrics.count(self._m_in)
+            self._proc_n = 1
             if tr is None:
                 with Timer(self._m_proc):
                     outs = el.process(pad, item)
@@ -607,6 +657,7 @@ class _Runner:
                 self._propagate_trace([item], outs)
                 if self._is_sink:
                     self._trace_sink_delivery(item, now0 + dur)
+            self._proc_n = 0
             self._emit(outs)
             metrics.count(self._m_out)
 
@@ -773,6 +824,7 @@ class Pipeline:
         trace_mode: Optional[str] = None,
         tenant: Optional[str] = None,
         slo=None,
+        max_stage_restarts: Optional[int] = None,
         validate: Union[bool, str] = False,
     ):
         if validate:
@@ -845,6 +897,12 @@ class Pipeline:
         self.reduce_outputs = bool(
             reduce_outputs if reduce_outputs is not None
             else cfg.reduce_outputs)
+        # elastic stage restarts (docs/SERVING.md "Elastic serving"):
+        # pure/stateless stages may be restarted in place this many
+        # times after an exception before the pipeline fails for real
+        self.max_stage_restarts = max(0, int(
+            max_stage_restarts if max_stage_restarts is not None
+            else cfg.max_stage_restarts))
         self.trace_mode = str(
             trace_mode if trace_mode is not None else cfg.trace_mode)
         if self.trace_mode not in ("off", "ring", "full"):
@@ -1277,6 +1335,77 @@ class Pipeline:
         ``trace_mode != off`` for latency/throughput objectives (the e2e
         histograms are only fed when tracing is on)."""
         return self._slo_loop().report()
+
+    # -- elastic serving: drain / handover ---------------------------------
+    def serve_streams(self) -> Dict[int, dict]:
+        """Continuous-serving streams live on this pipeline:
+        ``stream_id -> {"state", "tenant", "slot", "blocks",
+        "element"}`` (docs/SERVING.md "Elastic serving")."""
+        out: Dict[int, dict] = {}
+        for el in self.elements.values():
+            table_fn = getattr(el, "serve_streams", None)
+            if table_fn is None:
+                continue
+            try:
+                table = table_fn()
+            except Exception:  # noqa: BLE001 - discovery must not throw
+                continue
+            for sid, info in table.items():
+                out[sid] = {**info, "element": el.name}
+        return out
+
+    def drain_stream(self, stream_id: int, timeout: float = 30.0) -> dict:
+        """Serialize one live continuous-serving stream OFF this
+        pipeline: its paged KV blocks, slot state, and request meta
+        become a host-value snapshot (the trainer/checkpoint.py
+        serialization substrate — persist it with
+        ``trainer.checkpoint.save_stream_snapshot``), and its slot +
+        blocks return to the pool's free list.  :meth:`adopt_stream` on
+        another pipeline (or this one, after a versioned-config
+        restart) continues the stream — bit-identically for greedy
+        decode — so recompile-requiring config changes become
+        drain → restart → adopt instead of dropped traffic.  The move
+        is host-side values only; neither pipeline's 3-program decode
+        census is touched (span: ``elastic.drain``)."""
+        for el in self.elements.values():
+            table_fn = getattr(el, "serve_streams", None)
+            if table_fn is None:
+                continue
+            try:
+                owned = stream_id in table_fn()
+            except Exception:  # noqa: BLE001
+                continue
+            if owned:
+                return el.drain_serve_stream(stream_id, timeout)
+        raise PipelineError(
+            f"no live serve stream {stream_id} on this pipeline "
+            f"(known: {sorted(self.serve_streams())})")
+
+    def adopt_stream(self, snapshot: dict, timeout: float = 30.0) -> int:
+        """Re-admit a drained stream (:meth:`drain_stream`'s snapshot,
+        or one loaded via ``trainer.checkpoint.load_stream_snapshot``)
+        into this pipeline's continuous-serving filter.  Returns the
+        stream id; the remaining tokens flow to THIS pipeline's sinks
+        (span: ``elastic.adopt``)."""
+        last_err: Optional[Exception] = None
+        for el in self.elements.values():
+            adopt_fn = getattr(el, "adopt_serve_stream", None)
+            if adopt_fn is None:
+                continue
+            fw = getattr(el, "fw", None)
+            if fw is None or not getattr(fw, "continuous", False):
+                continue
+            try:
+                return adopt_fn(snapshot, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - try other filters
+                last_err = e
+        if last_err is not None:
+            raise PipelineError(
+                f"adopt_stream failed: {last_err}") from last_err
+        raise PipelineError(
+            "no continuous-serving filter on this pipeline to adopt "
+            "into (need tensor_filter framework=llm "
+            "custom=serve:continuous)")
 
     def __enter__(self) -> "Pipeline":
         return self.start()

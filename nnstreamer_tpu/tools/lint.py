@@ -155,7 +155,7 @@ def _unresolved_keys(fname: str, skipped: List[Tuple[int, str]]
 def lint_files(paths: List[str], *, strict: bool, verbose: bool,
                baseline: Optional[set] = None,
                collected: Optional[List[str]] = None,
-               deep: bool = False) -> int:
+               deep: bool = False, reconfig: Optional[dict] = None) -> int:
     from ..analysis import analyze
 
     rc = 0
@@ -182,7 +182,7 @@ def lint_files(paths: List[str], *, strict: bool, verbose: bool,
                       f"{snippet}")
         for desc in strings:
             total += 1
-            report = analyze(desc, deep=deep)
+            report = analyze(desc, deep=deep, reconfig=reconfig)
             keys = [_diag_key(fname, d, desc) for d in report]
             if collected is not None:
                 collected.extend(keys)
@@ -276,6 +276,14 @@ def main(argv=None) -> int:
                          "(jax.eval_shape: shape/dtype contract checks + "
                          "static HBM/recompile budgets; imports jax, zero "
                          "dispatch)")
+    ap.add_argument("--reconfig", metavar="K:V[,K:V...]",
+                    help="with --deep: propose a runtime config change "
+                         "for continuous-serving stages (e.g. "
+                         "slots:8,kv_blocks:256) — knobs whose change "
+                         "would alter a compiled signature warn "
+                         "recompile-on-reconfig with the drain/restart "
+                         "remediation (docs/SERVING.md 'Elastic "
+                         "serving')")
     ap.add_argument("--baseline", metavar="FILE",
                     help="accepted-diagnostics file: only NEW diagnostics "
                          "fail (one key per line, '#' comments)")
@@ -300,12 +308,24 @@ def main(argv=None) -> int:
             }
     collected: List[str] = []
 
+    reconfig = None
+    if args.reconfig:
+        if not args.deep:
+            # the check lives in the deep pass; silently ignoring the
+            # flag would green-light the exact mutation it exists to
+            # catch
+            print("--reconfig requires --deep", file=sys.stderr)
+            return 2
+        from ..filters.base import parse_custom_options
+
+        reconfig = parse_custom_options(args.reconfig)
+
     rc = 0
     if args.pipeline:
         from ..analysis import analyze
 
         for desc in args.pipeline:
-            report = analyze(desc, deep=args.deep)
+            report = analyze(desc, deep=args.deep, reconfig=reconfig)
             _render(desc, report, verbose=args.verbose)
             if report.errors or (args.strict and report.warnings):
                 rc = 1
@@ -325,7 +345,8 @@ def main(argv=None) -> int:
     if files:
         rc = max(rc, lint_files(files, strict=args.strict,
                                 verbose=args.verbose, baseline=baseline,
-                                collected=collected, deep=args.deep))
+                                collected=collected, deep=args.deep,
+                                reconfig=reconfig))
 
     if args.dogfood:
         rc = max(rc, dogfood(strict=args.strict, baseline=baseline,

@@ -69,6 +69,49 @@ def load_checkpoint(path: str) -> Tuple[Any, Optional[Any], int]:
     return blob["params"], blob.get("opt_state"), int(blob.get("step", 0))
 
 
+#: magic header of a serialized serve-stream snapshot (drain/adopt —
+#: docs/SERVING.md "Elastic serving"); bumped on layout changes so an
+#: adopt can reject a stale snapshot with a named error instead of a
+#: shape crash mid-restore.
+STREAM_SNAPSHOT_VERSION = 1
+
+
+def save_stream_snapshot(path: str, snapshot: Dict[str, Any]) -> str:
+    """Persist one drained serve-stream snapshot
+    (:meth:`~nnstreamer_tpu.pipeline.runtime.Pipeline.drain_stream`)
+    through the same serialization substrate checkpoints use: every
+    array leaf is moved to host (:func:`to_host_tree`) and the blob is
+    a single portable pickle.  Returns the path written."""
+    blob = dict(to_host_tree(snapshot))
+    blob["snapshot_version"] = STREAM_SNAPSHOT_VERSION
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    return path
+
+
+def load_stream_snapshot(path: str) -> Dict[str, Any]:
+    """Read a snapshot written by :func:`save_stream_snapshot`; raises
+    ``ValueError`` on a version the adopt path does not understand."""
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    ver = blob.pop("snapshot_version", None)
+    if ver != STREAM_SNAPSHOT_VERSION:
+        raise ValueError(
+            f"stream snapshot version {ver!r} unsupported "
+            f"(expected {STREAM_SNAPSHOT_VERSION})")
+    return blob
+
+
+def to_host_tree(tree: Any) -> Any:
+    """Public name of the checkpoint serialization substrate: every
+    array leaf (jax or numpy) becomes a host numpy array; containers and
+    namedtuples keep their structure.  Drain/adopt snapshots go through
+    this exact walk so a drained stream is plain host data."""
+    return _to_host(tree)
+
+
 def _to_host(tree: Any) -> Any:
     if tree is None:
         return None

@@ -75,6 +75,19 @@ SPAN_KINDS: Dict[str, str] = {
     "admit.downgrade": "query-server admission moved a request to the "
                        "low-priority lane under backlog (instant; args: "
                        "tenant, msg, backlog)",
+    "elastic.scale": "autoscaler action edge (utils/elastic.py — "
+                     "instant; args: action, tenant, burn, edge = "
+                     "engage|relax; rate-limited with hysteresis)",
+    "elastic.drain": "live serve stream serialized off its pipeline "
+                     "(Pipeline.drain_stream; args: stream_id, state, "
+                     "blocks — a host-side value move, the 3-program "
+                     "decode census is untouched)",
+    "elastic.adopt": "serialized serve stream re-admitted on a pipeline "
+                     "(Pipeline.adopt_stream; args: stream_id, state, "
+                     "blocks; greedy continuation is bit-identical)",
+    "serve.reap": "continuous LLM serving: an orphaned/cancelled "
+                  "stream's slot + KV blocks reclaimed to the free "
+                  "list (args: slot, stream_id, blocks, reason)",
 }
 
 #: buffer-meta keys the tracer owns (stamped only when tracing is active)

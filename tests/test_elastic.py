@@ -1,0 +1,637 @@
+"""nns-elastic (ISSUE 11): drain/handover, orphan reaping, admission
+robustness, the burn-rate autoscaler, and the recompile-on-reconfig
+lint — docs/SERVING.md "Elastic serving".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import Metrics, metrics
+from nnstreamer_tpu.filters.llm import LLMFramework
+from nnstreamer_tpu.trainer import checkpoint as ckpt
+from nnstreamer_tpu.pipeline.runtime import PipelineError
+from nnstreamer_tpu.utils import elastic, tracing
+
+SERVE = ("max_new:10,serve:continuous,slots:2,stream_chunk:2,"
+         "temperature:0.0,dtype:float32")
+
+
+def make_fw(custom: str = SERVE, model: str = "llama_tiny"):
+    fw = LLMFramework()
+    fw.open({"model": model, "custom": custom})
+    return fw
+
+
+class Collector:
+    """emit() target: records (token_id, meta) and flags completion."""
+
+    def __init__(self):
+        self.toks = []
+        self.done = threading.Event()
+
+    def __call__(self, tensors, meta):
+        self.toks.append((int(tensors[0][0]) if len(tensors[0]) else -1,
+                          dict(meta)))
+        if meta.get("stream_last"):
+            self.done.set()
+
+    @property
+    def ids(self):
+        return [t for t, m in self.toks if t >= 0]
+
+    @property
+    def sid(self):
+        return self.toks[0][1].get("stream_id") if self.toks else None
+
+
+# ---------------------------------------------------------------------------
+# drain / adopt
+# ---------------------------------------------------------------------------
+
+class TestDrainAdopt:
+    def test_greedy_bit_identity_and_census(self):
+        """A live greedy stream drained at step k and adopted on a fresh
+        loop continues BIT-IDENTICALLY to an undrained run, with the
+        3-program zero-recompile census intact on both loops, and both
+        pools' free lists fully restored."""
+        prompt = np.asarray([[3, 5, 7, 9]], np.int32)
+        ref_c = Collector()
+        fw_ref = make_fw()
+        fw_ref.submit([prompt[0]], {}, ref_c)
+        assert ref_c.done.wait(60)
+        ref = ref_c.ids
+
+        fw_a, fw_b = make_fw(), make_fw()
+        got = Collector()
+        seen3 = threading.Event()
+
+        def emit_a(tensors, meta):
+            got(tensors, meta)
+            if len(got.toks) >= 3:
+                seen3.set()
+
+        fw_a.submit([prompt[0]], {}, emit_a)
+        assert seen3.wait(60)
+        snap = fw_a.drain_stream(got.sid, timeout=30)
+        assert snap["kind"] == "live" and snap["greedy"] is True
+        # the drained pipeline's pool is whole again
+        assert fw_a._serve.pool_stats()["blocks_free"] == \
+            fw_a._serve.pool_stats()["blocks_total"]
+        # roundtrip through the checkpoint serialization substrate
+        snap = ckpt.load_stream_snapshot(
+            ckpt.save_stream_snapshot("/tmp/nns_elastic_snap.pkl", snap))
+
+        cont = Collector()
+        fw_b.adopt_stream(snap, cont)
+        assert cont.done.wait(60)
+        pre = got.ids[:snap["sidx"]]
+        assert pre + cont.ids == ref, (pre, cont.ids, ref)
+        # stream_index continues where the drained pipeline stopped
+        assert [m["stream_index"] for _, m in cont.toks] == \
+            list(range(snap["sidx"], len(ref)))
+        assert cont.toks[-1][1].get("stream_last") is True
+        # the 3-program zero-recompile pin holds on BOTH loops
+        for fw in (fw_a, fw_b):
+            loop = fw._serve
+            assert (loop._decode._cache_size(),
+                    loop._prefill._cache_size(),
+                    loop._set_tok._cache_size()) == (1, 1, 1)
+            stats = loop.pool_stats()
+            assert stats["blocks_free"] == stats["blocks_total"]
+        for fw in (fw_ref, fw_a, fw_b):
+            fw.close()
+
+    def test_drain_queued_stream_readmits(self):
+        """A stream still WAITING for admission drains as a queued-kind
+        snapshot (prompt + meta, no blocks) and completes after adopt."""
+        fw_a, fw_b = make_fw(), make_fw()
+        blocker, queued = Collector(), Collector()
+        # slots:2 — fill both so the third submit stays queued
+        fw_a.submit([np.asarray([1, 2, 3], np.int32)], {}, Collector())
+        fw_a.submit([np.asarray([2, 3, 4], np.int32)], {}, blocker)
+        sid = fw_a._serve.submit(np.asarray([[5, 6, 7]], np.int32),
+                                 {}, queued)
+        # it may admit once the first two finish — drain promptly; accept
+        # either kind (queued before admission, live after)
+        snap = fw_a.drain_stream(sid, timeout=30)
+        assert snap["kind"] in ("queued", "live")
+        cont = Collector()
+        fw_b.adopt_stream(snap, cont)
+        assert cont.done.wait(60)
+        ref_c = Collector()
+        ref_fw = make_fw()
+        ref_fw.submit([np.asarray([5, 6, 7], np.int32)], {}, ref_c)
+        assert ref_c.done.wait(60)
+        pre = [] if snap["kind"] == "queued" else snap["sidx"]
+        if snap["kind"] == "live":
+            assert cont.ids == ref_c.ids[snap["sidx"]:]
+        else:
+            assert cont.ids == ref_c.ids
+        for fw in (fw_a, fw_b, ref_fw):
+            fw.close()
+
+    def test_adopt_rejects_incompatible_snapshot(self):
+        fw_a = make_fw()
+        c = Collector()
+        fw_a.submit([np.asarray([1, 2, 3, 4], np.int32)], {}, c)
+        assert c.done.wait(60) or c.toks  # at least started
+        while not c.done.wait(1):
+            pass
+        # finished stream: drain on a fresh one to get a snapshot
+        got = Collector()
+        seen = threading.Event()
+
+        def emit(tensors, meta):
+            got(tensors, meta)
+            seen.set()
+
+        fw_a.submit([np.asarray([9, 8, 7], np.int32)], {}, emit)
+        assert seen.wait(60)
+        snap = fw_a.drain_stream(got.sid, timeout=30)
+        # different model geometry must be rejected with named problems
+        fw_other = make_fw(SERVE + ",n_layers:1")
+        from nnstreamer_tpu.filters.base import FrameworkError
+
+        with pytest.raises(FrameworkError, match="geometry"):
+            fw_other.adopt_stream(snap, Collector())
+        # stale snapshot version
+        bad = dict(snap, version=99)
+        with pytest.raises(FrameworkError, match="version"):
+            fw_a.adopt_stream(bad, Collector())
+        fw_a.close()
+        fw_other.close()
+
+    def test_snapshot_file_version_gate(self, tmp_path):
+        path = str(tmp_path / "snap.pkl")
+        ckpt.save_stream_snapshot(path, {"kind": "queued", "version": 1})
+        loaded = ckpt.load_stream_snapshot(path)
+        assert loaded["kind"] == "queued"
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump({"snapshot_version": 42}, f)
+        with pytest.raises(ValueError, match="version"):
+            ckpt.load_stream_snapshot(path)
+
+
+# ---------------------------------------------------------------------------
+# orphan reaping / cancellation
+# ---------------------------------------------------------------------------
+
+class TestCancelReap:
+    def test_force_cancel_reaps_blocks_and_terminates(self):
+        fw = make_fw(SERVE.replace("max_new:10", "max_new:200"))
+        got = Collector()
+        first = threading.Event()
+
+        def emit(tensors, meta):
+            got(tensors, meta)
+            first.set()
+
+        fw.submit([np.asarray([1, 2, 3], np.int32)], {}, emit)
+        assert first.wait(60)
+        sid = got.sid
+        base = metrics.snapshot().get("llm.serve.reaped", 0.0)
+        assert elastic.cancel_stream(sid, "test-reap", force=True)
+        # terminator arrives and the pool is whole again
+        assert got.done.wait(30)
+        last = got.toks[-1][1]
+        assert last.get("stream_aborted") is True
+        assert last.get("abort_reason") == "test-reap"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            stats = fw._serve.pool_stats()
+            if stats["blocks_free"] == stats["blocks_total"]:
+                break
+            time.sleep(0.05)
+        stats = fw._serve.pool_stats()
+        assert stats["blocks_free"] == stats["blocks_total"], stats
+        assert metrics.snapshot().get("llm.serve.reaped", 0.0) == base + 1
+        # registry entry cleaned up; cancel is now a no-op
+        assert elastic.cancel_stream(sid) is False
+        fw.close()
+
+    def test_cancel_unknown_stream_is_noop(self):
+        assert elastic.cancel_stream(999999999) is False
+        assert elastic.cancel_stream(None) is False
+
+    def test_slot_reusable_after_reap(self):
+        fw = make_fw(SERVE.replace("slots:2", "slots:1")
+                     .replace("max_new:10", "max_new:200"))
+        got = Collector()
+        first = threading.Event()
+
+        def emit(tensors, meta):
+            got(tensors, meta)
+            first.set()
+
+        fw.submit([np.asarray([1, 2, 3], np.int32)], {}, emit)
+        assert first.wait(60)
+        elastic.cancel_stream(got.sid, force=True)
+        assert got.done.wait(30)
+        # the only slot was reaped — a fresh stream must admit and finish
+        nxt = Collector()
+        fw.submit([np.asarray([4, 5, 6], np.int32)], {}, nxt)
+        assert nxt.done.wait(60)
+        assert not nxt.toks[-1][1].get("stream_aborted")
+        fw.close()
+
+
+# ---------------------------------------------------------------------------
+# admission robustness (FIFO head-of-line + quotas)
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_admit_timeout_rejects_typed(self):
+        """A waiting stream that cannot admit within admit_timeout is
+        rejected with a typed abort instead of wedging every tenant
+        queued behind it (the head-of-line fix)."""
+        # slots:1 + a long-running occupant: the second prompt waits
+        fw = make_fw("max_new:200,serve:continuous,slots:1,"
+                     "stream_chunk:2,temperature:0.0,dtype:float32,"
+                     "admit_timeout:0.3,n_layers:4")
+        occupant, waiter = Collector(), Collector()
+        first = threading.Event()
+
+        def emit(tensors, meta):
+            occupant(tensors, meta)
+            first.set()
+
+        fw.submit([np.asarray([1, 2, 3], np.int32)], {}, emit)
+        assert first.wait(60)
+        fw.submit([np.asarray([4, 5, 6], np.int32)], {}, waiter)
+        assert waiter.done.wait(30)
+        last = waiter.toks[-1][1]
+        # either the occupant finished first (fast host) and the waiter
+        # ran, or — the path under test — it timed out typed.  Force
+        # determinism: the occupant decodes 200 tokens, far longer than
+        # 0.3 s only on slow hosts, so accept the reject OR a full run
+        # but require the typed reason when aborted.
+        if last.get("stream_aborted"):
+            assert last.get("abort_reason") == "admit-timeout"
+            assert metrics.snapshot().get(
+                "llm.serve.admit_timeouts", 0.0) >= 1
+        fw.close()
+
+    def test_impossible_reservation_rejected_typed(self):
+        fw = make_fw()
+        # max_seq-exceeding prompt: typed oversize rejection
+        T = fw.cfg.max_seq + 4
+        c = Collector()
+        fw.submit([np.arange(1, T + 1, dtype=np.int32)], {}, c)
+        assert c.done.wait(30)
+        assert c.toks[-1][1].get("stream_aborted") is True
+        assert c.toks[-1][1].get("abort_reason") == "prompt-oversize"
+        fw.close()
+
+    def test_tenant_quota_skips_not_blocks(self):
+        """An over-quota tenant's prompt is SKIPPED (tenant-scoped
+        deferral), not allowed to head-of-line-block other tenants."""
+        fw = make_fw()
+        loop_holder = {}
+        capped, other = Collector(), Collector()
+        # quota 0 blocks all reservations for tenant "capped"
+        fw.submit([np.asarray([1, 2, 3], np.int32)],
+                  {"_tenant": "capped"}, capped)
+        loop_holder["loop"] = fw._serve
+        fw._serve.set_tenant_quota("capped", 0)
+        # wait out the first (pre-quota) stream, then submit both
+        assert capped.done.wait(60)
+        capped2 = Collector()
+        fw.submit([np.asarray([1, 2, 3], np.int32)],
+                  {"_tenant": "capped"}, capped2)
+        fw.submit([np.asarray([4, 5, 6], np.int32)],
+                  {"_tenant": "other"}, other)
+        # "other" completes while "capped" defers behind its quota
+        assert other.done.wait(60)
+        assert not capped2.done.is_set()
+        assert metrics.snapshot().get("llm.serve.quota_deferred",
+                                      0.0) >= 1
+        # lifting the quota admits the deferred stream
+        fw._serve.set_tenant_quota("capped", None)
+        assert capped2.done.wait(60)
+        assert not capped2.toks[-1][1].get("stream_aborted")
+        fw.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+class _StubCore:
+    def __init__(self):
+        self.tenant_admission = {}
+
+
+class _StubLoop:
+    def __init__(self):
+        self.quotas = {}
+
+    def set_tenant_quota(self, tenant, blocks):
+        if blocks is None:
+            self.quotas.pop(tenant, None)
+        else:
+            self.quotas[tenant] = blocks
+
+
+class _StubFw:
+    continuous = True
+
+    def __init__(self):
+        self._serve = _StubLoop()
+
+
+class _StubEl:
+    def __init__(self, core=None, fw=None):
+        if core is not None:
+            self._core = core
+        if fw is not None:
+            self.fw = fw
+
+
+class _StubPipeline:
+    def __init__(self, *els):
+        self.elements = dict(enumerate(els))
+
+
+class TestAutoscaler:
+    def _mk(self, rules, core=None, loop_el=None):
+        m = Metrics()
+        rec = tracing.FlightRecorder("ring", 1024)
+        els = [e for e in (
+            _StubEl(core=core) if core is not None else None,
+            loop_el) if e is not None]
+        scaler = elastic.Autoscaler(
+            _StubPipeline(*els), {"rules": rules}, metrics=m,
+            recorder=rec)
+        return scaler, m, rec
+
+    def test_engage_relax_hysteresis_and_spans(self):
+        core = _StubCore()
+        scaler, m, rec = self._mk(
+            [{"tenant": "*", "burn_above": 1.5, "burn_below": 0.5,
+              "action": "admission:shed", "cooldown_s": 0.0}],
+            core=core)
+        m.gauge("slo.burn_rate", 3.0, tenant="acme")
+        assert scaler.evaluate() == 1
+        assert core.tenant_admission == {"acme": "shed"}
+        # already engaged: in-band burn produces NO further edges
+        m.gauge("slo.burn_rate", 2.5, tenant="acme")
+        assert scaler.evaluate() == 0
+        # inside the hysteresis band (0.5..1.5): still engaged
+        m.gauge("slo.burn_rate", 1.0, tenant="acme")
+        assert scaler.evaluate() == 0
+        assert core.tenant_admission == {"acme": "shed"}
+        # below the low band: relax
+        m.gauge("slo.burn_rate", 0.2, tenant="acme")
+        assert scaler.evaluate() == 1
+        assert core.tenant_admission == {}
+        kinds = [e.kind for e in rec.events()]
+        assert kinds.count("elastic.scale") == 2
+        edges = [e.args["edge"] for e in rec.events()
+                 if e.kind == "elastic.scale"]
+        assert edges == ["engage", "relax"]
+        assert [a["edge"] for a in scaler.actions] == ["engage", "relax"]
+
+    def test_cooldown_rate_limits(self):
+        core = _StubCore()
+        scaler, m, _ = self._mk(
+            [{"tenant": "t", "burn_above": 1.0, "burn_below": 0.1,
+              "action": "admission:shed", "cooldown_s": 60.0}],
+            core=core)
+        m.gauge("slo.burn_rate", 5.0, tenant="t")
+        assert scaler.evaluate() == 1
+        # burn drops under the low band immediately — but the cooldown
+        # holds the relax edge back
+        m.gauge("slo.burn_rate", 0.0, tenant="t")
+        assert scaler.evaluate() == 0
+        assert core.tenant_admission == {"t": "shed"}
+
+    def test_kv_quota_action(self):
+        el = _StubEl(fw=_StubFw())
+        scaler, m, _ = self._mk(
+            [{"tenant": "big", "burn_above": 1.0, "burn_below": 0.2,
+              "action": "kv_quota:8", "cooldown_s": 0.0}],
+            loop_el=el)
+        m.gauge("slo.burn_rate", 2.0, tenant="big")
+        assert scaler.evaluate() == 1
+        assert el.fw._serve.quotas == {"big": 8}
+        m.gauge("slo.burn_rate", 0.0, tenant="big")
+        assert scaler.evaluate() == 1
+        assert el.fw._serve.quotas == {}
+
+    def test_policy_validation(self):
+        problems = elastic.validate_autoscale_policy({"rules": [
+            {"tenant": "x", "action": "explode"},
+            {"burn_above": 1.0, "burn_below": 2.0},
+            {"action": "kv_quota:-3"},
+        ]})
+        joined = "\n".join(problems)
+        assert "explode" in joined
+        assert "hysteresis" in joined
+        assert "kv_quota" in joined
+        with pytest.raises(ValueError, match="invalid autoscale"):
+            elastic.load_autoscale_policy({"rules": [{"action": "nope"}]})
+        assert elastic.load_autoscale_policy(None) == []
+
+    def test_spill_action_drains_to_second_pipeline(self):
+        """The spill action: a live stream of the burning tenant drains
+        off the primary pipeline and is adopted by ``spill_to`` — real
+        frameworks, stubbed only at the Pipeline wrapper level."""
+        fw_a = make_fw(SERVE.replace("max_new:10", "max_new:200"))
+        fw_b = make_fw(SERVE.replace("max_new:10", "max_new:200"))
+
+        class _Pipe:
+            def __init__(self, fw, sink):
+                self.fw, self.sink = fw, sink
+                self.elements = {}
+
+            def serve_streams(self):
+                return self.fw.serve_streams()
+
+            def drain_stream(self, sid, timeout=10.0):
+                return self.fw.drain_stream(sid, timeout)
+
+            def adopt_stream(self, snap, timeout=10.0):
+                return self.fw.adopt_stream(snap, self.sink)
+
+        cont = Collector()
+        prim, sec = _Pipe(fw_a, None), _Pipe(fw_b, cont)
+        got = Collector()
+        first = threading.Event()
+
+        def emit(tensors, meta):
+            got(tensors, meta)
+            first.set()
+
+        fw_a.submit([np.asarray([7, 8, 9], np.int32)],
+                    {"_tenant": "noisy"}, emit)
+        assert first.wait(60)
+        m = Metrics()
+        m.gauge("slo.burn_rate", 9.0, tenant="noisy")
+        scaler = elastic.Autoscaler(
+            prim, {"rules": [{"tenant": "noisy", "burn_above": 2.0,
+                              "burn_below": 0.5, "action": "spill",
+                              "cooldown_s": 60.0}]},
+            spill_to=sec, metrics=m)
+        assert scaler.evaluate() == 1
+        assert cont.done.wait(60)
+        # the spilled stream finished on the SECOND framework
+        assert fw_b.serve_streams() == {}
+        assert fw_a.serve_streams() == {}
+        fw_a.close()
+        fw_b.close()
+
+
+# ---------------------------------------------------------------------------
+# recompile-on-reconfig lint
+# ---------------------------------------------------------------------------
+
+class TestReconfigLint:
+    DESC = ("appsrc name=src ! tensor_filter framework=llm "
+            "model=llama_tiny custom=max_new:32,serve:continuous,slots:4 "
+            "invoke-dynamic=true ! tensor_sink name=out")
+
+    def test_signature_knobs_warn_value_knobs_pass(self):
+        report = nt.analyze(self.DESC, deep=True,
+                            reconfig={"slots": 8, "max_new": 64,
+                                      "kv_blocks": 128})
+        hits = [d for d in report
+                if d.code == "recompile-on-reconfig"]
+        msgs = "\n".join(d.message for d in hits)
+        assert "slots" in msgs and "kv_blocks" in msgs
+        assert "max_new" not in msgs  # host-value knob: silent
+        assert "drain_stream" in msgs  # the remediation is named
+        assert all(d.severity == "warning" for d in hits)
+
+    def test_unchanged_knob_is_silent(self):
+        report = nt.analyze(self.DESC, deep=True, reconfig={"slots": 4})
+        assert not [d for d in report
+                    if d.code == "recompile-on-reconfig"]
+
+    def test_unset_knob_compares_against_default(self):
+        # temperature is not in the custom= string; proposing its
+        # compiled-in default (0.0) is a no-op, not a recompile
+        report = nt.analyze(self.DESC, deep=True,
+                            reconfig={"temperature": 0.0})
+        assert not [d for d in report
+                    if d.code == "recompile-on-reconfig"]
+        report = nt.analyze(self.DESC, deep=True,
+                            reconfig={"temperature": 0.7})
+        assert [d for d in report if d.code == "recompile-on-reconfig"]
+
+    def test_unknown_knob_flagged(self):
+        report = nt.analyze(self.DESC, deep=True,
+                            reconfig={"warp_factor": 9})
+        hits = [d for d in report if d.code == "recompile-on-reconfig"]
+        assert hits and "warp_factor" in hits[0].message
+
+    def test_table_covers_documented_knobs(self):
+        for knob in ("slots", "block_size", "kv_blocks", "prefill_chunk",
+                     "stream_chunk", "max_new", "prefill_budget",
+                     "admit_timeout", "stream_idle_timeout"):
+            assert knob in elastic.SERVE_KNOB_SIGNATURE
+
+
+# ---------------------------------------------------------------------------
+# elastic stage restarts
+# ---------------------------------------------------------------------------
+
+class TestStageRestart:
+    def _register(self, name, fail_times):
+        from nnstreamer_tpu.core.types import TensorsSpec
+        from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+
+        spec = TensorsSpec.from_string("4", "float32")
+        state = {"n": 0}
+
+        def work(ins):
+            state["n"] += 1
+            if state["n"] <= fail_times:
+                raise RuntimeError("injected stage fault")
+            return [ins[0] * 2.0]
+
+        register_custom_easy(name, work, in_spec=spec, out_spec=spec)
+
+    @staticmethod
+    def _force_restartable(p):
+        """The injected fault lives in a HOST custom-easy fn (the only
+        way to raise deterministically per-buffer), which the planner
+        rightly does not mark pure — flip the marker to exercise the
+        runner's restart machinery itself."""
+        for r in {id(r): r for r in p._runners.values()}.values():
+            if r.element.kind == "tensor_filter":
+                r.stage.restartable = True
+
+    def test_planner_marks_pure_stages_restartable(self):
+        # the fused device chain (transform+filter) is pure → restartable;
+        # source and sink stages stay fail-fast
+        p = nt.Pipeline(
+            "appsrc name=src caps=other/tensors,dimensions=4:4,"
+            "types=float32 ! "
+            "tensor_transform mode=arithmetic "
+            "option=typecast:float32,div:2.0 ! "
+            "tensor_filter framework=jax model=scaler "
+            "custom=scale:4.0,dims:4:4 ! tensor_sink name=out")
+        fused = [s for s in p.stages if len(s.node_ids) > 1]
+        assert fused and all(s.restartable for s in fused)
+        for s in p.stages:
+            if s.element.kind in ("appsrc", "tensor_sink"):
+                assert not s.restartable
+
+    def test_restart_survives_bounded_faults(self):
+        self._register("elastic-flaky", fail_times=1)
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_filter name=flaky "
+            "framework=custom-easy model=elastic-flaky ! "
+            "tensor_sink name=out",
+            fuse=False, max_stage_restarts=2)
+        self._force_restartable(p)
+        with p:
+            for i in range(4):
+                p.push("src", np.full((4,), float(i + 1), np.float32))
+            outs = []
+            while True:
+                try:
+                    outs.append(float(np.asarray(
+                        p.pull("out", timeout=10).tensors[0])[0]))
+                except TimeoutError:
+                    break
+            p.eos("src")
+            p.wait(timeout=20)
+        # buffer 1 was lost to the fault; 2..4 survived the restart
+        assert outs == [4.0, 6.0, 8.0]
+        assert metrics.snapshot().get("flaky.restarts", 0.0) == 1
+
+    def test_restart_budget_exhausts_to_failure(self):
+        self._register("elastic-dead", fail_times=10 ** 9)
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_filter name=dead "
+            "framework=custom-easy model=elastic-dead ! "
+            "tensor_sink name=out",
+            fuse=False, max_stage_restarts=1)
+        self._force_restartable(p)
+        with p:
+            p.push("src", np.ones((4,), np.float32))
+            p.push("src", np.ones((4,), np.float32))
+            p.eos("src")
+            with pytest.raises(PipelineError, match="injected"):
+                p.wait(timeout=20)
+        assert metrics.snapshot().get("dead.restarts", 0.0) == 1
+
+    def test_default_is_fail_fast(self):
+        self._register("elastic-once", fail_times=1)
+        p = nt.Pipeline(
+            "appsrc name=src ! tensor_filter framework=custom-easy "
+            "model=elastic-once ! tensor_sink name=out", fuse=False)
+        with p:
+            p.push("src", np.ones((4,), np.float32))
+            p.eos("src")
+            with pytest.raises(PipelineError):
+                p.wait(timeout=20)

@@ -259,3 +259,95 @@ def test_continuous_serving_under_client_churn():
                     c.eos("src")
                     c.wait(timeout=15)
         assert completed == 6
+
+
+def test_stop_idempotent_under_serve():
+    """Double-stop across query/llm/sink elements: a second stop() (and
+    stray element-level stops) must be clean no-ops, mid-stream."""
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=81 ! "
+        "tensor_filter name=f framework=llm model=llama_tiny "
+        "custom=max_new:24,serve:continuous,slots:2,stream_chunk:2,"
+        "temperature:0.0,dtype:float32 invoke-dynamic=true ! "
+        "tensor_query_serversink name=ssink id=81")
+    srv.start()
+    port = srv.element("ssrc").bound_port
+    cli = nt.Pipeline(
+        f"appsrc name=src ! tensor_query_client name=qc port={port} "
+        "timeout=30 reconnect=3 ! tensor_sink name=out")
+    cli.start()
+    cli.push("src", np.asarray([1, 2, 3], np.int32))
+    cli.pull("out", timeout=60)  # at least one token flowed
+    # stop everything twice, in both orders, plus element-level stops
+    cli.stop()
+    cli.stop()
+    srv.stop()
+    srv.stop()
+    srv.element("ssrc").stop()
+    cli.element("qc").stop()
+    # the server id is free again: a fresh pair starts cleanly
+    srv2 = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=81 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,mul:1.0 ! "
+        "tensor_query_serversink id=81")
+    with srv2:
+        assert srv2.element("ssrc").bound_port > 0
+
+
+def test_stop_during_reconnect_backoff():
+    """stop() while the query client is mid-backoff must return promptly
+    (the full-jitter sleep is stop-aware), not ride out the retries."""
+    import time as _time
+
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=82 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,mul:2.0 ! "
+        "tensor_query_serversink id=82")
+    srv.start()
+    port = srv.element("ssrc").bound_port
+    cli = nt.Pipeline(
+        f"appsrc name=src ! tensor_query_client name=qc port={port} "
+        "timeout=20 reconnect=8 reconnect-base-ms=500 "
+        "reconnect-cap-ms=5000 ! tensor_sink name=out")
+    cli.start()
+    cli.push("src", np.ones((4,), np.float32))
+    cli.pull("out", timeout=20)
+    srv.stop()  # server gone: the client's rx loop enters backoff
+    cli.push("src", np.ones((4,), np.float32))  # pending; send may fail
+    _time.sleep(0.3)  # let the rx loop notice and start backing off
+    t0 = _time.monotonic()
+    cli.stop()
+    cli.stop()  # idempotent
+    took = _time.monotonic() - t0
+    # 8 retries at up to 5 s jitter each would be ~20 s unmitigated
+    assert took < 5.0, f"stop() waited out the backoff: {took:.1f}s"
+
+
+def test_stop_with_orphaned_slots():
+    """Stopping a continuous-serving server with live (and orphaned)
+    streams must tear down cleanly: the serve loop joins, the stream
+    registry drains, and a double stop stays a no-op."""
+    from nnstreamer_tpu.utils import elastic
+
+    srv = nt.Pipeline(
+        "tensor_query_serversrc name=ssrc port=0 id=83 ! "
+        "tensor_filter name=f framework=llm model=llama_tiny "
+        "custom=max_new:200,serve:continuous,slots:2,stream_chunk:2,"
+        "temperature:0.0,dtype:float32,stream_idle_timeout:60 "
+        "invoke-dynamic=true ! "
+        "tensor_query_serversink name=ssink id=83")
+    srv.start()
+    port = srv.element("ssrc").bound_port
+    cli = nt.Pipeline(
+        f"appsrc name=src ! tensor_query_client port={port} "
+        "timeout=30 on-timeout=drop ! tensor_sink name=out")
+    cli.start()
+    cli.push("src", np.asarray([5, 6, 7], np.int32))
+    cli.pull("out", timeout=60)  # the stream is live server-side
+    before = set(elastic.live_stream_ids())
+    assert before  # at least our stream is registered
+    cli.stop()  # client vanishes: the stream is now orphaned
+    srv.stop()  # must not hang on the orphaned slot
+    srv.stop()  # idempotent
+    # the dead loop unregistered everything it owned
+    assert not (set(elastic.live_stream_ids()) & before)

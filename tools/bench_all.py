@@ -54,6 +54,19 @@ ROWS = [
     # lands next to this sweep (see the row's "artifact" field)
     ("soak_front_door", ["SOAK", "--smoke", "--out",
                          "BENCH_SOAK_sweep.json"]),
+    # chaos-injected soak (ISSUE 11): kill_worker + drop_conn against a
+    # continuous-serving LLM front door — the row's metric is a
+    # recovered-or-not bool (surviving tenants' p99 green, orphaned KV
+    # blocks reclaimed to the free list, clients reconnected with
+    # backoff+jitter); the full artifact lands next to the sweep
+    ("soak_chaos", ["SOAK", "--chaos-smoke", "--out",
+                    "BENCH_CHAOS_sweep.json"]),
+    # autoscaler soak (ISSUE 11): offered load doubles mid-run; the
+    # utils/elastic.Autoscaler must react (elastic.scale spans in the
+    # ring) while no tenant's p99 objective breaches for more than one
+    # eval window — the BENCH_ELASTIC row
+    ("soak_elastic", ["SOAK", "--elastic", "--out",
+                      "BENCH_ELASTIC_sweep.json"]),
     ("detection_ssd", ["--config", "detection"]),
     ("detection_yolov5s", ["--config", "detection",
                            "--detection-model", "yolov5s"]),

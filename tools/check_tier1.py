@@ -76,6 +76,17 @@ recompile-unbounded regression; aggregator device-vs-host bit-identity,
 must PRICE the aggregator ring ("agg ring" bytes + the 3-program census)
 — strict against tools/asr_deep_baseline.txt.
 
+AND it runs the elastic gate (ISSUE 11, docs/SERVING.md "Elastic
+serving"): tests/test_elastic.py in its own pytest process (drain/adopt
+greedy bit-identity with the 3-program census pinned on both pipelines,
+orphan reaping back to the free list, admit-timeout head-of-line
+rejection, autoscaler hysteresis + elastic.scale spans, the
+recompile-on-reconfig lint goldens), then ``tools/soak.py
+--chaos-smoke``: a SIGKILLed tenant's stream must be cancelled through
+the dead-connection backchannel with its KV blocks reclaimed, and a
+mid-run connection cut must be survived via client reconnect
+(backoff + full jitter) — surviving tenants' p99 green both times.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -559,6 +570,109 @@ def run_soak_gate(timeout: int = 600) -> int:
     return 1 if problems else 0
 
 
+def run_elastic_gate(timeout: int = 900) -> int:
+    """Elastic gate (ISSUE 11, docs/SERVING.md "Elastic serving"):
+    tests/test_elastic.py as its own pytest process (drain/adopt greedy
+    bit-identity + the 3-program census pin on both pipelines, orphan
+    reap accounting, admit-timeout head-of-line rejection, autoscaler
+    hysteresis/spans, recompile-on-reconfig lint goldens), then the
+    chaos smoke (``tools/soak.py --chaos-smoke``): the kill_worker and
+    drop_conn profiles must RECOVER — surviving tenants' p99 green,
+    orphaned KV blocks reclaimed to the free list, reconnects observed,
+    slo_report schema intact."""
+    import json
+    import tempfile
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest", "tests/test_elastic.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"elastic gate: tests TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"elastic gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    out = os.path.join(tempfile.gettempdir(), "nns_chaos_gate.json")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+           "--chaos-smoke", "--out", out]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"elastic gate: chaos smoke TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    problems = []
+    if proc.returncode != 0:
+        problems.append(f"soak.py --chaos-smoke rc={proc.returncode}")
+    rows = {}
+    try:
+        with open(out) as f:
+            rows = {r["profile"]: r for r in json.load(f)["rows"]}
+    except (OSError, ValueError, KeyError) as e:
+        problems.append(f"unreadable chaos artifact: {e}")
+    for profile in ("chaos_kill_worker", "chaos_drop_conn"):
+        if profile not in rows:
+            problems.append(f"missing {profile} row")
+            continue
+        r = rows[profile]
+        if not r.get("reclaimed_ok"):
+            problems.append(
+                f"{profile}: KV blocks not reclaimed to the free list "
+                f"(pool={r.get('pool')})")
+        if not r.get("surviving_p99_green"):
+            problems.append(f"{profile}: surviving tenants' p99 not "
+                            f"green ({r.get('slo_report', {})})")
+        if r.get("watchdog_fired"):
+            problems.append(f"{profile}: watchdog fired")
+        rep = r.get("slo_report") or {}
+        missing = SLO_REPORT_KEYS - set(rep)
+        if missing:
+            problems.append(f"{profile}: slo_report missing {missing}")
+        else:
+            for t, v in rep["tenants"].items():
+                mv = SLO_VERDICT_KEYS - set(v)
+                if mv:
+                    problems.append(
+                        f"{profile}: verdict[{t}] missing {mv}")
+    kill = rows.get("chaos_kill_worker", {})
+    if kill:
+        if not kill.get("killed_tenants"):
+            problems.append("kill_worker: no worker was killed")
+        if kill.get("serve", {}).get("cancelled", 0) < 1:
+            problems.append(
+                "kill_worker: dead-connection backchannel cancelled no "
+                "stream")
+    drop = rows.get("chaos_drop_conn", {})
+    if drop:
+        if not drop.get("chaos_record", {}).get("conns_dropped"):
+            problems.append("drop_conn: no connections were severed")
+        reconnects = sum(w.get("reconnects", 0.0)
+                         for w in (drop.get("tenants") or {}).values())
+        if reconnects < 1:
+            problems.append("drop_conn: no client reconnected")
+        if not all(w.get("completed", 0) >= 1
+                   for w in (drop.get("tenants") or {}).values()):
+            problems.append(
+                "drop_conn: a tenant completed nothing after the cut")
+    tag = "OK" if not problems else "FAILED"
+    print(f"elastic gate: {tag} ({passed} tests passed)")
+    for p in problems:
+        print(f"  elastic gate: {p}", file=sys.stderr)
+    if problems and proc.stdout:
+        for line in proc.stdout.strip().splitlines()[-8:]:
+            print(f"  {line}", file=sys.stderr)
+    return 1 if problems else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -578,8 +692,10 @@ def main() -> int:
     serving_rc = run_serving_gate(args.update)
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
+    elastic_rc = run_elastic_gate()
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
-               or mxu_rc or serving_rc or fetch_rc or soak_rc)
+               or mxu_rc or serving_rc or fetch_rc or soak_rc
+               or elastic_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
