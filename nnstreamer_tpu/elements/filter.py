@@ -137,6 +137,10 @@ class TensorFilter(Element):
             self.fw = _load_framework(
                 self.props,
                 mesh_provider=getattr(self, "_mesh_provider", None))
+        # armor handoff (the _trace_rec pattern): the llm serve loop's
+        # nan_guard quarantines poisoned prompts through the pipeline's
+        # DLQ/breaker — docs/ROBUSTNESS.md
+        self.fw._armor = getattr(self, "_armor", None)
         return self.fw
 
     def stop(self) -> None:

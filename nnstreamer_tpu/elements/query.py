@@ -25,6 +25,7 @@ Protocol (all frames length-prefixed, utils/wire.read_frame/write_frame):
 
 from __future__ import annotations
 
+import collections
 import queue as _queue
 import random
 import socket
@@ -32,13 +33,14 @@ import threading
 import time
 
 import numpy as np
-from typing import Dict, Iterator, List, Optional, Tuple, Union
+from typing import Deque, Dict, Iterator, List, Optional, Tuple, Union
 
 from ..core.buffer import Buffer, Event
 from ..core.caps import Caps
 from ..core.log import logger, metrics
 from ..core.registry import register_element
 from ..utils import elastic, wire
+from ..utils.armor import META_POISON
 from ..utils.net import TcpListener, client_handshake, server_handshake
 from .base import Element, ElementError, SourceElement, SinkElement, SRC
 
@@ -46,6 +48,14 @@ log = logger(__name__)
 
 _META_MSG = "_query_msg"
 _META_CONN = "_query_conn"
+#: journal seqno of an accepted request (docs/ROBUSTNESS.md): stamped by
+#: the serversrc reader when a request journal is configured, consumed
+#: (ack + strip) by the serversink when the answer leaves
+_META_JSEQ = "_journal_seq"
+#: marks a buffer re-admitted by journal replay (its original
+#: connection died with the previous process; the serversink acks it
+#: as answered instead of warning about the missing conn)
+_META_REPLAY = "_journal_replay"
 #: tenant identity riding the wire meta (utils/tracing.META_TENANT):
 #: stamped by the client (``tenant=`` prop / appsrc / hello fallback),
 #: read by the server for per-tenant accounting + admission decisions
@@ -91,10 +101,15 @@ class _ServerCore:
 
     def __init__(self, host: str, port: int, topic: str = "",
                  max_backlog: int = 256, admission: str = "block",
-                 on_admit_event=None, send_buf: int = 0):
+                 on_admit_event=None, send_buf: int = 0, journal=None):
         self.topic = topic
         self.admission = admission
         self.max_backlog = max_backlog
+        #: durable request journal (utils/journal.Journal, or None):
+        #: accepted requests append their wire payload BEFORE entering
+        #: the pipeline; the serversink acks the entry when the answer
+        #: leaves — docs/ROBUSTNESS.md "Durable request journal"
+        self.journal = journal
         #: per-tenant admission OVERRIDE (tenant -> "shed"|"downgrade"):
         #: the autoscaler's host-value lever (utils/elastic.Autoscaler
         #: ``admission:`` action) — a burning tenant class can be
@@ -150,36 +165,119 @@ class _ServerCore:
                     raw = wire.read_frame(conn)
                 except socket.timeout:
                     continue
+                except wire.WireError as e:
+                    # FRAMING-level violation (forged length / CRC
+                    # mismatch): the byte stream can no longer be
+                    # trusted to resync — count it and drop the
+                    # connection.  Payload-level violations below are
+                    # recoverable per frame.
+                    self._wire_reject(cid, None, conn_tenant, e,
+                                      fatal=True)
+                    return
                 if raw is None:
                     return
-                buf, _flags = wire.decode_buffer(raw)
-                buf.meta[_META_CONN] = cid
+                try:
+                    buf, _flags = wire.decode_buffer(raw)
+                except wire.WireError as e:
+                    # ONE malformed frame must not tear down the whole
+                    # connection: answer a typed reject (best-effort
+                    # msg-id salvage so the client's slot resolves
+                    # instead of timing out) and keep reading.
+                    self._wire_reject(cid, raw, conn_tenant, e)
+                    continue
                 # stream ids are SERVER-minted (filters/llm.py submit
                 # overwrites them): a client-supplied value would let one
                 # tenant cancel another's live stream through the
                 # dead-connection backchannel
                 buf.meta.pop(elastic.META_STREAM_ID, None)
+                # same trust boundary for the armor/journal plumbing
+                # keys: never client-suppliable ("_poison" would let a
+                # tenant bypass stage invokes AND force an inflight
+                # flush per request on every batching stage)
+                buf.meta.pop(_META_JSEQ, None)
+                buf.meta.pop(_META_REPLAY, None)
+                buf.meta.pop(META_POISON, None)
+                frame_had_tenant = _META_TENANT in buf.meta
                 if conn_tenant is not None:
                     # per-frame meta wins; the hello tenant is the
                     # per-connection fallback
                     buf.meta.setdefault(_META_TENANT, conn_tenant)
                 metrics.count("query_server.in",
                               tenant=buf.meta.get(_META_TENANT))
+                if self.journal is not None:
+                    # journal BEFORE admission: an accepted request must
+                    # be durable before any work happens on it.  A shed
+                    # decision acks immediately below (it was answered).
+                    # A hello-fallback tenant is stamped into the
+                    # journaled payload (re-encode) — a replayed entry
+                    # must keep its tenant identity for quota/SLO/
+                    # breaker attribution even though the original
+                    # frame bytes lack the key.  The conn id is NOT
+                    # stamped yet, so the record stays connection-free.
+                    tenant = buf.meta.get(_META_TENANT)
+                    jraw = (wire.encode_buffer(buf)
+                            if (tenant is not None
+                                and not frame_had_tenant) else raw)
+                    seq = self.journal.append(jraw, tenant=tenant)
+                    if seq:  # 0 = journal already closed (shutdown)
+                        buf.meta[_META_JSEQ] = seq
+                        if self.on_admit_event is not None:
+                            self.on_admit_event("journal", buf, seq)
+                buf.meta[_META_CONN] = cid
                 self._admit(buf)
         finally:
             self.drop_conn(cid)
+
+    def _wire_reject(self, cid: int, raw: Optional[bytes], conn_tenant,
+                     err: wire.WireError, fatal: bool = False) -> None:
+        """Count + answer one rejected wire frame (docs/ROBUSTNESS.md).
+        ``fatal`` marks framing-level violations, where no answer can be
+        routed (the stream is desynced) and the caller drops the
+        connection."""
+        meta = wire.salvage_meta(raw) if raw is not None else None
+        tenant = ((meta or {}).get(_META_TENANT) or conn_tenant)
+        metrics.count("query_server.wire_rejects", tenant=tenant)
+        log.warning("query: rejected wire frame from conn %d "
+                    "(tenant=%s%s): %s", cid, tenant,
+                    ", connection dropped" if fatal else "", err)
+        if self.on_admit_event is not None:
+            victim = Buffer([], meta=dict(meta or {}))
+            if tenant is not None:
+                victim.meta.setdefault(_META_TENANT, tenant)
+            self.on_admit_event("wire_reject", victim,
+                                str(err)[:200])
+        if fatal:
+            return
+        mid = (meta or {}).get(_META_MSG)
+        if mid is None:
+            return  # nothing to route the reject to
+        notice = Buffer([], meta={
+            _META_MSG: mid, "wire_reject": True,
+            "abort_reason": "wire", "error": str(err)[:200]})
+        if tenant is not None:
+            notice.meta[_META_TENANT] = tenant
+        self.send(int(cid), wire.encode_buffer(notice))
 
     # -- admission ---------------------------------------------------------
     def backlog(self) -> int:
         return self.inbound.qsize() + self.lowprio.qsize()
 
-    def _admit(self, buf: Buffer) -> None:
+    def _admit(self, buf: Buffer) -> str:
+        """Admit one request per the (tenant-overridable) policy;
+        returns the decision: ``"ok"`` | ``"downgrade"`` | ``"shed"``."""
         # per-tenant override first (the autoscaler's admission action),
         # then the element-configured policy
         policy = self.admission
         tenant = buf.meta.get(_META_TENANT)
         if tenant is not None and self.tenant_admission:
             policy = self.tenant_admission.get(tenant, policy)
+        if policy == "shed-all":
+            # the armor circuit breaker's override (docs/ROBUSTNESS.md):
+            # a repeat poison offender is shed UNCONDITIONALLY, not just
+            # under backlog pressure like the autoscaler's "shed"
+            self._shed(buf)
+            metrics.gauge("query_server.backlog", float(self.backlog()))
+            return "shed"
         if policy == "block":
             while not self._stopping.is_set():
                 try:
@@ -188,7 +286,8 @@ class _ServerCore:
                 except _queue.Full:
                     continue
             metrics.gauge("query_server.backlog", float(self.backlog()))
-            return
+            return "ok"
+        decision = "ok"
         try:
             self.inbound.put_nowait(buf)
         except _queue.Full:
@@ -197,7 +296,9 @@ class _ServerCore:
                     self.lowprio.put_nowait(buf)
                 except _queue.Full:
                     self._shed(buf)
+                    decision = "shed"
                 else:
+                    decision = "downgrade"
                     metrics.count("query_server.downgraded",
                                   tenant=buf.meta.get(_META_TENANT))
                     if self.on_admit_event is not None:
@@ -205,7 +306,9 @@ class _ServerCore:
                                             self.backlog())
             else:
                 self._shed(buf)
+                decision = "shed"
         metrics.gauge("query_server.backlog", float(self.backlog()))
+        return decision
 
     def _shed(self, buf: Buffer) -> None:
         """Drop one request at admission: count it per tenant, notify the
@@ -215,6 +318,10 @@ class _ServerCore:
         metrics.count("query_server.shed", tenant=tenant)
         if self.on_admit_event is not None:
             self.on_admit_event("shed", buf, self.backlog())
+        seq = buf.meta.get(_META_JSEQ)
+        if seq is not None and self.journal is not None:
+            # a shed IS the answer: the journal entry must not replay
+            self.journal.ack(int(seq))
         cid = buf.meta.get(_META_CONN)
         mid = buf.meta.get(_META_MSG)
         if cid is None or mid is None:
@@ -327,15 +434,54 @@ class TensorQueryServerSrc(SourceElement):
         # ``send-buf`` bounds per-connection kernel send buffering (0 =
         # OS default); see _ServerCore.send_buf
         self.send_buf = int(self.props.get("send_buf", 0))
+        # Durable request journal (docs/ROBUSTNESS.md): ``journal=DIR``
+        # appends every accepted request's wire payload to a
+        # segment-rotated CRC'd WAL before the pipeline sees it;
+        # ``journal-fsync=off|batch|always`` picks the durability/
+        # latency trade; ``journal-replay=true`` (or the pipeline-level
+        # ``Pipeline(journal_replay=True)`` attach) re-admits the
+        # accepted-but-unanswered entries at start().
+        self.journal_dir = str(self.props.get("journal", "") or "")
+        self.journal_fsync = str(
+            self.props.get("journal_fsync", "batch")).lower()
+        self.journal_segment_bytes = int(
+            self.props.get("journal_segment_bytes", 8 << 20))
+        self.journal_replay = bool(self.props.get("journal_replay",
+                                                  False))
+        if self.journal_dir:
+            from ..utils.journal import FSYNC_MODES
+
+            if self.journal_fsync not in FSYNC_MODES:
+                raise ElementError(
+                    f"{self.name}: journal-fsync must be one of "
+                    f"{FSYNC_MODES}, got {self.journal_fsync!r}")
+        self._journal = None
         self._core: Optional[_ServerCore] = None
         self._carry: Optional[Buffer] = None  # shape-mismatch pushback
+        #: journal-replay buffers awaiting re-admission, drained FIRST
+        #: by generate() (normal backpressure — see _replay_journal)
+        self._replay: Deque[Buffer] = collections.deque()
 
-    def _on_admit_event(self, kind: str, buf: Buffer, backlog: int) -> None:
+    def _on_admit_event(self, kind: str, buf: Buffer, detail) -> None:
         """Span-stamp one admission decision with the victim's trace id
         (minted here when the client did not send one) — follows THIS
-        pipeline's trace mode via the element-pinned recorder."""
+        pipeline's trace mode via the element-pinned recorder.  Beside
+        the shed/downgrade decisions, the core reports ``journal``
+        (detail = the appended seqno -> ``journal.append`` span) and
+        ``wire_reject`` (counted only; no taxonomy span)."""
+        if kind == "wire_reject":
+            return  # counted in query_server.wire_rejects; no span kind
         tracer = getattr(self, "_trace_rec", None)
         if tracer is None:
+            return
+        if kind == "journal":
+            args = {"seq": detail}
+            ten = buf.meta.get(_META_TENANT)
+            if ten is not None:
+                args["tenant"] = ten
+            tracer.record("journal.append", self.name,
+                          buf.meta.get("_tid"), time.monotonic_ns(), 0,
+                          **args)
             return
         tid = buf.meta.get("_tid")
         if tid is None:
@@ -346,7 +492,7 @@ class TensorQueryServerSrc(SourceElement):
             # pre-existing _tid — so the admission span and the request's
             # later spans share one timeline
             tid = buf.meta["_tid"] = _tracing.next_trace_id()
-        args = {"msg": buf.meta.get(_META_MSG), "backlog": backlog}
+        args = {"msg": buf.meta.get(_META_MSG), "backlog": detail}
         ten = buf.meta.get(_META_TENANT)
         if ten is not None:
             args["tenant"] = ten
@@ -357,17 +503,93 @@ class TensorQueryServerSrc(SourceElement):
         with _servers_lock:
             if self.sid in _servers:
                 raise ElementError(f"query server id={self.sid} already running")
-        core = _ServerCore(self.host, self.port, topic=self.topic,
-                           max_backlog=self.max_backlog,
-                           admission=self.admission,
-                           on_admit_event=self._on_admit_event,
-                           send_buf=self.send_buf)
-        with _servers_lock:
-            if self.sid in _servers:  # lost a construction race
-                core.close()
-                raise ElementError(f"query server id={self.sid} already running")
-            _servers[self.sid] = core
+        if self.journal_dir:
+            from ..utils.journal import Journal
+
+            self._journal = Journal(
+                self.journal_dir, fsync=self.journal_fsync,
+                segment_bytes=self.journal_segment_bytes)
+        try:
+            core = _ServerCore(self.host, self.port, topic=self.topic,
+                               max_backlog=self.max_backlog,
+                               admission=self.admission,
+                               on_admit_event=self._on_admit_event,
+                               send_buf=self.send_buf,
+                               journal=self._journal)
+            with _servers_lock:
+                if self.sid in _servers:  # lost a construction race
+                    core.close()
+                    raise ElementError(
+                        f"query server id={self.sid} already running")
+                _servers[self.sid] = core
+        except BaseException:
+            # a failed bind / lost sid race must not leak the opened
+            # journal (segment fd + the fsync=batch flusher thread)
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+            raise
         self._core = core
+        # journal replay BEFORE any new connection's traffic: the
+        # previous process's accepted-but-unanswered requests re-enter
+        # the inbound queue exactly once (seqno dedup in the journal)
+        if self._journal is not None and (
+                self.journal_replay
+                or getattr(self, "_journal_replay", False)):
+            self._replay_journal()
+
+    def _replay_journal(self) -> None:
+        """Stage the journal's recovery snapshot for :meth:`generate`.
+
+        Two deliberate properties (docs/ROBUSTNESS.md): the source is
+        the snapshot ``Journal.__init__`` captured BEFORE the listener
+        existed — a reconnected client's resend, accepted once the
+        port is live again, is a new entry and can never be admitted a
+        second time by a later directory re-scan — and the buffers are
+        handed to the source's own ``generate`` loop rather than the
+        bounded inbound queue, so a backlog of unanswered entries
+        larger than ``max-backlog`` drains through normal pipeline
+        backpressure instead of deadlocking ``start()`` with no runner
+        thread alive to consume the queue."""
+        from ..utils import wire as _wire
+
+        replayed = skipped = 0
+        for seq, payload in self._journal.recovered_unanswered:
+            try:
+                buf, _flags = _wire.decode_buffer(payload)
+            except _wire.WireError as e:
+                # CRC'd journal bytes failing the (possibly tightened)
+                # wire limits: ack + skip, never crash the restart
+                log.warning("%s: journal entry %d unreplayable (%s); "
+                            "acked as dropped", self.name, seq, e)
+                self._journal.ack(seq)
+                skipped += 1
+                continue
+            buf.meta.pop(_META_CONN, None)  # the old conn died with the
+            buf.meta.pop(elastic.META_STREAM_ID, None)  # old process
+            # the live reader's trust boundary applies to REPLAYED
+            # bytes too: the journal may hold the original frame's
+            # meta verbatim, and a client-minted poison marker must
+            # not ride back in and retire the entry unprocessed
+            buf.meta.pop(META_POISON, None)
+            buf.meta[_META_JSEQ] = seq
+            buf.meta[_META_REPLAY] = True
+            metrics.count("query_server.replayed",
+                          tenant=buf.meta.get(_META_TENANT))
+            replayed += 1
+            self._replay.append(buf)
+        # release the snapshot's payload bytes: staged buffers hold the
+        # only copy now (a large window must not stay pinned twice)
+        self._journal.recovered_unanswered = []
+        if replayed or skipped:
+            log.info("%s: journal replay re-admitted %d unanswered "
+                     "request(s) (%d unreplayable)", self.name,
+                     replayed, skipped)
+        tracer = getattr(self, "_trace_rec", None)
+        if tracer is not None:
+            tracer.record("journal.replay", self.name, None,
+                          time.monotonic_ns(), 0, entries=replayed,
+                          acked_skipped=skipped)
 
     def stop(self) -> None:
         # Idempotent: after the first stop ``self._core`` is None, and
@@ -380,6 +602,12 @@ class TensorQueryServerSrc(SourceElement):
         if self._core is not None:
             self._core.close()
             self._core = None
+        # undrained replay buffers stay unanswered in the journal and
+        # simply replay again on the next start
+        self._replay.clear()
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
 
     @property
     def bound_port(self) -> int:
@@ -392,6 +620,10 @@ class TensorQueryServerSrc(SourceElement):
         while not stop.is_set():
             first = self._carry
             self._carry = None
+            if first is None and self._replay:
+                # journal-replayed requests re-admit ahead of new
+                # traffic, through the same (batching) path
+                first = self._replay.popleft()
             if first is None:
                 first = self._core.pop_request(timeout=0.1)
                 if first is None:
@@ -481,6 +713,29 @@ class TensorQueryServerSink(SinkElement):
                 self._cancelled_sids.clear()
             metrics.count(f"{self.name}.streams_cancelled")
 
+    @staticmethod
+    def _ack_journal(core, meta: Dict, seq=None,
+                     undeliverable: bool = False) -> bool:
+        """Mark the request's journal entry answered — once: plain
+        responses ack immediately, token streams ack on their final
+        (``stream_last``/aborted) buffer only (``Journal.ack`` is
+        additionally idempotent, so racing failure paths can't double-
+        record).  ``undeliverable=True`` acks regardless of stream
+        position: a DEAD client's entry must not pin the WAL's
+        prefix GC forever — the answer was produced, the work is not
+        lost, and replaying it to a vanished connection buys nothing
+        (the reconnected client's resend is a new entry).  Returns
+        True when an ack record was written."""
+        if seq is None:
+            seq = meta.get(_META_JSEQ)
+        if seq is None or core.journal is None:
+            return False
+        if not undeliverable and "stream_index" in meta \
+                and not (meta.get("stream_last")
+                         or meta.get("stream_aborted")):
+            return False
+        return core.journal.ack(int(seq))
+
     def process(self, pad, buf: Buffer):
         core = _get_server(self.sid)
         if core is None:
@@ -489,6 +744,19 @@ class TensorQueryServerSink(SinkElement):
             return self._send_batched(core, buf)
         cid = buf.meta.get(_META_CONN)
         if cid is None:
+            if buf.meta.get(_META_REPLAY) \
+                    and buf.meta.get(_META_JSEQ) is not None:
+                # journal-replayed request: its client connection died
+                # with the previous process.  The answer is recorded
+                # (acked) so a further restart never re-processes the
+                # entry — the reconnected client's RESEND is a new
+                # entry and gets its answer through the normal path.
+                # Counted once per REQUEST (the ack write), not once
+                # per token buffer of a replayed stream.
+                if self._ack_journal(core, buf.meta):
+                    metrics.count("query_server.replay_answered",
+                                  tenant=buf.meta.get(_META_TENANT))
+                return []
             log.warning("%s: buffer without query connection meta; dropped", self.name)
             metrics.count(f"{self.name}.dropped")
             return []
@@ -497,10 +765,18 @@ class TensorQueryServerSink(SinkElement):
         # the client (the queue-stamp map is this pipeline's plumbing).
         out.meta.pop(_META_CONN, None)
         out.meta.pop("_tq", None)
+        out.meta.pop(_META_REPLAY, None)
+        out.meta.pop(META_POISON, None)  # the typed abort_reason stays
+        jseq = out.meta.pop(_META_JSEQ, None)
         if core.send(int(cid), wire.encode_buffer(out)):
             metrics.count("query_server.out",
                           tenant=out.meta.get(_META_TENANT))
+            self._ack_journal(core, out.meta, jseq)
         else:
+            # undeliverable (client gone): ack anyway — the answer was
+            # produced; an unacked entry would pin the WAL's prefix GC
+            # forever and replay to nobody after the next restart
+            self._ack_journal(core, out.meta, jseq, undeliverable=True)
             self._send_failed(out.meta)
         return []
 
@@ -520,19 +796,32 @@ class TensorQueryServerSink(SinkElement):
                     "— the served model must be batch-leading for "
                     "serversrc max-batch")
         resp_meta = {k: v for k, v in host.meta.items()
-                     if k not in (_META_BATCH, _META_CONN, "_tq")}
+                     if k not in (_META_BATCH, _META_CONN, "_tq",
+                                  _META_JSEQ, _META_REPLAY,
+                                  META_POISON)}
         for i, m in enumerate(metas):
             cid = m.get(_META_CONN)
+            jseq = m.get(_META_JSEQ)
             if cid is None:
-                metrics.count(f"{self.name}.dropped")
+                if m.get(_META_REPLAY) and jseq is not None:
+                    if self._ack_journal(core, m, jseq):
+                        metrics.count("query_server.replay_answered",
+                                      tenant=m.get(_META_TENANT))
+                else:
+                    metrics.count(f"{self.name}.dropped")
                 continue
             out = Buffer([t[i] for t in tensors], pts=host.pts,
                          meta={**{k: v for k, v in m.items()
-                                  if k != _META_CONN}, **resp_meta})
+                                  if k not in (_META_CONN, _META_JSEQ,
+                                               _META_REPLAY)},
+                               **resp_meta})
             if core.send(int(cid), wire.encode_buffer(out)):
                 metrics.count("query_server.out",
                               tenant=out.meta.get(_META_TENANT))
+                self._ack_journal(core, out.meta, jseq)
             else:
+                self._ack_journal(core, out.meta, jseq,
+                                  undeliverable=True)
                 self._send_failed(out.meta)
         return []
 
@@ -907,6 +1196,15 @@ class TensorQueryClient(Element):
                 # the server's admission control dropped this request and
                 # answered immediately (docs/SERVING.md "Front door")
                 metrics.count(f"{self.name}.sheds")
+            if buf.meta.get("abort_reason") == "poison":
+                # typed poison terminator (docs/ROBUSTNESS.md): the
+                # request crashed a server stage and was quarantined
+                metrics.count(f"{self.name}.poisoned")
+            elif buf.meta.get("wire_reject"):
+                # the server rejected this request's wire frame (typed
+                # WireError) — delivered like any response so the app
+                # sees abort_reason="wire" instead of a timeout
+                metrics.count(f"{self.name}.wire_rejected")
             metrics.count(f"{self.name}.responses")
             self._cv.notify_all()
         if emit_now is not None:

@@ -236,6 +236,15 @@ class LLMFramework(Framework):
                                                      30.0)))
         self.stream_idle_timeout = max(
             0.0, float(opts.pop("stream_idle_timeout", 5.0)))
+        # nns-armor (docs/ROBUSTNESS.md): ``nan_guard:1`` checks every
+        # admitted prompt's final prefill logits for NaN/Inf — a
+        # poisoned request is quarantined (DLQ, when the pipeline
+        # configured one) and answered with a typed
+        # ``abort_reason=poison`` terminator instead of decoding
+        # garbage (or crashing the loop) from corrupt activations.
+        # Pays one [1, vocab] host fetch per admitted prompt.
+        self.nan_guard = str(opts.pop("nan_guard", "0")).lower() \
+            in ("1", "true", "yes")
         self.dtype = opts.get("dtype", "bfloat16")
         try:
             self.bundle = build_model(model, opts)
@@ -1474,6 +1483,33 @@ class _ContinuousLoop:
                                pos=p, final=bool(final))
                     progressed = True
                     if final:
+                        if fw.nan_guard and \
+                                not np.isfinite(
+                                    np.asarray(logits)).all():
+                            # poison pill: the prompt's own prefill
+                            # produced non-finite logits — quarantine
+                            # it (DLQ + breaker accounting through the
+                            # pipeline's armor) and answer the client
+                            # with the typed poison terminator; the
+                            # loop keeps serving every other stream
+                            err = FloatingPointError(
+                                "non-finite prefill logits (nan_guard)")
+                            armor_obj = getattr(fw, "_armor", None)
+                            if armor_obj is not None:
+                                from ..core.buffer import Buffer as _Buf
+
+                                armor_obj.quarantine(
+                                    _Buf([st["prompt"][:, :st["T"]]
+                                          .copy()],
+                                         meta=dict(st["meta"])),
+                                    error=err, stage="llm.serve")
+                            metrics.count("llm.serve.poisoned")
+                            _tr(f"poisoned prompt quarantined slot {s}")
+                            self._admitting.remove(st)
+                            reject(st["meta"], st["emit"], "poison")
+                            retire(s)
+                            progressed = True
+                            break
                         # first-token sample stays EAGER (outside jit):
                         # logits are already device-resident and the
                         # dispatch overlaps the decode chunk below

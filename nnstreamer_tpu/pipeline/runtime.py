@@ -34,6 +34,7 @@ from ..core.log import Timer, logger, metrics
 from ..core.registry import KIND_ELEMENT, get as registry_get
 from ..elements.base import Element, SinkElement, SourceElement, SRC
 from ..utils import tracing
+from ..utils.armor import META_POISON as _META_POISON
 from .graph import PipelineGraph
 from .parser import parse as parse_launch
 from .plan import Stage, plan_stages
@@ -542,6 +543,90 @@ class _Runner:
         while self._inflight:
             self._emit_oldest_inflight()
 
+    # -- nns-armor: poison-pill quarantine (docs/ROBUSTNESS.md) ------------
+    def _invoke(self, el, pad: str, batch: List[Buffer]):
+        """The stage invoke, armored when ``Pipeline(quarantine=...)`` /
+        ``nan_guard`` is configured: an exception (or a NaN/Inf output
+        under nan_guard) quarantines the triggering request(s) to the
+        DLQ and substitutes typed ``abort_reason=poison`` terminators —
+        the pipeline keeps serving instead of restarting/failing.
+        Sinks keep the pre-armor semantics (a send failure is not a
+        poisoned request)."""
+        n = len(batch)
+        armor = self.pipeline._armor
+        if armor is None or self._is_sink:
+            return (el.process_batch(pad, batch) if n > 1
+                    else el.process(pad, batch[0]))
+        try:
+            outs = (el.process_batch(pad, batch) if n > 1
+                    else el.process(pad, batch[0]))
+        except Exception as e:  # noqa: BLE001 - the quarantine contract
+            return self._poison_outs(armor, pad, batch, e)
+        if armor.nan_guard and outs:
+            outs = self._nan_screen(armor, batch, outs)
+        return outs
+
+    def _poison_outs(self, armor, pad: str, batch: List[Buffer],
+                     err: BaseException):
+        """A failed invoke becomes poison terminators — but only for the
+        buffers that actually poison.  A failed micro-BATCH is re-invoked
+        one buffer at a time (batchable stages are pure by the planner's
+        own rules, so re-running the innocent rows is safe): one
+        malicious tenant's pill must not quarantine — and breaker-
+        penalize — every request that happened to share its dispatch."""
+        from ..utils import armor as _armor_mod
+
+        el = self.element
+        outs = []
+        for b in batch:
+            row_err = err
+            if len(batch) > 1:
+                try:
+                    row_outs = el.process(pad, b)
+                except Exception as e:  # noqa: BLE001 - the real pill
+                    row_err = e
+                else:
+                    if armor.nan_guard and row_outs:
+                        # the retry path must not bypass the screen the
+                        # batched path would have applied
+                        row_outs = self._nan_screen(armor, [b],
+                                                    row_outs)
+                    outs.extend(row_outs)
+                    continue
+            metrics.count(f"{self._nm}.poisoned")
+            armor.quarantine(b, error=row_err, stage=self._nm)
+            outs.append((SRC, _armor_mod.poison_terminator(b, row_err)))
+        return outs
+
+    def _nan_screen(self, armor, batch: List[Buffer], outs):
+        """nan_guard: replace non-finite stage outputs with poison
+        terminators — row-aligned to inputs when the element honored
+        the one-output-per-input batch contract, counting BUFFER
+        outputs only (an interleaved event must not shift which source
+        request gets quarantined and breaker-penalized)."""
+        from ..utils import armor as _armor_mod
+
+        n_buf_outs = sum(1 for _, o in outs if isinstance(o, Buffer))
+        aligned = n_buf_outs == len(batch)
+        screened = []
+        row = 0
+        for out_pad, o in outs:
+            if not isinstance(o, Buffer):
+                screened.append((out_pad, o))
+                continue
+            if armor.nonfinite(o):
+                src = batch[row] if aligned else batch[0]
+                err = FloatingPointError(
+                    "non-finite stage output (nan_guard)")
+                metrics.count(f"{self._nm}.poisoned")
+                armor.quarantine(src, error=err, stage=self._nm)
+                screened.append(
+                    (SRC, _armor_mod.poison_terminator(src, err)))
+            else:
+                screened.append((out_pad, o))
+            row += 1
+        return screened
+
     def _run_stream(self) -> None:
         el = self.element
         all_policy = el.sync_policy == "all" and len(self.in_pads) > 1
@@ -590,6 +675,21 @@ class _Runner:
                     continue
                 self._emit(el.on_event(pad, item))
                 continue
+            if (not self._is_sink and not all_policy
+                    and isinstance(item, Buffer)
+                    and item.meta.get(_META_POISON)):
+                # a poison terminator is an ANSWER riding to the sink
+                # (utils/armor.py), never work: forward it untouched so
+                # downstream stages cannot crash on its empty payload.
+                # NOT on sync_policy="all" stages: skipping the pairing
+                # logic would permanently misalign the other pads'
+                # streams — a collator fed a terminator pairs (and may
+                # fail loudly) instead of silently merging off-by-one.
+                self._flush_inflight()
+                metrics.count(self._m_in)
+                self._emit([(SRC, item)])
+                metrics.count(self._m_out)
+                continue
             if all_policy:
                 metrics.count(self._m_in)
                 self._pending.setdefault(pad, []).append(item)
@@ -607,8 +707,7 @@ class _Runner:
                 metrics.observe_bucketed(self._m_occupancy, float(n))
                 t0 = time.perf_counter()
                 self._proc_n = n
-                outs = (el.process_batch(pad, batch) if n > 1
-                        else el.process(pad, batch[0]))
+                outs = self._invoke(el, pad, batch)
                 self._proc_n = 0
                 # PER-BUFFER proc time: the .proc series must keep one
                 # meaning whether batching is on or off (same rule the
@@ -639,13 +738,13 @@ class _Runner:
             self._proc_n = 1
             if tr is None:
                 with Timer(self._m_proc):
-                    outs = el.process(pad, item)
+                    outs = self._invoke(el, pad, [item])
             else:
                 now0 = time.monotonic_ns()
                 tid = self._trace_queue_wait(item, now0)
                 ten = item.meta.get(tracing.META_TENANT)
                 t0 = time.perf_counter()
-                outs = el.process(pad, item)
+                outs = self._invoke(el, pad, [item])
                 dt = time.perf_counter() - t0
                 metrics.observe_latency(self._m_proc, dt, tenant=ten)
                 dur = int(dt * 1e9)
@@ -792,6 +891,13 @@ class Pipeline:
     verdict — docs/SERVING.md "Front door".
     Defaults come from :func:`get_config`.
 
+    ``quarantine`` / ``nan_guard`` / ``journal_replay`` are the
+    nns-armor knobs (docs/ROBUSTNESS.md): a DLQ directory (or policy)
+    that turns stage-crashing poison-pill requests into quarantined
+    records + typed ``abort_reason=poison`` answers with a per-tenant
+    repeat-offender circuit breaker; an opt-in NaN/Inf output screen;
+    and the restart flag asking every journaled query serversrc to
+    re-admit its accepted-but-unanswered WAL entries exactly once.
     ``validate=True`` runs the full static analyzer (caps propagation,
     topology/deadlock, jit-purity — see docs/ANALYSIS.md) over the parsed
     graph before anything is instantiated and raises
@@ -825,6 +931,9 @@ class Pipeline:
         tenant: Optional[str] = None,
         slo=None,
         max_stage_restarts: Optional[int] = None,
+        quarantine=None,
+        nan_guard: bool = False,
+        journal_replay: bool = False,
         validate: Union[bool, str] = False,
     ):
         if validate:
@@ -934,6 +1043,33 @@ class Pipeline:
         self._err_lock = threading.Lock()
         self._started = False
 
+        # nns-armor (docs/ROBUSTNESS.md): ``quarantine=`` (a DLQ
+        # directory path / policy dict / QuarantinePolicy) turns a
+        # poison-pill request — one whose stage invoke raises — into a
+        # quarantined DLQ record + a typed ``abort_reason=poison``
+        # answer, with the pipeline serving on; ``nan_guard=True``
+        # additionally treats NaN/Inf stage outputs as poison (pays a
+        # host check per output).  Repeat offenders trip a per-tenant
+        # circuit breaker that flips the query front door's
+        # ``tenant_admission`` override to shed.  ``journal_replay=True``
+        # asks every journaled serversrc to re-admit its
+        # accepted-but-unanswered WAL entries at start().
+        self._armor = None
+        if quarantine is not None or nan_guard:
+            from ..utils import armor as _armor
+
+            policy = _armor.QuarantinePolicy.of(quarantine) \
+                if quarantine is not None else _armor.QuarantinePolicy()
+            try:
+                self._armor = _armor.Armor(
+                    policy, nan_guard=nan_guard,
+                    apply_admission=self._breaker_admission,
+                    recorder=(tracing.recorder
+                              if self.trace_mode != "off" else None))
+            except ValueError as e:
+                raise PipelineError(str(e)) from e
+        self._journal_replay = bool(journal_replay)
+
         # Deprecated ``custom=tp:N`` alias (the llm filter's pre-2-D
         # private-mesh knob): promote it to the pipeline-owned
         # model_parallel BEFORE any element opens, so the filter lands on
@@ -978,6 +1114,12 @@ class Pipeline:
             # model_parallel is configured, so dp-only/single-device
             # pipelines stay backend-free here)
             el._mesh_provider = self._model_mesh
+            # armor + journal attach (the _trace_rec pattern): the llm
+            # serve loop quarantines through el._armor, journaled
+            # serversrcs honor the pipeline-level replay flag
+            el._armor = self._armor
+            if self._journal_replay:
+                el._journal_replay = True
 
         # 2. HBM-residency pre-pass: mark filters whose downstream
         # consumers ALL admit reduced output geometry, so negotiation
@@ -1249,6 +1391,22 @@ class Pipeline:
             if self._errors:
                 name, exc = self._errors[0]
                 raise PipelineError(f"stage {name} failed: {exc!r}") from exc
+
+    def _breaker_admission(self, tenant: str, engage: bool) -> None:
+        """The armor circuit breaker's lever: flip ``tenant``'s admission
+        override to shed on every query-server core of this pipeline
+        (PR 11's autoscaler map, reused — docs/ROBUSTNESS.md)."""
+        for el in self.elements.values():
+            core = getattr(el, "_core", None)
+            if core is not None and hasattr(core, "tenant_admission"):
+                if engage:
+                    # "shed-all": unconditional, unlike the autoscaler's
+                    # backlog-conditional "shed" — a poison spewer must
+                    # not keep crashing invokes just because the queue
+                    # has room
+                    core.tenant_admission[tenant] = "shed-all"
+                else:
+                    core.tenant_admission.pop(tenant, None)
 
     def _record_error(self, name: str, exc: BaseException) -> None:
         with self._err_lock:

@@ -88,6 +88,24 @@ SPAN_KINDS: Dict[str, str] = {
     "serve.reap": "continuous LLM serving: an orphaned/cancelled "
                   "stream's slot + KV blocks reclaimed to the free "
                   "list (args: slot, stream_id, blocks, reason)",
+    "armor.quarantine": "poison-pill quarantine: a request whose stage "
+                        "invoke raised (or produced NaN/Inf under "
+                        "nan_guard) was serialized to the DLQ and "
+                        "answered with abort_reason=poison (instant; "
+                        "args: stage, tenant, error, dlq = the record "
+                        "file — docs/ROBUSTNESS.md)",
+    "armor.breaker": "repeat-offender circuit breaker edge: N poisons "
+                     "from one tenant inside the window flipped its "
+                     "tenant_admission override to shed (instant; "
+                     "args: tenant, threshold, window_s, edge = "
+                     "trip|reset)",
+    "journal.append": "durable request journal: one accepted request's "
+                      "wire payload appended to the WAL (instant; "
+                      "args: seq, tenant; fsync policy decides "
+                      "durability — docs/ROBUSTNESS.md)",
+    "journal.replay": "durable request journal: restart re-admitted "
+                      "the accepted-but-unanswered entries "
+                      "(instant; args: entries, acked_skipped)",
 }
 
 #: buffer-meta keys the tracer owns (stamped only when tracing is active)

@@ -1,0 +1,706 @@
+"""nns-armor (ISSUE 12, docs/ROBUSTNESS.md): poison-pill quarantine to
+the DLQ, typed abort_reason=poison answers, the repeat-offender circuit
+breaker, nan_guard, and the durable-journal pipeline wiring."""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+from nnstreamer_tpu.utils import armor, tracing, wire
+from nnstreamer_tpu.utils.armor import (
+    CircuitBreaker, DeadLetterQueue, QuarantinePolicy, load_dlq_entry,
+    poison_terminator)
+from nnstreamer_tpu.utils.journal import replay_unanswered
+
+SPEC = TensorsSpec.from_string("4", "float32")
+
+#: requests whose first element is this value make the work stage raise
+POISON_PILL = -666.0
+#: ... and this one makes it emit NaN (the nan_guard trigger)
+NAN_PILL = -777.0
+
+
+def _register_work(name="armor-work"):
+    def work(ins):
+        v = float(np.asarray(ins[0]).ravel()[0])
+        if v == POISON_PILL:
+            raise RuntimeError("deliberately poisoned request")
+        if v == NAN_PILL:
+            out = np.asarray(ins[0], np.float32).copy()
+            out[0] = np.nan
+            return [out]
+        return [np.asarray(ins[0], np.float32) * 2.0]
+
+    register_custom_easy(name, work, in_spec=SPEC, out_spec=SPEC)
+
+
+def _req(v, mid, tenant="t0"):
+    return Buffer([np.full((4,), v, np.float32)],
+                  meta={"_query_msg": mid, "_tenant": tenant})
+
+
+class TestUnits:
+    def test_policy_of(self, tmp_path):
+        p = QuarantinePolicy.of(str(tmp_path))
+        assert p.dir == str(tmp_path)
+        p2 = QuarantinePolicy.of({"dir": "/x", "breaker_threshold": 5})
+        assert p2.breaker_threshold == 5
+        with pytest.raises(ValueError, match="unknown"):
+            QuarantinePolicy.of({"nope": 1})
+        with pytest.raises(ValueError):
+            QuarantinePolicy.of(42)
+
+    def test_dlq_roundtrip(self, tmp_path):
+        dlq = DeadLetterQueue(str(tmp_path))
+        buf = Buffer([np.arange(4, dtype=np.float32)],
+                     meta={"_query_msg": 3, "_tenant": "bad"})
+        path = dlq.put(buf, error="RuntimeError: boom", stage="f",
+                       tenant="bad", ring=["  +0.0ms f stage 1.0ms"])
+        got, _flags = load_dlq_entry(path)
+        np.testing.assert_array_equal(got.tensors[0], buf.tensors[0])
+        rec = got.meta[armor.META_DLQ]
+        assert rec["error"] == "RuntimeError: boom"
+        assert rec["stage"] == "f"
+        assert rec["tenant"] == "bad"
+        assert rec["ring"] and "stage" in rec["ring"][0]
+
+    def test_dlq_bounded_evicts_oldest(self, tmp_path):
+        dlq = DeadLetterQueue(str(tmp_path), max_entries=4)
+        for i in range(9):
+            dlq.put(Buffer([np.full((4,), float(i), np.float32)]),
+                    error=f"e{i}", stage="f")
+        entries = dlq.entries()
+        assert len(entries) <= 4
+        kept = [load_dlq_entry(p)[0].meta[armor.META_DLQ]["error"]
+                for p in entries]
+        assert kept[-1] == "e8"  # newest kept, oldest evicted
+        assert "e0" not in kept
+
+    def test_breaker_trip_edge_and_reset(self):
+        flips = []
+        br = CircuitBreaker(3, 10.0,
+                            lambda t, engage: flips.append((t, engage)))
+        assert not br.record_poison("a")
+        assert not br.record_poison("a")
+        assert br.record_poison("a")          # third inside window: trip
+        assert not br.record_poison("a")      # latched: edge, not level
+        assert "a" in br.tripped
+        # the latch RE-ASSERTS on further poisons (self-healing against
+        # the autoscaler popping the shared override) — same value,
+        # never a new trip edge
+        assert flips == [("a", True), ("a", True)]
+        assert br.record_poison("b") is False  # independent per tenant
+        assert br.reset("a")
+        assert flips[-1] == ("a", False)
+        assert not br.reset("a")  # idempotent
+
+    def test_breaker_window_expires(self):
+        br = CircuitBreaker(2, 0.05, lambda t, e: None)
+        assert not br.record_poison("a")
+        time.sleep(0.08)
+        assert not br.record_poison("a")  # first hit aged out
+
+    def test_breaker_untenanted_never_trips(self):
+        br = CircuitBreaker(1, 10.0, lambda t, e: None)
+        assert not br.record_poison(None)
+
+    def test_poison_terminator_meta(self):
+        buf = Buffer([np.ones((4,), np.float32)],
+                     meta={"_query_msg": 5, "_query_conn": 1,
+                           "stream_index": 2})
+        term = poison_terminator(buf, RuntimeError("x"))
+        assert term.tensors == []
+        assert term.meta["abort_reason"] == "poison"
+        assert term.meta["_query_msg"] == 5  # routing meta survives
+        assert term.meta["stream_last"] and term.meta["stream_aborted"]
+
+
+class _FrontDoor:
+    """serversrc ! armor-work ! serversink with a raw-socket client."""
+
+    def __init__(self, tmp_path, sid, **pipe_kw):
+        _register_work()
+        self.srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id={sid} "
+            f"admission=shed max-backlog=64 ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id={sid}", **pipe_kw)
+
+    def __enter__(self):
+        from nnstreamer_tpu.utils.net import client_handshake
+
+        self.srv.start()
+        port = self.srv.element("ssrc").bound_port
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5.0)
+        client_handshake(self.sock, "hello", caps="other/tensors",
+                         topic="", tenant="t0")
+        self.sock.settimeout(5.0)
+        return self
+
+    def send(self, v, mid, tenant="t0"):
+        wire.write_frame(self.sock,
+                         wire.encode_buffer(_req(v, mid, tenant)))
+
+    def recv_all(self, n, timeout=15.0):
+        got = {}
+        t0 = time.monotonic()
+        while len(got) < n and time.monotonic() - t0 < timeout:
+            try:
+                raw = wire.read_frame(self.sock)
+            except socket.timeout:
+                continue
+            assert raw is not None, "server closed the connection"
+            buf, _ = wire.decode_buffer(raw)
+            got[int(buf.meta["_query_msg"])] = buf
+        return got
+
+    def __exit__(self, *exc):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.srv.stop()
+
+
+class TestPoisonQuarantine:
+    def test_poison_answered_typed_pipeline_survives(self, tmp_path):
+        metrics.reset()
+        tracing.recorder.clear()
+        dlq_dir = str(tmp_path / "dlq")
+        with _FrontDoor(tmp_path, sid=70, quarantine=dlq_dir,
+                        trace_mode="ring") as fd:
+            for mid in range(3):
+                fd.send(float(mid + 1), mid)
+            fd.send(POISON_PILL, 3)
+            for mid in range(4, 7):
+                fd.send(float(mid), mid)
+            got = fd.recv_all(7)
+            assert len(got) == 7
+            # the poisoned request got the TYPED terminator
+            assert got[3].meta["abort_reason"] == "poison"
+            assert "deliberately poisoned" in got[3].meta["error"]
+            assert got[3].tensors == []
+            # everyone else got real answers — the pipeline survived
+            for mid in (0, 1, 2, 4, 5, 6):
+                assert "abort_reason" not in got[mid].meta
+                v = float(mid + 1) if mid < 3 else float(mid)
+                np.testing.assert_allclose(
+                    np.asarray(got[mid].tensors[0]),
+                    np.full((4,), 2.0 * v, np.float32))
+            # DLQ holds the quarantined request with ring + context
+            entries = DeadLetterQueue(dlq_dir).entries()
+            assert len(entries) == 1
+            rec, _ = load_dlq_entry(entries[0])
+            ctx = rec.meta[armor.META_DLQ]
+            assert "RuntimeError" in ctx["error"]
+            assert ctx["tenant"] == "t0"
+            assert ctx["ring"], "flight-recorder excerpt not attached"
+            np.testing.assert_allclose(
+                np.asarray(rec.tensors[0]),
+                np.full((4,), POISON_PILL, np.float32))
+            snap = metrics.snapshot()
+            assert snap.get("armor.quarantined") == 1.0
+            assert metrics.labeled_counters().get(
+                ("armor.quarantined", "t0")) == 1.0
+            kinds = [e.kind for e in tracing.recorder.events()]
+            assert "armor.quarantine" in kinds
+
+    def test_nan_guard_quarantines(self, tmp_path):
+        metrics.reset()
+        dlq_dir = str(tmp_path / "dlq")
+        with _FrontDoor(tmp_path, sid=71, quarantine=dlq_dir,
+                        nan_guard=True) as fd:
+            fd.send(1.0, 0)
+            fd.send(NAN_PILL, 1)
+            fd.send(2.0, 2)
+            got = fd.recv_all(3)
+            assert got[1].meta["abort_reason"] == "poison"
+            assert "non-finite" in got[1].meta["error"]
+            for mid, v in ((0, 1.0), (2, 2.0)):
+                np.testing.assert_allclose(
+                    np.asarray(got[mid].tensors[0]),
+                    np.full((4,), 2.0 * v, np.float32))
+            assert len(DeadLetterQueue(dlq_dir).entries()) == 1
+        # without nan_guard the NaN flows through untouched (opt-in)
+        with _FrontDoor(tmp_path, sid=72,
+                        quarantine=str(tmp_path / "dlq2")) as fd:
+            fd.send(NAN_PILL, 0)
+            got = fd.recv_all(1)
+            assert "abort_reason" not in got[0].meta
+            assert np.isnan(np.asarray(got[0].tensors[0])[0])
+
+    def test_breaker_flips_tenant_to_shed(self, tmp_path):
+        metrics.reset()
+        tracing.recorder.clear()
+        pol = {"dir": str(tmp_path / "dlq"), "breaker_threshold": 3,
+               "breaker_window_s": 30.0}
+        with _FrontDoor(tmp_path, sid=73, quarantine=pol,
+                        trace_mode="ring") as fd:
+            for mid in range(3):
+                fd.send(POISON_PILL, mid)
+            got = fd.recv_all(3)
+            assert all(b.meta.get("abort_reason") == "poison"
+                       for b in got.values())
+            # breaker tripped: t0 is now SHED at admission
+            core = fd.srv.element("ssrc")._core
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and core.tenant_admission.get("t0") != "shed-all":
+                time.sleep(0.02)
+            assert core.tenant_admission.get("t0") == "shed-all"
+            fd.send(1.0, 3)  # healthy request, but the tenant is shed
+            got = fd.recv_all(1)
+            assert got[3].meta.get("shed") is True
+            assert metrics.labeled_counters().get(
+                ("armor.breaker_trips", "t0")) == 1.0
+            spans = [e for e in tracing.recorder.events()
+                     if e.kind == "armor.breaker"]
+            assert spans and spans[0].args["tenant"] == "t0"
+            assert spans[0].args["edge"] == "trip"
+            # reset restores the configured policy
+            assert fd.srv._armor.breaker.reset("t0")
+            assert "t0" not in core.tenant_admission
+
+    def test_client_cannot_supply_poison_marker(self, tmp_path):
+        """Trust boundary: a client-stamped '_poison' meta key must be
+        stripped at the reader — otherwise its requests bypass stage
+        invokes and force inflight flushes on batching stages."""
+        with _FrontDoor(tmp_path, sid=83,
+                        quarantine=str(tmp_path / "dlq")) as fd:
+            buf = _req(3.0, 0)
+            buf.meta["_poison"] = True
+            wire.write_frame(fd.sock, wire.encode_buffer(buf))
+            got = fd.recv_all(1)
+            # the stage RAN: a real doubled answer, not a forwarded fake
+            np.testing.assert_allclose(
+                np.asarray(got[0].tensors[0]),
+                np.full((4,), 6.0, np.float32))
+
+    def test_breaker_reasserts_after_external_override_pop(self):
+        """Latch self-healing: the autoscaler's relax edge shares the
+        tenant_admission map and may pop a tripped tenant's override —
+        the next poison from that tenant must re-assert it."""
+        overrides = {}
+
+        def apply(t, engage):
+            if engage:
+                overrides[t] = "shed-all"
+            else:
+                overrides.pop(t, None)
+
+        br = CircuitBreaker(2, 30.0, apply)
+        br.record_poison("a")
+        assert br.record_poison("a")  # trip
+        assert overrides == {"a": "shed-all"}
+        overrides.pop("a")  # the autoscaler relax edge
+        assert not br.record_poison("a")  # latched: no new trip edge...
+        assert overrides == {"a": "shed-all"}  # ...but re-asserted
+
+    def test_other_tenant_unaffected_by_breaker(self, tmp_path):
+        pol = {"dir": str(tmp_path / "dlq"), "breaker_threshold": 2,
+               "breaker_window_s": 30.0}
+        metrics.reset()
+        with _FrontDoor(tmp_path, sid=74, quarantine=pol) as fd:
+            for mid in range(2):
+                fd.send(POISON_PILL, mid, tenant="evil")
+            fd.recv_all(2)
+            core = fd.srv.element("ssrc")._core
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and core.tenant_admission.get("evil") != "shed-all":
+                time.sleep(0.02)
+            assert core.tenant_admission.get("evil") == "shed-all"
+            fd.send(5.0, 2, tenant="good")
+            got = fd.recv_all(1)
+            assert "shed" not in got[2].meta
+            np.testing.assert_allclose(
+                np.asarray(got[2].tensors[0]),
+                np.full((4,), 10.0, np.float32))
+
+
+class TestAppPathQuarantine:
+    """Poison quarantine on a non-query pipeline: the terminator rides
+    to the app sink, the pipeline keeps accepting pushes (the pre-armor
+    behavior was a stage error + dead pipeline)."""
+
+    def test_appsrc_poison_keeps_serving(self, tmp_path):
+        _register_work()
+        metrics.reset()
+        pipe = nt.Pipeline(
+            "appsrc name=src ! tensor_filter name=f "
+            "framework=custom-easy model=armor-work ! "
+            "tensor_sink name=out",
+            quarantine=str(tmp_path / "dlq"))
+        with pipe:
+            pipe.push("src", Buffer([np.full((4,), 3.0, np.float32)]))
+            out = pipe.pull("out", timeout=10)
+            np.testing.assert_allclose(np.asarray(out.tensors[0]),
+                                       np.full((4,), 6.0, np.float32))
+            pipe.push("src",
+                      Buffer([np.full((4,), POISON_PILL, np.float32)]))
+            term = pipe.pull("out", timeout=10)
+            assert term.meta["abort_reason"] == "poison"
+            assert term.tensors == []
+            # the pipeline is still alive and serving
+            pipe.push("src", Buffer([np.full((4,), 5.0, np.float32)]))
+            out = pipe.pull("out", timeout=10)
+            np.testing.assert_allclose(np.asarray(out.tensors[0]),
+                                       np.full((4,), 10.0, np.float32))
+            pipe.eos("src")
+            pipe.wait(timeout=10)  # no stage error recorded
+        assert metrics.snapshot().get("f.poisoned") == 1.0
+        assert len(DeadLetterQueue(str(tmp_path / "dlq")).entries()) == 1
+
+
+class TestBatchPoisonIsolation:
+    def test_only_the_pill_row_is_quarantined(self, tmp_path):
+        """Regression: a poison pill sharing a micro-batch with innocent
+        requests must not quarantine (or breaker-penalize) the whole
+        dispatch — the failed batch is re-invoked per buffer and only
+        the actual pill aborts."""
+        metrics.reset()
+
+        def work(ins):
+            arr = np.asarray(ins[0])
+            if np.any(arr == POISON_PILL):
+                raise RuntimeError("pill in the batch")
+            return [arr * 2.0]
+
+        register_custom_easy("armor-batch-work", work, in_spec=SPEC,
+                             out_spec=SPEC)
+        pipe = nt.Pipeline(
+            "appsrc name=src ! tensor_filter name=f "
+            "framework=custom-easy model=armor-batch-work ! "
+            "tensor_sink name=out",
+            batch_max=4, quarantine=str(tmp_path / "dlq"))
+        with pipe:
+            vals = [1.0, 2.0, POISON_PILL, 3.0]
+            for v in vals:
+                pipe.push("src", Buffer([np.full((4,), v, np.float32)]))
+            outs = [pipe.pull("out", timeout=15) for _ in vals]
+            pipe.eos("src")
+            pipe.wait(timeout=15)
+        poisoned = [o for o in outs
+                    if o.meta.get("abort_reason") == "poison"]
+        healthy = sorted(float(np.asarray(o.tensors[0])[0])
+                         for o in outs
+                         if "abort_reason" not in o.meta)
+        assert len(poisoned) == 1
+        assert healthy == [2.0, 4.0, 6.0]
+        assert metrics.snapshot().get("armor.quarantined") == 1.0
+        assert len(DeadLetterQueue(str(tmp_path / "dlq")).entries()) == 1
+
+
+class TestJournalPipeline:
+    """The durable journal on a live front door: accepted requests
+    append, answers ack, a restart with journal_replay=True re-admits
+    exactly the unanswered entries and answers them exactly once."""
+
+    def test_answered_requests_all_acked(self, tmp_path):
+        _register_work()
+        metrics.reset()
+        jdir = str(tmp_path / "wal")
+        with _FrontDoor(tmp_path, sid=75) as fd:
+            pass  # just to reuse the register; real server below
+        srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=76 "
+            f"journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id=76")
+        from nnstreamer_tpu.utils.net import client_handshake
+
+        with srv:
+            port = srv.element("ssrc").bound_port
+            sock = socket.create_connection(("127.0.0.1", port),
+                                            timeout=5.0)
+            try:
+                client_handshake(sock, "hello", caps="other/tensors",
+                                 topic="", tenant="t0")
+                sock.settimeout(5.0)
+                for mid in range(5):
+                    wire.write_frame(
+                        sock, wire.encode_buffer(_req(1.0 + mid, mid)))
+                got = 0
+                t0 = time.monotonic()
+                while got < 5 and time.monotonic() - t0 < 30:
+                    try:
+                        raw = wire.read_frame(sock)
+                    except socket.timeout:
+                        continue
+                    buf, _ = wire.decode_buffer(raw)
+                    # the journal seqno never leaks to the client
+                    assert "_journal_seq" not in buf.meta
+                    got += 1
+            finally:
+                sock.close()
+            assert got == 5
+            # poll: the sink acks AFTER the send the client just read
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline \
+                    and metrics.snapshot().get("journal.acks",
+                                               0.0) < 5.0:
+                time.sleep(0.02)
+        assert replay_unanswered(jdir) == []  # every answer acked
+        snap = metrics.snapshot()
+        assert snap.get("journal.appends") == 5.0
+        assert snap.get("journal.acks") == 5.0
+
+    def test_replay_answers_unanswered_exactly_once(self, tmp_path):
+        """Seed a journal with answered + unanswered entries (as a
+        killed process would leave it), then start a replaying server:
+        only the unanswered ones re-admit, each is answered (acked)
+        exactly once, and a SECOND restart replays nothing."""
+        from nnstreamer_tpu.utils.journal import Journal, scan
+
+        _register_work()
+        metrics.reset()
+        jdir = str(tmp_path / "wal")
+        j = Journal(jdir, fsync="always")
+        for i in range(6):
+            seq = j.append(wire.encode_buffer(_req(float(i + 1), i)))
+            if i < 2:
+                j.ack(seq)  # first two were answered pre-kill
+        j.close()
+        assert [s for s, _ in replay_unanswered(jdir)] == [3, 4, 5, 6]
+
+        srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=77 "
+            f"journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id=77",
+            journal_replay=True)
+        with srv:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline \
+                    and replay_unanswered(jdir):
+                time.sleep(0.05)
+        assert replay_unanswered(jdir) == []
+        snap = metrics.snapshot()
+        assert snap.get("query_server.replayed") == 4.0
+        assert snap.get("query_server.replay_answered") == 4.0
+        st = scan(jdir)
+        assert all(m == 1 for m in st.ack_multiplicity.values())
+        # second restart: nothing left to replay
+        metrics.reset()
+        srv2 = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=78 "
+            f"journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id=78",
+            journal_replay=True)
+        with srv2:
+            time.sleep(0.3)
+        assert metrics.snapshot().get("query_server.replayed", 0.0) == 0.0
+
+    def test_nan_guard_without_dlq_dir_still_typed(self, tmp_path):
+        """Regression: nan_guard-only armor (no quarantine= dir) must
+        answer typed and count — not stack-trace on makedirs('')."""
+        metrics.reset()
+        with _FrontDoor(tmp_path, sid=81, nan_guard=True) as fd:
+            fd.send(NAN_PILL, 0)
+            fd.send(1.0, 1)
+            got = fd.recv_all(2)
+            assert got[0].meta["abort_reason"] == "poison"
+            np.testing.assert_allclose(np.asarray(got[1].tensors[0]),
+                                       np.full((4,), 2.0, np.float32))
+        assert metrics.snapshot().get("armor.quarantined") == 1.0
+
+    def test_undeliverable_answer_acks_entry(self, tmp_path):
+        """Regression: a client that vanishes before its answer must
+        not pin the journal forever — the undeliverable answer acks
+        the entry (the work was done; replaying to nobody is waste)."""
+        from nnstreamer_tpu.utils.net import client_handshake
+
+        _register_work()
+        jdir = str(tmp_path / "wal")
+        srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=82 "
+            f"journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id=82")
+        with srv:
+            port = srv.element("ssrc").bound_port
+            sock = socket.create_connection(("127.0.0.1", port),
+                                            timeout=5.0)
+            try:
+                client_handshake(sock, "hello", caps="other/tensors",
+                                 topic="", tenant="ghost")
+                wire.write_frame(
+                    sock, wire.encode_buffer(_req(1.0, 0, "ghost")))
+            finally:
+                sock.close()  # gone before the answer
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline \
+                    and replay_unanswered(jdir):
+                time.sleep(0.05)
+        assert replay_unanswered(jdir) == []
+
+    def test_hello_fallback_tenant_persisted_in_journal(self, tmp_path):
+        """Regression: a tenant sent only in the connection hello (not
+        per-frame meta) must still ride the JOURNALED payload, or a
+        replayed entry loses quota/SLO/breaker attribution."""
+        from nnstreamer_tpu.utils.journal import scan
+        from nnstreamer_tpu.utils.net import client_handshake
+
+        _register_work()
+        jdir = str(tmp_path / "wal")
+        srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=79 "
+            f"journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id=79")
+        with srv:
+            port = srv.element("ssrc").bound_port
+            sock = socket.create_connection(("127.0.0.1", port),
+                                            timeout=5.0)
+            try:
+                client_handshake(sock, "hello", caps="other/tensors",
+                                 topic="", tenant="hello-only")
+                sock.settimeout(5.0)
+                buf = Buffer([np.full((4,), 1.0, np.float32)],
+                             meta={"_query_msg": 0})  # no _tenant key
+                wire.write_frame(sock, wire.encode_buffer(buf))
+                while True:
+                    try:
+                        wire.read_frame(sock)
+                        break
+                    except socket.timeout:
+                        continue
+            finally:
+                sock.close()
+        st = scan(jdir)
+        assert len(st.requests) == 1
+        rec, _ = wire.decode_buffer(next(iter(st.requests.values())))
+        assert rec.meta.get("_tenant") == "hello-only"
+        assert "_query_conn" not in rec.meta  # record stays conn-free
+
+    def test_replay_backlog_larger_than_max_backlog(self, tmp_path):
+        """Regression: more unanswered entries than max-backlog must
+        replay through generate()'s own backpressure, not deadlock
+        start() force-feeding a queue no runner drains yet."""
+        from nnstreamer_tpu.utils.journal import Journal
+
+        _register_work()
+        metrics.reset()
+        jdir = str(tmp_path / "wal")
+        j = Journal(jdir, fsync="always")
+        n = 12
+        for i in range(n):
+            j.append(wire.encode_buffer(_req(float(i + 1), i)))
+        j.close()
+        srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=80 "
+            f"max-backlog=4 journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-work ! "
+            f"tensor_query_serversink id=80",
+            journal_replay=True)
+        with srv:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline \
+                    and replay_unanswered(jdir):
+                time.sleep(0.05)
+        assert replay_unanswered(jdir) == []
+        assert metrics.snapshot().get("query_server.replayed") == float(n)
+
+    def test_replayed_entry_with_forged_poison_marker_is_processed(
+            self, tmp_path):
+        """Trust boundary on the REPLAY path too: a journaled frame
+        whose meta carries a client-minted '_poison' must still be
+        processed after restart — not forwarded unprocessed and acked
+        as answered."""
+        from nnstreamer_tpu.utils.journal import Journal
+
+        ran = []
+
+        def spy(ins):
+            ran.append(float(np.asarray(ins[0]).ravel()[0]))
+            return [np.asarray(ins[0], np.float32) * 2.0]
+
+        register_custom_easy("armor-spy", spy, in_spec=SPEC,
+                             out_spec=SPEC)
+        metrics.reset()
+        jdir = str(tmp_path / "wal")
+        j = Journal(jdir, fsync="always")
+        forged = _req(7.0, 0)
+        forged.meta["_poison"] = True
+        j.append(wire.encode_buffer(forged))
+        j.close()
+        srv = nt.Pipeline(
+            f"tensor_query_serversrc name=ssrc port=0 id=84 "
+            f"journal={jdir} journal-fsync=always ! "
+            f"tensor_filter framework=custom-easy model=armor-spy ! "
+            f"tensor_query_serversink id=84",
+            journal_replay=True)
+        with srv:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline \
+                    and replay_unanswered(jdir):
+                time.sleep(0.05)
+        assert replay_unanswered(jdir) == []
+        assert ran == [7.0]  # the stage RAN on the replayed request
+
+    def test_shed_request_is_acked_not_replayed(self, tmp_path):
+        """A shed IS an answer: its journal entry must not replay."""
+        from nnstreamer_tpu.elements.query import _ServerCore
+        from nnstreamer_tpu.utils.journal import Journal
+
+        jdir = str(tmp_path / "wal")
+        journal = Journal(jdir, fsync="always")
+        core = _ServerCore("127.0.0.1", 0, max_backlog=1,
+                           admission="shed", journal=journal)
+        try:
+            b1 = _req(1.0, 0)
+            raw = wire.encode_buffer(b1)
+            b1.meta["_journal_seq"] = journal.append(raw)
+            assert core._admit(b1) == "ok"
+            b2 = _req(2.0, 1)
+            b2.meta["_journal_seq"] = journal.append(
+                wire.encode_buffer(b2))
+            assert core._admit(b2) == "shed"  # backlog full -> shed+ack
+            assert [s for s, _ in replay_unanswered(jdir)] == [1]
+        finally:
+            core.close()
+            journal.close()
+
+
+class TestLlmNanGuardPoison:
+    @pytest.mark.slow
+    def test_poisoned_prompt_typed_abort(self, tmp_path):
+        """A serve-loop prompt whose prefill logits go non-finite is
+        quarantined and answered abort_reason=poison; the loop keeps
+        serving (filters/llm.py nan_guard)."""
+        import jax
+
+        metrics.reset()
+        pipe = nt.Pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=llm "
+            "model=llama_tiny custom=max_new:4,serve:continuous,"
+            "slots:2,stream_chunk:2,dtype:float32,nan_guard:1 "
+            "invoke-dynamic=true ! tensor_sink name=out",
+            quarantine=str(tmp_path / "dlq"))
+        with pipe:
+            fw = pipe.element("f").fw
+            # poison the weights BEFORE the loop's first submit captures
+            # them: every admitted prompt now prefills to NaN logits
+            fw.bundle.params = jax.tree_util.tree_map(
+                lambda a: (a * np.float32("nan"))
+                if hasattr(a, "dtype") and a.dtype.kind == "f" else a,
+                fw.bundle.params)
+            pipe.push("src", Buffer(
+                [np.array([[1, 2, 3]], np.int32)]))
+            term = pipe.pull("out", timeout=60)
+            assert term.meta.get("stream_aborted") is True
+            assert term.meta.get("abort_reason") == "poison"
+        assert metrics.snapshot().get("llm.serve.poisoned") == 1.0
+        assert len(DeadLetterQueue(
+            str(tmp_path / "dlq")).entries()) == 1

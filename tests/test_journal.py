@@ -1,0 +1,319 @@
+"""Durable request journal (ISSUE 12, docs/ROBUSTNESS.md): record
+framing, fsync policies, segment rotation + GC, seqno-dedup replay, and
+the crash-consistency property — a writer killed at ANY byte offset
+loses at most the torn tail, never a fully-CRC'd entry."""
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.utils import journal
+from nnstreamer_tpu.utils.journal import (
+    Journal, pack_record, replay_unanswered, scan, MAGIC_REQ)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestJournalBasics:
+    def test_append_ack_replay_roundtrip(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        seqs = [j.append(f"req-{i}".encode()) for i in range(5)]
+        assert seqs == [1, 2, 3, 4, 5]
+        j.ack(2)
+        j.ack(4)
+        j.close()
+        got = replay_unanswered(str(tmp_path))
+        assert [(s, p) for s, p in got] == [
+            (1, b"req-0"), (3, b"req-2"), (5, b"req-4")]
+
+    def test_ack_idempotent_and_closed_journal_noops(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        seq = j.append(b"one")
+        assert j.ack(seq) is True
+        assert j.ack(seq) is False  # second ack: no record written
+        assert j.ack(999) is False  # unknown seqno
+        j.close()
+        # racing reader threads after close(): no AttributeError, no
+        # record — the request is simply not journaled
+        assert j.append(b"late") == 0
+        assert j.ack(seq) is False
+        st = scan(str(tmp_path))
+        assert st.ack_multiplicity == {seq: 1}
+        assert list(st.requests) == [seq]
+
+    def test_bad_fsync_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync"):
+            Journal(str(tmp_path), fsync="sometimes")
+
+    def test_reopen_resumes_seqnos(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        j.append(b"a")
+        j.append(b"b")
+        j.ack(1)
+        j.close()
+        j2 = Journal(str(tmp_path), fsync="always")
+        assert j2.append(b"c") == 3  # continues, never reuses seqnos
+        assert j2.unacked_count() == 2  # b + c
+        j2.close()
+        assert [s for s, _ in replay_unanswered(str(tmp_path))] == [2, 3]
+
+    def test_segment_rotation_and_gc(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="off", segment_bytes=1 << 12)
+        payload = b"x" * 256
+        seqs = [j.append(payload) for _ in range(64)]
+        for s in seqs:
+            j.ack(s)
+        # force one more rotation so fully-acked segments collect
+        for _ in range(32):
+            s = j.append(payload)
+            j.ack(s)
+        j.close()
+        segs = [n for n in os.listdir(tmp_path) if n.startswith("wal-")]
+        assert len(segs) >= 1
+        # GC dropped fully-acked history: far fewer segments than the
+        # ~96 * 276B / 4KiB  (~7+) an unbounded log would hold
+        total = sum(os.path.getsize(os.path.join(tmp_path, n))
+                    for n in segs)
+        assert total < 96 * 300
+        assert replay_unanswered(str(tmp_path)) == []
+
+    def test_replay_spans_segments_in_order(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="off", segment_bytes=1 << 12)
+        seqs = [j.append(b"p" * 200) for _ in range(40)]
+        j.close()
+        got = [s for s, _ in replay_unanswered(str(tmp_path))]
+        assert got == seqs
+
+    def test_gc_is_strictly_prefix_acks_for_old_reqs_survive(
+            self, tmp_path):
+        """Regression: a fully-acked NEWER segment must not be GC'd
+        while an older segment still holds an unacked request — its
+        records include the ACKs for the old segment's answered
+        requests, and deleting them would resurrect answered work at
+        the next replay."""
+        j = Journal(str(tmp_path), fsync="off", segment_bytes=1 << 12)
+        payload = b"x" * 300
+        first_wave = [j.append(payload) for _ in range(12)]
+        straggler = first_wave[1]  # never answered (client vanished)
+        # answers land later — their ACK records live in LATER segments
+        for s in first_wave:
+            if s != straggler:
+                j.ack(s)
+        # plenty of fully-answered follow-on traffic to force rotations
+        for _ in range(60):
+            s = j.append(payload)
+            j.ack(s)
+        j.close()
+        got = [s for s, _ in replay_unanswered(str(tmp_path))]
+        assert got == [straggler]  # nothing answered came back
+
+    def test_recovered_snapshot_excludes_post_open_entries(
+            self, tmp_path):
+        """The replay source is the snapshot taken at open: entries
+        accepted AFTER the journal (re)opened — a reconnected client's
+        resends — must not be in it."""
+        j = Journal(str(tmp_path), fsync="always")
+        j.append(b"old-unanswered")
+        j.close()
+        j2 = Journal(str(tmp_path), fsync="always")
+        assert [s for s, p in j2.recovered_unanswered] == [1]
+        j2.append(b"new-after-open")
+        assert [s for s, p in j2.recovered_unanswered] == [1]
+        j2.close()
+
+    def test_duplicate_seqno_dedup(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        j.append(b"one")
+        j.close()
+        # forge a duplicate REQ record with the same seqno
+        seg = os.path.join(tmp_path, sorted(os.listdir(tmp_path))[0])
+        with open(seg, "ab") as f:
+            f.write(pack_record(MAGIC_REQ, 1, b"forged"))
+        st = scan(str(tmp_path))
+        assert st.duplicate_seqnos == 1
+        assert st.requests[1] == b"one"  # first durable copy wins
+
+    def test_batch_fsync_flushes_on_interval(self, tmp_path):
+        # batch mode: appends are buffered writes; the BACKGROUND
+        # flusher makes them durable within batch_interval_s — the
+        # fsync never sits on the request path
+        j = Journal(str(tmp_path), fsync="batch", batch_every=1000,
+                    batch_interval_s=0.01)
+        j.append(b"a")
+        j.append(b"b")
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if set(scan(str(tmp_path)).requests) == {1, 2}:
+                break
+            time.sleep(0.005)
+        assert set(scan(str(tmp_path)).requests) == {1, 2}
+        j.close()
+
+    def test_batch_every_backstop_bounds_loss_window(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="batch", batch_every=8,
+                    batch_interval_s=60.0)  # interval timer idle
+        for i in range(9):
+            j.append(b"x")
+        # the 8th write crossed the backstop and KICKED the flusher
+        # (never an inline fsync): durable within ms, not 60 s
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and len(scan(str(tmp_path)).requests) < 8:
+            time.sleep(0.01)
+        assert len(scan(str(tmp_path)).requests) >= 8
+        j.close()
+
+
+class TestTornTail:
+    """Property: truncating the last segment at ANY byte offset loses
+    only records at/after the cut — every fully-CRC'd entry before it
+    replays, and nothing torn ever comes back."""
+
+    def _build(self, path, n=24):
+        j = Journal(path, fsync="always", segment_bytes=1 << 20)
+        offsets = []  # byte offset AFTER each record
+        seg = j._seg_path(0)
+        for i in range(n):
+            j.append(f"entry-{i:03d}".encode() * (1 + i % 3))
+            j.flush()
+            offsets.append(os.path.getsize(seg))
+        j.close()
+        return seg, offsets
+
+    def test_truncate_at_random_offsets(self, tmp_path):
+        rng = np.random.default_rng(42)
+        seg, offsets = self._build(str(tmp_path))
+        with open(seg, "rb") as f:
+            full = f.read()
+        for _ in range(25):
+            cut = int(rng.integers(0, len(full) + 1))
+            with open(seg, "wb") as f:
+                f.write(full[:cut])
+            got = replay_unanswered(str(tmp_path))
+            # recovered = exactly the records fully before the cut
+            want = sum(1 for off in offsets if off <= cut)
+            assert len(got) == want, f"cut at {cut}"
+            for k, (s, payload) in enumerate(got):
+                assert s == k + 1
+                assert payload == f"entry-{k:03d}".encode() * (1 + k % 3)
+        with open(seg, "wb") as f:
+            f.write(full)
+
+    def test_corrupt_byte_in_tail_drops_from_there(self, tmp_path):
+        seg, offsets = self._build(str(tmp_path), n=8)
+        with open(seg, "rb") as f:
+            full = f.read()
+        # flip one byte inside record 6's payload: records 1-5 recover
+        pos = offsets[4] + journal._REC_SIZE + 2
+        bad = bytearray(full)
+        bad[pos] ^= 0xFF
+        with open(seg, "wb") as f:
+            f.write(bytes(bad))
+        got = replay_unanswered(str(tmp_path))
+        assert [s for s, _ in got] == [1, 2, 3, 4, 5]
+
+
+_WRITER = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from nnstreamer_tpu.utils.journal import Journal
+j = Journal(sys.argv[1], fsync="always", segment_bytes=1 << 14)
+i = 0
+while True:
+    seq = j.append(("payload-%06d" % i).encode() * 4)
+    # a printed seqno is a DURABLE claim: append() fsynced before
+    # returning (fsync=always), so the kill test may assert it survives
+    print("REQ %d" % seq, flush=True)
+    if i % 3 == 0:
+        j.ack(seq)
+        print("ACK %d" % seq, flush=True)
+    i += 1
+    time.sleep(0.001)
+"""
+
+
+class TestSigkillWriter:
+    """The committed crash-consistency property test: SIGKILL a real
+    writer subprocess mid-append stream, then assert replay recovers
+    every durably-reported entry (no lost accepted requests), drops the
+    torn tail, and never duplicates an answer (ack multiplicity 1)."""
+
+    @staticmethod
+    def _await_traffic(tmp_path, timeout=20.0):
+        """Anchor the kill timer on actual journal bytes, not interpreter
+        startup (imports dwarf millisecond-scale delays)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            segs = [n for n in os.listdir(tmp_path)
+                    if n.startswith("wal-")]
+            if segs and any(os.path.getsize(os.path.join(tmp_path, n))
+                            for n in segs):
+                return True
+            time.sleep(0.005)
+        return False
+
+    @pytest.mark.parametrize("delay_ms", [40, 110, 230])
+    def test_sigkill_mid_append(self, tmp_path, delay_ms):
+        script = _WRITER.format(repo=REPO)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, str(tmp_path)],
+            stdout=subprocess.PIPE, text=True, cwd=REPO)
+        assert self._await_traffic(tmp_path), "writer never started"
+        time.sleep(delay_ms / 1e3)
+        os.kill(proc.pid, signal.SIGKILL)
+        out, _ = proc.communicate(timeout=10)
+        reported_reqs, reported_acks = set(), set()
+        for line in out.splitlines():
+            kind, _, seq = line.partition(" ")
+            if kind == "REQ":
+                reported_reqs.add(int(seq))
+            elif kind == "ACK":
+                reported_acks.add(int(seq))
+        if not reported_reqs:
+            pytest.skip("writer was killed before its first append")
+        st = scan(str(tmp_path))
+        # 1. no lost accepted requests: every seqno the writer REPORTED
+        # (durably appended) is recovered
+        missing = reported_reqs - set(st.requests)
+        assert not missing, f"lost durable entries {sorted(missing)}"
+        # 2. the torn tail is dropped, not resurrected: at most one
+        # unreported record can have completed (the one mid-kill)
+        extra = set(st.requests) - reported_reqs
+        assert len(extra) <= 1, f"resurrected records {sorted(extra)}"
+        # 3. exactly-once watermark: no seqno acked twice, every
+        # reported ack durable
+        assert all(m == 1 for m in st.ack_multiplicity.values())
+        assert reported_acks - st.acked == set()
+        # 4. replay = reqs minus acks, ordered, deduped
+        got = [s for s, _ in replay_unanswered(str(tmp_path))]
+        assert got == sorted(set(st.requests) - st.acked)
+        assert len(got) == len(set(got))
+
+    def test_restart_after_kill_continues_cleanly(self, tmp_path):
+        """The journal a killed writer leaves behind must accept a new
+        writer (seqnos continue past the recovered max) — the restart
+        path the yank_process soak drives end-to-end."""
+        script = _WRITER.format(repo=REPO)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, str(tmp_path)],
+            stdout=subprocess.PIPE, text=True, cwd=REPO)
+        assert TestSigkillWriter._await_traffic(tmp_path), \
+            "writer never started"
+        time.sleep(0.15)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.communicate(timeout=10)
+        before = scan(str(tmp_path))
+        j = Journal(str(tmp_path), fsync="always")
+        seq = j.append(b"post-restart")
+        assert seq == before.max_seqno + 1
+        for s, _ in replay_unanswered(str(tmp_path)):
+            if s != seq:
+                j.ack(s)
+        j.close()
+        assert [s for s, _ in replay_unanswered(str(tmp_path))] == [seq]

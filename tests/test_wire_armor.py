@@ -1,0 +1,362 @@
+"""Hardened wire ingestion (ISSUE 12, docs/ROBUSTNESS.md): typed
+WireError rejects, configurable limits, declared-vs-actual
+cross-checks, meta-drop accounting, and msg-id salvage."""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.utils import wire
+from nnstreamer_tpu.utils.wire import WireError, WireLimits
+
+
+def _hdr(n=0, meta=b"", pts=-1, seqno=0, flags=0):
+    return struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, flags, n,
+                       pts, seqno, len(meta)) + meta
+
+
+class TestDecodeHardening:
+    def test_roundtrip_still_works(self):
+        buf = Buffer([np.arange(12, dtype=np.float32).reshape(3, 4),
+                      np.array([1, 2], np.int64)],
+                     meta={"_query_msg": 7, "_tenant": "a"})
+        buf.pts = 123
+        out, flags = wire.decode_buffer(wire.encode_buffer(buf, flags=3))
+        assert flags == 3
+        assert out.pts == 123
+        assert out.meta["_query_msg"] == 7
+        np.testing.assert_array_equal(out.tensors[0], buf.tensors[0])
+
+    def test_truncated_header_is_typed(self):
+        with pytest.raises(WireError):
+            wire.decode_buffer(b"\x01\x02")
+
+    def test_truncated_tensor_is_typed_not_struct_error(self):
+        raw = wire.encode_buffer(Buffer([np.zeros((4,), np.float32)]))
+        for cut in (len(raw) - 3, 40, 37):
+            with pytest.raises(WireError):
+                wire.decode_buffer(raw[:cut])
+
+    def test_bad_magic_and_version(self):
+        raw = wire.encode_buffer(Buffer([]))
+        with pytest.raises(WireError, match="magic"):
+            wire.decode_buffer(b"XXXX" + raw[4:])
+        bad = bytearray(raw)
+        bad[4:8] = struct.pack("<I", 99)
+        with pytest.raises(WireError, match="version"):
+            wire.decode_buffer(bytes(bad))
+
+    def test_tensor_count_bomb(self):
+        raw = bytearray(wire.encode_buffer(Buffer([])))
+        raw[12:16] = struct.pack("<I", 0xFFFFFFFF)
+        with pytest.raises(WireError, match="tensor count"):
+            wire.decode_buffer(bytes(raw))
+
+    def test_rank_bomb(self):
+        with pytest.raises(WireError, match="rank"):
+            wire.decode_buffer(_hdr(n=1) + struct.pack("<I", 1 << 30))
+
+    def test_meta_bomb_rejected_before_parse(self):
+        raw = bytearray(wire.encode_buffer(Buffer([])))
+        raw[32:36] = struct.pack("<I", 0xFFFFFFFF)
+        with pytest.raises(WireError, match="meta"):
+            wire.decode_buffer(bytes(raw))
+
+    def test_nbytes_dims_cross_check(self):
+        # dims say 4 float32 (16 bytes), header claims 20
+        raw = (_hdr(n=1) + struct.pack("<II", 1, 4)
+               + struct.pack("<I", 7) + b"float32"
+               + struct.pack("<Q", 20) + b"\x00" * 20)
+        with pytest.raises(WireError, match="declares 20 bytes"):
+            wire.decode_buffer(raw)
+
+    def test_tensor_bytes_limit(self):
+        lim = WireLimits(max_tensor_bytes=64)
+        raw = wire.encode_buffer(Buffer([np.zeros((65,), np.uint8)]))
+        with pytest.raises(WireError, match="limit 64"):
+            wire.decode_buffer(raw, lim)
+        # under the limit decodes fine
+        ok = wire.encode_buffer(Buffer([np.zeros((64,), np.uint8)]))
+        wire.decode_buffer(ok, lim)
+
+    def test_dtype_whitelist(self):
+        # "O8" (object) parses in numpy but must never cross the wire
+        raw = (_hdr(n=1) + struct.pack("<II", 1, 1)
+               + struct.pack("<I", 2) + b"O8"
+               + struct.pack("<Q", 8) + b"\x00" * 8)
+        with pytest.raises(WireError, match="whitelist"):
+            wire.decode_buffer(raw)
+
+    def test_meta_must_be_json_object(self):
+        with pytest.raises(WireError, match="JSON object"):
+            wire.decode_buffer(_hdr(meta=b"[1, 2]"))
+        with pytest.raises(WireError, match="json"):
+            wire.decode_buffer(_hdr(meta=b"{nope"))
+
+    def test_trailing_garbage_rejected(self):
+        raw = wire.encode_buffer(Buffer([np.ones((2,), np.int32)]))
+        with pytest.raises(WireError, match="trailing"):
+            wire.decode_buffer(raw + b"\xde\xad")
+
+    def test_encode_rejects_non_whitelisted_dtype(self):
+        # symmetric contract: encode must fail loudly rather than
+        # produce bytes (a DLQ/journal record) decode can never read
+        with pytest.raises(WireError, match="not wire-serializable"):
+            wire.encode_buffer(Buffer([np.zeros((2,), np.complex64)]))
+
+    def test_wire_error_is_value_error(self):
+        # pre-armor handlers catch ValueError; the typed reject must
+        # still land in them
+        assert issubclass(WireError, ValueError)
+
+
+class TestMetaDropAccounting:
+    def test_non_json_meta_counted_and_logged_once(self, caplog):
+        metrics.reset()
+        wire._warned_meta_keys.clear()
+
+        class Opaque:
+            pass
+
+        buf = Buffer([], meta={"good": 1, "bad": Opaque()})
+        import logging
+
+        with caplog.at_level(logging.DEBUG,
+                             logger="nnstreamer_tpu.utils.wire"):
+            wire.encode_buffer(buf)
+            wire.encode_buffer(buf)  # second drop: counted, not logged
+        out, _ = wire.decode_buffer(wire.encode_buffer(buf))
+        assert out.meta == {"good": 1}
+        assert metrics.snapshot().get("wire.meta_dropped") == 3.0
+        drops = [r for r in caplog.records if "bad" in r.getMessage()]
+        assert len(drops) == 1  # once per key
+
+
+class TestSalvage:
+    def test_salvage_recovers_msg_id_from_malformed_tensor_section(self):
+        buf = Buffer([np.zeros((4,), np.float32)],
+                     meta={"_query_msg": 42, "_tenant": "t1"})
+        raw = bytearray(wire.encode_buffer(buf))
+        raw[-8:] = b"\x00" * 8  # corrupt the tensor payload size field
+        raw = bytes(raw[:-4])   # and truncate
+        with pytest.raises(WireError):
+            wire.decode_buffer(raw)
+        meta = wire.salvage_meta(raw)
+        assert meta["_query_msg"] == 42
+        assert meta["_tenant"] == "t1"
+
+    def test_salvage_never_raises(self):
+        for garbage in (b"", b"\x00" * 40, b"NNST" + b"\xff" * 64):
+            assert wire.salvage_meta(garbage) is None or \
+                isinstance(wire.salvage_meta(garbage), dict)
+
+
+class _SockPair:
+    """Real socketpair so read_frame sees genuine socket semantics."""
+
+    def __enter__(self):
+        self.a, self.b = socket.socketpair()
+        self.b.settimeout(2.0)
+        return self
+
+    def __exit__(self, *exc):
+        for s in (self.a, self.b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class TestReadFrameHardening:
+    def test_roundtrip(self):
+        with _SockPair() as sp:
+            payload = wire.encode_buffer(Buffer([np.ones((3,), np.int8)]))
+            wire.write_frame(sp.a, payload)
+            assert wire.read_frame(sp.b) == payload
+
+    def test_length_bomb_rejected_before_body(self):
+        with _SockPair() as sp:
+            sp.a.sendall(struct.pack("<Q", 1 << 62) + b"junk")
+            with pytest.raises(WireError, match="declares"):
+                wire.read_frame(sp.b)
+
+    def test_crc_mismatch_typed(self):
+        from nnstreamer_tpu.native import wire_gather
+
+        with _SockPair() as sp:
+            frame = bytearray(wire_gather([b"hello world"]))
+            frame[-1] ^= 0xFF
+            sp.a.sendall(bytes(frame))
+            with pytest.raises(WireError, match="crc"):
+                wire.read_frame(sp.b)
+
+    def test_oversize_vs_limits_arg(self):
+        lim = WireLimits(max_frame_bytes=16)
+        from nnstreamer_tpu.native import wire_gather
+
+        with _SockPair() as sp:
+            sp.a.sendall(bytes(wire_gather([b"x" * 64])))
+            with pytest.raises(WireError, match="limit 16"):
+                wire.read_frame(sp.b, lim)
+
+
+class TestServerSurvivesGarbage:
+    """The serversrc read loop: a malformed frame is rejected typed —
+    counted per tenant, answered when the msg id salvages — and the
+    connection keeps serving (the satellite fix: one bad frame used to
+    tear down the whole connection)."""
+
+    def _serve(self):
+        import nnstreamer_tpu as nt
+        from nnstreamer_tpu.filters.custom_easy import \
+            register_custom_easy
+        from nnstreamer_tpu.core.types import TensorsSpec
+
+        spec = TensorsSpec.from_string("4", "float32")
+        register_custom_easy("wire-echo", lambda ins: [ins[0] * 2.0],
+                             in_spec=spec, out_spec=spec)
+        return nt.Pipeline(
+            "tensor_query_serversrc name=ssrc port=0 id=61 ! "
+            "tensor_filter framework=custom-easy model=wire-echo ! "
+            "tensor_query_serversink id=61")
+
+    def test_garbage_interleaved_with_valid_requests(self):
+        from nnstreamer_tpu.utils.net import client_handshake
+
+        metrics.reset()
+        srv = self._serve()
+        with srv:
+            port = srv.element("ssrc").bound_port
+            sock = socket.create_connection(("127.0.0.1", port),
+                                            timeout=5.0)
+            try:
+                client_handshake(sock, "hello", caps="other/tensors",
+                                 topic="", tenant="garbler")
+                sock.settimeout(5.0)
+                answered = {}
+                mid = 0
+                for round_ in range(6):
+                    # one VALID request
+                    buf = Buffer([np.full((4,), float(round_),
+                                          np.float32)],
+                                 meta={"_query_msg": mid,
+                                       "_tenant": "garbler"})
+                    wire.write_frame(sock, wire.encode_buffer(buf))
+                    mid += 1
+                    # one GARBAGE frame (valid framing+meta, forged
+                    # tensor section -> typed reject, salvaged msg id)
+                    bad = bytearray(wire.encode_buffer(
+                        Buffer([np.zeros((4,), np.float32)],
+                               meta={"_query_msg": mid,
+                                     "_tenant": "garbler"})))
+                    bad[-10:] = b"\xff" * 10
+                    wire.write_frame(sock, bytes(bad[:-6]))
+                    mid += 1
+                    # and one pure-noise frame (meta unsalvageable)
+                    wire.write_frame(sock, b"\x07garbage" * 5)
+                    mid += 0  # no msg id was consumed by noise
+                deadline = 12
+                import time as _t
+
+                t0 = _t.monotonic()
+                while len(answered) < 12 and _t.monotonic() - t0 < deadline:
+                    try:
+                        raw = wire.read_frame(sock)
+                    except socket.timeout:
+                        continue
+                    assert raw is not None, \
+                        "server dropped the connection on garbage"
+                    got, _ = wire.decode_buffer(raw)
+                    answered[int(got.meta["_query_msg"])] = got
+                # every valid request answered with real results
+                for r in range(6):
+                    got = answered[2 * r]
+                    assert not got.meta.get("wire_reject")
+                    np.testing.assert_allclose(
+                        np.asarray(got.tensors[0]),
+                        np.full((4,), 2.0 * r, np.float32))
+                # every salvageable garbage frame answered TYPED
+                for r in range(6):
+                    got = answered[2 * r + 1]
+                    assert got.meta.get("wire_reject") is True
+                    assert got.meta.get("abort_reason") == "wire"
+                    assert got.tensors == []
+            finally:
+                sock.close()
+            # 6 salvageable + 6 noise frames rejected, per tenant.
+            # Poll: the last NOISE frame is never answered, so its
+            # reject may still be mid-count when the 12th answer lands
+            # client-side.
+            import time as _t
+
+            deadline = _t.monotonic() + 5.0
+            while _t.monotonic() < deadline and metrics.snapshot().get(
+                    "query_server.wire_rejects", 0.0) < 12.0:
+                _t.sleep(0.02)
+            snap = metrics.snapshot()
+            lab = metrics.labeled_counters()
+            assert snap.get("query_server.wire_rejects") == 12.0
+            assert lab.get(("query_server.wire_rejects",
+                            "garbler")) == 12.0
+            assert snap.get("query_server.out") == 6.0
+
+    def test_framing_violation_drops_connection_but_server_survives(self):
+        from nnstreamer_tpu.utils.net import client_handshake
+
+        metrics.reset()
+        srv = self._serve()
+        with srv:
+            port = srv.element("ssrc").bound_port
+            # connection 1: length bomb -> dropped
+            s1 = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+            try:
+                client_handshake(s1, "hello", caps="other/tensors",
+                                 topic="", tenant="bomber")
+                s1.sendall(struct.pack("<Q", 1 << 62) + b"x" * 16)
+                s1.settimeout(5.0)
+                # server closes: read returns EOF eventually
+                import time as _t
+
+                t0 = _t.monotonic()
+                closed = False
+                while _t.monotonic() - t0 < 8:
+                    try:
+                        if s1.recv(4096) == b"":
+                            closed = True
+                            break
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        closed = True
+                        break
+                assert closed, "length-bomb connection was not dropped"
+            finally:
+                s1.close()
+            # connection 2 on the SAME server still serves
+            s2 = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+            try:
+                client_handshake(s2, "hello", caps="other/tensors",
+                                 topic="")
+                buf = Buffer([np.ones((4,), np.float32)],
+                             meta={"_query_msg": 0})
+                wire.write_frame(s2, wire.encode_buffer(buf))
+                s2.settimeout(5.0)
+                while True:
+                    try:
+                        raw = wire.read_frame(s2)
+                        break
+                    except socket.timeout:
+                        continue
+                got, _ = wire.decode_buffer(raw)
+                np.testing.assert_allclose(
+                    np.asarray(got.tensors[0]),
+                    np.full((4,), 2.0, np.float32))
+            finally:
+                s2.close()
+            assert metrics.labeled_counters().get(
+                ("query_server.wire_rejects", "bomber")) == 1.0

@@ -67,6 +67,12 @@ ROWS = [
     # eval window — the BENCH_ELASTIC row
     ("soak_elastic", ["SOAK", "--elastic", "--out",
                       "BENCH_ELASTIC_sweep.json"]),
+    # nns-armor (ISSUE 12): journal-overhead A/B on the query front
+    # door (fsync=batch vs journal off, interleaved-median p50 —
+    # target < 3%) + the yank_process kill -9 / journal-replay
+    # exactly-once row; artifact lands next to the sweep
+    ("journal_overhead_ab", ["ARMOR", "--out",
+                             "BENCH_ARMOR_sweep.json"]),
     ("detection_ssd", ["--config", "detection"]),
     ("detection_yolov5s", ["--config", "detection",
                            "--detection-model", "yolov5s"]),
@@ -144,6 +150,10 @@ def run_row(label: str, argv, timeout: int) -> dict:
     if argv and argv[0] == "SOAK":
         cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py")] \
             + argv[1:]
+    # ARMOR sentinel: tools/bench_armor.py (same stdout contract)
+    elif argv and argv[0] == "ARMOR":
+        cmd = [sys.executable,
+               os.path.join(REPO, "tools", "bench_armor.py")] + argv[1:]
     else:
         cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)

@@ -87,6 +87,19 @@ the dead-connection backchannel with its KV blocks reclaimed, and a
 mid-run connection cut must be survived via client reconnect
 (backoff + full jitter) — surviving tenants' p99 green both times.
 
+AND it runs the armor gate (ISSUE 12, docs/ROBUSTNESS.md):
+tests/test_wire_armor.py + tests/test_journal.py + tests/test_armor.py
+as their own pytest process (typed WireError rejects + limits, the
+SIGKILL crash-consistency property test, the journal replay golden,
+poison quarantine/DLQ/breaker), then ``tools/fuzz_wire.py --smoke``
+(the committed regression corpus + 2000 seeded structure-aware
+mutations over decode_buffer/read_frame/the parser — zero uncaught
+exceptions, zero over-limit allocations), then ``tools/soak.py
+--yank-smoke``: SIGKILL the journaled serving subprocess mid-run,
+restart it with journal-replay on the same port, and assert the
+exactly-once contract (unanswered-at-kill all re-admitted and acked
+once, journal fully answered at the end, no client losses).
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -673,6 +686,95 @@ def run_elastic_gate(timeout: int = 900) -> int:
     return 1 if problems else 0
 
 
+def run_armor_gate(timeout: int = 900) -> int:
+    """nns-armor gate (ISSUE 12, see module docstring): the armor test
+    files as their own pytest process, the seeded fuzz smoke over the
+    wire codec + parser, and the yank_process kill -9 / journal-replay
+    exactly-once smoke."""
+    import json
+    import tempfile
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_wire_armor.py", "tests/test_journal.py",
+           "tests/test_armor.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"armor gate: tests TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"armor gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    cmd = [sys.executable, os.path.join(REPO, "tools", "fuzz_wire.py"),
+           "--smoke"]
+    try:
+        fuzz = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"armor gate: fuzz smoke TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    if fuzz.returncode != 0:
+        print(f"armor gate: FUZZ FAILED ({passed} tests passed)")
+        for line in (fuzz.stdout + fuzz.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return fuzz.returncode
+
+    out = os.path.join(tempfile.gettempdir(), "nns_yank_gate.json")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+           "--yank-smoke", "--out", out]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"armor gate: yank smoke TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    problems = []
+    if proc.returncode != 0:
+        problems.append(f"soak.py --yank-smoke rc={proc.returncode}")
+    try:
+        with open(out) as f:
+            row = json.load(f)["rows"][0]
+    except (OSError, ValueError, KeyError, IndexError) as e:
+        row = {}
+        problems.append(f"unreadable yank artifact: {e}")
+    if row:
+        if not row.get("killed"):
+            problems.append("yank: server was never killed")
+        if row.get("unanswered_at_kill", 0) < 1:
+            problems.append("yank: nothing unanswered at the kill "
+                            "(fault missed the live window)")
+        if not row.get("replay_exactly_once"):
+            problems.append(
+                f"yank: exactly-once contract failed "
+                f"(unanswered_at_kill={row.get('unanswered_at_kill')}, "
+                f"replayed={row.get('replayed')}, "
+                f"replay_answered={row.get('replay_answered')}, "
+                f"unanswered_end={row.get('unanswered_end')}, "
+                f"ack_multiplicity_ok={row.get('ack_multiplicity_ok')})")
+        if row.get("lost_total", 1) != 0:
+            problems.append(f"yank: clients lost "
+                            f"{row.get('lost_total')} request(s)")
+    tag = "OK" if not problems else "FAILED"
+    print(f"armor gate: {tag} ({passed} tests passed, fuzz clean, "
+          f"yank replayed={row.get('replayed')})")
+    for p in problems:
+        print(f"  armor gate: {p}", file=sys.stderr)
+    if problems and proc.stdout:
+        for line in proc.stdout.strip().splitlines()[-8:]:
+            print(f"  {line}", file=sys.stderr)
+    return 1 if problems else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -693,9 +795,10 @@ def main() -> int:
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
     elastic_rc = run_elastic_gate()
+    armor_rc = run_armor_gate()
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
                or mxu_rc or serving_rc or fetch_rc or soak_rc
-               or elastic_rc)
+               or elastic_rc or armor_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:

@@ -1,0 +1,407 @@
+#!/usr/bin/env python
+"""Deterministic structure-aware fuzz harness for the wire codec + the
+pipeline parser (ISSUE 12, docs/ROBUSTNESS.md).
+
+The wire codec decodes attacker-controlled bytes on the public front
+door; this harness is the standing proof that EVERY malformed input
+surfaces as the typed :exc:`~nnstreamer_tpu.utils.wire.WireError`
+(``decode_buffer``/``read_frame``) or :class:`ParseError` (the pipeline
+parser) — never a raw ``struct.error``, ``UnicodeDecodeError``,
+``MemoryError``, or a multi-gigabyte allocation.
+
+    python tools/fuzz_wire.py --smoke              # the CI gate shape:
+                                                   # corpus + 2000 seeded iters
+    python tools/fuzz_wire.py --iters 50000 --seed 7
+    python tools/fuzz_wire.py --regen-corpus       # rewrite tools/wire_corpus
+
+Mutation strategy (structure-aware, seeded, deterministic): start from a
+VALID encoding of a random buffer/frame/pipeline string, then corrupt it
+the way headers actually get corrupted — field overwrites with extreme
+values (u32/u64 maxima, off-by-one lengths), byte flips, truncation,
+splicing, and pure-noise controls.  Every failure writes a repro file
+and is reported; the committed regression corpus (``tools/wire_corpus``)
+replays first, so every crasher this harness ever found stays fixed.
+
+Invariants asserted beyond "typed error only":
+
+* no decoded tensor exceeds ``WireLimits.max_tensor_bytes``;
+* ``read_frame`` never issues a recv() larger than the wire module's
+  1 MiB chunk bound, and a frame declaring more than
+  ``max_frame_bytes`` is rejected BEFORE any body byte is read.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nnstreamer_tpu.core.buffer import Buffer  # noqa: E402
+from nnstreamer_tpu.pipeline.parser import ParseError, parse  # noqa: E402
+from nnstreamer_tpu.utils import wire  # noqa: E402
+
+CORPUS_DIR = os.path.join(REPO, "tools", "wire_corpus")
+SMOKE_SEED = 1234
+SMOKE_ITERS = 2000
+
+#: limits the fuzzer runs under — tight, so limit enforcement itself is
+#: exercised (a 1 MiB tensor bound makes size-bomb rejects reachable)
+FUZZ_LIMITS = wire.WireLimits(
+    max_tensors=8, max_rank=8, max_tensor_bytes=1 << 20,
+    max_meta_bytes=1 << 16, max_frame_bytes=1 << 21)
+
+_DTYPES = ["uint8", "int8", "int16", "int32", "int64", "float16",
+           "float32", "float64"]
+
+_PIPE_SEEDS = [
+    "videotestsrc ! tensor_converter ! tensor_sink",
+    "appsrc name=src ! tensor_filter framework=custom-easy model=m ! "
+    "tensor_sink name=out",
+    "tensor_query_serversrc port=0 id=7 admission=shed max-backlog=4 ! "
+    "tensor_filter framework=llm model=llama_tiny custom=max_new:8 ! "
+    "tensor_query_serversink id=7",
+    "appsrc ! tee name=t t. ! queue ! tensor_sink t. ! queue ! fakesink",
+    "filesrc location=x.mp4 ! decodebin ! videoconvert ! "
+    "video/x-raw,format=RGB,width=224,height=224 ! tensor_converter ! "
+    "other/tensors,types=uint8 ! tensor_sink",
+]
+
+_PIPE_TOKENS = ["!", "name=", "tensor_filter", "caps=", ",", ":", "=",
+                "tee", "queue", ".", "other/tensors", "%", "\x00", '"',
+                "framework=", "video/x-raw", " ", "(", ")"]
+
+
+class ByteSock:
+    """socket-like reader over bytes, instrumenting recv sizes (the
+    allocation-guard assertions read ``max_req``/``reads``)."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._off = 0
+        self.max_req = 0
+        self.reads = 0
+
+    def recv(self, n: int) -> bytes:
+        self.reads += 1
+        self.max_req = max(self.max_req, n)
+        chunk = self._data[self._off:self._off + n]
+        self._off += len(chunk)
+        return chunk
+
+
+# ---------------------------------------------------------------------------
+# generators + mutators
+# ---------------------------------------------------------------------------
+
+def make_valid_payload(rng: np.random.Generator) -> bytes:
+    tensors = []
+    for _ in range(int(rng.integers(0, 4))):
+        rank = int(rng.integers(0, 4))
+        shape = tuple(int(rng.integers(1, 5)) for _ in range(rank))
+        dt = np.dtype(_DTYPES[int(rng.integers(0, len(_DTYPES)))])
+        if dt.kind == "f":
+            t = rng.standard_normal(shape).astype(dt)
+        else:
+            t = rng.integers(0, 100, shape).astype(dt)
+        tensors.append(t)
+    meta = {}
+    if rng.random() < 0.7:
+        meta["_query_msg"] = int(rng.integers(0, 1 << 20))
+    if rng.random() < 0.5:
+        meta["_tenant"] = f"t{int(rng.integers(0, 4))}"
+    if rng.random() < 0.3:
+        meta["k" * int(rng.integers(1, 8))] = \
+            list(rng.integers(0, 9, 3).tolist())
+    buf = Buffer(tensors, meta=meta)
+    if rng.random() < 0.3:
+        buf.pts = int(rng.integers(0, 1 << 40))
+    return wire.encode_buffer(buf)
+
+
+_EXTREMES_U32 = [0, 1, 0x7FFFFFFF, 0xFFFFFFFF, 0xFFFFFFFE, 1 << 20]
+_EXTREMES_U64 = [0, 1, 0x7FFFFFFFFFFFFFFF, 0xFFFFFFFFFFFFFFFF,
+                 1 << 40, 1 << 62, (1 << 32) - 1]
+
+
+def mutate(rng: np.random.Generator, data: bytes) -> bytes:
+    """One structure-aware corruption of ``data``."""
+    b = bytearray(data)
+    kind = int(rng.integers(0, 7))
+    if kind == 0 and b:  # byte flips
+        for _ in range(int(rng.integers(1, 8))):
+            i = int(rng.integers(0, len(b)))
+            b[i] ^= int(rng.integers(1, 256))
+    elif kind == 1 and b:  # truncate
+        del b[int(rng.integers(0, len(b))):]
+    elif kind == 2:  # append junk
+        b += bytes(rng.integers(0, 256,
+                                int(rng.integers(1, 64))).astype(np.uint8))
+    elif kind == 3 and len(b) >= 4:  # u32 field overwrite
+        off = int(rng.integers(0, len(b) - 3))
+        v = _EXTREMES_U32[int(rng.integers(0, len(_EXTREMES_U32)))]
+        b[off:off + 4] = struct.pack("<I", v)
+    elif kind == 4 and len(b) >= 8:  # u64 field overwrite
+        off = int(rng.integers(0, len(b) - 7))
+        v = _EXTREMES_U64[int(rng.integers(0, len(_EXTREMES_U64)))]
+        b[off:off + 8] = struct.pack("<Q", v)
+    elif kind == 5:  # pure noise (control)
+        b = bytearray(bytes(rng.integers(
+            0, 256, int(rng.integers(0, 256))).astype(np.uint8)))
+    else:  # splice two valids
+        other = make_valid_payload(rng)
+        cut = int(rng.integers(0, len(b) + 1)) if b else 0
+        b = bytearray(bytes(b[:cut]) + other[int(rng.integers(
+            0, len(other))):])
+    return bytes(b)
+
+
+def mutate_pipeline(rng: np.random.Generator, desc: str) -> str:
+    s = list(desc)
+    for _ in range(int(rng.integers(1, 6))):
+        op = int(rng.integers(0, 3))
+        if op == 0 and s:  # delete a span
+            i = int(rng.integers(0, len(s)))
+            del s[i:i + int(rng.integers(1, 9))]
+        elif op == 1:  # insert a token
+            tok = _PIPE_TOKENS[int(rng.integers(0, len(_PIPE_TOKENS)))]
+            i = int(rng.integers(0, len(s) + 1))
+            s[i:i] = list(tok)
+        elif s:  # swap a char
+            i = int(rng.integers(0, len(s)))
+            s[i] = chr(int(rng.integers(32, 127)))
+    return "".join(s)
+
+
+# ---------------------------------------------------------------------------
+# targets
+# ---------------------------------------------------------------------------
+
+def check_decode(data: bytes) -> str:
+    """'' = OK (decoded or typed reject); else the failure description."""
+    try:
+        buf, _flags = wire.decode_buffer(data, FUZZ_LIMITS)
+    except wire.WireError:
+        return ""
+    except Exception as e:  # noqa: BLE001 - the finding
+        return f"decode_buffer raised {type(e).__name__}: {e}"
+    for t in buf.tensors:
+        if t.nbytes > FUZZ_LIMITS.max_tensor_bytes:
+            return (f"decoded tensor of {t.nbytes} bytes above the "
+                    f"{FUZZ_LIMITS.max_tensor_bytes} limit")
+    return ""
+
+
+def check_frame(data: bytes) -> str:
+    sock = ByteSock(data)
+    try:
+        payload = wire.read_frame(sock, FUZZ_LIMITS)
+    except wire.WireError:
+        payload = None
+    except Exception as e:  # noqa: BLE001
+        return f"read_frame raised {type(e).__name__}: {e}"
+    if sock.max_req > wire._RECV_CHUNK:
+        return (f"read_frame issued a {sock.max_req}-byte recv "
+                f"(> {wire._RECV_CHUNK} chunk bound)")
+    if len(data) >= 8:
+        (length,) = struct.unpack("<Q", data[:8])
+        if length > FUZZ_LIMITS.max_frame_bytes and sock.reads > 1:
+            return (f"read_frame read the body of a {length}-byte "
+                    "over-limit frame instead of rejecting at the "
+                    "header")
+    if payload is not None:
+        return check_decode(payload)
+    return ""
+
+
+def check_parse(desc: str) -> str:
+    try:
+        parse(desc, validate=False)
+    except ParseError:
+        return ""
+    except Exception as e:  # noqa: BLE001
+        return f"parse raised {type(e).__name__}: {e}"
+    return ""
+
+
+def frame_bytes(payload: bytes) -> bytes:
+    from nnstreamer_tpu.native import wire_gather
+
+    return bytes(wire_gather([payload]))
+
+
+# ---------------------------------------------------------------------------
+# corpus
+# ---------------------------------------------------------------------------
+
+def regen_corpus() -> int:
+    """(Re)write the committed regression corpus: one file per crasher
+    class the hardened codec must keep rejecting typed.  Deterministic
+    content — safe to re-run, diffs only when a case is added."""
+    os.makedirs(CORPUS_DIR, exist_ok=True)
+    rng = np.random.default_rng(7)
+    valid = make_valid_payload(rng)
+    hdr = struct.calcsize("<IIIIqQI")
+
+    def u32_at(data, off, v):
+        b = bytearray(data)
+        b[off:off + 4] = struct.pack("<I", v)
+        return bytes(b)
+
+    cases = {
+        # pre-armor crashers: raw struct.error in the read loop
+        "decode-truncated-header.bin": valid[:11],
+        "decode-truncated-midtensor.bin": valid[:-3],
+        "decode-empty.bin": b"",
+        # shape/size bombs: multi-GB allocation attempts
+        "decode-count-bomb.bin": u32_at(valid, 12, 0xFFFFFFFF),
+        "decode-rank-bomb.bin": (
+            struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 1,
+                        -1, 0, 0) + struct.pack("<I", 0xFFFFFFFF)),
+        "decode-meta-bomb.bin": u32_at(valid, hdr - 4, 0xFFFFFFFF),
+        "decode-nbytes-bomb.bin": (
+            struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 1,
+                        -1, 0, 0)
+            + struct.pack("<IIII", 1, 0x40000000, 7, 0)[:12]
+            + b"float32" + struct.pack("<Q", 1 << 62)),
+        # forged cross-check: dims say 4 floats, nbytes says 7
+        "decode-nbytes-mismatch.bin": (
+            struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 1,
+                        -1, 0, 0)
+            + struct.pack("<II", 1, 4) + struct.pack("<I", 7)
+            + b"float32" + struct.pack("<Q", 7) + b"\x00" * 7),
+        # dtype outside the whitelist (numpy would happily parse "O8")
+        "decode-dtype-object.bin": (
+            struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 1,
+                        -1, 0, 0)
+            + struct.pack("<II", 1, 1) + struct.pack("<I", 2)
+            + b"O8" + struct.pack("<Q", 8) + b"\x00" * 8),
+        # meta that is valid JSON but not an object
+        "decode-meta-nonobject.bin": (
+            struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 0,
+                        -1, 0, 4) + b"[1]"),
+        "decode-meta-badjson.bin": (
+            struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 0,
+                        -1, 0, 4) + b"{{{{"),
+        "decode-trailing-garbage.bin": valid + b"\xde\xad\xbe\xef",
+        "decode-bad-magic.bin": b"XXXX" + valid[4:],
+        "decode-bad-version.bin": u32_at(valid, 4, 99),
+        # framing: length bomb (must reject at the header, no body read)
+        "frame-length-bomb.bin": struct.pack("<Q", 1 << 62) + b"xx",
+        "frame-crc-mismatch.bin": (
+            lambda f: f[:-1] + bytes([f[-1] ^ 0xFF]))(
+                frame_bytes(valid)),
+        "frame-truncated.bin": frame_bytes(valid)[:-2],
+        # parser: the inputs that historically hit asserts/KeyErrors
+        "parse-unbalanced.txt":
+            b"appsrc ! tee name=t t. ! ! queue ! tensor_sink",
+        "parse-empty-prop.txt": b"appsrc name= ! tensor_sink",
+        "parse-caps-noise.txt":
+            b"appsrc ! other/tensors,types=,,dimensions=::: ! fakesink",
+        "parse-control-chars.txt": b"appsrc \x00\x01 ! tensor_sink",
+    }
+    # meta length just over the fuzz limit (bounds check, not overrun)
+    big_meta = b'{"k": "' + b"a" * (1 << 16) + b'"}'
+    cases["decode-meta-overlimit.bin"] = (
+        struct.pack("<IIIIqQI", wire.MAGIC, wire.VERSION, 0, 0, -1, 0,
+                    len(big_meta)) + big_meta)
+    for name, data in cases.items():
+        with open(os.path.join(CORPUS_DIR, name), "wb") as f:
+            f.write(data)
+    print(f"wrote {len(cases)} corpus cases to {CORPUS_DIR}")
+    return 0
+
+
+def run_corpus() -> list:
+    failures = []
+    if not os.path.isdir(CORPUS_DIR):
+        return [("corpus", "missing corpus dir tools/wire_corpus")]
+    for name in sorted(os.listdir(CORPUS_DIR)):
+        path = os.path.join(CORPUS_DIR, name)
+        with open(path, "rb") as f:
+            data = f.read()
+        if name.startswith("decode-"):
+            problem = check_decode(data)
+        elif name.startswith("frame-"):
+            problem = check_frame(data)
+        elif name.startswith("parse-"):
+            problem = check_parse(data.decode("utf-8", "replace"))
+        else:
+            continue
+        if problem:
+            failures.append((name, problem))
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# main loop
+# ---------------------------------------------------------------------------
+
+def run_fuzz(seed: int, iters: int, repro_dir: str) -> list:
+    rng = np.random.default_rng(seed)
+    failures = []
+    for i in range(iters):
+        target = i % 3
+        if target == 0:
+            data = mutate(rng, make_valid_payload(rng))
+            problem = check_decode(data)
+            tag = "decode"
+        elif target == 1:
+            data = mutate(rng, frame_bytes(make_valid_payload(rng)))
+            problem = check_frame(data)
+            tag = "frame"
+        else:
+            desc = mutate_pipeline(
+                rng, _PIPE_SEEDS[int(rng.integers(0, len(_PIPE_SEEDS)))])
+            data = desc.encode("utf-8", "replace")
+            problem = check_parse(desc)
+            tag = "parse"
+        if problem:
+            os.makedirs(repro_dir, exist_ok=True)
+            repro = os.path.join(repro_dir, f"{tag}-seed{seed}-i{i}.bin")
+            with open(repro, "wb") as f:
+                f.write(data)
+            failures.append((f"{tag} iter {i}", f"{problem} "
+                                                f"[repro: {repro}]"))
+            if len(failures) >= 20:
+                failures.append(("...", "stopping after 20 failures"))
+                break
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help=f"CI shape: corpus replay + {SMOKE_ITERS} "
+                         f"iters at seed {SMOKE_SEED}")
+    ap.add_argument("--seed", type=int, default=SMOKE_SEED)
+    ap.add_argument("--iters", type=int, default=SMOKE_ITERS)
+    ap.add_argument("--regen-corpus", action="store_true",
+                    help="rewrite tools/wire_corpus (after adding a "
+                         "case)")
+    ap.add_argument("--repro-dir",
+                    default=os.path.join("/tmp", "nns_fuzz_repro"))
+    args = ap.parse_args()
+    if args.regen_corpus:
+        return regen_corpus()
+
+    failures = run_corpus()
+    n_corpus = len([n for n in os.listdir(CORPUS_DIR)]
+                   if os.path.isdir(CORPUS_DIR) else [])
+    failures += run_fuzz(args.seed, args.iters, args.repro_dir)
+    ok = not failures
+    print(f"fuzz_wire: {'OK' if ok else 'FAILED'} "
+          f"(corpus {n_corpus} cases, {args.iters} iters, "
+          f"seed {args.seed}, {len(failures)} failures)")
+    for name, problem in failures:
+        print(f"  {name}: {problem}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,16 @@ pool) and inject one fault mid-run via :class:`ChaosController`:
   (``utils/elastic.chaos_slow_stage``) for a window mid-run: the SLO
   engine must attribute the breach, and the run must recover.
 
+The ``yank_process`` profile (``--yank`` / ``--yank-smoke``, ISSUE 12,
+docs/ROBUSTNESS.md) is the durability row: the SERVER itself runs as a
+subprocess with a request journal (``serversrc journal=DIR``), gets
+SIGKILLed mid-run, and is restarted with ``journal-replay=true`` on the
+same port while reconnecting clients resend their pending requests.
+The row asserts the exactly-once contract: every accepted-but-unanswered
+journal entry at the kill is re-admitted and answered (acked) exactly
+once by the restarted process, the journal ends fully answered, and no
+client loses a request.
+
 The stdout tail is one JSON line carrying ``"metric"`` so
 ``tools/bench_all.py`` ingests the result as a sweep row.
 """
@@ -110,15 +120,18 @@ def _rate_at(profile: str, t: float, duration: float, peak: float) -> float:
 
 def _worker_segment(port: int, tenant: str, profile: str,
                     duration: float, peak: float, timeout: float,
-                    stats: dict, inflight: int = 8) -> None:
+                    stats: dict, inflight: int = 8,
+                    reconnect: int = 0) -> None:
     """One client-pipeline lifetime: push at the profile rate, pull every
     response, record latencies/sheds into ``stats``."""
     import nnstreamer_tpu as nt
 
+    extra = (f"reconnect={reconnect} reconnect_cap_ms=1500 "
+             if reconnect else "")
     cli = nt.Pipeline(
-        f"appsrc name=src ! tensor_query_client port={port} "
+        f"appsrc name=src ! tensor_query_client name=qc port={port} "
         f"tenant={tenant} timeout={timeout} on-timeout=drop "
-        f"max-in-flight={inflight} ! "
+        f"max-in-flight={inflight} {extra}! "
         "tensor_sink name=out")
     done = threading.Event()
 
@@ -347,7 +360,8 @@ def run_worker(args) -> int:
     for _ in range(segments):
         _worker_segment(args.port, args.tenant, args.profile, seg_dur,
                         args.rate, args.timeout, stats,
-                        inflight=args.inflight)
+                        inflight=args.inflight,
+                        reconnect=args.reconnect)
     lats = sorted(stats["latencies_ms"])
 
     def pct(q):
@@ -370,6 +384,9 @@ def run_worker(args) -> int:
     span = (comps[-1] - comps[0]) if len(comps) > 1 else 0.0
     sustained = (stats["completed"] / span if span > 1.0
                  else stats["completed"] / args.duration)
+    from nnstreamer_tpu.core.log import metrics as _metrics
+
+    snap = _metrics.snapshot()
     out = {
         "tenant": args.tenant,
         "profile": args.profile,
@@ -377,6 +394,8 @@ def run_worker(args) -> int:
         "completed": stats["completed"],
         "sheds_seen": stats["sheds_seen"],
         "lost": stats["lost"],
+        "reconnects": snap.get("qc.reconnects", 0.0),
+        "resends": snap.get("qc.resends", 0.0),
         "p50_ms": pct(50), "p99_ms": pct(99), "max_ms": pct(100),
         "sustained_fps": sustained,
         "burst_fps": burst / BURST_WINDOW_S,
@@ -910,6 +929,218 @@ def run_elastic_profile(*, tenants: int = 3, duration: float = 24.0,
     return row
 
 
+# ---------------------------------------------------------------------------
+# yank_process: kill -9 the serving process, restart with journal replay
+# ---------------------------------------------------------------------------
+
+def run_server(args) -> int:
+    """--server worker mode: the KILLABLE serving process of the
+    yank_process profile — a journaled front door on a FIXED port that
+    runs until SIGTERM (clean stats dump) or SIGKILL (the fault)."""
+    import signal as _signal
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics
+    from nnstreamer_tpu.utils.journal import scan
+
+    _register_work(args.service_ms)
+    replay = " journal-replay=true" if args.journal_replay else ""
+    srv = nt.Pipeline(
+        f"tensor_query_serversrc name=ssrc port={args.port} "
+        f"id={args.sid} admission=block max-backlog=256 "
+        f"journal={args.journal} journal-fsync={args.journal_fsync}"
+        f"{replay} ! "
+        f"tensor_filter framework=custom-easy model=soak-work ! "
+        f"tensor_query_serversink id={args.sid}")
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *a: stop.set())
+    with srv:
+        print("SERVER_READY", flush=True)
+        stop.wait(args.duration)
+        # quiesce: let in-flight answers drain before the stats dump
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            snap = metrics.snapshot()
+            if snap.get("query_server.in", 0.0) + snap.get(
+                    "query_server.replayed", 0.0) <= \
+                    snap.get("query_server.out", 0.0) + snap.get(
+                        "query_server.replay_answered", 0.0) + snap.get(
+                        "query_server.shed", 0.0):
+                break
+            time.sleep(0.1)
+    snap = metrics.snapshot()
+    st = scan(args.journal)
+    row = {
+        "requests_in": snap.get("query_server.in", 0.0),
+        "responses_out": snap.get("query_server.out", 0.0),
+        "replayed": snap.get("query_server.replayed", 0.0),
+        "replay_answered": snap.get("query_server.replay_answered", 0.0),
+        "journal_appends": snap.get("journal.appends", 0.0),
+        "journal_acks": snap.get("journal.acks", 0.0),
+        "wire_rejects": snap.get("query_server.wire_rejects", 0.0),
+        "journal_unanswered_at_exit": len(st.unanswered),
+    }
+    with open(args.out, "w") as f:
+        json.dump(row, f)
+    return 0
+
+
+def _free_port() -> int:
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_server(port: int, sid: int, jdir: str, replay: bool,
+                  service_ms: float, fsync: str, lifetime: float):
+    fd, path = tempfile.mkstemp(prefix="soak-srv-", suffix=".json")
+    os.close(fd)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--server",
+         "--port", str(port), "--sid", str(sid), "--journal", jdir,
+         "--journal-replay", "1" if replay else "0",
+         "--journal-fsync", fsync,
+         "--service-ms", str(service_ms),
+         "--duration", str(lifetime), "--out", path],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, text=True)
+    return proc, path
+
+
+def _await_port(port: int, timeout: float = 90.0) -> bool:
+    import socket as _socket
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            _socket.create_connection(("127.0.0.1", port),
+                                      timeout=1.0).close()
+            return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def run_yank_profile(*, tenants: int = 2, duration: float = 8.0,
+                     rate: float = 40.0, service_ms: float = 15.0,
+                     sid: int = 940, fsync: str = "batch") -> dict:
+    """The yank_process durability row (ISSUE 12): SIGKILL the serving
+    subprocess mid-run, restart it with journal replay on the same
+    port, and prove the exactly-once contract on the journal files
+    themselves (unanswered-at-kill == replayed == replay-answered, ack
+    multiplicity 1, nothing unanswered at the end, no client losses)."""
+    import signal as _signal
+
+    from nnstreamer_tpu.utils.journal import scan
+
+    jdir = tempfile.mkdtemp(prefix="soak-journal-")
+    port = _free_port()
+    row: dict = {"profile": "yank_process", "tenants_n": tenants,
+                 "duration_s": duration, "rate_per_tenant": rate,
+                 "service_ms": service_ms, "journal_fsync": fsync,
+                 "port": port}
+    srv_a, stats_a_path = _spawn_server(
+        port, sid, jdir, False, service_ms, fsync, duration * 6 + 120)
+    try:
+        if not _await_port(port):
+            row["error"] = "server A never came up"
+            return row
+        workers, outs = [], []
+        for i in range(tenants):
+            fd, path = tempfile.mkstemp(prefix="soak-yank-",
+                                        suffix=".json")
+            os.close(fd)
+            outs.append(path)
+            workers.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--port", str(port), "--tenant", f"t{i}",
+                 "--profile", "steady", "--duration", str(duration),
+                 "--rate", str(rate), "--timeout", "60",
+                 "--reconnect", "25", "--out", path],
+                cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu")))
+        # anchor the kill on observed traffic (journal bytes), then
+        # yank mid-run
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and not scan(jdir).requests:
+            time.sleep(0.1)
+        time.sleep(duration * 0.35)
+        os.kill(srv_a.pid, _signal.SIGKILL)
+        srv_a.wait(timeout=10)
+        row["killed"] = True
+        st_kill = scan(jdir)
+        row["journaled_at_kill"] = len(st_kill.requests)
+        row["unanswered_at_kill"] = len(st_kill.unanswered)
+        # restart on the SAME port with replay: reconnecting clients
+        # resend their pending requests as NEW journal entries while
+        # the replayed ones answer server-side
+        srv_b, stats_b_path = _spawn_server(
+            port, sid, jdir, True, service_ms, fsync,
+            duration * 6 + 120)
+        try:
+            row["restarted"] = _await_port(port)
+            w_deadline = time.monotonic() + duration * 6 + 120
+            for w in workers:
+                try:
+                    w.wait(timeout=max(5.0,
+                                       w_deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.kill()
+            # the journal must drain to fully-answered
+            drain_by = time.monotonic() + 30.0
+            while time.monotonic() < drain_by \
+                    and scan(jdir).unanswered:
+                time.sleep(0.2)
+            srv_b.send_signal(_signal.SIGTERM)
+            try:
+                srv_b.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                srv_b.kill()
+        finally:
+            if srv_b.poll() is None:
+                srv_b.kill()
+        _collect_worker_rows(row, outs)
+        try:
+            with open(stats_b_path) as f:
+                row["server_b"] = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            row["server_b"] = None
+        st_end = scan(jdir)
+        row["journaled_total"] = len(st_end.requests)
+        row["unanswered_end"] = len(st_end.unanswered)
+        row["ack_multiplicity_ok"] = all(
+            m == 1 for m in st_end.ack_multiplicity.values())
+        row["lost_total"] = sum(
+            w.get("lost", 0) for w in (row.get("tenants") or {}).values())
+        row["completed_total"] = sum(
+            w.get("completed", 0)
+            for w in (row.get("tenants") or {}).values())
+        row["reconnects_total"] = sum(
+            w.get("reconnects", 0.0)
+            for w in (row.get("tenants") or {}).values())
+        sb = row.get("server_b") or {}
+        row["replayed"] = sb.get("replayed")
+        row["replay_answered"] = sb.get("replay_answered")
+        row["replay_exactly_once"] = bool(
+            sb
+            and sb.get("replayed") == row["unanswered_at_kill"]
+            and sb.get("replay_answered") == sb.get("replayed")
+            and row["unanswered_end"] == 0
+            and row["ack_multiplicity_ok"])
+        return row
+    finally:
+        for leftover in (srv_a,):
+            if leftover.poll() is None:
+                leftover.kill()
+        try:
+            os.unlink(stats_a_path)
+        except OSError:
+            pass
+
+
 def default_profiles(smoke: bool) -> list:
     """(profile, kwargs) rows.  Smoke = the seconds-long CI shape: a
     low-load steady pass that must shed nothing, and a deliberately
@@ -953,6 +1184,15 @@ def main() -> int:
                     help="the autoscaler row: load doubles mid-run, the "
                          "utils/elastic.Autoscaler must react "
                          "(BENCH_ELASTIC rows)")
+    ap.add_argument("--yank", action="store_true",
+                    help="yank_process durability row (ISSUE 12): "
+                         "SIGKILL the journaled serving subprocess "
+                         "mid-run, restart with journal-replay, assert "
+                         "exactly-once answers (BENCH_ARMOR rows)")
+    ap.add_argument("--yank-smoke", dest="yank_smoke",
+                    action="store_true",
+                    help="seconds-long yank_process shape (the CI "
+                         "armor gate)")
     ap.add_argument("--profiles", default=None,
                     help=f"comma-separated subset of {PROFILES}")
     ap.add_argument("--duration", type=float, default=None,
@@ -971,7 +1211,25 @@ def main() -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--inflight", type=int, default=8,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--reconnect", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    # server mode (internal): the yank_process killable serving process
+    ap.add_argument("--server", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--sid", type=int, default=940,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--journal", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--journal-replay", dest="journal_replay",
+                    default="0", help=argparse.SUPPRESS)
+    ap.add_argument("--journal-fsync", dest="journal_fsync",
+                    default="batch", help=argparse.SUPPRESS)
+    ap.add_argument("--service-ms", dest="service_ms", type=float,
+                    default=2.0, help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.server:
+        args.journal_replay = args.journal_replay in ("1", "true")
+        args.duration = args.duration or 600.0
+        return run_server(args)
     if args.worker:
         return run_worker(args)
 
@@ -1031,6 +1289,48 @@ def main() -> int:
         }))
         print(f"wrote {out_path} ({len(rows)} rows)")
         return 0 if recovered else 1
+
+    if args.yank or args.yank_smoke:
+        t_start = time.time()
+        dur = args.duration or (6.0 if args.yank_smoke else 12.0)
+        print(f"== yank_process ({dur}s, fsync=batch)", flush=True)
+        row = run_yank_profile(duration=dur)
+        ok = bool(row.get("replay_exactly_once")
+                  and row.get("lost_total", 1) == 0
+                  and row.get("unanswered_at_kill", 0) >= 1)
+        print(f"   killed={row.get('killed')} "
+              f"unanswered_at_kill={row.get('unanswered_at_kill')} "
+              f"replayed={row.get('replayed')} "
+              f"replay_answered={row.get('replay_answered')} "
+              f"unanswered_end={row.get('unanswered_end')} "
+              f"lost={row.get('lost_total')} "
+              f"reconnects={row.get('reconnects_total')}", flush=True)
+        doc = {
+            "note": "yank_process durability soak (tools/soak.py "
+                    "--yank, ISSUE 12): the journaled serving process "
+                    "is SIGKILLed mid-run and restarted with "
+                    "journal-replay=true on the same port; exactly-once "
+                    "= every accepted-but-unanswered entry at the kill "
+                    "is re-admitted and acked once (journal files are "
+                    "the source of truth), reconnecting clients resend "
+                    "pending requests as new entries and lose nothing.",
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                         time.gmtime(t_start)),
+            "smoke": bool(args.yank_smoke),
+            "rows": [row],
+        }
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({
+            "metric": "yank_replay_exactly_once",
+            "value": 1.0 if ok else 0.0, "unit": "bool",
+            "unanswered_at_kill": row.get("unanswered_at_kill"),
+            "replayed": row.get("replayed"),
+            "lost_total": row.get("lost_total"),
+            "artifact": os.path.basename(out_path),
+        }))
+        print(f"wrote {out_path} (1 row)")
+        return 0 if ok else 1
 
     if args.elastic:
         t_start = time.time()
