@@ -129,6 +129,18 @@ class ResourceReport:
     def compiled_variants(self) -> int:
         return sum(s.variants for s in self.stages)
 
+    def by_category(self) -> Dict[str, int]:
+        """The HBM estimate split per ledger category — what nns-xray's
+        runtime HBM ledger reconciles measured bytes against
+        (utils/xray.py, docs/OBSERVABILITY.md "Predicted vs actual")."""
+        return {
+            "params": sum(s.param_bytes for s in self.stages),
+            "kv_pool": sum(s.pool_bytes for s in self.stages),
+            "agg_rings": sum(s.ring_bytes for s in self.stages),
+            "activations": sum(s.act_row_bytes * s.rows_per_device
+                               for s in self.stages),
+        }
+
     def summary(self) -> str:
         return (f"{len(self.stages)} device stage(s), est HBM high-water "
                 f"{_mib(self.hbm_estimate)}"

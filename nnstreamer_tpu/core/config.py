@@ -39,6 +39,9 @@ _ENV_REDUCE_OUTPUTS = "NNS_TPU_REDUCE_OUTPUTS"
 _ENV_LINK_D2H_MBPS = "NNS_TPU_LINK_D2H_MBPS"
 _ENV_LINK_RTT_MS = "NNS_TPU_LINK_RTT_MS"
 _ENV_STAGE_RESTARTS = "NNS_TPU_MAX_STAGE_RESTARTS"
+_ENV_XRAY = "NNS_TPU_XRAY"
+_ENV_XRAY_HBM_TOL = "NNS_TPU_XRAY_HBM_TOLERANCE"
+_ENV_PEAK_TFLOPS = "NNS_TPU_PEAK_TFLOPS"
 
 
 @dataclasses.dataclass
@@ -151,6 +154,20 @@ class Config:
     trace_mode: str = "off"
     #: span capacity of the ``ring`` trace mode
     trace_ring_capacity: int = 65536
+    #: nns-xray predicted-vs-actual reconciliation (utils/xray.py,
+    #: docs/OBSERVABILITY.md "Predicted vs actual"): register every jit
+    #: entry point's compiles with the live program census, attribute
+    #: per-stage device time / MFU, and reconcile the HBM ledger against
+    #: the deep-lint estimate.  False = structurally off — every hook is
+    #: one pointer check, no meta, no cost_analysis calls.
+    xray: bool = False
+    #: HBM-ledger drift tolerance: a category whose measured bytes drift
+    #: past this factor from the deep-lint estimate (either direction,
+    #: above the 1 MiB noise floor) warns once
+    xray_hbm_tolerance: float = 2.0
+    #: peak dense-matmul TFLOPs per chip for the MFU gauges (0 = derive
+    #: from the device kind; utils/xray.peak_flops)
+    peak_tflops: float = 0.0
     #: emit per-stage latency measurements
     enable_latency: bool = True
     #: free-form per-framework options ([filter-jax] section of the ini)
@@ -230,6 +247,13 @@ class Config:
             if ini.has_option("common", "trace_ring_capacity"):
                 cfg.trace_ring_capacity = ini.getint(
                     "common", "trace_ring_capacity")
+            if ini.has_option("common", "xray"):
+                cfg.xray = ini.getboolean("common", "xray")
+            if ini.has_option("common", "xray_hbm_tolerance"):
+                cfg.xray_hbm_tolerance = ini.getfloat(
+                    "common", "xray_hbm_tolerance")
+            if ini.has_option("common", "peak_tflops"):
+                cfg.peak_tflops = ini.getfloat("common", "peak_tflops")
             for sec in ini.sections():
                 if sec.startswith("filter-"):
                     cfg.framework_options[sec[len("filter-"):]] = dict(ini.items(sec))
@@ -263,6 +287,13 @@ class Config:
             cfg.link_fetch_rtt_ms = float(os.environ[_ENV_LINK_RTT_MS])
         if os.environ.get(_ENV_STAGE_RESTARTS):
             cfg.max_stage_restarts = int(os.environ[_ENV_STAGE_RESTARTS])
+        if os.environ.get(_ENV_XRAY):
+            cfg.xray = os.environ[_ENV_XRAY].lower() in (
+                "1", "true", "yes", "on")
+        if os.environ.get(_ENV_XRAY_HBM_TOL):
+            cfg.xray_hbm_tolerance = float(os.environ[_ENV_XRAY_HBM_TOL])
+        if os.environ.get(_ENV_PEAK_TFLOPS):
+            cfg.peak_tflops = float(os.environ[_ENV_PEAK_TFLOPS])
         if os.environ.get(_ENV_TRACE):
             cfg.trace_mode = os.environ[_ENV_TRACE].strip().lower()
         if os.environ.get(_ENV_TRACE_RING):

@@ -151,6 +151,16 @@ class TensorAggregator(Element):
             "append": jax.jit(append),
             "window": jax.jit(window_advance),
         }
+        xr = getattr(self, "_xray", None)
+        if xr is not None:
+            # nns-xray: exactly the 3 lifetime programs the deep lint
+            # prices (analysis/tracecheck.AGGREGATOR_PROGRAMS) — a 4th
+            # compile (a re-specializing upstream) is census drift
+            xr.expect(self.name, "agg", budget=3,
+                      note="device-aggregator 3-program ring")
+            rec = getattr(self, "_trace_rec", None)
+            self._progs = {k: xr.track(p, self.name, "agg", rec=rec)
+                           for k, p in self._progs.items()}
         return self._progs
 
     def _process_device(self, buf: Buffer):

@@ -141,6 +141,14 @@ class TensorFilter(Element):
         # nan_guard quarantines poisoned prompts through the pipeline's
         # DLQ/breaker — docs/ROBUSTNESS.md
         self.fw._armor = getattr(self, "_armor", None)
+        # nns-xray handoff: the framework's jitted paths register their
+        # compiles under THIS element's stage name (None = off, and the
+        # framework never learns xray exists)
+        xr = getattr(self, "_xray", None)
+        if xr is not None and getattr(self.fw, "_xray", None) is not xr:
+            self.fw.attach_xray(xr, self.name,
+                                rec=lambda: getattr(self, "_trace_rec",
+                                                    None))
         return self.fw
 
     def stop(self) -> None:
@@ -414,7 +422,8 @@ class TensorFilter(Element):
                         fn, getattr(self, "_batch_buckets", None),
                         name=self.name, mesh=mesh, prepare=prep,
                         tracer=getattr(self, "_trace_rec", None),
-                        ladder=getattr(self, "_batch_ladder", None)))
+                        ladder=getattr(self, "_batch_ladder", None),
+                        xray=getattr(self, "_xray", None)))
                     self._batchers = {id(fw): entry}  # drop stale programs
                 rows = entry[1].run(
                     [tuple(self._select_inputs(b.tensors)) for b in bufs])

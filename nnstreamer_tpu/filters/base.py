@@ -107,9 +107,40 @@ class Framework:
         HBM high-water estimate."""
         return 0
 
+    # -- nns-xray (docs/OBSERVABILITY.md "Predicted vs actual") -------------
+    def attach_xray(self, registry, stage: str, rec=None) -> None:
+        """Hand the framework the owning pipeline's program registry (the
+        ``_trace_rec`` handoff pattern): ``stage`` is the element name
+        compiles are counted under, ``rec`` the pipeline's flight
+        recorder for the device track.  Subclasses with jitted paths
+        override to wrap them via ``registry.track`` — the base just
+        stores the handles for lazily-built programs (the llm serve
+        loop).  Never called when xray is off: the disabled path stays
+        one pointer check at the element."""
+        self._xray = registry
+        self._xray_stage = stage
+        self._xray_rec = rec
+
     # -- events ------------------------------------------------------------
     def handle_event(self, kind: str, payload=None) -> None:
         """Reference eventHandler (model reload etc.)."""
+
+
+def tree_param_bytes(tree) -> int:
+    """Total bytes of a params pytree's leaves — ``nbytes`` when the
+    leaf carries it, shape x dtype itemsize otherwise (lazy/proxy
+    leaves).  The ONE accounting walk shared by the frameworks'
+    ``param_bytes`` hooks and nns-xray's measured HBM ledger."""
+    import jax
+    import numpy as _np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is None and hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            nb = int(_np.prod(leaf.shape)) * _np.dtype(leaf.dtype).itemsize
+        total += int(nb or 0)
+    return total
 
 
 def parse_custom_options(custom: str) -> Dict[str, str]:

@@ -99,6 +99,21 @@ class JaxFramework(Framework):
             return out if isinstance(out, (tuple, list)) else (out,)
 
         self._jitted = jax.jit(run)
+        self._wrap_xray()
+
+    def attach_xray(self, registry, stage, rec=None):
+        super().attach_xray(registry, stage, rec)
+        self._wrap_xray()
+
+    def _wrap_xray(self):
+        """nns-xray: the standalone invoke program registers its compiles
+        under the element's stage name (re-applied across reload /
+        reduced-output rebuilds; track() is idempotent)."""
+        xr = getattr(self, "_xray", None)
+        if xr is not None and self._jitted is not None:
+            self._jitted = xr.track(
+                self._jitted, getattr(self, "_xray_stage", self.name),
+                "stage", rec=getattr(self, "_xray_rec", None))
 
     def _constrain(self, arrays):
         """Apply the data-parallel sharding constraint to every input (one
@@ -224,15 +239,9 @@ class JaxFramework(Framework):
     def param_bytes(self) -> int:
         if self.bundle is None:
             return 0
-        import jax
+        from .base import tree_param_bytes
 
-        total = 0
-        for leaf in jax.tree_util.tree_leaves(self.bundle.params):
-            nb = getattr(leaf, "nbytes", None)
-            if nb is None and hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-                nb = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
-            total += int(nb or 0)
-        return total
+        return tree_param_bytes(self.bundle.params)
 
 
 def _accel_list(props) -> List[str]:

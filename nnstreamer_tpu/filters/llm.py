@@ -396,6 +396,29 @@ class LLMFramework(Framework):
 
         self._decode_chunk = jax.jit(
             decode_chunk, static_argnames=("length",), donate_argnums=(2,))
+        self._wrap_stream_xray()
+
+    def attach_xray(self, registry, stage, rec=None):
+        super().attach_xray(registry, stage, rec)
+        self._wrap_stream_xray()
+
+    def _wrap_stream_xray(self) -> None:
+        """nns-xray: the per-request stream path's programs are recorded
+        UNBOUNDED (no expectation) — prompt-length bucketing bounds them
+        in practice, but the deep lint calls invoke-dynamic stages
+        recompile-unbounded and the live census mirrors that verdict.
+        The serve loop's closed 3-program census registers separately
+        (_ContinuousLoop)."""
+        xr = getattr(self, "_xray", None)
+        if xr is None:
+            return
+        stage = getattr(self, "_xray_stage", "llm")
+        rec = getattr(self, "_xray_rec", None)
+        if getattr(self, "_fwd", None) is not None:
+            self._fwd = xr.track(self._fwd, stage, "llm.prefill", rec=rec)
+        if getattr(self, "_decode_chunk", None) is not None:
+            self._decode_chunk = xr.track(self._decode_chunk, stage,
+                                          "llm.decode", rec=rec)
 
     def close(self) -> None:
         if self._serve is not None:
@@ -505,6 +528,19 @@ class LLMFramework(Framework):
         flex_out = TensorsSpec.from_string("1", "int32").replace(
             format=TensorFormat.FLEXIBLE)
         return flex_in, flex_out
+
+    def param_bytes(self) -> int:
+        """Live parameter bytes (quantized trees included — nibble-packed
+        int4 leaves report their packed nbytes).  Feeds the deep pass
+        AND nns-xray's measured HBM ledger — without it an llm
+        pipeline's ledger read 0 params against a priced estimate, which
+        is exactly the under-prediction drift the reconciler warns on."""
+        bundle = getattr(self, "bundle", None)
+        if bundle is None or bundle.params is None:
+            return 0
+        from .base import tree_param_bytes
+
+        return tree_param_bytes(bundle.params)
 
     # -- tokenization ------------------------------------------------------
     def _to_tokens(self, arr: np.ndarray) -> np.ndarray:
@@ -754,6 +790,39 @@ class _ContinuousLoop:
         # and value traced: ONE program for every admission)
         self._set_tok = jax.jit(lambda a, i, v: a.at[i].set(v),
                                 donate_argnums=(0,))
+        xr = getattr(fw, "_xray", None)
+        if xr is not None:
+            # nns-xray: the standing loop's predicted census IS
+            # serving_plan()'s fixed program set (plan["programs"] == 3:
+            # decode chunk, prefill step, slot-token setter — the same
+            # arithmetic the deep lint prices serve:continuous with), so
+            # each program expects exactly ONE compile; anything more —
+            # e.g. a numpy-scalar _set_tok argument minting a 4th
+            # signature — fires census-drift with the signature diff.
+            # Keyed by the owning ELEMENT's stage name (the attach_xray
+            # handoff) + ".serve", so two serve loops in one process
+            # never collide on one budget.
+            stage = f"{getattr(fw, '_xray_stage', None) or 'llm'}.serve"
+            rec = lambda: getattr(fw, "_trace_rec", None)  # noqa: E731
+            # TP: the paged decode executes across the mesh's model
+            # axis — MFU/roofline divide by the participating chips
+            devs = 1
+            if fw.mesh is not None:
+                from ..parallel.mesh import mesh_axis_size
+
+                devs = max(1, mesh_axis_size(fw.mesh, "model"))
+            xr.expect(stage, "decode", budget=1,
+                      note="serving_plan fixed decode signature")
+            xr.expect(stage, "prefill", budget=1,
+                      note="serving_plan fixed prefill signature")
+            xr.expect(stage, "set_tok", budget=1,
+                      note="serving_plan slot-token setter")
+            self._decode = xr.track(self._decode, stage, "decode",
+                                    rec=rec, devices=devs)
+            self._prefill = xr.track(self._prefill, stage, "prefill",
+                                     rec=rec, devices=devs)
+            self._set_tok = xr.track(self._set_tok, stage, "set_tok",
+                                     rec=rec)
         self._thread = threading.Thread(
             target=self._run, name="llm-serve", daemon=True)
         self._thread.start()
@@ -1000,6 +1069,12 @@ class _ContinuousLoop:
         # published like the allocator bookkeeping below: tests and
         # post-mortems read the pool's actual placement off the loop
         self._pool_sharding = getattr(pool["k"], "sharding", None)
+        # the MEASURED pool footprint (global bytes; /M per chip under
+        # TP) — nns-xray's HBM ledger reconciles this against the deep
+        # lint's serving_plan pool_bytes estimate
+        from .base import tree_param_bytes as _tree_bytes
+
+        self._pool_nbytes = _tree_bytes(pool)
         # Device carries tok/pool/key between chunks (r4: materializing
         # them per chunk cost tunnel roundtrips).  EVERYTHING ELSE is
         # host bookkeeping: positions advance deterministically (+length

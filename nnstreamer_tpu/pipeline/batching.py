@@ -238,7 +238,7 @@ class BatchRunner:
     def __init__(self, fn: Callable, buckets: Optional[Sequence[int]] = None,
                  name: Optional[str] = None, mesh=None,
                  prepare: Optional[Callable] = None, tracer=None,
-                 ladder: Optional[AdaptiveLadder] = None):
+                 ladder: Optional[AdaptiveLadder] = None, xray=None):
         self.fn = fn
         self.buckets = tuple(sorted(set(buckets))) if buckets else None
         # adaptive mode: the per-stage AdaptiveLadder replaces the static
@@ -248,8 +248,13 @@ class BatchRunner:
         # the owning pipeline's flight recorder (None = that pipeline runs
         # trace_mode=off, even if another pipeline enabled the global one)
         self._tracer = tracer
+        # the owning pipeline's nns-xray program registry (None = off:
+        # bucket programs compile untracked, one pointer check here)
+        self._xray = xray
         self._progs: Dict[int, Callable] = {}
         self._pad_metric = f"{name}.batch_pad_waste" if name else None
+        self._waste_flops_metric = (f"{name}.pad_waste_flops"
+                                    if name else None)
         self._shard_metric = f"{name}.shard_rows" if name else None
         self._dispatch_metric = f"{name}.shard_dispatch" if name else None
         self.mesh = None
@@ -297,7 +302,15 @@ class BatchRunner:
             rows = pad_rows(rows, bucket)
             if self._pad_metric:
                 metrics.count(self._pad_metric, bucket - n)
-        return list(prog(*rows)[:n])
+        out = list(prog(*rows)[:n])
+        if self._xray is not None and bucket > n:
+            # pad waste priced in FLOPs, not rows: the bucket program's
+            # cost analysis split per row times the pad rows appended
+            flops = getattr(prog, "flops", 0.0)
+            if flops and self._waste_flops_metric:
+                metrics.count(self._waste_flops_metric,
+                              flops * (bucket - n) / bucket)
+        return out
 
     def _build(self, bucket: int) -> Callable:
         import jax
@@ -311,7 +324,14 @@ class BatchRunner:
                 outs = (outs,)
             return tuple(split_rows(tuple(outs), bucket))
 
-        return jax.jit(prog)
+        jitted = jax.jit(prog)
+        if self._xray is not None:
+            # the trigger batch dim is the bucket (stacking happens
+            # INSIDE the program, so the registry can't read it off the
+            # args) — the census allow-check prices it against the ladder
+            jitted = self._xray.track(jitted, self._name, "batch",
+                                      rec=self._tracer, rows=bucket)
+        return jitted
 
     # -- sharded dispatch --------------------------------------------------
     def _run_sharded(self, rows: List[Tuple]) -> List[Tuple]:
@@ -356,6 +376,14 @@ class BatchRunner:
         if prog is None:
             prog = self._progs[-1] = self._build_sharded()
         outs = prog(*stacked)
+        if self._xray is not None and bucket > n:
+            # approximation: the tracked program's cost is the LATEST
+            # compiled bucket's — steady-state drains sit in one bucket,
+            # where this is exact
+            flops = getattr(prog, "flops", 0.0)
+            if flops and self._waste_flops_metric:
+                metrics.count(self._waste_flops_metric,
+                              flops * (bucket - n) / bucket)
         if self._dispatch_metric:
             metrics.count(self._dispatch_metric)
             # Per-replica placement counters: read the real shard layout
@@ -437,4 +465,15 @@ class BatchRunner:
 
         # One sharding broadcasts over all args/outputs (rank-agnostic
         # P("data") — see parallel/sharding.data_sharding).
-        return jax.jit(prog, in_shardings=sh, out_shardings=sh)
+        jitted = jax.jit(prog, in_shardings=sh, out_shardings=sh)
+        if self._xray is not None:
+            # ONE jit serves every bucket here (cache keys shapes), so
+            # the trigger batch dim is read off the stacked leading dim;
+            # the program's cost analysis covers the GLOBAL batch spread
+            # over the mesh, so MFU denominates in the aggregate peak
+            jitted = self._xray.track(jitted, self._name, "batch",
+                                      rec=self._tracer,
+                                      rows_from_leading=True,
+                                      devices=self.replicas
+                                      * self.model_axis)
+        return jitted

@@ -137,6 +137,13 @@ class FusedElement(Element):
             else:
                 self._fn = jax.jit(self._composed)
                 self._donate_active = False
+            xr = getattr(self, "_xray", None)
+            if xr is not None:
+                # nns-xray census: the fused chain's single-buffer
+                # program (the bucketed twins register via BatchRunner)
+                self._fn = xr.track(
+                    self._fn, self.name, "stage",
+                    rec=getattr(self, "_trace_rec", None))
         return self._fn
 
     @property
@@ -247,7 +254,8 @@ class FusedElement(Element):
                 name=self.name, mesh=mesh,
                 prepare=self._shard_prepare if mesh is not None else None,
                 tracer=getattr(self, "_trace_rec", None),
-                ladder=getattr(self, "_batch_ladder", None))
+                ladder=getattr(self, "_batch_ladder", None),
+                xray=getattr(self, "_xray", None))
         rows = self._batcher.run([tuple(b.tensors) for b in bufs])
         return [(SRC, self._finish(buf, row)) for buf, row in zip(bufs, rows)]
 

@@ -233,6 +233,15 @@ class _Runner:
         # THIS pipeline's trace_mode, not whatever another pipeline in the
         # process switched the global recorder to.
         self.element._trace_rec = self._tr
+        # nns-xray registry handle (None = off): the fused program,
+        # BatchRunner buckets, and framework jit paths read it at build
+        # time.  A folded device source wraps a FusedElement that is NOT
+        # in pipeline.elements — forward both handles to it.
+        self.element._xray = pipeline._xray_reg
+        fused_inner = getattr(self.element, "fused", None)
+        if fused_inner is not None:
+            fused_inner._xray = pipeline._xray_reg
+            fused_inner._trace_rec = self._tr
         self._is_sink = isinstance(self.element, SinkElement)
         self._last_sink_ns = 0  # sampler reads: staleness watermark
         self._max_pts = None  # watermark_pts gauge is a high-water mark
@@ -883,6 +892,15 @@ class Pipeline:
     keyed by trace ids assigned at source ingress, dumped with
     :meth:`dump_trace` as Perfetto-loadable Chrome trace JSON and to the
     log on watchdog fires / stage errors — docs/OBSERVABILITY.md.
+    ``xray`` switches on nns-xray predicted-vs-actual reconciliation
+    (utils/xray.py): every jit entry point registers its compiles with a
+    live program census reconciled against the deep lint's prediction
+    (census-drift warnings with signature diffs), per-stage ``mfu`` /
+    ``roofline_fraction`` / ``pad_waste_flops`` land in Prometheus and a
+    ``device:<stage>`` track in the Chrome trace, and an HBM ledger is
+    reconciled per category against the static estimate —
+    :meth:`explain` / ``python -m nnstreamer_tpu.tools.doctor`` join it
+    all into one report (docs/OBSERVABILITY.md "Predicted vs actual").
     ``tenant`` sets a default tenant identity stamped at source ingress
     (traced runs only) so latency histograms, queue-depth gauges, and
     Chrome-trace tracks split per tenant; ``slo`` attaches a per-tenant
@@ -929,6 +947,7 @@ class Pipeline:
         reduce_outputs: Optional[bool] = None,
         trace_mode: Optional[str] = None,
         tenant: Optional[str] = None,
+        xray: Optional[bool] = None,
         slo=None,
         max_stage_restarts: Optional[int] = None,
         quarantine=None,
@@ -1021,6 +1040,19 @@ class Pipeline:
         # tracing is active (the off path stays stamp-free — see
         # _Runner._run_source and docs/SERVING.md "Front door")
         self.tenant = None if tenant is None else str(tenant)
+        # nns-xray predicted-vs-actual reconciliation (utils/xray.py,
+        # docs/OBSERVABILITY.md "Predicted vs actual"): when on, every
+        # jit entry point registers its compiles with the process-wide
+        # program registry, per-stage device time/MFU is attributed, and
+        # a reconciler daemon checks the HBM ledger against the deep
+        # lint's estimate.  Off = elements hold None, one pointer check
+        # per hook (the trace_mode=off discipline).
+        self.xray = bool(xray if xray is not None else cfg.xray)
+        self._xray_reg = None
+        if self.xray:
+            from ..utils import xray as _xray_mod
+
+            self._xray_reg = _xray_mod.registry
         # slo policy parsed HERE so a bad config fails at construction
         # (a ValueError naming every schema problem), not inside start()
         # after stage threads are already running
@@ -1273,6 +1305,14 @@ class Pipeline:
                         # shard_bucket_for's rounding is a no-op on them
                         # (2-D mesh rounding still applies)
                         lad.align = max(1, replicas)
+        if self._xray_reg is not None:
+            # census expectations BEFORE any streaming thread can compile:
+            # the predicted budgets use the same shared arithmetic the
+            # deep lint prices with (ladder / adaptive budget / shard
+            # rounding), so runtime drift is measured against the exact
+            # static promise.
+            self._install_xray_expectations(
+                self.mesh_shape[0] if self._mesh_built else 1)
         for r in {id(r): r for r in self._runners.values()}.values():
             r.thread.start()
         if self.trace_mode != "off":
@@ -1287,6 +1327,14 @@ class Pipeline:
             # / breach gauges per tenant (utils/slo.py).  Requires tracing
             # (the e2e histograms only fill when trace_mode != off).
             self._slo_loop().start()
+        if self._xray_reg is not None:
+            # the predicted-vs-actual loop: MFU/roofline gauges + the HBM
+            # ledger reconciled against the deep-lint estimate, on the
+            # SLO engine's cadence; stopped AND joined by stop()
+            from ..utils.xray import XrayReconciler
+
+            self._xray_recon = XrayReconciler(self)
+            self._xray_recon.start()
         return self
 
     @property
@@ -1356,10 +1404,55 @@ class Pipeline:
             return None
         return self._shared_mesh()
 
+    def _install_xray_expectations(self, replicas: int) -> None:
+        """Install the predicted census for every stage that can compile
+        (docs/OBSERVABILITY.md "Predicted vs actual") — the SAME shared
+        arithmetic the deep lint prices with: the bucket ladder (plus
+        replica rounding under a data mesh) for batchable stages, the
+        adaptive mint budget when ladders refine online, and a
+        2-program allowance for the single-buffer path (static spec +
+        the truncated-tail shape a non-aligned device source can mint).
+        invoke-dynamic filters get NO expectation — the lint calls them
+        recompile-unbounded, so the live census records without judging.
+        The llm serve loop and device aggregator install their own
+        (serving_plan / AGGREGATOR_PROGRAMS) at build time."""
+        from .batching import ladder as _ladder_fn, shard_bucket_for
+
+        reg = self._xray_reg
+        for r in {id(r): r for r in self._runners.values()}.values():
+            el = r.element
+            target = getattr(el, "fused", el)  # folded-source inner chain
+            nm = target.name
+            if r.stage.batchable and r.batch_max > 1:
+                lad = _ladder_fn(r.batch_max, self.batch_buckets)
+                if getattr(el, "_batch_ladder", None) is not None:
+                    # adaptive: minted sizes are legal anywhere, the
+                    # budget is the closed bound (plan arithmetic)
+                    reg.expect(nm, "batch", budget=self._ladder_budget,
+                               note="adaptive ladder budget")
+                else:
+                    allow = set(lad)
+                    if replicas > 1:
+                        allow |= {shard_bucket_for(b, replicas,
+                                                   self.batch_buckets)
+                                  for b in lad}
+                    reg.expect(nm, "batch", budget=len(allow),
+                               allow=allow, note="static bucket ladder")
+                reg.expect(nm, "stage", budget=2,
+                           note="single-buffer program (+ tail shape)")
+            elif (getattr(el, "kind", "") == "fused"
+                  or (getattr(el, "kind", "") == "tensor_filter"
+                      and not getattr(el, "invoke_dynamic", False))):
+                reg.expect(nm, "stage", budget=2,
+                           note="single-buffer program (+ tail shape)")
+
     def stop(self) -> None:
         self._stopping.set()
         if self._slo_engine is not None:
             self._slo_engine.stop()
+        recon = getattr(self, "_xray_recon", None)
+        if recon is not None:
+            recon.stop()  # joins: the thread-shutdown audit counts it
         runners = {id(r): r for r in self._runners.values()}.values()
         # Close every stage queue first: blocked getters receive _POISON
         # and blocked putters shed immediately, so join() below is not
@@ -1374,6 +1467,12 @@ class Pipeline:
                 el.stop()
             except Exception:  # noqa: BLE001
                 log.exception("stop() failed for %s", el.name)
+        # the sampler exits on _stopping; JOIN it so stop() returning
+        # means every pipeline-owned thread is actually gone (the
+        # shutdown audit's contract — daemon status is not cleanup)
+        sampler = getattr(self, "_sampler", None)
+        if sampler is not None and sampler.is_alive():
+            sampler.join(timeout=2.0)
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until every stage thread finished (sources EOS'd and all
@@ -1462,6 +1561,22 @@ class Pipeline:
                 self.sample_queues()
             except Exception:  # noqa: BLE001 - sampler must never die loud
                 log.exception("queue sampler tick failed")
+
+    def explain(self) -> dict:
+        """The predicted-vs-actual doctor report (utils/xray.explain):
+        plan + mesh, residency, the compiled-program census (deep-lint
+        budgets vs the live program set + any drift), the HBM ledger per
+        category (measured vs the deep-lint estimate), per-stage
+        device-time/MFU attribution, and the SLO verdict when an engine
+        is attached.  JSON-serializable; render with
+        ``utils.xray.render_report`` or via
+        ``python -m nnstreamer_tpu.tools.doctor`` — see
+        docs/OBSERVABILITY.md "Predicted vs actual".  Works on any
+        pipeline; census/MFU columns fill only under
+        ``Pipeline(xray=True)``."""
+        from ..utils import xray as _xray_mod
+
+        return _xray_mod.explain(self)
 
     def dump_trace(self, path: str) -> int:
         """Write the flight recorder's current contents as Chrome

@@ -98,6 +98,33 @@ _SERIES_META = {
                   "the budget (utils/slo.py)", "gauge"),
     "breach": ("SLO breach flag: 1 = tenant currently out of SLO",
                "gauge"),
+    # nns-xray predicted-vs-actual series (utils/xray.py,
+    # docs/OBSERVABILITY.md "Predicted vs actual")
+    "compiles": ("XLA programs compiled by this stage's tracked jit "
+                 "entry points (nns-xray program registry)", "counter"),
+    "census_drifts": ("compiled programs that escaped the deep lint's "
+                      "predicted census (counter; fired at register "
+                      "time)", "counter"),
+    "census_drift": ("census-drift total, republished every reconciler "
+                     "tick (gauge twin of the xray.census_drifts "
+                     "counter — distinct names so neither family ever "
+                     "changes type between scrapes)", "gauge"),
+    "mfu": ("model FLOPs utilization: tracked-program FLOPs per second "
+            "of measured dispatch time over the device's peak "
+            "(Config.peak_tflops / device-kind table)", "gauge"),
+    "roofline_fraction": ("fraction of the compute/HBM roofline this "
+                          "stage's dispatches achieve (ideal time from "
+                          "cost analysis vs measured)", "gauge"),
+    "pad_waste_flops": ("FLOPs spent computing bucket-ladder pad rows "
+                        "(the adaptive ladder's pad waste priced in "
+                        "FLOPs, not rows)", "counter"),
+    "hbm": ("nns-xray HBM ledger: live measured bytes per category "
+            "(params / kv_pool / agg_rings / activations)", "gauge"),
+    "hbm_predicted": ("nns-xray HBM ledger: the deep-lint estimate per "
+                      "category", "gauge"),
+    "hbm_drift": ("nns-xray HBM ledger: measured / predicted ratio per "
+                  "category (warns past Config.xray_hbm_tolerance)",
+                  "gauge"),
 }
 
 #: HELP text for histogram series, by raw-name suffix (fallback generic)
@@ -215,7 +242,7 @@ def _render_histograms(lines: list) -> None:
                          label=f'tenant="{tlabels[ten]}",')
 
 
-def metrics_text() -> str:
+def metrics_text(openmetrics: bool = False) -> str:
     """Render the global metrics registry in Prometheus text format.
 
     Histograms first (``observe_latency`` series), then gauges, then
@@ -227,6 +254,11 @@ def metrics_text() -> str:
     yields identical series names).  Per-tenant labeled twins render
     under the same family as ``{tenant="..."}`` samples, with tenant
     label values passed through the SAME sanitize+hash rule.
+
+    ``openmetrics=True`` appends the mandatory ``# EOF`` trailer — the
+    OpenMetrics framing a negotiating scraper (``Accept:
+    application/openmetrics-text``) uses to detect truncated bodies; the
+    ``/metrics`` handler selects it via content negotiation.
     """
     lines: list = []
     _render_histograms(lines)
@@ -261,16 +293,31 @@ def metrics_text() -> str:
     for raw in sorted(counters):
         name = cnames[raw]
         meta = _series_meta(raw)
+        # OpenMetrics: counter SAMPLES are named `<family>_total` (the
+        # parser rejects a typed counter sample without the suffix);
+        # untyped series stay "unknown" and keep the bare name
+        sample = name
         if meta is not None:
             lines.append(f"# HELP nnstpu_{name} {meta[0]}")
             lines.append(f"# TYPE nnstpu_{name} {meta[1]}")
+            if openmetrics and meta[1] == "counter":
+                sample = f"{name}_total"
         if raw in snap:
-            lines.append(f"nnstpu_{name} {snap[raw]:.9g}")
+            lines.append(f"nnstpu_{sample} {snap[raw]:.9g}")
         for ten in sorted(lc_by_name.get(raw, ()),
                           key=lambda t: ctlabels[t]):
-            lines.append(f'nnstpu_{name}{{tenant="{ctlabels[ten]}"}} '
+            lines.append(f'nnstpu_{sample}{{tenant="{ctlabels[ten]}"}} '
                          f"{lc_by_name[raw][ten]:.9g}")
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+#: OpenMetrics media type (negotiated via the Accept header); the
+#: classic Prometheus text exposition stays the default
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 
 class _MetricsHandler(http.server.BaseHTTPRequestHandler):
@@ -279,9 +326,17 @@ class _MetricsHandler(http.server.BaseHTTPRequestHandler):
             self.send_response(404)
             self.end_headers()
             return
-        body = metrics_text().encode()
+        # Content negotiation: a scraper that asks for OpenMetrics gets
+        # the matching Content-Type AND the `# EOF` trailer (its
+        # truncation detector); everyone else keeps the classic text
+        # exposition byte-for-byte.
+        accept = self.headers.get("Accept", "") or ""
+        om = "application/openmetrics-text" in accept
+        body = metrics_text(openmetrics=om).encode()
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Type",
+                         OPENMETRICS_CONTENT_TYPE if om
+                         else _PROM_CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -106,6 +106,15 @@ SPAN_KINDS: Dict[str, str] = {
     "journal.replay": "durable request journal: restart re-admitted "
                       "the accepted-but-unanswered entries "
                       "(instant; args: entries, acked_skipped)",
+    "device": "nns-xray device-time attribution: one tracked-program "
+              "dispatch on its own `device:<stage>` track beside the "
+              "host spans (args: program, flops from the lowered "
+              "program's cost analysis; dur = measured dispatch wall "
+              "time — docs/OBSERVABILITY.md 'Predicted vs actual')",
+    "xray.drift": "nns-xray census drift: a compiled program escaped "
+                  "the deep lint's predicted census (instant; args: "
+                  "program, reason; the flight-recorder window is "
+                  "dumped to the log alongside)",
 }
 
 #: buffer-meta keys the tracer owns (stamped only when tracing is active)

@@ -73,6 +73,11 @@ ROWS = [
     # exactly-once row; artifact lands next to the sweep
     ("journal_overhead_ab", ["ARMOR", "--out",
                              "BENCH_ARMOR_sweep.json"]),
+    # nns-xray (ISSUE 13): doctor-overhead A/B — the predicted-vs-actual
+    # attribution (program registry + cost analysis + reconciler) on vs
+    # off on the backlogged bench pipeline, interleaved-median wall; the
+    # row also pins census drift == 0 on the live run
+    ("doctor_overhead", ["DOCTOR", "--bench"]),
     ("detection_ssd", ["--config", "detection"]),
     ("detection_yolov5s", ["--config", "detection",
                            "--detection-model", "yolov5s"]),
@@ -154,6 +159,10 @@ def run_row(label: str, argv, timeout: int) -> dict:
     elif argv and argv[0] == "ARMOR":
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "bench_armor.py")] + argv[1:]
+    # DOCTOR sentinel: the nns-xray doctor CLI (same stdout contract)
+    elif argv and argv[0] == "DOCTOR":
+        cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.doctor"] \
+            + argv[1:]
     else:
         cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)

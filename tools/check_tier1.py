@@ -100,6 +100,16 @@ restart it with journal-replay on the same port, and assert the
 exactly-once contract (unanswered-at-kill all re-admitted and acked
 once, journal fully answered at the end, no client losses).
 
+AND it runs the xray gate (ISSUE 13, docs/OBSERVABILITY.md "Predicted
+vs actual"): tests/test_xray.py as its own pytest process (census-drift
+goldens incl. the numpy-scalar serve-loop trap, the llm 3-program churn
+census, MFU/pad-waste gauges, the HBM ledger, the xray-off structural
+pin, OpenMetrics negotiation, the thread-shutdown audit), then
+``python -m nnstreamer_tpu.tools.doctor --gate`` on the built-in bench
+pipeline — census drift must be 0 and every HBM ledger category within
+tolerance — with the deterministic verdict lines pinned strict against
+tools/xray_baseline.txt (``--update`` refreshes it).
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -126,6 +136,7 @@ DEEP_BASELINE = os.path.join(REPO, "tools", "deep_baseline.txt")
 SERVING_BASELINE = os.path.join(REPO, "tools", "serving_deep_baseline.txt")
 FETCH_BASELINE = os.path.join(REPO, "tools", "fetch_deep_baseline.txt")
 ASR_BASELINE = os.path.join(REPO, "tools", "asr_deep_baseline.txt")
+XRAY_BASELINE = os.path.join(REPO, "tools", "xray_baseline.txt")
 
 #: HBM budget the MXU gate pins for the streaming-ASR example's deep
 #: lint: below the estimate, so the hbm-budget warning fires with the
@@ -775,6 +786,68 @@ def run_armor_gate(timeout: int = 900) -> int:
     return 1 if problems else 0
 
 
+def run_xray_gate(update: bool, timeout: int = 900) -> int:
+    """nns-xray gate (ISSUE 13, see module docstring): the predicted-vs-
+    actual test file as its own pytest process, then the doctor CLI on
+    the built-in bench pipeline — census drift must be 0 and every HBM
+    category within tolerance — with the deterministic verdict lines
+    pinned against tools/xray_baseline.txt."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest", "tests/test_xray.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"xray gate: tests TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"xray gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.doctor", "--gate"]
+    try:
+        doc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                             text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"xray gate: doctor TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    lines = [ln.rstrip() for ln in doc.stdout.strip().splitlines()]
+    if doc.returncode != 0:
+        print(f"xray gate: DOCTOR DRIFT ({passed} tests passed)")
+        for line in (doc.stdout + doc.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return doc.returncode
+    if update:
+        with open(XRAY_BASELINE, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"xray gate: updated ({passed} tests passed)")
+        return 0
+    try:
+        with open(XRAY_BASELINE) as f:
+            want = [ln.rstrip() for ln in f.read().strip().splitlines()]
+    except OSError:
+        print(f"xray gate: no baseline at {XRAY_BASELINE} — run with "
+              "--update once to check one in", file=sys.stderr)
+        return 2
+    if lines != want:
+        print(f"xray gate: VERDICT DRIFT vs baseline ({passed} tests "
+              "passed)")
+        for got, exp in zip(lines + ["<missing>"] * len(want),
+                            want + ["<missing>"] * len(lines)):
+            if got != exp:
+                print(f"  got {got!r} != baseline {exp!r}",
+                      file=sys.stderr)
+        return 1
+    print(f"xray gate: OK ({passed} tests passed, doctor census drift 0)")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -796,9 +869,10 @@ def main() -> int:
     soak_rc = run_soak_gate()
     elastic_rc = run_elastic_gate()
     armor_rc = run_armor_gate()
+    xray_rc = run_xray_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
                or mxu_rc or serving_rc or fetch_rc or soak_rc
-               or elastic_rc or armor_rc)
+               or elastic_rc or armor_rc or xray_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
