@@ -1162,6 +1162,89 @@ def bench_asr_stream(batches: int, warmup: int, chunk: int = 4000,
     }
 
 
+def bench_train_stream(batches: int, warmup: int, in_dim: int = 64,
+                       hidden: int = 256, classes: int = 8,
+                       bs: int = 32, epochs: int = 3) -> dict:
+    """nns-learn A/B (ISSUE 14 acceptance, docs/TRAINING.md): the SAME
+    jitted masked update step fed by (a) the device-resident streaming
+    window (per-sample in-program appends, no host epoch accumulation)
+    vs (b) the legacy host-accumulated epoch (stack + pad per
+    minibatch).  Reports samples/sec for the device path and the ratio;
+    the paths are bit-identical by test, so this is pure pipeline
+    mechanics.  ``host_bytes_held`` contrasts the resident host memory:
+    the host path keeps the WHOLE epoch as numpy, the streaming path one
+    [batch-size] HBM window — on the tunneled chip the host path
+    additionally pays an H2D per minibatch where the window is already
+    resident.  The row also carries the checkpoint-resume contract:
+    fsync'd write time and a save→load→train-one-epoch continuation
+    checked BITWISE against the uninterrupted run."""
+    import numpy as np
+
+    from nnstreamer_tpu.trainer.subplugin import JaxTrainer
+
+    n = max(256, batches * 8)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n, in_dim)).astype(np.float32)
+    ys = rng.integers(0, classes, (n, 1)).astype(np.int32)
+    model = f"mlp:{in_dim}:{hidden}:{hidden}:{classes}"
+    props = {"model": model, "batch_size": bs, "learning_rate": 0.01}
+
+    def epoch(tr):
+        for i in range(n):
+            tr.push_data([xs[i]], [ys[i]], False)
+        return tr.train_epoch()
+
+    def run(host: bool):
+        tr = JaxTrainer()
+        tr.open(dict(props, host_accumulate="true" if host else "false"))
+        epoch(tr)  # warmup: compiles land here
+        times = []
+        for _ in range(epochs):
+            t0 = time.perf_counter()
+            epoch(tr)
+            times.append(time.perf_counter() - t0)
+        return tr, n * len(times) / sum(times)
+
+    tr_dev, sps_dev = run(False)
+    tr_host, sps_host = run(True)
+
+    # checkpoint-resume row: fsync'd write, then a fresh trainer resumes
+    # and must continue BITWISE where the uninterrupted twin lands
+    import os
+    import tempfile
+
+    import jax
+
+    ck = os.path.join(tempfile.mkdtemp(), "bench.ckpt")
+    t0 = time.perf_counter()
+    tr_dev.save(ck)
+    ckpt_ms = (time.perf_counter() - t0) * 1e3
+    resumed = JaxTrainer()
+    resumed.open(dict(props, model_load_path=ck))
+    epoch(resumed)
+    epoch(tr_dev)
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(resumed.params),
+                        jax.tree_util.tree_leaves(tr_dev.params)))
+    return {
+        "metric": "train_stream_device_vs_host_speedup",
+        "value": round(sps_dev / max(1e-9, sps_host), 3),
+        "unit": "x",
+        "vs_baseline": round(sps_dev / max(1e-9, sps_host), 3),
+        "samples_per_sec_device": round(sps_dev, 1),
+        "samples_per_sec_host": round(sps_host, 1),
+        "samples": n, "batch_size": bs, "epochs": epochs,
+        "model": model,
+        "census": tr_dev.compile_counts(),
+        "train_state_bytes": tr_dev.train_state_bytes(),
+        "host_bytes_held_host_path": n * (xs[0].nbytes + ys[0].nbytes),
+        "host_bytes_held_device_path": 0,
+        "ckpt_write_ms": round(ckpt_ms, 2),
+        "resume_bit_identical": bool(identical),
+    }
+
+
 def bench_sharded(batches: int, warmup: int, replicas: int = 4,
                   batch_max: int = 32, dims: int = 640,
                   layers: int = 40) -> dict:
@@ -1685,7 +1768,7 @@ def main() -> int:
                     choices=["classification", "classification_quant",
                              "detection", "pose", "segmentation", "audio",
                              "llm", "llm7b", "link", "batching", "adaptive",
-                             "asr_stream", "sharded",
+                             "asr_stream", "train_stream", "sharded",
                              "tp", "tp_grid", "fetch", "all"])
     # classification defaults to 256: the r3 on-chip session measured 2x
     # the fps AND 2x the MFU of batch 64 (30,137 fps / 0.175 MFU vs
@@ -1773,6 +1856,7 @@ def main() -> int:
             "adaptive": ("adaptive_ladder_speedup_burst6_vs_static", "x"),
             "asr_stream": ("asr_streaming_window_windows_per_sec",
                            "windows/sec"),
+            "train_stream": ("train_stream_device_vs_host_speedup", "x"),
             "sharded": ("mesh_sharded_batching_speedup_dp4_vs_1", "x"),
             "tp": (f"{args.llm_model}_decode_tp{args.tp_ways}_vs_tp1_"
                    "tokens_per_sec", "tokens/sec"),
@@ -1837,6 +1921,8 @@ def main() -> int:
         "batching": lambda: bench_batching(args.batches, args.warmup),
         "adaptive": lambda: bench_adaptive(args.batches, args.warmup),
         "asr_stream": lambda: bench_asr_stream(args.batches, args.warmup),
+        "train_stream": lambda: bench_train_stream(args.batches,
+                                                   args.warmup),
         "sharded": lambda: bench_sharded(args.batches, args.warmup),
         "tp": lambda: bench_tp(max(1, args.batches // 16), args.warmup,
                                model=args.llm_model, ways=args.tp_ways),

@@ -1,39 +1,70 @@
-"""On-device training: datareposrc -> tensor_trainer with the optax
-sub-plugin, epoch stats downstream, checkpoint at EOS.
+"""nns-learn: streaming on-device training (docs/TRAINING.md).
+
+Two pipelines, the capture→replay contract:
+
+1. **Capture** — an appsrc-fed "live stream" of (input, label) samples is
+   recorded by ``datareposink manifest=true`` into a binary shard + a
+   JSON manifest the trainer can replay (``files`` list, SURVEY §2.8
+   datarepo semantics).
+2. **Train** — ``datareposrc`` replays the manifest with deterministic
+   per-epoch shuffling (``is-shuffle`` + ``shuffle-seed``: epoch k's
+   order is a pure function of (seed, k)), streaming samples into
+   ``tensor_trainer``'s device-resident window; the jitted optax step
+   updates params in HBM (closed 3-program census), per-epoch stats flow
+   to the sink, and ``checkpoint-every=1`` writes a step-versioned
+   fsync'd checkpoint after every epoch — kill the process and
+   ``model-load-path`` resumes bit-identically.
 
 Reference analog: SURVEY §3.4 (datareposrc + tensor_trainer + nntrainer).
+
+``--prepare-only`` writes the captured dataset and exits (the CI learn
+gate uses it before deep-linting this file's pipeline strings).
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import json, os, tempfile
 import numpy as np
 import nnstreamer_tpu as nt
 
-tmp = tempfile.mkdtemp()
-data_path, json_path = os.path.join(tmp, "xor.bin"), os.path.join(tmp, "xor.json")
-ckpt = os.path.join(tmp, "model.ckpt")
+DATA = "/tmp/nns_learn_xor.bin"
+META = "/tmp/nns_learn_xor.json"
+CKPT = "/tmp/nns_learn_model.ckpt"
+SAMPLES = 32
+EPOCHS = 3
 
-x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]] * 8, np.float32)
-y = (x[:, 0].astype(np.int32) ^ x[:, 1].astype(np.int32))[:, None]
-with open(data_path, "wb") as f:
-    for xi, yi in zip(x, y):
-        f.write(xi.tobytes()); f.write(yi.tobytes())
-json.dump({"dims": "2,1", "types": "float32,int32",
-           "total_samples": len(x),
-           "sample_size": x[0].nbytes + y[0].nbytes}, open(json_path, "w"))
+
+def prepare() -> None:
+    """Capture a live (input, label) stream into a replayable manifest."""
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]] * (SAMPLES // 4), np.float32)
+    y = (x[:, 0].astype(np.int32) ^ x[:, 1].astype(np.int32))[:, None]
+    cap = nt.Pipeline(
+        f"appsrc name=src ! datareposink location={DATA} json={META} "
+        "manifest=true"
+    )
+    with cap:
+        for xi, yi in zip(x, y):
+            cap.push("src", [xi, yi])
+        cap.eos()
+        cap.wait(timeout=60)
+
+
+prepare()
+if "--prepare-only" in sys.argv:
+    sys.exit(0)
 
 pipe = nt.Pipeline(
-    f"datareposrc location={data_path} json={json_path} epochs=3 ! "
-    f"tensor_trainer framework=jax model=mlp:2:16:2 num-training-samples={len(x)} "
-    f"epochs=3 batch-size=8 learning-rate=0.1 model-save-path={ckpt} ! "
+    f"datareposrc json={META} epochs={EPOCHS} is-shuffle=true "
+    f"shuffle-seed=7 ! "
+    f"tensor_trainer framework=jax model=mlp:2:16:2 "
+    f"num-training-samples={SAMPLES} epochs={EPOCHS} batch-size=8 "
+    f"learning-rate=0.1 checkpoint-every=1 model-save-path={CKPT} ! "
     "tensor_sink name=stats",
 )
 with pipe:
-    for epoch in range(3):
+    for epoch in range(EPOCHS):
         s = np.asarray(pipe.pull("stats", timeout=300).tensors[0])
         print(f"epoch {epoch}: loss={s[0]:.4f} acc={s[1]:.3f}")
     pipe.wait(timeout=120)
-print("checkpoint written:", os.path.exists(ckpt) or os.path.exists(ckpt + ".opt"))
+print("checkpoint written:", os.path.exists(CKPT) or os.path.exists(CKPT + ".opt"))

@@ -52,6 +52,8 @@ SAFE_CONFIGURE = frozenset({
     "tensor_debug", "tensor_sink", "fakesink",
     "tensor_reposink", "tensor_reposrc",
     "tensor_decoder",
+    # nns-learn: configure() only emits the fixed stats spec — pure
+    "tensor_trainer",
 })
 
 

@@ -83,13 +83,19 @@ class StageResource:
     #: (elements/aggregator.py device mode) — like the KV pool, resident
     #: for the stage's lifetime
     ring_bytes: int = 0
+    #: device-resident TRAINING state (nns-learn, trainer/subplugin.py
+    #: train_plan): optimizer moments + the streaming sample window,
+    #: resident for the stage's lifetime; the transient per-step
+    #: gradient tree is priced into act_row_bytes instead
+    train_bytes: int = 0
 
     @property
     def hbm_bytes(self) -> int:
         """Per-device HBM this stage plans for: resident params + KV pool
-        + aggregator ring + in-flight activations (dispatch window
-        already multiplied into rows)."""
+        + aggregator ring + training state + in-flight activations
+        (dispatch window already multiplied into rows)."""
         return (self.param_bytes + self.pool_bytes + self.ring_bytes
+                + self.train_bytes
                 + self.act_row_bytes * self.rows_per_device)
 
 
@@ -139,6 +145,7 @@ class ResourceReport:
             "agg_rings": sum(s.ring_bytes for s in self.stages),
             "activations": sum(s.act_row_bytes * s.rows_per_device
                                for s in self.stages),
+            "train_state": sum(s.train_bytes for s in self.stages),
         }
 
     def summary(self) -> str:
@@ -171,6 +178,8 @@ class ResourceReport:
                 + (f"kv pool {_mib(s.pool_bytes)}, " if s.pool_bytes
                    else "")
                 + (f"agg ring {_mib(s.ring_bytes)}, " if s.ring_bytes
+                   else "")
+                + (f"train state {_mib(s.train_bytes)}, " if s.train_bytes
                    else "")
                 + f"act/row {_mib(s.act_row_bytes)}, "
                 f"rows/dev {s.rows_per_device}, "
@@ -297,6 +306,16 @@ def deep_check(
             # a serving stage that couldn't be priced, already diagnosed
             if isinstance(serving, StageResource):
                 serving_stages.append(serving)
+            continue
+        train = _trainer_stage(node, diags, model_par)
+        if train is not None:
+            # tensor_trainer (nns-learn): priced statically via the
+            # runtime's own train_plan arithmetic — optimizer state
+            # abstracted via eval_shape, never materialized.  The
+            # element is stateful (device window + opt moments), so the
+            # generic stateless walk must skip it either way.
+            if isinstance(train, StageResource):
+                serving_stages.append(train)
             continue
         ring = _aggregator_stage(graph, node, out_caps, diags)
         if ring is not None:
@@ -521,6 +540,77 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
         rows_per_device=slots, variants=plan["programs"],
         batchable=False, shard_eligible=False, sharded=ways > 1,
         pos=node.pos, pool_bytes=pool)
+
+
+def _trainer_stage(node, diags, model_par: int = 1):
+    """Price a jax ``tensor_trainer`` stage statically (nns-learn).
+
+    Returns ``None`` when the node is not a jax-framework trainer, a
+    :class:`StageResource` when priced, or ``True`` when it is one but
+    could not be priced (diagnostic appended).  The arithmetic is the
+    runtime's own :func:`~nnstreamer_tpu.trainer.subplugin.train_plan`
+    (the ``serving_plan`` shared-home discipline): param bytes from the
+    model config, optimizer-state bytes from the optax tree ABSTRACTED
+    via ``jax.eval_shape(tx.init, params)`` (no optimizer state ever
+    materializes), the device-resident streaming window, and one
+    transient gradient tree per step (activation-class).  Under a >1
+    ``model`` axis the bundle's ``param_pspecs`` walk
+    (:func:`_pspec_audit`) divides model-sharded leaves — params, their
+    Adam moments, and their gradients — by M per chip.  The census is
+    the trainer's fixed :data:`~nnstreamer_tpu.trainer.subplugin.
+    TRAINER_PROGRAMS` program set (append / step / eval), verified live
+    by nns-xray."""
+    if node.kind != "tensor_trainer":
+        return None
+    fw = str(node.props.get("framework", "jax")).lower()
+    if fw != "jax":
+        return None
+    label = node_label(node)
+    from ..trainer.subplugin import train_plan
+
+    try:
+        plan = train_plan(dict(node.props))
+    except Exception:  # noqa: BLE001 - unpriceable model config
+        plan = None
+    if plan is None:
+        diags.append(Diagnostic(
+            "training-unpriced", WARNING,
+            f"tensor_trainer model {node.props.get('model')!r} cannot be "
+            "resolved statically — optimizer-state/gradient HBM cannot "
+            "be priced (use mlp:IN:...:OUT or a preset zoo name)",
+            path=label, pos=node.pos))
+        return True
+    params = plan["param_bytes"]
+    opt = plan["opt_bytes"]
+    grads = plan["grad_bytes"]
+    # trainer's own mesh prop: a model:M axis in it shards like the
+    # pipeline's model_parallel would
+    mesh_prop = str(node.props.get("mesh", "") or "")
+    ways = model_par
+    if "model:" in mesh_prop:
+        try:
+            ways = max(ways, int(
+                mesh_prop.split("model:", 1)[1].split(",", 1)[0]))
+        except ValueError:
+            pass
+    if ways > 1 and plan["pspecs"] is not None \
+            and plan["params"] is not None:
+        shard = _pspec_audit(plan["params"], plan["pspecs"], ways,
+                             label, node.pos, diags)
+        if params:
+            frac_rep = (params - min(shard, params)) / params
+            scale = frac_rep + (1 - frac_rep) / ways
+            params = int(params * scale)
+            # Adam moments and gradients mirror the param tree leaf for
+            # leaf, so the same shard fraction divides them
+            opt = int(opt * scale)
+            grads = int(grads * scale)
+    return StageResource(
+        label=label, param_bytes=params,
+        act_row_bytes=grads,  # one transient gradient tree per step
+        rows_per_device=1, variants=plan["programs"],
+        batchable=False, shard_eligible=False, sharded=ways > 1,
+        pos=node.pos, train_bytes=opt + plan["window_bytes"])
 
 
 #: compiled programs a device-mode aggregator runs for its LIFETIME (the
@@ -948,12 +1038,17 @@ def _budget_diags(report: ResourceReport) -> List[Diagnostic]:
             f"{_mib(top.hbm_bytes)} = params {_mib(top.param_bytes)} + "
             + (f"kv pool {_mib(top.pool_bytes)} + " if top.pool_bytes
                else "")
+            + (f"train state {_mib(top.train_bytes)} + " if top.train_bytes
+               else "")
             + f"{top.rows_per_device} row(s) x {_mib(top.act_row_bytes)}); "
             "shrink batch_max/buckets, raise data_parallel, or raise "
             "Config.hbm_budget_bytes"
             + (" (paged pools: shrink kv_blocks/slots — a smaller pool "
                "defers admission instead of overflowing)"
-               if top.pool_bytes else ""),
+               if top.pool_bytes else "")
+            + (" (training: shrink batch-size — the streaming window — "
+               "or pick a lighter optimizer; sgd carries no moments)"
+               if top.train_bytes else ""),
             path=top.label, pos=top.pos))
     if report.max_compiled_variants and report.stages \
             and report.compiled_variants > report.max_compiled_variants:

@@ -15,6 +15,17 @@ JSON meta here::
 
 (dims/types are our caps-string equivalents of ``gst_caps``; sample_size is
 the byte length of one sample = all tensors concatenated.)
+
+Manifest file lists (nns-learn, docs/TRAINING.md): the meta may carry a
+``"files"`` list instead of a single ``location`` data file::
+
+    {"dims": "4,1", "types": "float32,int32", "sample_size": 20,
+     "files": ["shard0.bin", "shard1.bin"]}
+
+Relative entries resolve against the meta's own directory; each file must
+hold a whole number of samples and the dataset is their concatenation in
+list order — the replay contract for a ``datareposink``-captured stream
+split across shards.
 """
 
 from __future__ import annotations
@@ -36,11 +47,14 @@ from .base import Element, ElementError, Out, SinkElement, SourceElement
 class DataRepoSrc(SourceElement):
     """Reads (input, label) samples from a binary file + JSON meta.
 
-    Props: ``location`` (data file), ``json`` (meta file),
+    Props: ``location`` (data file; optional when the meta carries a
+    ``files`` manifest list), ``json`` (meta file),
     ``start-sample-index``, ``stop-sample-index`` (inclusive; -1 = last),
     ``epochs`` (dataset repetitions; each epoch re-emits the samples — the
     reference drives multi-epoch training this way), ``is-shuffle``
-    (per-epoch deterministic shuffle, seeded by epoch index).
+    (per-epoch deterministic shuffle: epoch k's order is a pure function
+    of ``(shuffle-seed, k)``, so replays reproduce exactly while every
+    epoch still sees a DIFFERENT order), ``shuffle-seed`` (default 0).
     """
 
     kind = "datareposrc"
@@ -57,8 +71,10 @@ class DataRepoSrc(SourceElement):
             "1",
             "yes",
         )
+        self.shuffle_seed = int(self.props.get("shuffle_seed", 0))
         self.spec: Optional[TensorsSpec] = None
         self._meta = None
+        self._files: List[str] = []
 
     def _load_meta(self):
         if self._meta is not None:
@@ -76,6 +92,19 @@ class DataRepoSrc(SourceElement):
             raise ElementError(
                 f"datarepo meta sample_size={size} != spec bytes {expect}"
             )
+        files = self._meta.get("files")
+        if files:
+            base = os.path.dirname(os.path.abspath(self.json_path))
+            self._files = [
+                f if os.path.isabs(f) else os.path.join(base, f)
+                for f in files
+            ]
+        elif self.location:
+            self._files = [self.location]
+        else:
+            raise ElementError(
+                "datareposrc needs location= or a 'files' manifest list "
+                "in the json meta")
 
     def configure(self, in_caps, out_pads):
         self._load_meta()
@@ -89,26 +118,51 @@ class DataRepoSrc(SourceElement):
         # Memory-map the dataset: samples are zero-copy views into the OS
         # page cache (the reference's C reader streams from the file; a
         # Python read() would materialize the WHOLE set in process RAM and
-        # copy every sample).  Views stay valid while the mapping is held.
-        fsize = os.path.getsize(self.location)
-        total = int(self._meta.get("total_samples", fsize // sample_size))
+        # copy every sample).  Views stay valid while the mappings are
+        # held.  With a manifest ``files`` list the dataset is the
+        # concatenation of the shards, each holding whole samples.
+        sizes = [os.path.getsize(f) for f in self._files]
+        for f, fsize in zip(self._files, sizes):
+            if fsize % sample_size:
+                raise ElementError(
+                    f"datarepo shard {f} holds {fsize} bytes — not a "
+                    f"whole number of {sample_size}-byte samples")
+        file_samples = [fsize // sample_size for fsize in sizes]
+        avail = sum(file_samples)
+        total = int(self._meta.get("total_samples", avail))
         stop = total - 1 if self.stop_idx < 0 else min(self.stop_idx, total - 1)
         # Size check BEFORE the empty-file return: a truncated/zero file
         # whose meta still claims samples must error, not yield nothing.
-        if (stop + 1) * sample_size > fsize:
+        if stop + 1 > avail:
             raise ElementError(
-                f"datarepo file holds {fsize} bytes; meta claims "
+                f"datarepo file(s) holds {sum(sizes)} bytes; meta claims "
                 f"{total} samples of {sample_size}")
         indices = list(range(self.start_idx, stop + 1))
-        if not indices or fsize == 0:
+        if not indices or avail == 0:
             return  # empty dataset (mmap of an empty file is an error)
-        data = np.memmap(self.location, dtype=np.uint8, mode="r")
+        maps = [np.memmap(f, dtype=np.uint8, mode="r")
+                for f, fsize in zip(self._files, sizes) if fsize]
+        # global sample index -> (mapping, local offset)
+        starts: List[int] = []
+        acc = 0
+        for n in file_samples:
+            if n:
+                starts.append(acc)
+                acc += n
+        import bisect
+
         for epoch in range(self.epochs):
             order = list(indices)
             if self.shuffle:
-                np.random.default_rng(epoch).shuffle(order)
+                # epoch k's order is a pure function of (seed, k):
+                # deterministic replay across runs, different order per
+                # epoch — the reference's is-shuffle semantics
+                np.random.default_rng(
+                    (self.shuffle_seed, epoch)).shuffle(order)
             for i in order:
-                off = i * sample_size
+                fi = bisect.bisect_right(starts, i) - 1
+                off = (i - starts[fi]) * sample_size
+                data = maps[fi]
                 tensors: List[np.ndarray] = []
                 pos = off
                 for s in self.spec:
@@ -123,7 +177,11 @@ class DataRepoSrc(SourceElement):
 class DataRepoSink(SinkElement):
     """Writes incoming sample buffers to a binary file + JSON meta at EOS.
 
-    Props: ``location``, ``json``.
+    Props: ``location``, ``json``, ``manifest`` (``true`` = the meta
+    also lists the data file under ``files`` — a standalone manifest a
+    ``datareposrc json=`` replays with no ``location=`` prop, the
+    capture→replay contract for training on recorded live streams,
+    docs/TRAINING.md).
     """
 
     kind = "datareposink"
@@ -132,6 +190,8 @@ class DataRepoSink(SinkElement):
         super().__init__(props, name)
         self.location = str(self.props.get("location", ""))
         self.json_path = str(self.props.get("json", ""))
+        self.manifest = str(self.props.get("manifest", "false")).lower() in (
+            "true", "1", "yes")
         self._f = None
         self._count = 0
         self._spec: Optional[TensorsSpec] = None
@@ -173,5 +233,14 @@ class DataRepoSink(SinkElement):
             "total_samples": self._count,
             "sample_size": sample_size,
         }
+        if self.manifest:
+            # relative to the meta's directory when co-located (the
+            # datareposrc resolution rule — the pair stays relocatable),
+            # absolute otherwise; either way the captured set replays by
+            # json= alone
+            base = os.path.dirname(os.path.abspath(self.json_path))
+            loc = os.path.abspath(self.location)
+            meta["files"] = [os.path.basename(loc)
+                             if os.path.dirname(loc) == base else loc]
         with open(self.json_path, "w") as f:
             json.dump(meta, f)

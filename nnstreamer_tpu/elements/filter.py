@@ -115,6 +115,8 @@ class TensorFilter(Element):
         self._lat_ema: Optional[float] = None
         self._n_invoked = 0
         self._batchers: Dict[int, object] = {}
+        #: per-swap version counter (nns-learn train-while-serve)
+        self._param_version = 0
         import threading
 
         self._fw_lock = threading.Lock()  # process vs reload_model swap
@@ -346,6 +348,41 @@ class TensorFilter(Element):
             return self._batchable_fn(self._ensure_fw()) is not None
         except Exception:  # noqa: BLE001 - capability probe only
             return False
+
+    # -- nns-learn: train-while-serve param hot-swap ------------------------
+    def swap_params(self, tree) -> int:
+        """Hot-swap the live model weights as a VALUE move
+        (docs/TRAINING.md): delegates to the framework's ``swap_params``
+        under ``_fw_lock`` so the swap lands at a DISPATCH BOUNDARY —
+        never under an in-flight invoke (continuous frameworks further
+        defer to their own chunk boundary via the control-command
+        queue).  Bumps and returns the per-stage param version
+        (``<name>.param_version`` gauge, ``learn.swap`` span).  Raises
+        when the framework's dispatch path is not hot-swappable or the
+        tree does not match the serving avals."""
+        import time as _time
+
+        t0 = _time.monotonic_ns()
+        with self._fw_lock:
+            if self._batchers:
+                # belt-and-braces twin of the Pipeline-level batch_max
+                # guard: bucket programs were built from pure_fn()
+                # closures that snapshot params — swapping under them
+                # would serve stale weights
+                raise FrameworkError(
+                    f"{self.name}: micro-batched dispatch captures "
+                    "params at build time — hot-swap needs batch_max=1")
+            fw = self._ensure_fw()
+            fw.swap_params(tree)
+            self._param_version += 1
+            version = self._param_version
+        metrics.count(f"{self.name}.param_swaps")
+        metrics.gauge(f"{self.name}.param_version", float(version))
+        rec = getattr(self, "_trace_rec", None)
+        if rec is not None and rec.active:
+            rec.record("learn.swap", self.name, None, t0,
+                       _time.monotonic_ns() - t0, version=version)
+        return version
 
     def place_params(self, mesh) -> bool:
         """Place the framework's model params onto ``mesh`` once (the

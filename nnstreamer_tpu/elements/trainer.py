@@ -1,4 +1,4 @@
-"""tensor_trainer: on-device training as a pipeline element.
+"""tensor_trainer: on-device training as a pipeline element (nns-learn).
 
 Reference analog: ``gst/nnstreamer/elements/gsttensor_trainer.c`` (SURVEY
 §2.2, upstream-reconstructed): receives (input, label) tensor pairs from the
@@ -11,22 +11,32 @@ an epoch; each completed epoch runs a training pass and pushes ONE stats
 buffer (float64 [4]: training_loss, training_acc, val_loss, val_acc);
 ``model-save-path`` is written at EOS (and on explicit ``ready-to-complete``).
 
-TPU-first difference: the epoch is not handed to a library thread (the
-reference queues into nntrainer's own event loop); the minibatch loop is a
-jitted optax scan executed synchronously — deterministic, testable, and the
-stats buffer is ready the moment the epoch's XLA program returns.
+TPU-first differences (docs/TRAINING.md): samples stream into the jax
+sub-plugin's device-resident window (no host epoch accumulation), the
+update step is a fixed-signature jitted program (closed 3-program census),
+``checkpoint-every=N`` writes step-versioned fsync'd checkpoints every N
+epochs so a killed pipeline resumes bit-identically via
+``model-load-path``, and ``swap-to=<stage>`` hot-swaps each epoch's
+refreshed params into a live serving stage (``Pipeline.swap_params``) —
+train-while-serve.  Stats buffers ride the flight-recorder/tenant rails:
+they inherit the triggering sample's trace id + tenant and each epoch
+records a ``learn.step`` span (``learn.ckpt`` per checkpoint write), so
+trainer activity joins the Perfetto timeline like every other stage.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.buffer import Buffer, Event
 from ..core.caps import Caps
+from ..core.log import metrics
 from ..core.registry import get as registry_get, register_element, KIND_TRAINER
 from ..core.types import TensorSpec, TensorsSpec
+from ..utils import tracing
 from .base import Element, ElementError, Out, SRC
 
 STATS_SPEC = TensorsSpec.single(TensorSpec(name="stats", dtype="float64", dims=(4,)))
@@ -40,9 +50,13 @@ class TensorTrainer(Element):
     (model-config passed to the sub-plugin), ``model-save-path``,
     ``model-load-path`` (resume), ``num-inputs`` (default 1), ``num-labels``
     (default 1), ``num-training-samples``, ``num-validation-samples``,
-    ``epochs`` (stop after N epochs; further data is ignored), plus
-    sub-plugin props (``optimizer``, ``learning-rate``, ``loss``,
-    ``batch-size``, ``mesh``...) forwarded verbatim.
+    ``epochs`` (stop after N epochs; further data is ignored),
+    ``checkpoint-every`` (write a step-versioned fsync'd checkpoint to
+    ``model-save-path`` every N completed epochs; 0 = only at EOS),
+    ``swap-to`` (serving stage name: hot-swap refreshed params into it
+    after every epoch — requires the pipeline-attached swap callback),
+    plus sub-plugin props (``optimizer``, ``learning-rate``, ``loss``,
+    ``batch-size``, ``mesh``, ``host-accumulate``...) forwarded verbatim.
     """
 
     kind = "tensor_trainer"
@@ -59,6 +73,8 @@ class TensorTrainer(Element):
         self.epochs = int(self.props.get("epochs", 1))
         self.save_path = str(self.props.get("model_save_path", "") or "")
         self.fw_name = str(self.props.get("framework", "jax"))
+        self.checkpoint_every = int(self.props.get("checkpoint_every", 0))
+        self.swap_to = str(self.props.get("swap_to", "") or "")
         # Reference: tensor_trainer arms nnstreamer_watchdog around the
         # sub-plugin; a wedged train step must surface, not hang the stage.
         self.wd_timeout = float(self.props.get("watchdog_timeout", 0.0))
@@ -67,12 +83,26 @@ class TensorTrainer(Element):
         self._epochs_done = 0
         self._stats_pts = 0
         self._hung: Optional[str] = None
+        #: the most recent input buffer's trace identity (trace id,
+        #: ingress ns, tenant): stamped onto emitted stats buffers so
+        #: learn.* activity joins the request timeline (nns-trace)
+        self._last_tid = None
+        self._last_ingress = None
+        self._last_tenant = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         cls = registry_get(KIND_TRAINER, self.fw_name)
         self.trainer = cls()
         self.trainer.open(self.props)
+        xr = getattr(self, "_xray", None)
+        attach = getattr(self.trainer, "attach_xray", None)
+        if xr is not None and attach is not None:
+            # the Framework.attach_xray handoff: the trainer's 3-program
+            # census registers under <name>.learn with budget-1
+            # expectations (utils/xray.py)
+            attach(xr, self.name,
+                   rec=lambda: getattr(self, "_trace_rec", None))
 
     def stop(self) -> None:
         if self.trainer is not None:
@@ -83,6 +113,18 @@ class TensorTrainer(Element):
         caps = Caps.tensors(STATS_SPEC)
         self.out_caps = {p: caps for p in out_pads}
         return self.out_caps
+
+    # -- accounting (deep lint / nns-xray HBM ledger) -----------------------
+    def param_bytes(self) -> int:
+        fn = getattr(self.trainer, "param_nbytes", None)
+        return int(fn()) if fn is not None else 0
+
+    def train_state_bytes(self) -> int:
+        """Device-resident training state (optimizer moments + streaming
+        window) — the live side of the ledger's ``train_state`` category
+        (utils/xray.measure_hbm)."""
+        fn = getattr(self.trainer, "train_state_bytes", None)
+        return int(fn()) if fn is not None else 0
 
     # -- streaming ---------------------------------------------------------
     def _epoch_size(self) -> int:
@@ -102,6 +144,16 @@ class TensorTrainer(Element):
                 f"(num-inputs={self.num_inputs} + num-labels={self.num_labels}), "
                 f"got {len(buf.tensors)}"
             )
+        # remember the triggering request's identity for the stats
+        # buffer + learn.step span (written only when tracing stamped
+        # the meta — the off path stays stamp-free)
+        tid = buf.meta.get(tracing.META_TRACE_ID)
+        if tid is not None:
+            self._last_tid = tid
+            self._last_ingress = buf.meta.get(tracing.META_INGRESS_NS)
+        ten = buf.meta.get(tracing.META_TENANT)
+        if ten is not None:
+            self._last_tenant = ten
         inputs = buf.tensors[: self.num_inputs]
         labels = buf.tensors[self.num_inputs :]
         pos = self._pushed % self._epoch_size()
@@ -116,14 +168,20 @@ class TensorTrainer(Element):
 
     def process_group(self, bufs: Dict[str, Buffer]) -> Out:
         tensors: List = []
-        for pad in sorted(bufs):
+        pads = sorted(bufs)
+        for pad in pads:
             tensors.extend(bufs[pad].tensors)
-        merged = Buffer(tensors, pts=next(iter(bufs.values())).pts)
+        # pts/meta (trace id, tenant) from the SAME sorted-first pad the
+        # tensor order starts with — dict insertion order could name a
+        # different pad and misattribute learn.* spans
+        first = bufs[pads[0]]
+        merged = Buffer(tensors, pts=first.pts, meta=dict(first.meta))
         return self.process("sink", merged)
 
     def _run_epoch(self) -> Out:
         if self._hung:
             raise ElementError(self._hung)
+        t0 = time.monotonic_ns()
         if self.wd_timeout > 0:
             from ..utils.watchdog import call_with_watchdog
 
@@ -138,6 +196,19 @@ class TensorTrainer(Element):
         else:
             stats = self.trainer.train_epoch()
         self._epochs_done += 1
+        metrics.count(f"{self.name}.epochs")
+        rec = getattr(self, "_trace_rec", None)
+        if rec is not None and rec.active:
+            # learn.step: one span per trained epoch on the trainer's
+            # own track, joined to the LAST contributing request's trace
+            # id (the batch-span linkage convention) + tenant
+            args = {"epoch": self._epochs_done,
+                    "step": getattr(self.trainer, "step", 0),
+                    "loss": stats.get("training_loss")}
+            if self._last_tenant is not None:
+                args["tenant"] = self._last_tenant
+            rec.record("learn.step", self.name, self._last_tid, t0,
+                       time.monotonic_ns() - t0, **args)
         arr = np.array(
             [
                 stats.get("training_loss", np.nan),
@@ -148,14 +219,63 @@ class TensorTrainer(Element):
             dtype=np.float64,
         )
         self._stats_pts += 1
-        out: Out = [(SRC, Buffer([arr], spec=STATS_SPEC, pts=self._stats_pts))]
+        stats_buf = Buffer([arr], spec=STATS_SPEC, pts=self._stats_pts)
+        # the stats buffer rides the flight-recorder/tenant rails: it
+        # inherits the triggering sample's identity so downstream sinks'
+        # e2e spans and per-tenant histograms see trainer emissions
+        # (satellite: trainer stats were invisible to nns-trace)
+        if self._last_tid is not None:
+            stats_buf.meta[tracing.META_TRACE_ID] = self._last_tid
+            if self._last_ingress is not None:
+                stats_buf.meta[tracing.META_INGRESS_NS] = self._last_ingress
+        if self._last_tenant is not None:
+            stats_buf.meta[tracing.META_TENANT] = self._last_tenant
+        out: Out = [(SRC, stats_buf)]
+        if self.checkpoint_every > 0 and self.save_path \
+                and self._epochs_done % self.checkpoint_every == 0 \
+                and self._epochs_done < self.epochs:
+            self._checkpoint(versioned=True)
+        if self.swap_to:
+            self._swap_into_serving()
         if self._epochs_done >= self.epochs:
             self._save()
         return out
 
+    def _swap_into_serving(self) -> None:
+        """Train-while-serve: push the refreshed param tree into the
+        ``swap-to`` serving stage through the pipeline-attached swap
+        callback (``Pipeline.swap_params`` — a VALUE move at the serving
+        stage's dispatch boundary, zero recompiles)."""
+        cb = getattr(self, "_swap_cb", None)
+        if cb is None:
+            raise ElementError(
+                f"{self.name}: swap-to={self.swap_to!r} needs the "
+                "pipeline swap callback (run inside a Pipeline)")
+        export = getattr(self.trainer, "export_params", None)
+        tree = export() if export is not None else self.trainer.params
+        version = cb(self.swap_to, tree)
+        metrics.gauge(f"{self.name}.swap_version", float(version))
+
+    def _checkpoint(self, versioned: bool = False) -> None:
+        """One fsync'd checkpoint write (+ a step-versioned sibling so a
+        rollback target survives the next overwrite), span-stamped
+        ``learn.ckpt``."""
+        t0 = time.monotonic_ns()
+        path = self.trainer.save(self.save_path)
+        if versioned:
+            step = int(getattr(self.trainer, "step", 0))
+            self.trainer.save(f"{self.save_path}.step{step}")
+        metrics.count(f"{self.name}.ckpt_writes")
+        rec = getattr(self, "_trace_rec", None)
+        if rec is not None and rec.active:
+            rec.record("learn.ckpt", self.name, self._last_tid, t0,
+                       time.monotonic_ns() - t0,
+                       step=int(getattr(self.trainer, "step", 0)),
+                       path=path)
+
     def _save(self) -> None:
         if self.save_path and self.trainer is not None:
-            self.trainer.save(self.save_path)
+            self._checkpoint()
             self._saved_at_epoch = self._epochs_done
 
     def finalize(self) -> Out:

@@ -121,9 +121,68 @@ class Framework:
         self._xray_stage = stage
         self._xray_rec = rec
 
+    # -- nns-learn: train-while-serve param hot-swap ------------------------
+    def swap_params(self, tree) -> None:
+        """Replace the live parameter tree with ``tree`` as a VALUE move
+        — same tree structure, same per-leaf shapes/dtypes, so the
+        compiled programs' abstract signatures are untouched and NOTHING
+        recompiles (docs/TRAINING.md "Hot-swap").  Raises
+        :class:`FrameworkError` when this framework's dispatch path
+        bakes params into closures (swap would silently not take) or
+        the tree does not match."""
+        raise FrameworkError(
+            f"{self.name} framework does not support param hot-swap")
+
     # -- events ------------------------------------------------------------
     def handle_event(self, kind: str, payload=None) -> None:
         """Reference eventHandler (model reload etc.)."""
+
+
+def place_swapped_params(current, tree):
+    """Validate + place one hot-swap tree against the LIVE params
+    (the one walk every ``Framework.swap_params`` shares): structure and
+    per-leaf shape/dtype must match exactly (a mismatch raises
+    :class:`FrameworkError` naming the first offending leaf), and each
+    new leaf is copied onto the corresponding live leaf's placement —
+    a FRESH buffer per leaf (``jnp.array(copy=True)``), never an alias,
+    so a trainer that later DONATES its own params through an update
+    step cannot invalidate the serving copy."""
+    import jax
+    import jax.numpy as jnp
+
+    cur_leaves, cur_def = jax.tree_util.tree_flatten(current)
+    new_leaves, new_def = jax.tree_util.tree_flatten(tree)
+    if cur_def != new_def:
+        raise FrameworkError(
+            f"swap_params tree structure mismatch: got {new_def}, "
+            f"serving {cur_def}")
+    placed = []
+    for i, (c, n) in enumerate(zip(cur_leaves, new_leaves)):
+        c_shape = tuple(getattr(c, "shape", ()) or ())
+        n_shape = tuple(getattr(n, "shape", ()) or ())
+        c_dt = getattr(c, "dtype", None)
+        n_dt = getattr(n, "dtype", None)
+        if c_shape != n_shape or str(c_dt) != str(n_dt):
+            raise FrameworkError(
+                f"swap_params leaf {i} mismatch: got "
+                f"{list(n_shape)}{n_dt}, serving {list(c_shape)}{c_dt} "
+                "— hot-swap is a value move, shapes/dtypes are frozen")
+        sh = getattr(c, "sharding", None)
+        if sh is None:
+            # live leaf is HOST numpy (some trees mix host norms with
+            # device mats): keep it numpy — jit's fast path keys on
+            # argument type, and a jax-array copy here would mint a
+            # second cache entry (census drift) despite identical avals
+            import numpy as _np
+
+            placed.append(_np.array(_np.asarray(n), copy=True))
+            continue
+        fresh = jnp.array(n, copy=True)
+        # match the live leaf's COMMITTED-ness too — same cache-key rule
+        if bool(getattr(c, "committed", False)):
+            fresh = jax.device_put(fresh, sh)
+        placed.append(fresh)
+    return jax.tree_util.tree_unflatten(cur_def, placed)
 
 
 def tree_param_bytes(tree) -> int:

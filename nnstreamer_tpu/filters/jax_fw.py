@@ -78,6 +78,10 @@ class JaxFramework(Framework):
         if self._device is not None:
             params = jax.device_put(params, self._device)
             self.bundle.params = params
+        #: params commit to jax arrays at FIRST invoke, not here: the
+        #: deep pass opens frameworks to learn model I/O and must stay
+        #: zero-dispatch (jnp.asarray transfers) — see _commit_params
+        self._committed = self._device is not None
 
         self._sharding = None
         if mesh_spec:
@@ -87,14 +91,22 @@ class JaxFramework(Framework):
     def _rebuild_jitted(self):
         """(Re)build the standalone jitted path over the CURRENT bundle —
         one implementation shared by open() and select_reduced_output()
-        so dispatch-path changes apply to both."""
+        so dispatch-path changes apply to both.
+
+        Params are an ARGUMENT of the jitted program, not a closure
+        capture: jit caches on the abstract signature, so
+        :meth:`swap_params` replacing the tree with aval-identical
+        leaves is a pure VALUE move — the standing program serves the
+        new weights with ZERO recompiles (nns-learn's train-while-serve
+        contract, docs/TRAINING.md).  The fused/batched paths still
+        close over params (``pure_fn``) — those snapshot weights at
+        build time and are not hot-swappable."""
         import jax
 
         apply_fn = self.bundle.apply_fn
-        params = self.bundle.params
         constrain = self._constrain
 
-        def run(*inputs):
+        def run(params, *inputs):
             out = apply_fn(params, *constrain(inputs))
             return out if isinstance(out, (tuple, list)) else (out,)
 
@@ -155,6 +167,25 @@ class JaxFramework(Framework):
         self._sharding = NamedSharding(mesh, P("data"))
         replicated = NamedSharding(mesh, P())
         self.bundle.params = jax.device_put(params, replicated)
+        self._committed = True
+
+    def swap_params(self, tree) -> None:
+        """Hot-swap the live weights (nns-learn train-while-serve): the
+        tree must match the serving bundle's structure and per-leaf
+        avals exactly; each leaf is copied onto the live leaf's
+        placement (mesh replication / device selection carries over).
+        Because the standalone jitted path takes params as an argument,
+        the swap is a VALUE move — zero recompiles, pinned by test.
+        Callers serialize against in-flight invokes (the element holds
+        ``_fw_lock``)."""
+        if self.bundle is None:
+            raise FrameworkError("framework is not open")
+        from .base import place_swapped_params
+
+        # the live leaves' shardings already encode accelerator= device
+        # selection AND mesh replication — the shared placement walk
+        # copies onto them, so both carry over
+        self.bundle.params = place_swapped_params(self.bundle.params, tree)
 
     def select_reduced_output(self):
         """Swap in the bundle's reduced output variant (residency planner
@@ -179,9 +210,26 @@ class JaxFramework(Framework):
             return None, None
         return self.bundle.in_spec, self.bundle.out_spec
 
+    def _commit_params(self) -> None:
+        """Commit params to device arrays ONCE, at first dispatch (the
+        serve loop's carried-state discipline): jit's fast path keys on
+        argument TYPE, so a swap_params replacing numpy leaves with jax
+        arrays would otherwise mint a second cache entry and break the
+        zero-recompile census pin.  Deferred off open() so the deep
+        pass's framework probing stays zero-dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        self.bundle.params = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a) if hasattr(a, "shape") else a,
+            self.bundle.params)
+        self._committed = True
+
     def invoke(self, inputs) -> List:
         import jax.numpy as jnp
 
+        if not self._committed:
+            self._commit_params()
         if self._device is not None:
             # accelerator= selected a non-default device: params were
             # placed there at open(), so inputs must follow — a bare
@@ -193,7 +241,7 @@ class JaxFramework(Framework):
             arrays = [jax.device_put(x, self._device) for x in inputs]
         else:
             arrays = [jnp.asarray(x) for x in inputs]
-        outs = self._jitted(*arrays)
+        outs = self._jitted(self.bundle.params, *arrays)
         return list(outs)
 
     def pure_fn(self):

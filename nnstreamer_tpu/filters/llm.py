@@ -53,7 +53,8 @@ from ..models import llama
 from ..models.zoo import build as build_model
 from ..utils import elastic
 from ..utils.tracing import META_TENANT as _META_TENANT
-from .base import Framework, FrameworkError, parse_custom_options
+from .base import (Framework, FrameworkError, parse_custom_options,
+                   place_swapped_params)
 
 #: buffer-meta keys that must NOT ride a drain snapshot: the queue-stamp
 #: map is the source pipeline's tracer plumbing, and the query
@@ -522,6 +523,23 @@ class LLMFramework(Framework):
                     self._serve = _ContinuousLoop(self)
         return self._serve.adopt_stream(snapshot, emit, timeout)
 
+    def swap_params(self, tree) -> Optional[int]:
+        """Hot-swap the live weights (nns-learn train-while-serve).  With
+        a standing serve loop the swap executes as a control command AT
+        A CHUNK BOUNDARY — the drain/adopt discipline: every slot's host
+        bookkeeping is consistent, the three compiled loop programs take
+        params as arguments, and aval-identical leaves mean the census
+        stays closed (zero recompiles, pinned by test) — and returns the
+        loop's new param version.  Without a loop the stream path reads
+        ``bundle.params`` per request, so the next request serves the
+        new weights (returns None)."""
+        if self.bundle is None:
+            raise FrameworkError("framework is not open")
+        if self._serve is not None:
+            return self._serve.swap_params(tree)
+        self.bundle.params = place_swapped_params(self.bundle.params, tree)
+        return None
+
     def get_model_info(self):
         flex_in = TensorsSpec.from_string("1", "uint8").replace(
             format=TensorFormat.FLEXIBLE)
@@ -752,6 +770,9 @@ class _ContinuousLoop:
         #: up on retire/abort/shutdown so the process-wide registry
         #: never leaks entries)
         self._owned_sids: set = set()
+        #: per-swap version counter (nns-learn train-while-serve): bumps
+        #: once per executed hot-swap, published as llm.serve.param_version
+        self.param_version = 0
 
         def decode_chunk(params, tok, pool, tables, pos, key, length):
             """``length`` paged decode steps as ONE program (lax.scan):
@@ -971,6 +992,11 @@ class _ContinuousLoop:
         return self._ctl_call(
             {"kind": "adopt", "snapshot": snapshot, "emit": emit},
             timeout)
+
+    def swap_params(self, tree, timeout: float = 30.0) -> int:
+        """Enqueue a param hot-swap, executed at the next chunk boundary
+        (nns-learn train-while-serve); returns the new param version."""
+        return self._ctl_call({"kind": "swap", "tree": tree}, timeout)
 
     # -- serve thread ------------------------------------------------------
     def _emit_token(self, emit, meta: Dict, token_id: int, index: int,
@@ -1378,6 +1404,32 @@ class _ContinuousLoop:
                                    blocks=len(blocks))
                         _tr(f"adopted stream {sid} into slot {s}")
                         cmd["result"] = sid
+                        progressed = True
+                    cmd["ev"].set()
+                elif cmd["kind"] == "swap":
+                    # nns-learn param hot-swap (docs/TRAINING.md): a pure
+                    # VALUE move executed where drain/adopt execute — the
+                    # decode/prefill programs take params as arguments,
+                    # so aval-identical leaves re-use the standing
+                    # 3-program census (zero recompiles, pinned by test).
+                    # Placement copies onto the live leaves' shardings
+                    # (TP pspecs carry over) with FRESH buffers, so a
+                    # trainer donating its own tree can't invalidate us.
+                    t0 = time.monotonic_ns()
+                    try:
+                        params = place_swapped_params(params, cmd["tree"])
+                    except Exception as e:  # noqa: BLE001 - caller's error
+                        cmd["error"] = str(e)
+                    else:
+                        fw.bundle.params = params
+                        self.param_version += 1
+                        metrics.count("llm.serve.param_swaps")
+                        metrics.gauge("llm.serve.param_version",
+                                      float(self.param_version))
+                        self._span(rec, "learn.swap", t0,
+                                   version=self.param_version)
+                        _tr(f"params swapped (v{self.param_version})")
+                        cmd["result"] = self.param_version
                         progressed = True
                     cmd["ev"].set()
                 else:

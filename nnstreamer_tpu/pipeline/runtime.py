@@ -1150,6 +1150,10 @@ class Pipeline:
             # serve loop quarantines through el._armor, journaled
             # serversrcs honor the pipeline-level replay flag
             el._armor = self._armor
+            # nns-learn: a tensor_trainer with swap-to=<stage> hot-swaps
+            # its refreshed params into that serving stage at each epoch
+            # boundary through this callback (docs/TRAINING.md)
+            el._swap_cb = self.swap_params
             if self._journal_replay:
                 el._journal_replay = True
 
@@ -1679,6 +1683,64 @@ class Pipeline:
             "no continuous-serving filter on this pipeline to adopt "
             "into (need tensor_filter framework=llm "
             "custom=serve:continuous)")
+
+    # -- nns-learn: train-while-serve param hot-swap -----------------------
+    def swap_params(self, stage: str, tree_or_ckpt) -> int:
+        """Hot-swap updated parameters into a LIVE serving stage
+        (docs/TRAINING.md): ``tree_or_ckpt`` is a param pytree (e.g. a
+        trainer's ``export_params()``) or a checkpoint path
+        (``trainer/checkpoint.py``).  The swap is a VALUE move executed
+        at a dispatch boundary — same tree structure, same per-leaf
+        avals, so the stage's compiled programs are untouched and
+        NOTHING recompiles (census pinned by nns-xray); a no-op swap is
+        bit-identical, a real one serves the new weights from the next
+        dispatch.  Returns the stage's new param version (the
+        ``<stage>.param_version`` gauge / ``learn.swap`` span twin).
+
+        Raises :class:`PipelineError` for a stage that cannot swap: a
+        FUSED chain (its program bakes params into the composed closure
+        at build time — run the serving filter unfused, e.g. between
+        host elements or with ``fuse=False``) or a framework without a
+        parametric dispatch path."""
+        el = self.element(stage)
+        nid = next((k for k, v in self.elements.items() if v is el), None)
+        runner = self._runners.get(nid) if nid is not None else None
+        if runner is not None and runner.element is not el:
+            raise PipelineError(
+                f"stage {stage!r} is fused into {runner.element.name!r} — "
+                "the fused program captures params at build time, so a "
+                "swap would silently not take; keep hot-swappable "
+                "serving filters unfused (fuse=False, or a graph where "
+                "the filter is not part of a linear device chain)")
+        if runner is not None and runner.batch_max > 1 \
+                and runner.stage.batchable:
+            # same trap as fusion: the BatchRunner's bucket programs are
+            # built from pure_fn() closures that SNAPSHOT params — a
+            # swap would bump the version yet keep serving old weights
+            raise PipelineError(
+                f"stage {stage!r} runs micro-batched (batch_max="
+                f"{runner.batch_max}) — bucketed dispatch captures "
+                "params at build time, so a swap would silently not "
+                "take; run the hot-swappable serving stage with "
+                "batch_max=1 (or an llm serve:continuous stage, whose "
+                "loop swaps at chunk boundaries)")
+        swap = getattr(el, "swap_params", None)
+        if swap is None:
+            raise PipelineError(
+                f"element {stage!r} ({getattr(el, 'kind', '?')}) has no "
+                "swappable parameters")
+        tree = tree_or_ckpt
+        if isinstance(tree_or_ckpt, str):
+            from ..trainer.checkpoint import load_checkpoint
+
+            tree, _opt, _step = load_checkpoint(tree_or_ckpt)
+        try:
+            return int(swap(tree))
+        except PipelineError:
+            raise
+        except Exception as e:  # noqa: BLE001 - typed to the caller
+            raise PipelineError(
+                f"swap_params({stage!r}) failed: {e}") from e
 
     def __enter__(self) -> "Pipeline":
         return self.start()

@@ -17,13 +17,21 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 
-def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0) -> str:
+def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0,
+                    fsync: bool = False) -> str:
     """Write a checkpoint; returns the path written.
 
     ``params`` must be a pytree of arrays.  Uses orbax when available
     (directory checkpoint), else a single pickle file.  A *failed* orbax
     save propagates — falling back there would leave a partial orbax
     directory shadowing the fallback file.
+
+    ``fsync=True`` makes the pickle path DURABLE the way the request
+    journal is (utils/journal.py's flusher discipline): the blob is
+    written to a temp sibling, flushed, ``os.fsync``'d, and atomically
+    renamed over ``path`` — a crash mid-write leaves the previous
+    checkpoint intact, never a torn file, so ``model-load-path`` resume
+    always finds a complete ``(params, opt_state, step)`` tree.
     """
     try:
         import orbax.checkpoint as ocp
@@ -46,9 +54,25 @@ def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0
     # Portable fallback: numpy pickle of host arrays.
     host = _to_host(params)
     blob = {"params": host, "opt_state": _to_host(opt_state), "step": int(step)}
-    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(blob, f)
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if not fsync:
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+        return path
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(blob, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed write: don't leave the temp
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return path
 
 

@@ -106,6 +106,17 @@ SPAN_KINDS: Dict[str, str] = {
     "journal.replay": "durable request journal: restart re-admitted "
                       "the accepted-but-unanswered entries "
                       "(instant; args: entries, acked_skipped)",
+    "learn.step": "nns-learn: one trained epoch on a tensor_trainer "
+                  "stage (args: epoch, step = optimizer step counter, "
+                  "loss, tenant; tid = the last contributing sample's "
+                  "trace id — docs/TRAINING.md)",
+    "learn.swap": "nns-learn: live param hot-swap into a serving stage "
+                  "(Pipeline.swap_params — a VALUE move at a dispatch/"
+                  "chunk boundary, zero recompiles; args: version = the "
+                  "stage's per-swap counter)",
+    "learn.ckpt": "nns-learn: one fsync'd step-versioned trainer "
+                  "checkpoint write (args: step, path; model-load-path "
+                  "resume continues bit-identically)",
     "device": "nns-xray device-time attribution: one tracked-program "
               "dispatch on its own `device:<stage>` track beside the "
               "host spans (args: program, flops from the lowered "

@@ -63,9 +63,12 @@ from ..core.log import logger, metrics
 log = logger(__name__)
 
 #: HBM ledger categories — the deep lint's StageResource fields, measured
-#: live (docs/OBSERVABILITY.md "Predicted vs actual")
+#: live (docs/OBSERVABILITY.md "Predicted vs actual").  ``train_state``
+#: (nns-learn) = trainer optimizer moments + the device-resident
+#: streaming sample window, reconciled against
+#: ``trainer/subplugin.train_plan``'s eval_shape-abstracted estimate.
 HBM_CATEGORIES: Tuple[str, ...] = ("params", "kv_pool", "agg_rings",
-                                   "activations")
+                                   "activations", "train_state")
 
 #: ledger categories below this are never drift-warned: transient
 #: windows (activations) legitimately read 0 between dispatches, and
@@ -590,6 +593,12 @@ def measure_hbm(pipeline) -> Dict[str, int]:
         ring = getattr(el, "_ring", None)
         if ring is not None and hasattr(ring, "nbytes"):
             out["agg_rings"] += int(ring.nbytes)
+        train_fn = getattr(el, "train_state_bytes", None)
+        if train_fn is not None:
+            try:
+                out["train_state"] += int(train_fn() or 0)
+            except Exception:  # noqa: BLE001 - accounting probe only
+                pass
     act = 0
     for r in {id(r): r for r in pipeline._runners.values()}.values():
         try:

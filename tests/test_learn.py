@@ -1,0 +1,680 @@
+"""nns-learn (ISSUE 14): streaming on-TPU fine-tuning inside the pipeline
+— device-resident trainer state, fixed-signature census, mesh-sharded
+training, checkpoint/resume durability, train-while-serve param hot-swap,
+datarepo epoch-semantics parity, deep-lint pricing, and the nns-xray
+``train_state`` ledger.  docs/TRAINING.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import metrics
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.models.zoo import ModelBundle
+from nnstreamer_tpu.pipeline.runtime import PipelineError
+from nnstreamer_tpu.trainer.checkpoint import load_checkpoint, save_checkpoint
+from nnstreamer_tpu.trainer.subplugin import (JaxTrainer, TRAINER_PROGRAMS,
+                                              _build_mlp, train_plan)
+from nnstreamer_tpu.utils import tracing, xray
+
+
+def _toy(n=24, in_dim=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((in_dim, classes)).astype(np.float32)
+    xs = rng.standard_normal((n, in_dim)).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).astype(np.int32)[:, None]
+    return xs, ys
+
+
+def _write_dataset(tmp_path, n=24, in_dim=4, classes=3, seed=0,
+                   name="data"):
+    xs, ys = _toy(n, in_dim, classes, seed)
+    data = tmp_path / f"{name}.bin"
+    meta = tmp_path / f"{name}.json"
+    with open(data, "wb") as f:
+        for i in range(n):
+            f.write(xs[i].tobytes())
+            f.write(ys[i].tobytes())
+    json.dump(
+        {"dims": f"{in_dim},1", "types": "float32,int32",
+         "total_samples": n, "sample_size": in_dim * 4 + 4},
+        open(meta, "w"))
+    return str(data), str(meta), xs, ys
+
+
+def _params_equal(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def serve_mlp_bundle(opts=None):
+    """A trainable-shaped serving model for the swap tests: the SAME
+    param tree as ``JaxTrainer(model=mlp:4:8:3)``, applied per-vector."""
+    params, apply = _build_mlp([4, 8, 3], seed=0)
+    return ModelBundle(
+        params=params, apply_fn=lambda p, x: apply(p, x[None])[0],
+        in_spec=TensorsSpec.from_string("4", "float32"),
+        out_spec=TensorsSpec.from_string("3", "float32"))
+
+
+SERVE_MODEL = "tests.test_learn:serve_mlp_bundle"
+
+
+def _train_stream(tr, xs, ys, epochs=1, n_valid=0):
+    stats = []
+    n = len(xs)
+    for _ in range(epochs):
+        for i in range(n):
+            tr.push_data([xs[i]], [ys[i]], is_validation=i >= n - n_valid)
+        stats.append(tr.train_epoch())
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# the device-resident streaming trainer
+# ---------------------------------------------------------------------------
+
+class TestStreamingTrainer:
+    def test_streaming_matches_host_accumulated(self):
+        """The device-window streaming path is BIT-IDENTICAL to the
+        legacy host-accumulated epoch (same masked step program): same
+        losses, same params, same step count — including a partial tail
+        window (23 % 8 != 0)."""
+        xs, ys = _toy(23)
+        runs = []
+        for host in (False, True):
+            tr = JaxTrainer()
+            tr.open({"model": "mlp:4:8:3", "learning_rate": 0.05,
+                     "batch_size": 8,
+                     "host_accumulate": "true" if host else "false"})
+            stats = _train_stream(tr, xs, ys, epochs=3)
+            runs.append((tr, stats))
+        (ts, ss), (th, sh) = runs
+        assert [s["training_loss"] for s in ss] == \
+            [s["training_loss"] for s in sh]
+        assert _params_equal(ts.params, th.params)
+        assert ts.step == th.step == 9  # ceil(23/8) x 3
+
+    def test_census_pinned_across_epoch_churn(self):
+        """Append/step/eval each compile EXACTLY once for the stage
+        lifetime — partial tail windows and validation evals reuse the
+        same programs (TRAINER_PROGRAMS census, the PR 10 ring
+        discipline)."""
+        xs, ys = _toy(23)
+        tr = JaxTrainer()
+        tr.open({"model": "mlp:4:8:3", "learning_rate": 0.05,
+                 "batch_size": 8})
+        _train_stream(tr, xs, ys, epochs=4, n_valid=3)
+        # a DIFFERENT validation count (the EOS partial-epoch shape) and
+        # a set bigger than one window must reuse the same masked eval
+        # program — validation chunks through the window shape
+        _train_stream(tr, xs[:20], ys[:20], epochs=1, n_valid=11)
+        counts = tr.compile_counts()
+        assert counts == {"append": 1, "step": 1, "eval": 1}
+        assert len(counts) == TRAINER_PROGRAMS
+
+    @staticmethod
+    def _need_devices(n: int) -> None:
+        import jax
+
+        if len(jax.devices()) < n:
+            pytest.skip(f"needs {n} local devices")
+
+    def test_mesh_data_parallel_trajectory(self):
+        self._need_devices(4)
+        """data:4 training vs single-device: the forward loss of the
+        first step is BIT-identical (per-row math never crosses chips —
+        the PR 3 contract), the 3-epoch loss/param trajectories agree to
+        f32 round-off (the gradient all-reduce sums per-shard partials
+        in a different order than one chip's matmul — a documented
+        1-2 ulp effect, docs/TRAINING.md), and the census stays pinned.
+        A DEGENERATE data:1 mesh is exactly bit-identical."""
+        xs, ys = _toy(24)
+
+        def run(mesh):
+            tr = JaxTrainer()
+            p = {"model": "mlp:4:8:3", "learning_rate": 0.05,
+                 "batch_size": 8}
+            if mesh:
+                p["mesh"] = mesh
+            tr.open(p)
+            losses = []
+            for _ in range(3):
+                for i in range(24):
+                    tr.push_data([xs[i]], [ys[i]], False)
+                losses.append(tr.train_epoch()["training_loss"])
+            return tr, losses
+
+        t0, l0 = run(None)
+        t1, l1 = run("data:1")
+        t4, l4 = run("data:4")
+        # degenerate mesh: exact
+        assert l0 == l1 and _params_equal(t0.params, t1.params)
+        # sharded: first-step forward bit-identical, trajectory f32-tight
+        assert np.float32(l0[0]) == np.float32(l4[0])
+        assert np.allclose(l0, l4, rtol=1e-5, atol=1e-7)
+        import jax
+
+        for a, b in zip(jax.tree_util.tree_leaves(t0.params),
+                        jax.tree_util.tree_leaves(t4.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)
+        assert t4.compile_counts() == {"append": 1, "step": 1, "eval": 0}
+
+    def test_mesh_2d_pspecs_shard_params(self):
+        """(data:2, model:2) training of a ``param_pspecs`` zoo model:
+        pointwise-conv kernels shard over the model axis (per-chip
+        weight HBM halves), the census stays pinned across epochs, and
+        the shard/replica placement counters prove it."""
+        self._need_devices(4)
+        before = metrics.snapshot().get("trainer.param_shards", 0.0)
+        tr = JaxTrainer()
+        tr.open({"model": "mobilenet_v1", "classes": "4", "width": "0.25",
+                 "size": "32", "batch_size": 4, "mesh": "data:2,model:2",
+                 "learning_rate": 0.01})
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 32, 3)).astype(np.float32)
+        for e in range(2):
+            for i in range(4):
+                tr.push_data([x], [np.asarray([i % 4], np.int32)], False)
+            s = tr.train_epoch()
+        assert np.isfinite(s["training_loss"])
+        assert tr.compile_counts() == {"append": 1, "step": 1, "eval": 0}
+        import jax
+
+        specs = {str(getattr(lf, "sharding").spec)
+                 for lf in jax.tree_util.tree_leaves(tr.params)
+                 if hasattr(lf, "sharding")}
+        assert any("model" in s for s in specs), specs
+        snap = metrics.snapshot()
+        assert snap.get("trainer.param_shards", 0.0) > before
+        assert snap.get("trainer.param_replicas", 0.0) > 0
+
+    def test_train_plan_matches_live_state(self):
+        """The static plan (eval_shape-abstracted optax tree) prices the
+        LIVE device-resident training state exactly — the ledger's
+        ratio-1.0 contract."""
+        xs, ys = _toy(16)
+        props = {"model": "mlp:4:8:3", "learning_rate": 0.05,
+                 "batch_size": 8}
+        tr = JaxTrainer()
+        tr.open(dict(props))
+        _train_stream(tr, xs, ys)
+        plan = train_plan(props)
+        assert plan["programs"] == TRAINER_PROGRAMS
+        assert plan["grad_bytes"] == plan["param_bytes"] \
+            == tr.param_nbytes()
+        assert tr.train_state_bytes() == \
+            plan["opt_bytes"] + plan["window_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# durability: step-versioned fsync'd checkpoints, bit-identical resume
+# ---------------------------------------------------------------------------
+
+class TestDurability:
+    def test_save_kill_resume_bit_identical(self, tmp_path):
+        """2 epochs + checkpoint + a FRESH trainer resuming 2 more
+        epochs == 4 epochs straight, bitwise (params, opt moments, step
+        counter) — the killed-pipeline restart contract."""
+        xs, ys = _toy(24)
+        ck = str(tmp_path / "resume.ckpt")
+
+        straight = JaxTrainer()
+        straight.open({"model": "mlp:4:8:3", "learning_rate": 0.05,
+                       "batch_size": 8})
+        _train_stream(straight, xs, ys, epochs=4)
+
+        first = JaxTrainer()
+        first.open({"model": "mlp:4:8:3", "learning_rate": 0.05,
+                    "batch_size": 8})
+        _train_stream(first, xs, ys, epochs=2)
+        first.save(ck)
+
+        resumed = JaxTrainer()
+        resumed.open({"model": "mlp:4:8:3", "learning_rate": 0.05,
+                      "batch_size": 8, "model_load_path": ck})
+        assert resumed.step == first.step
+        _train_stream(resumed, xs, ys, epochs=2)
+        assert resumed.step == straight.step
+        assert _params_equal(resumed.params, straight.params)
+        assert _params_equal(resumed.opt_state, straight.opt_state)
+
+    def test_fsync_checkpoint_atomic(self, tmp_path, monkeypatch):
+        """The portable (no-orbax) path writes tmp → fsync → atomic
+        rename: the roundtrip is exact and no temp sibling survives."""
+        monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+        params = {"a": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        path = str(tmp_path / "ck")
+        got = save_checkpoint(path, params, step=5, fsync=True)
+        back, _, step = load_checkpoint(got)
+        assert step == 5
+        np.testing.assert_array_equal(np.asarray(back["a"]), params["a"])
+        leftovers = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+        assert not leftovers
+
+    def test_element_periodic_step_versioned_checkpoints(self, tmp_path):
+        """``checkpoint-every=1`` writes the primary checkpoint AND a
+        step-versioned sibling per epoch, span-stamped ``learn.ckpt``;
+        ``model-load-path`` resume through the ELEMENT continues where
+        the killed pipeline stopped."""
+        data, meta, xs, ys = _write_dataset(tmp_path, n=16)
+        ck = str(tmp_path / "m.ckpt")
+        desc = (
+            f"datareposrc location={data} json={meta} epochs=2 ! "
+            "tensor_trainer framework=jax model=mlp:4:8:3 "
+            "num-training-samples=16 epochs=2 batch-size=8 "
+            f"learning-rate=0.05 checkpoint-every=1 model-save-path={ck} "
+            "! tensor_sink name=stats")
+        p = nt.Pipeline(desc, trace_mode="ring")
+        with p:
+            for _ in range(2):
+                p.pull("stats", timeout=60)
+            p.wait(timeout=30)
+        # epoch 1's versioned sibling (2 steps of bs=8 over 16 samples)
+        assert os.path.exists(ck) or os.path.exists(ck + ".opt")
+        versioned = [f for f in os.listdir(tmp_path) if ".step" in f]
+        assert versioned, "no step-versioned checkpoint written"
+        kinds = {e.kind for e in tracing.recorder.events()}
+        assert "learn.ckpt" in kinds and "learn.step" in kinds
+
+        params, _, step = load_checkpoint(ck)
+        resumed = nt.Pipeline(
+            f"datareposrc location={data} json={meta} epochs=1 ! "
+            "tensor_trainer framework=jax model=mlp:4:8:3 "
+            "num-training-samples=16 epochs=1 batch-size=8 "
+            f"learning-rate=0.05 model-load-path={ck} "
+            f"model-save-path={ck}.more ! tensor_sink name=stats")
+        with resumed:
+            resumed.pull("stats", timeout=60)
+            resumed.wait(timeout=30)
+        _, _, step2 = load_checkpoint(f"{ck}.more")
+        assert step2 == step + 2  # continued, not restarted
+
+
+# ---------------------------------------------------------------------------
+# train-while-serve: Pipeline.swap_params
+# ---------------------------------------------------------------------------
+
+class TestSwapParams:
+    SERVE_DESC = (
+        "appsrc name=in ! other/tensors,dimensions=4,types=float32 ! "
+        f"tensor_filter framework=jax model={SERVE_MODEL} name=serve ! "
+        "tensor_sink name=out")
+
+    def test_noop_swap_bit_identity_then_update(self):
+        """A no-op swap (same values) leaves serving outputs BITWISE
+        identical; a real swap serves the new weights from the next
+        dispatch; both are VALUE moves — one compiled program, zero
+        census drift under xray."""
+        import jax
+
+        p = nt.Pipeline(self.SERVE_DESC, xray=True)
+        with p:
+            x = np.arange(4, dtype=np.float32)
+            p.push("in", [x])
+            o1 = np.asarray(p.pull("out", timeout=10).tensors[0])
+            fw = p.element("serve").fw
+            clone = jax.tree_util.tree_map(
+                lambda a: np.asarray(a).copy(), fw.bundle.params)
+            v1 = p.swap_params("serve", clone)
+            p.push("in", [x])
+            o2 = np.asarray(p.pull("out", timeout=10).tensors[0])
+            np.testing.assert_array_equal(o1, o2)
+
+            tr = JaxTrainer()
+            tr.open({"model": "mlp:4:8:3", "learning_rate": 0.5,
+                     "batch_size": 4})
+            xs, ys = _toy(8)
+            _train_stream(tr, xs, ys)
+            v2 = p.swap_params("serve", tr.export_params())
+            p.push("in", [x])
+            o3 = np.asarray(p.pull("out", timeout=10).tensors[0])
+            assert not np.array_equal(o1, o3)
+            p.eos()
+            p.wait(timeout=10)
+        assert (v1, v2) == (1, 2)
+        census = xray.registry.census()
+        assert census["serve/stage"]["live_compiles"] == 1
+        assert xray.registry.drift_count() == 0
+
+    def test_swap_mismatch_raises_named(self):
+        p = nt.Pipeline(self.SERVE_DESC)
+        with p:
+            with pytest.raises(PipelineError, match="mismatch"):
+                p.swap_params("serve", {"wrong": np.zeros(3, np.float32)})
+            tr = JaxTrainer()
+            tr.open({"model": "mlp:4:16:3"})  # wrong hidden width
+            with pytest.raises(PipelineError, match="mismatch"):
+                p.swap_params("serve", tr.export_params())
+            p.eos()
+            p.wait(timeout=10)
+
+    def test_swap_on_fused_stage_raises_named(self):
+        """A filter fused into a device chain bakes params into the
+        composed closure — swap refuses with the named remediation
+        instead of silently not taking."""
+        desc = (
+            "appsrc name=in ! other/tensors,dimensions=4,types=float32 ! "
+            "tensor_transform mode=arithmetic option=mul:2.0 ! "
+            f"tensor_filter framework=jax model={SERVE_MODEL} name=serve "
+            "! tensor_sink name=out")
+        p = nt.Pipeline(desc)
+        with p:
+            with pytest.raises(PipelineError, match="fused"):
+                p.swap_params("serve", {})
+            p.eos()
+            p.wait(timeout=10)
+
+    def test_swap_on_batched_stage_raises_named(self):
+        """A micro-batched stage's bucket programs snapshot params into
+        pure_fn closures (the fusion trap's twin) — swap refuses with
+        the named remediation instead of bumping the version while
+        serving stale weights."""
+        p = nt.Pipeline(self.SERVE_DESC, batch_max=4)
+        with p:
+            with pytest.raises(PipelineError, match="micro-batched"):
+                p.swap_params("serve", {})
+            p.eos()
+            p.wait(timeout=10)
+
+    def test_swap_from_checkpoint_path(self, tmp_path):
+        xs, ys = _toy(8)
+        tr = JaxTrainer()
+        tr.open({"model": "mlp:4:8:3", "learning_rate": 0.5,
+                 "batch_size": 4})
+        _train_stream(tr, xs, ys)
+        ck = tr.save(str(tmp_path / "swap.ckpt"))
+        p = nt.Pipeline(self.SERVE_DESC)
+        with p:
+            x = np.arange(4, dtype=np.float32)
+            p.push("in", [x])
+            o1 = np.asarray(p.pull("out", timeout=10).tensors[0])
+            assert p.swap_params("serve", ck) == 1
+            p.push("in", [x])
+            o2 = np.asarray(p.pull("out", timeout=10).tensors[0])
+            assert not np.array_equal(o1, o2)
+            p.eos()
+            p.wait(timeout=10)
+
+    def test_train_while_serve_e2e(self):
+        """THE acceptance pipeline: live traffic tee'd into a trainer
+        branch (inputs + labels via the stream; the serving filter
+        selects the input tensor via input-combination), ``swap-to``
+        hot-swapping refreshed params into the serving stage at every
+        epoch boundary — >= 2 swaps under live traffic, ZERO recompiles
+        on the serving stage (xray census 1 program, drift 0), and
+        post-swap outputs reflect the newly trained params."""
+        desc = (
+            "appsrc name=in ! "
+            "other/tensors,dimensions=4.1,types=float32.int32 ! "
+            "tee name=t "
+            f"t. ! tensor_filter framework=jax model={SERVE_MODEL} "
+            "name=serve input-combination=0 ! tensor_sink name=out "
+            "t. ! tensor_trainer framework=jax model=mlp:4:8:3 "
+            "num-training-samples=8 epochs=3 batch-size=8 "
+            "learning-rate=0.5 swap-to=serve ! tensor_sink name=stats")
+        xs, ys = _toy(24, seed=3)
+        p = nt.Pipeline(desc, xray=True, trace_mode="ring")
+        serve_el = p.element("serve")
+        with p:
+            x0 = np.arange(4, dtype=np.float32)
+            outs = []
+            stats = []
+            for epoch in range(3):
+                for i in range(8):
+                    p.push("in", [xs[epoch * 8 + i], ys[epoch * 8 + i]])
+                stats.append(np.asarray(
+                    p.pull("stats", timeout=60).tensors[0]))
+                # live traffic between epochs: probe the serving stage
+                p.push("in", [x0, np.asarray([0], np.int32)])
+                outs.append(np.asarray(p.pull("out", timeout=30,
+                                              ).tensors[0]))
+                # drain the probe's stats-side copy (the tee feeds both
+                # branches; the trainer banks it toward the next epoch)
+            p.eos()
+            p.wait(timeout=60)
+        assert serve_el._param_version >= 2  # >= 2 swaps landed
+        # the swap changed what the serving stage answers
+        assert not np.array_equal(outs[0], outs[-1])
+        census = xray.registry.census()
+        assert census["serve/stage"]["live_compiles"] == 1
+        assert xray.registry.drift_count() == 0
+        kinds = [e.kind for e in tracing.recorder.events()]
+        assert "learn.swap" in kinds and "learn.step" in kinds
+
+    def test_llm_serve_loop_swap_census(self):
+        """Hot-swap into a LIVE continuous llm serve loop: executed at a
+        chunk boundary, version bumps, streams keep completing, and the
+        3-program census is untouched (zero recompiles)."""
+        from tests.test_elastic import Collector, make_fw
+
+        fw = make_fw()
+        try:
+            c1 = Collector()
+            fw.submit([np.asarray([3, 5, 7], np.int32)], {}, c1)
+            assert c1.done.wait(60)
+            import jax
+
+            loop = fw._serve
+            before = (loop._decode._cache_size(),
+                      loop._prefill._cache_size())
+            clone = jax.tree_util.tree_map(
+                lambda a: np.asarray(a).copy(), fw.bundle.params)
+            assert fw.swap_params(clone) == 1
+            c2 = Collector()
+            fw.submit([np.asarray([3, 5, 7], np.int32)], {}, c2)
+            assert c2.done.wait(60)
+            # greedy + identical weights: the post-swap stream matches
+            assert c2.ids == c1.ids
+            assert (loop._decode._cache_size(),
+                    loop._prefill._cache_size()) == before
+            with pytest.raises(Exception, match="mismatch"):
+                fw.swap_params({"nope": np.zeros(2, np.float32)})
+        finally:
+            fw.close()
+
+
+# ---------------------------------------------------------------------------
+# datarepo epoch-semantics parity
+# ---------------------------------------------------------------------------
+
+class TestDataRepoParity:
+    def test_shuffle_seed_determinism_and_divergence(self, tmp_path):
+        """Epoch k's order is a pure function of (shuffle-seed, k):
+        identical across runs, DIFFERENT across epochs, and a different
+        seed reorders."""
+        data, meta, xs, _ = _write_dataset(tmp_path, n=8)
+
+        def orders(seed, epochs=3):
+            p = nt.Pipeline(
+                f"datareposrc location={data} json={meta} "
+                f"epochs={epochs} is-shuffle=true shuffle-seed={seed} ! "
+                "tensor_sink name=out")
+            got = []
+            with p:
+                for _ in range(8 * epochs):
+                    got.append(p.pull("out", timeout=10).meta)
+                p.wait(timeout=10)
+            return [[m["sample_index"] for m in got[e * 8:(e + 1) * 8]]
+                    for e in range(epochs)]
+
+        a = orders(7)
+        b = orders(7)
+        c = orders(11)
+        assert a == b  # deterministic replay
+        assert a[0] != a[1]  # epochs see different orders
+        assert a != c  # the seed matters
+        for ep in a:
+            assert sorted(ep) == list(range(8))
+
+    def test_manifest_file_list(self, tmp_path):
+        """A ``files`` manifest concatenates shards in list order;
+        relative entries resolve against the meta's directory."""
+        xs, ys = _toy(12)
+        for shard, sl in (("s0", slice(0, 5)), ("s1", slice(5, 12))):
+            with open(tmp_path / f"{shard}.bin", "wb") as f:
+                for i in range(*sl.indices(12)):
+                    f.write(xs[i].tobytes())
+                    f.write(ys[i].tobytes())
+        meta = tmp_path / "set.json"
+        json.dump({"dims": "4,1", "types": "float32,int32",
+                   "sample_size": 20, "files": ["s0.bin", "s1.bin"]},
+                  open(meta, "w"))
+        p = nt.Pipeline(f"datareposrc json={meta} ! tensor_sink name=out")
+        with p:
+            got = [p.pull("out", timeout=10) for _ in range(12)]
+            p.wait(timeout=10)
+        for i, b in enumerate(got):
+            np.testing.assert_array_equal(b.tensors[0], xs[i])
+        # a shard with a torn sample errors, never yields garbage
+        with open(tmp_path / "s1.bin", "ab") as f:
+            f.write(b"\x00" * 3)
+        from nnstreamer_tpu.elements.datarepo import DataRepoSrc
+
+        src = DataRepoSrc({"json": str(meta)})
+        src.configure({}, ["src"])
+        with pytest.raises(Exception, match="whole number"):
+            list(src.generate())
+
+    def test_sink_capture_manifest_replays(self, tmp_path):
+        """datareposink manifest=true capture → datareposrc replay by
+        json= alone (no location prop) → tensor_trainer consumes it:
+        the live-stream capture→train contract."""
+        xs, ys = _toy(16)
+        data = str(tmp_path / "cap.bin")
+        meta = str(tmp_path / "cap.json")
+        cap = nt.Pipeline(
+            f"appsrc name=src ! datareposink location={data} json={meta} "
+            "manifest=true")
+        with cap:
+            for i in range(16):
+                cap.push("src", [xs[i], ys[i]])
+            cap.eos()
+            cap.wait(timeout=30)
+        m = json.load(open(meta))
+        assert m["files"] == ["cap.bin"] and m["total_samples"] == 16
+
+        p = nt.Pipeline(
+            f"datareposrc json={meta} epochs=2 is-shuffle=true ! "
+            "tensor_trainer framework=jax model=mlp:4:8:3 "
+            "num-training-samples=16 epochs=2 batch-size=8 "
+            "learning-rate=0.1 ! tensor_sink name=stats")
+        with p:
+            s = [np.asarray(p.pull("stats", timeout=60).tensors[0])
+                 for _ in range(2)]
+            p.wait(timeout=30)
+        assert s[1][0] < s[0][0]  # it learned from the captured stream
+
+
+# ---------------------------------------------------------------------------
+# observability: stats buffers on the tracing/tenant rails
+# ---------------------------------------------------------------------------
+
+class TestLearnTracing:
+    def test_stats_buffer_rides_trace_and_tenant_rails(self, tmp_path):
+        """Stats buffers inherit the triggering sample's trace id +
+        tenant (so sinks' e2e spans and per-tenant histograms see them)
+        and every epoch records a ``learn.step`` span — trainer
+        emissions join the Perfetto timeline."""
+        data, meta, xs, ys = _write_dataset(tmp_path, n=8)
+        p = nt.Pipeline(
+            f"datareposrc location={data} json={meta} epochs=2 ! "
+            "tensor_trainer framework=jax model=mlp:4:8:3 name=learn "
+            "num-training-samples=8 epochs=2 batch-size=8 "
+            "learning-rate=0.05 ! tensor_sink name=stats",
+            trace_mode="ring", tenant="lab")
+        with p:
+            bufs = [p.pull("stats", timeout=60) for _ in range(2)]
+            p.wait(timeout=30)
+        for b in bufs:
+            assert b.meta.get(tracing.META_TRACE_ID) is not None
+            assert b.meta.get(tracing.META_TENANT) == "lab"
+        steps = [e for e in tracing.recorder.events()
+                 if e.kind == "learn.step" and e.stage == "learn"]
+        assert len(steps) == 2
+        assert all(e.args.get("tenant") == "lab" for e in steps)
+        assert all(e.tid is not None for e in steps)
+        # spans validate into the Chrome dump beside every other stage
+        out = str(tmp_path / "trace.json")
+        assert p.dump_trace(out) > 0
+        assert any(e["name"] == "learn.step"
+                   for e in json.load(open(out))["traceEvents"]
+                   if e.get("ph") == "X")
+
+
+# ---------------------------------------------------------------------------
+# priced and verified: deep lint + nns-xray ledger
+# ---------------------------------------------------------------------------
+
+class TestPricedAndVerified:
+    DESC = ("datareposrc location=/tmp/none.bin json=/tmp/none.json ! "
+            "tensor_trainer framework=jax model=mlp:4:16:3 "
+            "num-training-samples=24 batch-size=8 epochs=3 ! "
+            "tensor_sink name=stats")
+
+    def test_deep_lint_prices_train_state(self):
+        rep = nt.analyze(self.DESC, deep=True)
+        assert rep.clean
+        cats = rep.resources.by_category()
+        plan = train_plan({"model": "mlp:4:16:3", "batch_size": 8})
+        assert cats["train_state"] == \
+            plan["opt_bytes"] + plan["window_bytes"]
+        # gradients price as transient activation-class bytes
+        assert cats["activations"] >= plan["grad_bytes"]
+        assert "train state" in rep.resources.render()
+        stage = next(s for s in rep.resources.stages
+                     if "tensor_trainer" in s.label)
+        assert stage.variants == TRAINER_PROGRAMS
+
+    def test_deep_lint_budget_names_trainer(self):
+        rep = nt.analyze(self.DESC, deep=True, hbm_budget_bytes=512)
+        hits = [d for d in rep.diagnostics if d.code == "hbm-budget"]
+        assert hits and "tensor_trainer" in hits[0].path
+
+    def test_deep_lint_unpriceable_model_warns(self):
+        rep = nt.analyze(
+            "datareposrc location=/tmp/x.bin json=/tmp/x.json ! "
+            "tensor_trainer framework=jax model=mlp:bogus "
+            "num-training-samples=8 ! tensor_sink", deep=True)
+        assert any(d.code == "training-unpriced"
+                   for d in rep.diagnostics)
+
+    def test_xray_ledger_train_state_ratio_one(self, tmp_path):
+        """Live run: the reconciler's ``train_state`` category measures
+        the trainer's actual opt-state + window bytes at ratio ~1.0
+        against the deep-lint estimate, with census drift 0 under epoch
+        churn — the lint predicts, xray verifies."""
+        data, meta, xs, ys = _write_dataset(tmp_path, n=24)
+        p = nt.Pipeline(
+            f"datareposrc location={data} json={meta} epochs=3 ! "
+            "tensor_trainer framework=jax model=mlp:4:16:3 name=learn "
+            "num-training-samples=24 epochs=3 batch-size=8 "
+            "learning-rate=0.05 ! tensor_sink name=stats", xray=True)
+        with p:
+            for _ in range(3):
+                p.pull("stats", timeout=60)
+            p.wait(timeout=30)
+            measured = xray.measure_hbm(p)
+            predicted = xray.predicted_hbm(p)
+        assert predicted["train_state"] > 0
+        ratio = measured["train_state"] / predicted["train_state"]
+        assert ratio == pytest.approx(1.0, rel=0.05)
+        assert xray.registry.drift_count() == 0
+        census = xray.registry.census()
+        for kind in ("append", "step", "eval"):
+            ent = census.get(f"learn.learn/{kind}")
+            assert ent is not None and ent["within"], (kind, ent)

@@ -38,6 +38,12 @@ ROWS = [
     # d2h+concat+h2d round trip per window) vs the device-resident HBM
     # ring (zero d2h between window dispatches, 3-program census)
     ("asr_streaming_window", ["--config", "asr_stream"]),
+    # nns-learn (ISSUE 14): device-resident streaming-window trainer vs
+    # host-accumulated epoch (same masked step program, bit-identical by
+    # test) + the fsync'd checkpoint-resume identity row.  CPU-proxy
+    # caveat in BENCH_LEARN_r01: per-sample append dispatch is the
+    # number to re-measure on silicon, where appends overlap the step.
+    ("train_stream_ab", ["--config", "train_stream"]),
     ("classification", ["--config", "classification"]),
     ("classification_quant", ["--config", "classification_quant"]),
     ("classification_appsrc", ["--config", "classification",

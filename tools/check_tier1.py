@@ -110,6 +110,17 @@ pipeline — census drift must be 0 and every HBM ledger category within
 tolerance — with the deterministic verdict lines pinned strict against
 tools/xray_baseline.txt (``--update`` refreshes it).
 
+AND it runs the learn gate (ISSUE 14, docs/TRAINING.md):
+tests/test_learn.py + tests/test_trainer.py as their own pytest process
+— device-window streaming vs host-accumulated bit-identity, the trainer
+3-program census pins, mesh-sharded trajectories, checkpoint save→kill→
+resume continuation identity, train-while-serve hot-swap with zero
+recompiles on the serving stage — then ``lint --deep`` over
+examples/training.py with ``NNS_TPU_HBM_BUDGET`` pinned below the
+estimate, asserting the resource report prices the trainer's
+optimizer-state + gradient HBM (the "train state" line + the budget
+warning naming it), strict against tools/learn_deep_baseline.txt.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -137,6 +148,7 @@ SERVING_BASELINE = os.path.join(REPO, "tools", "serving_deep_baseline.txt")
 FETCH_BASELINE = os.path.join(REPO, "tools", "fetch_deep_baseline.txt")
 ASR_BASELINE = os.path.join(REPO, "tools", "asr_deep_baseline.txt")
 XRAY_BASELINE = os.path.join(REPO, "tools", "xray_baseline.txt")
+LEARN_BASELINE = os.path.join(REPO, "tools", "learn_deep_baseline.txt")
 
 #: HBM budget the MXU gate pins for the streaming-ASR example's deep
 #: lint: below the estimate, so the hbm-budget warning fires with the
@@ -786,6 +798,80 @@ def run_armor_gate(timeout: int = 900) -> int:
     return 1 if problems else 0
 
 
+#: HBM budget the learn gate pins for the training example's deep lint:
+#: far below the trainer stage's opt-state + window estimate, so the
+#: ``hbm-budget`` warning must fire with "train state" priced into the
+#: resource report — proving optimizer/gradient HBM is actually budgeted
+LEARN_GATE_HBM_BUDGET = "256"
+
+
+def run_learn_gate(update: bool, timeout: int = 900) -> int:
+    """nns-learn gate (ISSUE 14, docs/TRAINING.md): the trainer test
+    files as their own pytest process (streaming-vs-host bit-identity,
+    3-program census pins, mesh trajectories, checkpoint save→kill→
+    resume identity, train-while-serve hot-swap with census drift 0),
+    then ``lint --deep`` over examples/training.py with
+    ``NNS_TPU_HBM_BUDGET`` pinned below the estimate — "train state"
+    must be PRICED — strict against tools/learn_deep_baseline.txt."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_learn.py", "tests/test_trainer.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"learn gate: tests TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"learn gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    # the example's dataset files exist before its pipeline runs in CI
+    # drives elsewhere; the lint itself never opens them
+    prep = subprocess.run(
+        [sys.executable, os.path.join("examples", "training.py"),
+         "--prepare-only"], cwd=REPO, env=env, capture_output=True,
+        text=True, timeout=120)
+    if prep.returncode != 0:
+        print("learn gate: example --prepare-only FAILED", file=sys.stderr)
+        for line in (prep.stdout + prep.stderr).strip().splitlines()[-8:]:
+            print(f"  {line}", file=sys.stderr)
+        return prep.returncode
+
+    env["NNS_TPU_HBM_BUDGET"] = LEARN_GATE_HBM_BUDGET
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--deep", "-v", "--strict",
+           "--files", os.path.join("examples", "training.py"),
+           "--baseline", LEARN_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("learn gate: deep lint TIMED OUT after 300s",
+              file=sys.stderr)
+        return 2
+    priced = "train state" in lint.stdout
+    budgeted = "hbm-budget" in lint.stdout
+    ok = lint.returncode == 0 and priced and budgeted
+    tag = ("updated" if update else
+           "OK" if ok else
+           "TRAIN STATE NOT PRICED" if not priced else
+           "BUDGET NOT ENFORCED" if not budgeted else "NEW DIAGNOSTICS")
+    print(f"learn gate: {tag} ({passed} tests passed)")
+    if not ok and not update:
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_xray_gate(update: bool, timeout: int = 900) -> int:
     """nns-xray gate (ISSUE 13, see module docstring): the predicted-vs-
     actual test file as its own pytest process, then the doctor CLI on
@@ -870,9 +956,10 @@ def main() -> int:
     elastic_rc = run_elastic_gate()
     armor_rc = run_armor_gate()
     xray_rc = run_xray_gate(args.update)
+    learn_rc = run_learn_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
                or mxu_rc or serving_rc or fetch_rc or soak_rc
-               or elastic_rc or armor_rc or xray_rc)
+               or elastic_rc or armor_rc or xray_rc or learn_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
