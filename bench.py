@@ -556,7 +556,9 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int,
 
 
 def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
-                          streams: int, model: str, quant: str) -> dict:
+                          streams: int, model: str, quant: str,
+                          shared_prefix: int = 0, draft: str = "",
+                          spec_k: int = 4) -> dict:
     """Continuous batching: stagger ``streams`` prompts into the RUNNING
     decode loop; report aggregate tokens/sec plus the late joiner's
     first-token latency (the metric continuous batching exists for —
@@ -579,16 +581,27 @@ def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
         return b
     tagged.n = 0
 
+    # prefix-sharing rows: every prompt = one shared preamble + its own
+    # suffix (docs/SERVING.md §4b) — joiners after stream 0's prefill
+    # hit the prefix cache, so their admission reservation and
+    # first-token prefill collapse to ~the suffix
+    pre = (rng.integers(1, 400, (shared_prefix,), dtype=np.int32)
+           if shared_prefix else None)
+
+    def prompt():
+        suf = rng.integers(1, 400, (prompt_len,), dtype=np.int32)
+        return suf if pre is None else np.concatenate([pre, suf])
+
+    from nnstreamer_tpu.core.log import metrics as _metrics
+    snap0 = _metrics.snapshot()
+
     with p:
-        p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
-                                          dtype=np.int32)))
+        p.push("src", tagged(prompt()))
         first = p.pull("out", timeout=2100)  # stream 0 live (+compile)
         t_join = time.monotonic()
-        p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
-                                          dtype=np.int32)))
+        p.push("src", tagged(prompt()))
         for _ in range(streams - 2):
-            p.push("src", tagged(rng.integers(1, 400, (prompt_len,),
-                                              dtype=np.int32)))
+            p.push("src", tagged(prompt()))
         total = streams * max_new - 1
         bufs = [p.pull("out", timeout=900) for _ in range(total)]
         p.eos()
@@ -630,9 +643,11 @@ def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
         # together; the gap between bursts is one chunk's decode time)
         gaps = np.diff(np.asarray(s0[:17]))
         chunk_ms = float(np.max(gaps)) * 1e3
-    return {
+    row = {
         "metric": (f"{model}_{quant or 'bf16'}_continuous_tokens_per_sec"
-                   f"_{streams}_streams"),
+                   f"_{streams}_streams"
+                   + (f"_prefix{shared_prefix}" if shared_prefix else "")
+                   + (f"_spec_k{spec_k}" if draft else "")),
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tps / 20.0, 3),
@@ -644,6 +659,25 @@ def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
         "full_occupancy_tokens_per_sec": round(occ_tps, 1),
         "wall_s": round(wall, 3),
     }
+    snap1 = _metrics.snapshot()
+
+    def delta(name):
+        return snap1.get(name, 0.0) - snap0.get(name, 0.0)
+
+    if shared_prefix:
+        row["shared_prefix"] = shared_prefix
+        row["prefix_hits"] = int(delta("llm.serve.prefix_hits"))
+        row["prefix_hit_blocks"] = int(delta("llm.serve.prefix_hit_blocks"))
+        row["cow_forks"] = int(delta("llm.serve.cow_forks"))
+    if draft:
+        acc = delta("llm.serve.spec_accepted")
+        rej = delta("llm.serve.spec_rejected")
+        row["spec_draft"] = draft
+        row["spec_k"] = spec_k
+        row["spec_accept_rate"] = round(acc / (acc + rej), 3) \
+            if acc + rej else 0.0
+        row["spec_rounds"] = int(delta("llm.serve.spec_rounds"))
+    return row
 
 
 def bench_segmentation(batch: int, batches: int, size: int,
@@ -788,7 +822,9 @@ def _text_vocab_file(model: str) -> str:
 def bench_llm(batches: int, warmup: int, model: str = "llama_small",
               max_new: int | None = None, prompt_len: int = 32,
               quant: str = "", streams: int = 1,
-              serve: str = "", text: bool = False) -> dict:
+              serve: str = "", text: bool = False,
+              shared_prefix: int = 0, draft: str = "",
+              spec_k: int = 4) -> dict:
     """Config #5: tokens/sec through the llm filter (jitted prefill +
     lax.scan decode).  vs_baseline compares against the reference's
     llama.cpp CPU path order of magnitude (~20 tok/s).
@@ -803,6 +839,11 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     import nnstreamer_tpu as nt
 
     rng = np.random.default_rng(0)
+    if (shared_prefix or draft) and serve != "continuous":
+        # both rows only exist on the serve loop; silently dropping the
+        # flags would record a mislabeled plain-decode artifact
+        raise SystemExit("--llm-prefix/--llm-draft require "
+                         "--llm-serve continuous")
     if max_new is None:
         # continuous default decodes longer so the steady full-occupancy
         # phase dominates the stagger ramp in the headline window (the
@@ -816,7 +857,8 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         # so size it to the workload — 8 streams at max_seq:1024 blew a
         # 16 GB chip's HBM by 0.2 GB on the cache copies alone.
         max_seq = (1024 if streams == 1 and serve != "continuous"
-                   else max(256, 1 << (prompt_len + max_new).bit_length()))
+                   else max(256, 1 << (shared_prefix + prompt_len
+                                       + max_new).bit_length()))
         # continuous serving shortens the chunk: admission is quantized
         # to chunk boundaries, so 8 tokens (~150 ms at 7B int8) bounds a
         # late joiner's wait while the per-chunk roundtrip overhead stays
@@ -849,10 +891,15 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         # max_seq-worst-case pool at x64 would hold ~1.6x the HBM for
         # rows no stream can ever write.
         block_size = 16
-        need = -(-(prompt_len + max_new) // block_size)
+        full_len = shared_prefix + prompt_len
+        need = -(-(full_len + max_new) // block_size)
         custom += (f",serve:continuous,slots:{n_streams}"
                    f",block_size:{block_size}"
                    f",kv_blocks:{n_streams * need}")
+        if draft:
+            # speculative decoding (docs/SERVING.md §4c): greedy-only,
+            # preset draft priced beside the target
+            custom += f",draft:{draft},spec_k:{spec_k},temperature:0.0"
     # invoke-dynamic only for the continuous path: the committed static
     # rows were measured without it, and it must stay that way so this
     # commit reproduces the artifact's exact pipelines.  The '!' before
@@ -870,7 +917,9 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     p = nt.Pipeline(desc)
     if serve == "continuous":
         return _bench_llm_continuous(p, rng, max_new, prompt_len,
-                                     n_streams, model, quant)
+                                     n_streams, model, quant,
+                                     shared_prefix=shared_prefix,
+                                     draft=draft, spec_k=spec_k)
     toks = 0
     with p:
         # streams>1: N concurrent prompts decode in ONE lax.scan loop.
@@ -916,6 +965,146 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         "max_new": max_new,
         "prompt_len": prompt_len,
         "wall_s": round(wall, 3),
+    }
+
+
+def bench_prefix_spec(batches: int, warmup: int,
+                      model: str = "llama_small",
+                      prefix_len: int = 512, suffix_len: int = 8,
+                      spec_k: int = 4) -> dict:
+    """ISSUE 15 A/B: prefix-sharing admission-to-first-token + the
+    speculative-decoding round structure (docs/SERVING.md §4b/§4c).
+
+    Arm 1 (prefix): serial shared-prefix streams against a warm
+    continuous loop, ``prefix_cache:1`` vs ``prefix_cache:0`` — the
+    cache-hit arm prefills only the non-shared suffix, so
+    admission-to-first-token collapses (the ≥5x tentpole target; this
+    IS visible on the CPU proxy, where prefill chunks are real compute).
+
+    Arm 2 (speculation): decode tok/s with ``draft:<same preset>``
+    (identical params → accept rate 1, the trained-draft agreement
+    CEILING) vs plain decode.  The CPU proxy can NOT show the silicon
+    win: the same-preset draft's propose steps cost exactly one
+    target-step each here, while on silicon the row's real draft
+    (llama_tiny vs 7B int8, llm7b_spec_k4) reads ~0.2% of the target's
+    HBM bytes per step — the roofline projection
+    ``(accept*k + 1) / (1 + k*draft_cost_ratio)`` rides the row
+    (BENCH_LEARN_r01 precedent: proxy number + silicon rationale)."""
+    import numpy as np
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics as _metrics
+    from nnstreamer_tpu.models import llama as _llama
+
+    rng = np.random.default_rng(0)
+    max_new = 16
+    base = (f"max_new:{max_new},serve:continuous,slots:2,stream_chunk:2,"
+            f"temperature:0.0,block_size:16,prefill_chunk:32,kv_blocks:0")
+    pre = rng.integers(1, 400, (prefix_len,), dtype=np.int32)
+
+    def admission_ms(prefix_cache: int) -> float:
+        desc = ("appsrc name=src ! "
+                f"tensor_filter framework=llm model={model} "
+                f"custom={base},prefix_cache:{prefix_cache} "
+                "invoke-dynamic=true ! tensor_sink name=out")
+        lat = []
+        with nt.Pipeline(desc) as p:
+            # stream 0: compile warm-up + (hit arm) cache population
+            p.push("src", np.concatenate(
+                [pre, rng.integers(1, 400, (suffix_len,), np.int32)]))
+            for _ in range(max_new):
+                p.pull("out", timeout=2100)
+            for i in range(warmup + batches):
+                prompt = np.concatenate(
+                    [pre, rng.integers(1, 400, (suffix_len,), np.int32)])
+                t0 = time.monotonic()
+                p.push("src", prompt)
+                bufs = [p.pull("out", timeout=900)
+                        for _ in range(max_new)]
+                if i >= warmup:
+                    first = next(b for b in bufs
+                                 if b.meta["stream_index"] == 0)
+                    lat.append((first.meta["emit_t"] - t0) * 1e3)
+            p.eos()
+            p.wait(timeout=60)
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    hit_ms = admission_ms(1)
+    cold_ms = admission_ms(0)
+
+    # -- arm 2: speculation round structure --------------------------------
+    spec_new, streams, plen = 64, 2, 12
+
+    def decode_tps(spec: bool) -> tuple:
+        extra = f",draft:{model},spec_k:{spec_k}" if spec else ""
+        desc = ("appsrc name=src ! "
+                f"tensor_filter framework=llm model={model} "
+                f"custom=max_new:{spec_new},serve:continuous,slots:"
+                f"{streams},stream_chunk:4,temperature:0.0,block_size:16,"
+                f"kv_blocks:0,prefix_cache:0{extra} "
+                "invoke-dynamic=true ! tensor_sink name=out")
+        a0 = _metrics.snapshot().get("llm.serve.spec_accepted", 0.0)
+        r0 = _metrics.snapshot().get("llm.serve.spec_rejected", 0.0)
+        with nt.Pipeline(desc) as p:
+            p.push("src", rng.integers(1, 400, (plen,), np.int32))
+            first = p.pull("out", timeout=2100)  # compile + stream 0 live
+            for _ in range(streams - 1):
+                p.push("src", rng.integers(1, 400, (plen,), np.int32))
+            bufs = [p.pull("out", timeout=900)
+                    for _ in range(streams * spec_new - 1)]
+            p.eos()
+            p.wait(timeout=60)
+        emits = sorted(b.meta["emit_t"] for b in bufs)
+        wall = emits[-1] - first.meta["emit_t"]
+        snap = _metrics.snapshot()
+        acc = snap.get("llm.serve.spec_accepted", 0.0) - a0
+        rej = snap.get("llm.serve.spec_rejected", 0.0) - r0
+        rate = acc / (acc + rej) if acc + rej else 0.0
+        return len(emits) / wall, rate
+
+    plain_tps, _ = decode_tps(False)
+    spec_tps, accept_rate = decode_tps(True)
+
+    # silicon roofline projection for the REAL row (llm7b_spec_k4:
+    # llama_tiny draft against the int8 7B target): per decode step the
+    # draft reads its own params, the target reads quantized params —
+    # cost ratio c from the same estimates serving_plan() prices
+    tiny = _llama.PRESETS["llama_tiny"]
+    big = _llama.PRESETS["llama2_7b"]
+    c = (_llama.param_bytes_estimate(tiny, param_dtype="float32")
+         / _llama.param_bytes_estimate(big, quant="int8",
+                                       param_dtype="bfloat16"))
+    projected = {
+        f"accept_{int(a * 100)}": round((a * spec_k + 1)
+                                        / (1 + spec_k * c), 2)
+        for a in (0.5, 0.7, 0.9)}
+
+    speedup = cold_ms / hit_ms if hit_ms else 0.0
+    return {
+        "metric": f"{model}_prefix_hit_admission_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup / 5.0, 3),  # the ≥5x tentpole bar
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "admission_first_token_hit_ms": round(hit_ms, 1),
+        "admission_first_token_cold_ms": round(cold_ms, 1),
+        "spec_tokens_per_sec": round(spec_tps, 1),
+        "plain_tokens_per_sec": round(plain_tps, 1),
+        "spec_speedup_vs_plain": round(spec_tps / plain_tps, 3)
+        if plain_tps else 0.0,
+        "spec_k": spec_k,
+        "spec_accept_rate": round(accept_rate, 3),
+        "spec_draft_cost_ratio_7b_int8": round(c, 4),
+        "spec_projected_speedup_7b": projected,
+        "spec_proxy_caveat": (
+            "same-preset draft on the CPU proxy: every propose step "
+            "costs one full target step, so the measured ratio is the "
+            "structural floor — the silicon row (llm7b_spec_k4, "
+            "llama_tiny draft vs int8 7B) pays ~{:.2%} of the target's "
+            "HBM bytes per draft step; projection = "
+            "(accept*k+1)/(1+k*cost_ratio)".format(c)),
     }
 
 
@@ -1769,7 +1958,8 @@ def main() -> int:
                              "detection", "pose", "segmentation", "audio",
                              "llm", "llm7b", "link", "batching", "adaptive",
                              "asr_stream", "train_stream", "sharded",
-                             "tp", "tp_grid", "fetch", "all"])
+                             "tp", "tp_grid", "fetch", "prefix_spec",
+                             "all"])
     # classification defaults to 256: the r3 on-chip session measured 2x
     # the fps AND 2x the MFU of batch 64 (30,137 fps / 0.175 MFU vs
     # 15,116 / 0.088) at a still-interactive 5.4 ms p50 — deeper batches
@@ -1789,6 +1979,16 @@ def main() -> int:
     ap.add_argument("--llm-streams", type=int, default=1,
                     help="concurrent prompts decoded in one batched scan "
                          "(aggregate tokens/sec reported)")
+    ap.add_argument("--llm-prefix", type=int, default=0,
+                    help="llm/llm7b continuous: every stream's prompt "
+                         "shares an N-token prefix (prefix-sharing row; "
+                         "0 = independent prompts)")
+    ap.add_argument("--llm-draft", default="",
+                    help="llm/llm7b continuous: speculative-decoding "
+                         "draft preset (e.g. llama_tiny)")
+    ap.add_argument("--llm-spec-k", type=int, default=4,
+                    help="proposals per speculative round (with "
+                         "--llm-draft)")
     ap.add_argument("--llm-serve", default="", choices=["", "continuous"],
                     help="continuous: staggered prompts join a RUNNING "
                          "decode loop (reports late-join latency too)")
@@ -1862,6 +2062,8 @@ def main() -> int:
                    "tokens_per_sec", "tokens/sec"),
             "tp_grid": ("sharded_grid_dp2xtp2_vs_dp4_fps", "frames/sec"),
             "fetch": ("async_fetch_speedup_depth2_donate_vs_serial", "x"),
+            "prefix_spec": ("llama_small_prefix_hit_admission_speedup",
+                            "x"),
         }
         todo = (["classification", "detection", "pose", "segmentation",
                  "audio", "llm"]
@@ -1911,12 +2113,18 @@ def main() -> int:
                                  quant=args.llm_quant,
                                  streams=args.llm_streams,
                                  serve=args.llm_serve,
-                                 text=args.llm_text),
+                                 text=args.llm_text,
+                                 shared_prefix=args.llm_prefix,
+                                 draft=args.llm_draft,
+                                 spec_k=args.llm_spec_k),
         "llm7b": lambda: bench_llm(2, 1, model="llama2_7b",
                                    quant=args.llm_quant,
                                    streams=args.llm_streams,
                                    serve=args.llm_serve,
-                                   text=args.llm_text),
+                                   text=args.llm_text,
+                                   shared_prefix=args.llm_prefix,
+                                   draft=args.llm_draft,
+                                   spec_k=args.llm_spec_k),
         "link": bench_link,
         "batching": lambda: bench_batching(args.batches, args.warmup),
         "adaptive": lambda: bench_adaptive(args.batches, args.warmup),
@@ -1928,6 +2136,9 @@ def main() -> int:
                                model=args.llm_model, ways=args.tp_ways),
         "tp_grid": lambda: bench_tp_grid(args.batches, args.warmup),
         "fetch": lambda: bench_fetch(args.batches, args.warmup),
+        "prefix_spec": lambda: bench_prefix_spec(
+            max(4, args.batches // 16), args.warmup,
+            model=args.llm_model, spec_k=args.llm_spec_k),
     }
     todo = list(runners) if args.config == "all" else [args.config]
     if args.config == "all":

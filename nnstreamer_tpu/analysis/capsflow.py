@@ -237,6 +237,11 @@ def _check_demux_arity(graph, out_caps) -> List[Diagnostic]:
     for node in graph.nodes.values():
         if node.kind != "tensor_demux":
             continue
+        if str(node.props.get("by-meta", node.props.get("by_meta", ""))):
+            # meta routing forwards the WHOLE buffer to one pad chosen
+            # by a meta value: every pad can emit, the per-tensor arity
+            # rule does not apply
+            continue
         ins = graph.in_edges(node.id)
         if not ins:
             continue

@@ -88,6 +88,15 @@ class StageResource:
     #: resident for the stage's lifetime; the transient per-step
     #: gradient tree is priced into act_row_bytes instead
     train_bytes: int = 0
+    #: speculative-decoding draft model (continuous LLM serving,
+    #: custom=draft:<preset>): INFORMATIONAL split of bytes ALREADY
+    #: counted in param_bytes / pool_bytes above — the draft's params
+    #: and its block pool (which shares the target allocator's
+    #: n_blocks/block_size at the draft's own geometry).  Rendered in
+    #: the report so "the draft is priced" is visible and gateable;
+    #: excluded from hbm_bytes/by_category to avoid double counting.
+    draft_param_bytes: int = 0
+    draft_pool_bytes: int = 0
 
     @property
     def hbm_bytes(self) -> int:
@@ -174,9 +183,14 @@ class ResourceReport:
             flags = "".join(
                 f for f, on in (("B", s.batchable), ("S", s.sharded)) if on)
             lines.append(
-                f"  {s.label}: params {_mib(s.param_bytes)}, "
-                + (f"kv pool {_mib(s.pool_bytes)}, " if s.pool_bytes
-                   else "")
+                f"  {s.label}: params {_mib(s.param_bytes)}"
+                + (f" (draft params {_mib(s.draft_param_bytes)})"
+                   if s.draft_param_bytes else "")
+                + ", "
+                + (f"kv pool {_mib(s.pool_bytes)}"
+                   + (f" (draft pool {_mib(s.draft_pool_bytes)})"
+                      if s.draft_pool_bytes else "")
+                   + ", " if s.pool_bytes else "")
                 + (f"agg ring {_mib(s.ring_bytes)}, " if s.ring_bytes
                    else "")
                 + (f"train state {_mib(s.train_bytes)}, " if s.train_bytes
@@ -484,19 +498,39 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
             prefill_chunk=max(1, int(opts.get("prefill_chunk", 32))),
         )
         int(opts.get("stream_chunk", 8))  # the decode chunk length
+        spec_k = max(1, int(opts.get("spec_k", 4)))
     except (TypeError, ValueError):
         diags.append(Diagnostic(
             "recompile-unbounded", WARNING,
             "continuous decode signature depends on unresolvable serving "
-            "knobs (slots/block_size/prefill_chunk/stream_chunk must be "
-            "integer literals) — the compiled-variant census cannot "
-            "bound this stage",
+            "knobs (slots/block_size/prefill_chunk/stream_chunk/spec_k "
+            "must be integer literals) — the compiled-variant census "
+            "cannot bound this stage",
             path=label, pos=node.pos))
         return True
+    # Speculative decoding: the draft's params + its block pool (same
+    # allocator geometry as the target's) are resident for the stage
+    # lifetime — price them with the SAME shared arithmetic the loop
+    # sizes with (serving_plan), and the program census grows 3 -> 5
+    # (target/draft prefill, propose, verify, slot-token setter).
+    draft_name = str(opts.get("draft", "") or "")
+    draft_cfg = None
+    if draft_name:
+        draft_cfg = llama.resolve_config(draft_name, {
+            "vocab": cfg.vocab, "max_seq": cfg.max_seq})
+        if draft_cfg is None:
+            diags.append(Diagnostic(
+                "serving-unpriced", WARNING,
+                f"draft model {draft_name!r} cannot be resolved "
+                "statically — the llm filter's open() only accepts "
+                "preset zoo names for draft: and will fail; the draft "
+                "params/pool cannot be priced",
+                path=label, pos=node.pos))
     from ..filters.llm import serving_plan
 
     dtype = str(opts.get("dtype", "bfloat16"))
-    plan = serving_plan(cfg, dtype=dtype, **plan_kw)
+    plan = serving_plan(cfg, dtype=dtype, draft_cfg=draft_cfg,
+                        spec_k=spec_k, **plan_kw)
     quant = str(opts.get("quant", "")).lower()
     param_dtype = str(opts.get("param_dtype", "float32"))
     # Tensor parallelism: the pipeline's resolved model axis, with the
@@ -511,8 +545,16 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
     params = llama.param_bytes_estimate(cfg, quant=quant,
                                         param_dtype=param_dtype)
     pool = plan["pool_bytes"]
+    draft_params = (llama.param_bytes_estimate(
+        draft_cfg, param_dtype=param_dtype)
+        if draft_cfg is not None else 0)
+    draft_pool = plan["draft_pool_bytes"]
     if ways > 1:
         problems = llama.tp_divisibility_problems(cfg, ways)
+        if draft_cfg is not None:
+            problems += [
+                f"draft {p}" for p in
+                llama.tp_divisibility_problems(draft_cfg, ways)]
         if problems:
             # open() raises the same arithmetic at runtime — surface it
             # statically with the dims named
@@ -525,21 +567,32 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
         else:
             # per-chip pricing: sheared leaves (the big mats + lm_head)
             # divide by M, embed/norms replicate; the paged KV pool
-            # shards its head dim, so pool bytes divide too
+            # shards its head dim, so pool bytes divide too — target
+            # and draft alike
             shard, repl = llama.param_bytes_split(cfg, quant=quant,
                                                   param_dtype=param_dtype)
             params = shard // ways + repl
             pool = pool // ways
+            if draft_cfg is not None:
+                dsh, drep = llama.param_bytes_split(
+                    draft_cfg, param_dtype=param_dtype)
+                draft_params = dsh // ways + drep
+                draft_pool = draft_pool // ways
     # Per-slot in-flight activations of the decode step: the f32 logits
-    # row dominates ([vocab] per slot per scan step), plus the hidden
-    # state at a couple of residencies — a deliberate over-estimate that
-    # stays O(vocab + dim), nowhere near pool/param scale.
-    act_row = 4 * cfg.vocab + 8 * cfg.dim
+    # row dominates ([vocab] per slot per scan step — the k+1-wide
+    # verify step multiplies it by spec_k+1 under speculation), plus
+    # the hidden state at a couple of residencies — a deliberate
+    # over-estimate that stays O(vocab + dim), nowhere near pool/param
+    # scale.
+    act_row = (4 * cfg.vocab * (spec_k + 1 if draft_cfg is not None
+                                else 1) + 8 * cfg.dim)
     return StageResource(
-        label=label, param_bytes=params, act_row_bytes=act_row,
+        label=label, param_bytes=params + draft_params,
+        act_row_bytes=act_row,
         rows_per_device=slots, variants=plan["programs"],
         batchable=False, shard_eligible=False, sharded=ways > 1,
-        pos=node.pos, pool_bytes=pool)
+        pos=node.pos, pool_bytes=pool + draft_pool,
+        draft_param_bytes=draft_params, draft_pool_bytes=draft_pool)
 
 
 def _trainer_stage(node, diags, model_par: int = 1):

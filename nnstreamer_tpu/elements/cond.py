@@ -6,6 +6,12 @@ indices), supplied-value, operator (EQ/NE/GT/GE/LT/LE/RANGE_*), then/else
 actions (PASSTHROUGH / SKIP / TENSORPICK), plus registerable custom
 condition callbacks (reference: nnstreamer_if_custom API).
 
+TPU-native extension: ``compared_value=META_VALUE`` gates on a buffer
+META key (compared_value_option names it) instead of tensor contents —
+zero D2H, the routing surface for per-buffer flags stamped by upstream
+stages (e.g. the LLM serve loop's speculative ``spec_draft``
+accept/reject flag, docs/SERVING.md §4c).
+
 Pads: ``src_0`` receives the THEN result, ``src_1`` (optional) the ELSE
 result; with only one src pad linked, else falls back to SKIP semantics on
 that pad (matching the common upstream usage of tensor_if as a gate).
@@ -61,6 +67,7 @@ class TensorIf(Element):
         sv = str(self.props.get("supplied_value", "0"))
         self.supplied = [float(v) for v in sv.split(":") if v != ""]
         self.then_action = str(self.props.get("then", "PASSTHROUGH")).upper()
+        self._else_explicit = "else" in self.props
         self.else_action = str(self.props.get("else", "SKIP")).upper()
         self.then_pick = _parse_pick(self.props.get("then_option"))
         self.else_pick = _parse_pick(self.props.get("else_option"))
@@ -73,6 +80,11 @@ class TensorIf(Element):
         src = next(iter(in_caps.values()), Caps.any())
         self.out_caps = {p: src for p in out_pads}
         self._pads = sorted(out_pads)
+        # two linked src pads: ELSE results flow to src_1 unless the
+        # user asked for something explicitly (single-pad default stays
+        # SKIP — the upstream gate idiom)
+        if not self._else_explicit and len(self._pads) > 1:
+            self.else_action = "PASSTHROUGH"
         return self.out_caps
 
     # -- condition ---------------------------------------------------------
@@ -95,6 +107,22 @@ class TensorIf(Element):
         elif self.compared_value == "TENSOR_AVERAGE_VALUE":
             t_idx = int(self.cv_option or 0)
             value = float(np.asarray(buf.tensors[t_idx]).astype(np.float64).mean())
+        elif self.compared_value == "META_VALUE":
+            # Buffer-meta gate: compared_value_option names the meta key
+            # (absent keys read 0).  The pipeline-native home for
+            # routing on per-buffer decisions an upstream stage stamped
+            # — e.g. the continuous LLM serve loop's speculative
+            # accept/reject flag ``spec_draft`` (docs/SERVING.md §4c):
+            # META_VALUE + operator=GE + supplied_value=1 gates
+            # accepted-draft tokens.  Reads NO tensors: device-resident
+            # buffers route without a D2H copy.
+            raw = buf.meta.get(self.cv_option or "", 0)
+            try:
+                value = float(raw if raw is not None else 0)
+            except (TypeError, ValueError) as e:
+                raise ElementError(
+                    f"tensor_if META_VALUE key {self.cv_option!r} holds "
+                    f"non-numeric {raw!r}") from e
         else:
             raise ElementError(f"unknown compared_value {self.compared_value!r}")
         op = _OPERATORS[self.operator]

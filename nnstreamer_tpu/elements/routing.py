@@ -217,6 +217,15 @@ class TensorDemux(Element):
 
     ``tensorpick="0,2"`` selects tensors; out pads are src_0.. in pick order
     (reference: gsttensor_demux.c tensorpick property).
+
+    ``by-meta=<key>`` switches to META ROUTING: the WHOLE buffer goes to
+    pad ``src_<int(meta[key])>`` (absent/invalid key -> src_0), tensors
+    untouched.  This is the pipeline-native home for per-buffer routing
+    decisions an upstream stage stamped as meta — e.g. the continuous
+    LLM serve loop's speculative accept/reject flag (``spec_draft`` —
+    accepted-draft tokens route to src_1, target-sampled ones to src_0;
+    docs/SERVING.md §4c).  Routing reads meta only: device-resident
+    tensors never materialize here.
     """
 
     kind = "tensor_demux"
@@ -226,6 +235,8 @@ class TensorDemux(Element):
         super().__init__(props, name)
         pick = str(self.props.get("tensorpick", ""))
         self.pick = [int(v) for v in pick.split(",") if v != ""] if pick else None
+        self.by_meta = str(self.props.get("by-meta",
+                                          self.props.get("by_meta", "")))
 
     def configure(self, in_caps, out_pads):
         self.in_caps = dict(in_caps)
@@ -234,6 +245,11 @@ class TensorDemux(Element):
         self.out_caps = {}
         pads = sorted(out_pads, key=_pad_index)
         for i, p in enumerate(pads):
+            if self.by_meta:
+                # meta routing passes the whole buffer through: every
+                # pad carries the input spec unchanged
+                self.out_caps[p] = src
+                continue
             sub = None
             if spec is not None:
                 idx = self.pick[i] if self.pick else i
@@ -243,8 +259,15 @@ class TensorDemux(Element):
         return self.out_caps
 
     def process(self, pad, buf: Buffer):
-        outs = []
         pads = sorted(self.out_caps, key=_pad_index)
+        if self.by_meta:
+            try:
+                idx = int(buf.meta.get(self.by_meta, 0) or 0)
+            except (TypeError, ValueError):
+                idx = 0
+            idx = max(0, min(idx, len(pads) - 1))
+            return [(pads[idx], buf)]
+        outs = []
         for i, p in enumerate(pads):
             idx = self.pick[i] if self.pick else i
             if idx >= len(buf.tensors):

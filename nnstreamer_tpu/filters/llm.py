@@ -79,7 +79,8 @@ def _next_bucket(t: int) -> int:
 
 def serving_plan(cfg, *, slots: int, block_size: int = 16,
                  kv_blocks: int = 0, prefill_chunk: int = 32,
-                 dtype: str = "bfloat16") -> Dict[str, int]:
+                 dtype: str = "bfloat16", draft_cfg=None,
+                 spec_k: int = 4) -> Dict[str, int]:
     """Static sizing of the paged-KV serving state, WITHOUT building
     anything — one home for the arithmetic :class:`_ContinuousLoop` and
     the deep lint's resource report (analysis/tracecheck.py) must agree
@@ -92,17 +93,31 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
       padded prompt (its final chunk's END position), not just
       ``max_seq`` — otherwise that chunk's context length would clamp to
       zero mid-prefill.  The extra entries stay sentinel forever.
+      (Prefix sharing keeps this bound: a cache-hit prompt starts its
+      suffix prefill at a ``prefill_chunk`` multiple, so the padded END
+      position never exceeds the cold-path's.)
     * ``n_blocks`` — pool size.  ``kv_blocks`` 0 = worst case
       (``slots * ceil(max_seq/block_size)``: admission never defers on
       blocks); larger is clamped (a slot can't use more than its table).
     * ``pool_bytes`` — HBM the k+v block pool occupies
       (:func:`~nnstreamer_tpu.models.llama.paged_cache_bytes`).
+    * ``draft_pool_bytes`` — the draft model's block pool when
+      speculative decoding is configured (``draft_cfg`` non-None): the
+      draft shares the allocator, block tables, and ``n_blocks`` with
+      the target, so its pool is the same geometry at the draft's
+      (L, H_kv, hd) — 0 without a draft.
     * ``programs`` — compiled XLA signatures the standing loop ever
-      uses: the ``[slots]``-row paged decode chunk, the
-      ``[1, prefill_chunk]`` prefill step, and the slot-token setter.
-      Every shape is static in admission state — stream join/leave/
-      complete changes VALUES only — which is why this census is CLOSED
-      (the compile-counter pin in tests/test_llm_continuous.py).
+      uses.  Without speculation: the ``[slots]``-row paged decode
+      chunk, the ``[1, prefill_chunk]`` prefill step, and the slot-token
+      setter (3).  With a draft model the decode chunk is REPLACED by
+      the propose/verify pair and the draft gets its own prefill step:
+      target prefill, draft prefill, draft propose (k draft steps + the
+      refresh step as ONE scan), target verify (a ``[slots, k+1]``-wide
+      paged step), and the slot-token setter (5).  Every shape is
+      static in admission state — stream join/leave/complete AND
+      accept/reject ratios change VALUES only — which is why this
+      census is CLOSED (the compile-counter pins in
+      tests/test_llm_continuous.py and tests/test_spec_decode.py).
     """
     import math
 
@@ -111,7 +126,17 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
     bs = max(1, int(block_size))
     C = max(1, int(prefill_chunk))
     pad_max = math.ceil((cfg.max_seq - 1) / C) * C
-    max_blocks = math.ceil(max(cfg.max_seq, pad_max) / bs)
+    # Speculation: the final rounds dispatch the fixed [slots, k+1]-wide
+    # verify (and the k-step propose scan) even when fewer tokens remain,
+    # so positions reach up to max_seq-1 + k.  The table must SPAN them
+    # or forward_paged's stale-table clamp zeroes the whole row's context
+    # and the committed tokens go bit-wrong near max_seq.  The extra
+    # entries stay sentinel: overrun writes drop, and causal masking
+    # keeps every COMMITTED token's logits independent of the dropped
+    # tail — bit-identity holds right up to the last token.
+    seq_span = cfg.max_seq + (max(1, int(spec_k))
+                              if draft_cfg is not None else 0)
+    max_blocks = math.ceil(max(seq_span, pad_max) / bs)
     worst = int(slots) * math.ceil(cfg.max_seq / bs)
     n_blocks = min(int(kv_blocks), worst) if kv_blocks else worst
     return {
@@ -119,7 +144,10 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
         "n_blocks": n_blocks,
         "pool_bytes": _llama.paged_cache_bytes(cfg, n_blocks, bs,
                                                dtype=dtype),
-        "programs": 3,
+        "draft_pool_bytes": (
+            _llama.paged_cache_bytes(draft_cfg, n_blocks, bs, dtype=dtype)
+            if draft_cfg is not None else 0),
+        "programs": 5 if draft_cfg is not None else 3,
     }
 
 
@@ -191,6 +219,11 @@ class LLMFramework(Framework):
         self.mesh = None
         self._fwd = None
         self.continuous = False
+        self.prefix_cache = True
+        self.draft_name = ""
+        self.draft_bundle = None
+        self.draft_cfg = None
+        self.spec_k = 4
         self._serve: Optional["_ContinuousLoop"] = None
         self._serve_lock = threading.Lock()
 
@@ -225,6 +258,22 @@ class LLMFramework(Framework):
         self.prefill_chunk = max(1, int(opts.pop("prefill_chunk", 32)))
         self.prefill_budget = max(
             1, int(opts.pop("prefill_budget", self.prefill_chunk)))
+        # Prefix sharing (docs/SERVING.md §4b): hash token-block chains
+        # so a shared system prompt / few-shot preamble prefills ONCE
+        # and maps copy-on-write into every stream's block table.
+        # Host-only behavior (refcounts, the hash index) — no compiled
+        # signature changes, so it is runtime-safe to flip.
+        self.prefix_cache = str(opts.pop("prefix_cache", "1")).lower() \
+            not in ("0", "false", "no")
+        # Speculative decoding (docs/SERVING.md §4c): ``draft:<preset>``
+        # builds a small draft model that proposes ``spec_k`` tokens per
+        # round; the target verifies them in ONE fixed-shape
+        # [slots, k+1]-wide paged step.  Greedy-only: acceptance is
+        # exact prefix match against the target's own argmax, so the
+        # emitted stream is bit-identical to plain decode.
+        self.draft_name = str(opts.pop("draft", "") or "")
+        self.spec_k = max(1, int(opts.pop("spec_k", 4)))
+        draft_seed = int(opts.pop("draft_seed", 0))
         # Elastic-serving knobs (docs/SERVING.md "Elastic serving"):
         # admit_timeout bounds how long a prompt may sit at the
         # admission queue's head waiting for capacity before it is
@@ -257,6 +306,35 @@ class LLMFramework(Framework):
                 f"model {model!r} has no LlamaConfig; the llm framework needs "
                 "a decoder-LM bundle (models/llama.py)"
             )
+        self.draft_bundle = None
+        self.draft_cfg = None
+        if self.draft_name:
+            if not self.continuous:
+                raise FrameworkError(
+                    "draft: (speculative decoding) requires "
+                    "serve:continuous — the per-request stream path has "
+                    "no standing verify loop")
+            if self.temperature > 0.0:
+                raise FrameworkError(
+                    "draft: (speculative decoding) is greedy-only: "
+                    "acceptance is exact prefix match against the "
+                    "target's argmax, which sampling breaks — set "
+                    "temperature:0 or drop the draft")
+            if self.draft_name not in llama.PRESETS:
+                raise FrameworkError(
+                    f"draft model {self.draft_name!r} must be a preset "
+                    "zoo name (the deep lint prices the draft's params "
+                    "statically; a checkpoint path cannot be)")
+            # the draft MUST share the target's token space and position
+            # span: vocab/max_seq are overridden onto the draft preset so
+            # its proposals are target token ids at target positions
+            self.draft_bundle = build_model(self.draft_name, {
+                "vocab": str(self.cfg.vocab),
+                "max_seq": str(self.cfg.max_seq),
+                "seed": str(draft_seed),
+                "param_dtype": str(opts.get("param_dtype", "float32")),
+            })
+            self.draft_cfg = self.draft_bundle.config
         # Tokenizer priority: explicit custom=tokenizer:PATH, then the
         # model file's own embedded vocab, then the byte-level fallback.
         if tok_path is not None:
@@ -333,6 +411,10 @@ class LLMFramework(Framework):
         if mesh is not None:
             ways = mesh_axis_size(mesh, "model")
             problems = llama.tp_divisibility_problems(cfg, ways)
+            if self.draft_cfg is not None:
+                problems += [
+                    f"draft {p}" for p in
+                    llama.tp_divisibility_problems(self.draft_cfg, ways)]
             if problems:
                 # fail with the dims named instead of a GSPMD/device_put
                 # reshape error mid-shard (the deep lint reports the same
@@ -346,6 +428,13 @@ class LLMFramework(Framework):
             pspecs = self.bundle.param_pspecs or llama.param_pspecs()
             params = shard_params(mesh, params, pspecs)
             self.bundle.params = params
+            if self.draft_bundle is not None:
+                # the draft shards over the same mesh — its pspecs match
+                # its own (unquantized) pytree
+                dspecs = self.draft_bundle.param_pspecs \
+                    or llama.param_pspecs()
+                self.draft_bundle.params = shard_params(
+                    mesh, self.draft_bundle.params, dspecs)
             # pallas_call has no GSPMD partitioning rule: int4 and paged-
             # attention programs traced for this sharded mesh must take
             # their shardable XLA reference paths.  Refcounted disables,
@@ -433,15 +522,18 @@ class LLMFramework(Framework):
             _attn.enable_paged_kernel()
             self._int4_disabled = False
         self.bundle = None
+        self.draft_bundle = None
         self._fwd = None
         self._decode_chunk = None
 
     # -- continuous serving ------------------------------------------------
-    def submit(self, inputs: Sequence, meta: Dict, emit) -> None:
+    def submit(self, inputs: Sequence, meta: Dict, emit) -> int:
         """Queue one prompt into the standing decode loop
         (``custom=serve:continuous``).  ``emit(tensors, meta)`` is called
         from the serve thread once per generated token, carrying the
-        request's meta plus stream_index/stream_last."""
+        request's meta plus stream_index/stream_last.  Returns the
+        minted stream id (also stamped into every emitted token's meta
+        — the :meth:`drain_stream`/utils.elastic handle)."""
         # Lock the lazy creation: two first-submits racing from different
         # threads must not spawn two serve loops (duplicate slot caches,
         # split streams) — the framework API stays safe outside the
@@ -450,7 +542,7 @@ class LLMFramework(Framework):
             with self._serve_lock:
                 if self._serve is None:
                     self._serve = _ContinuousLoop(self)
-        self._serve.submit(self._to_tokens(inputs[0]), meta, emit)
+        return self._serve.submit(self._to_tokens(inputs[0]), meta, emit)
 
     def drain(self, timeout: float = 600.0) -> bool:
         """Block until every admitted stream has finished (EOS path)."""
@@ -486,11 +578,20 @@ class LLMFramework(Framework):
         problems: List[str] = []
         if not isinstance(snapshot, dict):
             return ["snapshot must be a dict (drain_stream's return)"]
-        if snapshot.get("version") != 1:
+        if snapshot.get("version") not in (1, 2):
             problems.append(
                 f"snapshot version {snapshot.get('version')!r} "
-                "unsupported (expected 1)")
+                "unsupported (expected 1 or 2)")
             return problems
+        if self.draft_name and snapshot.get("kind") == "live" \
+                and "tok_prev" not in snapshot:
+            # the speculative refresh step re-feeds the second-to-last
+            # committed token; a pre-speculation (v1) snapshot does not
+            # carry it — still adoptable by any non-speculating loop
+            problems.append(
+                "snapshot predates speculative decoding (no tok_prev); "
+                "adopt it on a loop without draft:, or re-drain from a "
+                "current pipeline")
         if snapshot.get("cfg") != _dc.asdict(self.cfg):
             problems.append("model geometry differs from the snapshot's")
         if snapshot.get("kind") == "live":
@@ -558,7 +659,15 @@ class LLMFramework(Framework):
             return 0
         from .base import tree_param_bytes
 
-        return tree_param_bytes(bundle.params)
+        total = tree_param_bytes(bundle.params)
+        draft = getattr(self, "draft_bundle", None)
+        if draft is not None and draft.params is not None:
+            # the speculative-decoding draft lives in HBM beside the
+            # target for the stage lifetime — the deep lint prices it
+            # (draft params in the resource report), so the measured
+            # side must include it or the ledger ratio drifts
+            total += tree_param_bytes(draft.params)
+        return total
 
     # -- tokenization ------------------------------------------------------
     def _to_tokens(self, arr: np.ndarray) -> np.ndarray:
@@ -696,17 +805,44 @@ class _ContinuousLoop:
     one monolithic batch-1 prefill + cache-copy, which is what a late
     joiner's first-token latency was made of.
 
-    **Fixed decode signature.**  Both programs — the per-chunk paged
-    decode ``[slots]``-row scan and the ``[1, prefill_chunk]`` prefill
-    step — take (pool, tables, positions) with shapes static in every
-    admission-state dimension; stream join/leave/complete changes
-    VALUES only.  Warm once, recompile never (pinned by the compile-
-    counter test in tests/test_llm_continuous.py and priced by the deep
-    lint's resource report).  Idle slots decode garbage parked at
-    position ``max_blocks * block_size`` — their table lookups resolve
-    to the sentinel, writes drop, context length is 0, and the paged
-    kernel issues ZERO block DMAs for them: an idle slot costs FLOPs,
-    not HBM bandwidth.
+    **Prefix sharing (copy-on-write).**  With ``prefix_cache`` on
+    (default), every full prompt block's token CHAIN hash indexes its
+    pool block after prefill.  A new prompt walks the index: matched
+    leading blocks map into its table with a reference count bump
+    instead of a reservation — the shared system prompt / few-shot
+    preamble that a million streams repeat is prefilled ONCE, and a
+    cache-hit prompt's admission cost collapses to ~the non-shared
+    suffix.  Blocks free only at refcount 0; cached blocks at refcount
+    0 REST IN THE FREE LIST (content + index intact), so the cache
+    never costs admission capacity and eviction is simply allocation.
+    A matched block the suffix prefill would partially rewrite is
+    copy-on-write FORKED first (``llm.serve.cow_forks``).  All host
+    values — no compiled signature changes.
+
+    **Speculative decoding.**  With ``draft:<preset>`` a small draft
+    model proposes ``spec_k`` tokens per round (one scan; its paged
+    pool shares this allocator's tables block-for-block) and the
+    target verifies them in ONE fixed-shape ``[slots, spec_k+1]``-wide
+    paged step — a k-wide prefill chunk.  The host accepts the longest
+    proposal prefix matching the target's own argmax plus the target's
+    bonus token: 1..k+1 tokens per TARGET dispatch, bit-identical to
+    plain greedy decode at every accept rate.  Accept/reject moves
+    positions by VALUES; the census grows to exactly 5 programs
+    (serving_plan).
+
+    **Fixed decode signature.**  Every program — the per-chunk paged
+    decode ``[slots]``-row scan (or the propose/verify pair), the
+    ``[1, prefill_chunk]`` prefill steps — takes (pool, tables,
+    positions) with shapes static in every admission-state dimension;
+    stream join/leave/complete, cache hits, CoW forks, and accept/
+    reject ratios change VALUES only.  Warm once, recompile never
+    (pinned by the compile-counter tests in tests/test_llm_continuous
+    .py and tests/test_spec_decode.py and priced by the deep lint's
+    resource report).  Idle slots decode garbage parked at position
+    ``max_blocks * block_size`` — their table lookups resolve to the
+    sentinel, writes drop, context length is 0, and the paged kernel
+    issues ZERO block DMAs for them: an idle slot costs FLOPs, not HBM
+    bandwidth.
     """
 
     def __init__(self, fw: LLMFramework):
@@ -725,7 +861,8 @@ class _ContinuousLoop:
         # chunk-padded prompt, pool defaults to the worst case.
         plan = serving_plan(cfg, slots=fw.slots, block_size=bs,
                             kv_blocks=fw.kv_blocks,
-                            prefill_chunk=fw.prefill_chunk, dtype=fw.dtype)
+                            prefill_chunk=fw.prefill_chunk, dtype=fw.dtype,
+                            draft_cfg=fw.draft_cfg, spec_k=fw.spec_k)
         self.max_blocks = plan["max_blocks"]
         self.n_blocks = plan["n_blocks"]
         self.sentinel = self.n_blocks  # unallocated table entry
@@ -811,6 +948,79 @@ class _ContinuousLoop:
         # and value traced: ONE program for every admission)
         self._set_tok = jax.jit(lambda a, i, v: a.at[i].set(v),
                                 donate_argnums=(0,))
+        # -- speculative decoding (custom=draft:<preset>,spec_k:K) ------
+        # The draft model shares the allocator, block tables, sentinel,
+        # and n_blocks with the target: block id j holds target K/V in
+        # the target pool and draft K/V in the draft pool, so a prefix-
+        # cache hit shares BOTH models' cache rows and a CoW fork copies
+        # both.  Three extra programs, all static-shaped — accept/reject
+        # ratios are host VALUES: the census stays closed at 5.
+        self._spec = fw.draft_bundle is not None
+        if self._spec:
+            dcfg = fw.draft_cfg
+            k_spec = fw.spec_k
+            park_bound = self.max_blocks * bs  # static python int
+
+            def draft_prefill_step(dparams, toks, dpool, table, pos0):
+                """The draft's twin of the target prefill chunk: writes
+                the chunk's draft K/V into the SAME reserved blocks of
+                the draft pool (logits discarded — ``logit_off=0``
+                keeps the draft lm_head at one row)."""
+                _, dpool = llama.forward_paged(
+                    dparams, toks, dpool, table, pos0, dcfg,
+                    compute_dtype=fw.dtype, logit_off=0)
+                return dpool
+
+            self._draft_prefill = jax.jit(draft_prefill_step,
+                                          donate_argnums=(2,))
+
+            def propose(dparams, tok_prev, tok, dpool, tables, pos):
+                """One speculative round's draft side: re-feed the
+                PREVIOUS token at ``pos - 1`` (the refresh step — after
+                a fully-accepted round the draft pool has a hole at the
+                last committed position; recomputing it from identical
+                context is bit-exact and keeps the pool hole-free), then
+                ``k`` greedy draft steps from ``tok``.  Returns
+                proposals [B, k] + the updated draft pool.  Parked rows
+                stay parked: the refresh position is clamped to the
+                park value so their table lookups still resolve to the
+                sentinel and the paged kernel issues zero DMAs."""
+                rpos = jnp.where(pos >= park_bound, pos, pos - 1)
+                _, dpool = llama.forward_paged(
+                    dparams, tok_prev[:, None], dpool, tables, rpos,
+                    dcfg, compute_dtype=fw.dtype)
+
+                def step(carry, _):
+                    t, dpool, p = carry
+                    logits, dpool = llama.forward_paged(
+                        dparams, t[:, None], dpool, tables, p, dcfg,
+                        compute_dtype=fw.dtype)
+                    nxt = jnp.argmax(logits[:, -1],
+                                     axis=-1).astype(jnp.int32)
+                    return (nxt, dpool, p + 1), nxt
+
+                (_, dpool, _), props = lax.scan(
+                    step, (tok, dpool, pos), None, length=k_spec)
+                return jnp.moveaxis(props, 0, 1), dpool
+
+            self._propose = jax.jit(propose, donate_argnums=(3,))
+
+            def verify(params, tok, props, pool, tables, pos):
+                """One speculative round's target side: ONE fixed-shape
+                ``[B, k+1]``-wide paged step over (last committed token
+                + the k proposals) — a k-wide prefill chunk in the
+                chunked-prefill sense.  Returns the target's greedy
+                argmax at every position [B, k+1]; the host computes
+                the accepted prefix by comparing against the proposals
+                (values, not shapes)."""
+                toks = jnp.concatenate([tok[:, None], props], axis=1)
+                logits, pool = llama.forward_paged(
+                    params, toks, pool, tables, pos, cfg,
+                    compute_dtype=fw.dtype)
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return g, pool
+
+            self._verify = jax.jit(verify, donate_argnums=(3,))
         xr = getattr(fw, "_xray", None)
         if xr is not None:
             # nns-xray: the standing loop's predicted census IS
@@ -832,18 +1042,37 @@ class _ContinuousLoop:
                 from ..parallel.mesh import mesh_axis_size
 
                 devs = max(1, mesh_axis_size(fw.mesh, "model"))
-            xr.expect(stage, "decode", budget=1,
-                      note="serving_plan fixed decode signature")
             xr.expect(stage, "prefill", budget=1,
                       note="serving_plan fixed prefill signature")
             xr.expect(stage, "set_tok", budget=1,
                       note="serving_plan slot-token setter")
-            self._decode = xr.track(self._decode, stage, "decode",
-                                    rec=rec, devices=devs)
             self._prefill = xr.track(self._prefill, stage, "prefill",
                                      rec=rec, devices=devs)
             self._set_tok = xr.track(self._set_tok, stage, "set_tok",
                                      rec=rec)
+            if self._spec:
+                # speculation swaps the decode chunk for the draft
+                # propose + target verify pair and adds the draft's
+                # prefill twin — serving_plan()["programs"] == 5, each
+                # expecting exactly one compile
+                xr.expect(stage, "draft_prefill", budget=1,
+                          note="serving_plan draft prefill twin")
+                xr.expect(stage, "propose", budget=1,
+                          note="serving_plan draft propose scan")
+                xr.expect(stage, "verify", budget=1,
+                          note="serving_plan k+1-wide verify step")
+                self._draft_prefill = xr.track(
+                    self._draft_prefill, stage, "draft_prefill", rec=rec,
+                    devices=devs)
+                self._propose = xr.track(self._propose, stage, "propose",
+                                         rec=rec, devices=devs)
+                self._verify = xr.track(self._verify, stage, "verify",
+                                        rec=rec, devices=devs)
+            else:
+                xr.expect(stage, "decode", budget=1,
+                          note="serving_plan fixed decode signature")
+                self._decode = xr.track(self._decode, stage, "decode",
+                                        rec=rec, devices=devs)
         self._thread = threading.Thread(
             target=self._run, name="llm-serve", daemon=True)
         self._thread.start()
@@ -935,6 +1164,13 @@ class _ContinuousLoop:
             "blocks_total": self.n_blocks,
             "blocks_free": self.n_blocks if free is None else len(free),
             "live_streams": sum(1 for s in slots if s is not None),
+            # prefix-sharing accounting: blocks whose content + chain
+            # hash are indexed (many resting in the free list at
+            # refcount 0), and blocks currently mapped by >1 stream
+            "blocks_cached": len(getattr(self, "_block_hash", {}) or {}),
+            "blocks_shared": int(
+                (np.asarray(getattr(self, "_ref", [])) > 1).sum())
+            if getattr(self, "_ref", None) is not None else 0,
         }
 
     def stream_table(self) -> Dict[int, Dict]:
@@ -1000,8 +1236,10 @@ class _ContinuousLoop:
 
     # -- serve thread ------------------------------------------------------
     def _emit_token(self, emit, meta: Dict, token_id: int, index: int,
-                    last: bool) -> None:
+                    last: bool, extra: Optional[Dict] = None) -> None:
         out_meta = dict(meta)
+        if extra:
+            out_meta.update(extra)
         out_meta["stream_index"] = index
         # Serving telemetry: when THIS token left the decode loop
         # (monotonic seconds).  Lets consumers measure generation-window
@@ -1082,6 +1320,15 @@ class _ContinuousLoop:
         params = fw.bundle.params
         pool = llama.init_paged_cache(cfg, self.n_blocks, bs,
                                       dtype=fw.dtype)
+        d_params = draft_pool = None
+        if self._spec:
+            d_params = fw.draft_bundle.params
+            # the draft pool mirrors the target's (n_blocks, block_size)
+            # at the draft's own (L, H_kv, hd): ONE allocator, ONE table
+            # set steers both — block id j holds both models' K/V for
+            # the same token positions
+            draft_pool = llama.init_paged_cache(
+                fw.draft_cfg, self.n_blocks, bs, dtype=fw.dtype)
         if fw.mesh is not None:
             # Tensor parallelism: the block pool shards over `model` on
             # the K/V head dim exactly like the dense cache, so a
@@ -1092,15 +1339,20 @@ class _ContinuousLoop:
             from ..parallel.sharding import shard_params as _sp
 
             pool = _sp(fw.mesh, pool, llama.paged_cache_pspecs())
+            if draft_pool is not None:
+                draft_pool = _sp(fw.mesh, draft_pool,
+                                 llama.paged_cache_pspecs())
         # published like the allocator bookkeeping below: tests and
         # post-mortems read the pool's actual placement off the loop
         self._pool_sharding = getattr(pool["k"], "sharding", None)
         # the MEASURED pool footprint (global bytes; /M per chip under
-        # TP) — nns-xray's HBM ledger reconciles this against the deep
-        # lint's serving_plan pool_bytes estimate
+        # TP; target + draft pools) — nns-xray's HBM ledger reconciles
+        # this against the deep lint's serving_plan pool_bytes +
+        # draft_pool_bytes estimate
         from .base import tree_param_bytes as _tree_bytes
 
-        self._pool_nbytes = _tree_bytes(pool)
+        self._pool_nbytes = _tree_bytes(pool) + (
+            _tree_bytes(draft_pool) if draft_pool is not None else 0)
         # Device carries tok/pool/key between chunks (r4: materializing
         # them per chunk cost tunnel roundtrips).  EVERYTHING ELSE is
         # host bookkeeping: positions advance deterministically (+length
@@ -1108,26 +1360,50 @@ class _ContinuousLoop:
         # change only at admit/retire, so both live as numpy and ride to
         # the device as tiny async H2D args — never a fetch.
         tok = jnp.zeros((B,), jnp.int32)
+        tok_prev = jnp.zeros((B,), jnp.int32) if self._spec else None
         key = jax.random.PRNGKey(fw.seed)
+        _rep = None
         if fw.mesh is not None:
             # Commit the carried device state to the mesh UP FRONT: the
             # first decode otherwise traces against single-device inputs
             # while every later call sees mesh-replicated outputs — one
-            # avoidable extra signature that would break the 3-program
-            # census TP must preserve (the compile-counter pin).
+            # avoidable extra signature that would break the fixed-
+            # census pin TP must preserve (the compile-counter pin).
             from ..parallel.sharding import replicate as _rep
 
             tok = _rep(fw.mesh, tok)
             key = _rep(fw.mesh, key)
+            if tok_prev is not None:
+                tok_prev = _rep(fw.mesh, tok_prev)
         pos = np.full((B,), self.park, np.int32)  # parked = idle
         tables = np.full((B, self.max_blocks), self.sentinel, np.int32)
         free = list(range(self.n_blocks))  # host free list (block ids)
         slot_blocks: list = [[] for _ in range(B)]
+        #: per-block reference counts: 0 = on the free list, 1 = one
+        #: private owner, >1 = a prefix-shared block mapped into several
+        #: streams' tables.  A block returns to the free list ONLY at
+        #: refcount 0 (release) — the prefix-sharing invariant the
+        #: property tests in tests/test_spec_decode.py pin.
+        ref = np.zeros((self.n_blocks,), np.int64)
+        #: prefix cache: chain-hash -> pool block id.  Cached blocks with
+        #: refcount 0 LIVE IN THE FREE LIST (content + index intact):
+        #: the cache never shrinks admission capacity, and eviction is
+        #: simply allocation — popping an indexed block drops its entry.
+        prefix_index: Dict[bytes, int] = {}
+        block_hash: Dict[int, bytes] = {}
+        #: host mirrors of the carried token state (the last committed
+        #: token and the one before it) per slot — the speculative
+        #: round's accept/commit writes them and rebuilds the device
+        #: vectors by value; drain snapshots read tok_prev from here.
+        tok_h = np.zeros((B,), np.int32)
+        tok_prev_h = np.zeros((B,), np.int32)
         # Bookkeeping published on self (mutated in place, so the refs
         # stay live): the leak/contamination tests read them after
         # drain(), and a post-mortem can see the pool state.
         self._pos, self._tables = pos, tables
         self._free, self._slot_blocks = free, slot_blocks
+        self._ref, self._prefix_index = ref, prefix_index
+        self._block_hash = block_hash
         remaining = np.zeros((B,), np.int64)
         sidx = np.zeros((B,), np.int64)
         slots: list = [None] * B  # (meta, emit) per live slot
@@ -1151,13 +1427,124 @@ class _ContinuousLoop:
                 print(f"[serve {time.monotonic():.3f}] {tag}",
                       file=_sys.stderr, flush=True)
 
+        def take_blocks(need: int) -> list:
+            """Allocate ``need`` private blocks (refcount 1) off the
+            free list, preferring blocks that do NOT hold a cached
+            prefix; when only cached blocks remain, the oldest-released
+            ones are evicted (their index entries dropped) — eviction
+            IS allocation, so the prefix cache can never make admission
+            defer.
+
+            O(need * len(free)) from the head-pops — per ADMISSION,
+            not per token; at the worst-case bench pool (64 7B
+            streams, ~4.6k blocks) that is ~1 ms of host time under
+            the prefill dispatch it precedes.  Revisit with a deque +
+            free-set if pools grow past that."""
+            got: list = []
+            cached: list = []
+            while free and len(got) < need:
+                b = free.pop(0)
+                (cached if b in block_hash else got).append(b)
+            while cached and len(got) < need:
+                b = cached.pop(0)
+                del prefix_index[block_hash.pop(b)]
+                metrics.count("llm.serve.prefix_evictions")
+                got.append(b)
+            free[0:0] = cached  # skipped cached blocks keep their place
+            if len(got) < need:
+                # every caller pre-checks capacity (admission counts
+                # resting matched blocks on top of phys; adopt checks
+                # len(free)); a shortfall here is an allocator-invariant
+                # bug — fail LOUDLY instead of handing back a short
+                # list that becomes a silently truncated block table
+                # and bit-wrong output
+                free[0:0] = got
+                for b in got:
+                    ref[b] = 0
+                raise RuntimeError(
+                    f"KV allocator invariant violated: asked for {need} "
+                    f"blocks, only {len(got)} allocatable")
+            for b in got:
+                ref[b] = 1
+            return got
+
         def alloc(n_tokens: int) -> list:
-            need = math.ceil(n_tokens / bs)
-            blocks, free[:] = free[:need], free[need:]
-            return blocks
+            return take_blocks(math.ceil(n_tokens / bs))
+
+        def release(blocks) -> None:
+            """Drop one reference per block; a block returns to the
+            free list ONLY at refcount 0 (prefix-shared blocks stay
+            resident for their other holders; cached content + index
+            survive until eviction-by-allocation)."""
+            for b in blocks:
+                ref[b] -= 1
+                if ref[b] <= 0:
+                    ref[b] = 0
+                    free.append(b)
+
+        def map_shared(bid: int) -> None:
+            """Take one more reference on a cached/shared block — off
+            the free list if it was resting there at refcount 0."""
+            if ref[bid] == 0:
+                free.remove(bid)
+            ref[bid] += 1
+
+        def cow_fork(src: int, rec=None) -> int:
+            """Copy-on-write fork: a stream about to WRITE into a block
+            it shares gets a private copy first (target AND draft pool
+            rows — an eager value move like adopt's scatter; none of
+            the compiled programs is touched).  The source keeps its
+            other holders' references.
+
+            Trade-off (shared with adopt): the eager ``.at[].set`` holds
+            the old pool alive across the update, so XLA materializes a
+            transient second pool buffer — at most one fork per
+            admission, off the decode dispatch path.  A donated jitted
+            fork would avoid the spike but mint a program the closed
+            census (serving_plan/tracecheck/xray) would have to price;
+            revisit if silicon pools sized to the HBM edge OOM here."""
+            t0 = time.monotonic_ns()
+            new = take_blocks(1)[0]
+            src_i = np.asarray([src], np.int32)
+            new_i = np.asarray([new], np.int32)
+            pool["k"] = pool["k"].at[:, new_i].set(pool["k"][:, src_i])
+            pool["v"] = pool["v"].at[:, new_i].set(pool["v"][:, src_i])
+            if draft_pool is not None:
+                draft_pool["k"] = draft_pool["k"].at[:, new_i].set(
+                    draft_pool["k"][:, src_i])
+                draft_pool["v"] = draft_pool["v"].at[:, new_i].set(
+                    draft_pool["v"][:, src_i])
+            metrics.count("llm.serve.cow_forks")
+            self._span(rec, "serve.cow_fork", t0, src=int(src),
+                       dst=int(new))
+            return new
+
+        def chain_hashes(row: np.ndarray, full: int) -> list:
+            """Token-block chain hashes: hash j commits to ALL tokens
+            of blocks 0..j, so two prompts share block j only when
+            their entire prefixes match — which is exactly when the
+            cached K/V rows (position-dependent through RoPE) are
+            bit-valid for both."""
+            import hashlib
+
+            h = b"nns-prefix-v1"
+            out = []
+            for j in range(full):
+                h = hashlib.sha1(
+                    h + row[j * bs:(j + 1) * bs].tobytes()).digest()
+                out.append(h)
+            return out
+
+        #: sid -> chain_hashes(prompt) memo for WAITING prompts: a
+        #: capacity-deferred entry is re-scanned every loop iteration,
+        #: and its prompt is immutable after submit — re-hashing a long
+        #: prompt per spin would burn serve-thread time exactly when
+        #: the system is saturated.  Pruned against the live waiting
+        #: set each admission phase, so no path can leak entries.
+        chain_cache: Dict[int, list] = {}
 
         def retire(s: int) -> None:
-            free.extend(slot_blocks[s])
+            release(slot_blocks[s])
             slot_blocks[s] = []
             tables[s, :] = self.sentinel
             pos[s] = self.park
@@ -1218,10 +1605,23 @@ class _ContinuousLoop:
         first_w = llama.sample_token(logits_w, sub, fw.temperature,
                                      fw.top_k, fw.top_p)[0]
         tok = self._set_tok(tok, np.int32(0), first_w)
-        toks_w, tok, pool, key = self._decode(
-            params, tok, pool, tables, pos, key, length=fw.chunk)
-        np.asarray(toks_w)
-        free.extend(warm_blocks)
+        if self._spec:
+            # every slot is parked: the propose/verify warm-ups compile
+            # their (only) signatures, write nothing (sentinel tables),
+            # and DMA nothing
+            draft_pool = self._draft_prefill(
+                d_params, jnp.zeros((1, C), jnp.int32), draft_pool,
+                tables[:1], pos[:1] * 0)
+            props_w, draft_pool = self._propose(
+                d_params, tok_prev, tok, draft_pool, tables, pos)
+            g_w, pool = self._verify(params, tok, props_w, pool, tables,
+                                     pos)
+            np.asarray(g_w)
+        else:
+            toks_w, tok, pool, key = self._decode(
+                params, tok, pool, tables, pos, key, length=fw.chunk)
+            np.asarray(toks_w)
+        release(warm_blocks)
         tables[0, :] = self.sentinel
         _tr("warmup done")
 
@@ -1265,20 +1665,34 @@ class _ContinuousLoop:
                         ids = np.asarray(slot_blocks[s][:n_used],
                                          np.int32)
                         meta, _emit_cb = slots[s]
+                        n_shared = sum(
+                            1 for b in slot_blocks[s][:n_used]
+                            if ref[b] > 1)
                         cmd["result"] = {
-                            "version": 1, "kind": "live",
+                            # v2: adds tok_prev (the speculative
+                            # refresh step's input) + shared_blocks;
+                            # v1 snapshots stay adoptable (the gather
+                            # below MATERIALIZES every block — shared
+                            # ones included — as host copies, so a
+                            # snapshot never aliases pool blocks
+                            # another live stream still holds)
+                            "version": 2, "kind": "live",
                             "stream_id": sid,
                             "cfg": _dc.asdict(cfg), "dtype": fw.dtype,
                             "block_size": bs, "pos": int(pos[s]),
                             "remaining": int(remaining[s]),
                             "sidx": int(sidx[s]),
                             "tok": int(np.asarray(tok)[s]),
+                            "tok_prev": int(tok_prev_h[s]),
+                            "shared_blocks": n_shared,
                             "greedy": fw.temperature == 0.0,
                             "meta": {k: v for k, v in meta.items()
                                      if k not in _SNAPSHOT_META_DROP},
                             "prompt": np.asarray(self._slot_prompt[s]),
                             # valid cache rows [0, pos) gathered to
-                            # host, whole blocks at a time
+                            # host, whole blocks at a time — a COPY,
+                            # never an alias (np.asarray of a device
+                            # gather materializes)
                             "blocks_k": np.asarray(pool["k"][:, ids]),
                             "blocks_v": np.asarray(pool["v"][:, ids]),
                         }
@@ -1294,7 +1708,7 @@ class _ContinuousLoop:
                         t0 = time.monotonic_ns()
                         ent = self._waiting.pop(wi)
                         cmd["result"] = {
-                            "version": 1, "kind": "queued",
+                            "version": 2, "kind": "queued",
                             "stream_id": sid,
                             "cfg": _dc.asdict(cfg), "dtype": fw.dtype,
                             "block_size": bs,
@@ -1385,6 +1799,21 @@ class _ContinuousLoop:
                         tok = self._set_tok(tok, np.int32(s),
                                             jnp.asarray(
                                                 np.int32(snap["tok"])))
+                        tok_h[s] = int(snap["tok"])
+                        tok_prev_h[s] = int(snap.get("tok_prev", 0))
+                        if self._spec:
+                            # the refresh step re-feeds tok_prev at
+                            # pos-1; adopting into a spec loop requires
+                            # it (snapshot_problems gates v1 snapshots
+                            # out).  The DRAFT pool stays unwritten for
+                            # the adopted rows — proposals degrade
+                            # until positions rewrite, greedy
+                            # continuation is target-decided and stays
+                            # bit-identical.
+                            tok_prev = self._set_tok(
+                                tok_prev, np.int32(s),
+                                jnp.asarray(np.int32(
+                                    snap.get("tok_prev", 0))))
                         pos[s] = p_next
                         remaining[s] = rem
                         sidx[s] = int(snap["sidx"])
@@ -1496,6 +1925,12 @@ class _ContinuousLoop:
             # abort instead of wedging every tenant queued behind it,
             # and a tenant over its kv-block quota is SKIPPED — tenant-
             # attributed deferral must not head-of-line-block the rest.
+            if chain_cache:
+                waiting_sids = {e[1].get(elastic.META_STREAM_ID)
+                                for e in self._waiting}
+                for k in [k for k in chain_cache
+                          if k not in waiting_sids]:
+                    del chain_cache[k]
             wi = 0
             while wi < len(self._waiting):
                 prompt, meta, emit, t_enq = self._waiting[wi]
@@ -1531,9 +1966,15 @@ class _ContinuousLoop:
                 tenant = meta.get(_META_TENANT)
                 quota = (self._tenant_quota.get(tenant)
                          if tenant is not None else None)
-                need = math.ceil((T + n) / bs)
+                # Quota charges LOGICAL blocks (per reference): a tenant
+                # pays for every block its streams MAP, shared or not —
+                # a shared prefix neither lets it exceed its cap for
+                # free nor double-charges the physical pool (the free-
+                # list check below is the physical side and charges the
+                # non-shared suffix only).
+                logical = math.ceil((T + n) / bs)
                 if quota is not None and \
-                        tenant_blocks(tenant) + need > quota:
+                        tenant_blocks(tenant) + logical > quota:
                     if overdue:
                         self._waiting.pop(wi)
                         metrics.count("llm.serve.admit_timeouts")
@@ -1543,12 +1984,48 @@ class _ContinuousLoop:
                     metrics.count("llm.serve.quota_deferred")
                     wi += 1  # skip: quota deferral is tenant-scoped
                     continue
+                # Prefix lookup BEFORE the capacity check: a cache hit
+                # shrinks the PHYSICAL reservation to ~the non-shared
+                # suffix, so a hit prompt admits where a cold one
+                # defers.  The suffix prefill starts at p0 — the
+                # largest prefill_chunk multiple not past the shared
+                # extent (or the last real token): chunk ends stay on
+                # the cold path's grid, so the table-span arithmetic in
+                # serving_plan() is untouched.  A matched block
+                # straddling p0 is copy-on-write FORKED (the chunk
+                # rewrites part of it); matched blocks past p0 are
+                # simply re-prefilled into fresh private blocks.
+                hashes: list = []
+                matched_ids: list = []
+                if fw.prefix_cache:
+                    hashes = chain_cache.get(sid)
+                    if hashes is None:
+                        hashes = chain_cache[sid] = chain_hashes(
+                            prompt[0], T // bs)
+                    for h in hashes:
+                        bid = prefix_index.get(h)
+                        if bid is None:
+                            break
+                        matched_ids.append(bid)
+                s0 = len(matched_ids) * bs
+                p0 = min(s0 // C, (T - 1) // C) * C if s0 else 0
+                shared = p0 // bs
+                fork = 1 if p0 % bs else 0
+                phys = logical - shared
+                # matched blocks RESTING in the free list (refcount 0,
+                # cached content) still count as free right now, but
+                # map_shared pulls each one OUT of the list below — the
+                # capacity check must demand phys blocks ON TOP of
+                # them, or take_blocks comes up short and the stream
+                # gets a silently truncated table
+                resting = sum(1 for b in matched_ids[:shared]
+                              if ref[b] == 0)
                 freeslots = np.flatnonzero(remaining == 0)
                 freeslots = [int(s) for s in freeslots
                              if slots[s] is None and not any(
                                  st["slot"] == s
                                  for st in self._admitting)]
-                if not freeslots or len(free) * bs < T + n:
+                if not freeslots or len(free) < phys + resting:
                     if overdue:
                         # head-of-line fix: a wedged/dead/huge stream at
                         # the queue head times out instead of blocking
@@ -1562,26 +2039,39 @@ class _ContinuousLoop:
                 t_admit = time.monotonic_ns()
                 self._waiting.pop(wi)
                 s = freeslots[0]
-                blocks = alloc(T + n)
+                blocks = list(matched_ids[:shared])
+                for bid in blocks:
+                    map_shared(bid)
+                if fork:
+                    blocks.append(cow_fork(matched_ids[shared], rec=rec))
+                blocks.extend(take_blocks(phys - fork))
                 slot_blocks[s] = blocks
                 tables[s, :len(blocks)] = blocks
                 self._slot_sid[s] = sid
                 self._slot_tenant[s] = tenant
                 self._slot_prompt[s] = prompt[:, :T].copy()
+                if shared:
+                    metrics.count("llm.serve.prefix_hits")
+                    metrics.count("llm.serve.prefix_hit_blocks", shared)
+                    self._span(rec, "serve.prefix_hit", t_admit, slot=s,
+                               blocks=shared, tokens=p0)
                 # chunk-multiple padding (replaces the old power-of-two
-                # prompt bucketing on this path: waste < one chunk)
-                P = math.ceil(T / C) * C
+                # prompt bucketing on this path: waste < one chunk);
+                # only the suffix [p0, P) is prefilled
+                P = p0 + math.ceil((T - p0) / C) * C
                 if P > T:
                     prompt = np.pad(prompt, ((0, 0), (0, P - T)))
-                metrics.count("llm.serve.prefill_tokens", P)
+                metrics.count("llm.serve.prefill_tokens", P - p0)
                 metrics.count("llm.serve.prefill_pad_waste", P - T)
                 self._admitting.append({
                     "slot": s, "prompt": prompt.astype(np.int32), "T": T,
-                    "P": P, "p": 0, "n": n, "meta": meta, "emit": emit,
-                    "first": None})
+                    "P": P, "p": p0, "n": n, "meta": meta, "emit": emit,
+                    "first": None, "hashes": hashes,
+                    "last_tok": int(prompt[0, T - 1])})
                 self._span(rec, "serve.admit", t_admit, slot=s, tokens=T,
-                           blocks=len(blocks))
-                _tr(f"admitted slot {s} ({T} tokens, {len(blocks)} blocks)")
+                           blocks=phys, shared=shared)
+                _tr(f"admitted slot {s} ({T} tokens, {len(blocks)} "
+                    f"blocks, {shared} shared)")
                 progressed = True
 
             # 2. chunked prefill: dispatch up to prefill_budget tokens of
@@ -1604,6 +2094,16 @@ class _ContinuousLoop:
                         params, jnp.asarray(st["prompt"][:, p:p + C]),
                         pool, tables[s:s + 1],
                         np.asarray([p], np.int32), off)
+                    if self._spec:
+                        # the draft's prefill twin writes the chunk's
+                        # draft K/V into the SAME blocks of the draft
+                        # pool — a later prefix hit shares both models'
+                        # rows
+                        draft_pool = self._draft_prefill(
+                            d_params,
+                            jnp.asarray(st["prompt"][:, p:p + C]),
+                            draft_pool, tables[s:s + 1],
+                            np.asarray([p], np.int32))
                     st["p"] = p + C
                     budget -= C
                     self._span(rec, "serve.prefill_chunk", t_pf, slot=s,
@@ -1645,6 +2145,27 @@ class _ContinuousLoop:
                             logits, sub, fw.temperature, fw.top_k,
                             fw.top_p)[0]
                         tok = self._set_tok(tok, np.int32(s), st["first"])
+                        tok_prev_h[s] = st["last_tok"]
+                        if self._spec:
+                            # the round's refresh step re-feeds the
+                            # LAST PROMPT token at T-1 (bit-exact
+                            # rewrite); must be device-resident before
+                            # this iteration's propose dispatch
+                            tok_prev = self._set_tok(
+                                tok_prev, np.int32(s),
+                                jnp.asarray(np.int32(st["last_tok"])))
+                        # register the prompt's full blocks in the
+                        # prefix index (content is in-flight on device;
+                        # pool donation chains order any reader after
+                        # this prefill).  Forked/shared blocks' hashes
+                        # are already present — only fresh tails
+                        # register.
+                        if fw.prefix_cache:
+                            for j, h in enumerate(st["hashes"]):
+                                if h not in prefix_index:
+                                    bid = slot_blocks[s][j]
+                                    prefix_index[h] = bid
+                                    block_hash[bid] = h
                         pos[s] = st["T"]
                         remaining[s] = st["n"] - 1
                         sidx[s] = 1
@@ -1669,12 +2190,27 @@ class _ContinuousLoop:
             # reserved blocks or drop; outputs are never emitted).
             live = remaining > 0
             toks_dev = None
+            g_dev = props_dev = None
             if live.any():
                 t_dec = time.monotonic_ns()
-                toks_dev, tok, pool, key = self._decode(
-                    params, tok, pool, tables, pos, key, length=fw.chunk)
-                pos[live] += fw.chunk  # parked rows stay parked
-                _tr("chunk dispatched")
+                if self._spec:
+                    # one speculative round: draft proposes k tokens,
+                    # the target verifies them in ONE [slots, k+1]-wide
+                    # paged step.  Both dispatches are async; positions
+                    # advance per-row by the ACCEPTED count in step 5
+                    # (a host value — no shape ever changes).
+                    props_dev, draft_pool = self._propose(
+                        d_params, tok_prev, tok, draft_pool, tables, pos)
+                    g_dev, pool = self._verify(
+                        params, tok, props_dev, pool, tables, pos)
+                    metrics.count("llm.serve.spec_rounds")
+                    _tr("spec round dispatched")
+                else:
+                    toks_dev, tok, pool, key = self._decode(
+                        params, tok, pool, tables, pos, key,
+                        length=fw.chunk)
+                    pos[live] += fw.chunk  # parked rows stay parked
+                    _tr("chunk dispatched")
                 progressed = True
             metrics.gauge("llm.serve.occupancy", float(live.sum()))
             metrics.gauge("llm.serve.free_blocks", float(len(free)))
@@ -1690,6 +2226,7 @@ class _ContinuousLoop:
                 _tr(f"first-token sync begins slot {s}")
                 first = int(np.asarray(st["first"]))
                 _tr(f"first-token synced slot {s}")
+                tok_h[s] = first
                 first_last = st["n"] == 1 or first == eos
                 self._emit_token(st["emit"], st["meta"], first, 0,
                                  first_last)
@@ -1718,10 +2255,73 @@ class _ContinuousLoop:
                         last = remaining[s] == 1 or tokid == eos
                         self._emit_token(emit, meta, tokid,
                                          int(sidx[s]), bool(last))
+                        tok_prev_h[s] = tok_h[s]
+                        tok_h[s] = tokid
                         sidx[s] += 1
                         remaining[s] -= 1
                         if last:
                             retire(int(s))
+
+            # 5b. speculative accept/commit: compare the draft's
+            # proposals against the target's own greedy argmax at every
+            # verified position — the accepted prefix plus the target's
+            # bonus token emit; everything after the first divergence is
+            # discarded (its K/V rows get overwritten before they can
+            # ever be attended, the same overwrite-before-attend
+            # discipline chunked prefill relies on).  All host VALUES:
+            # positions/tokens update per row, nothing recompiles.
+            if g_dev is not None:
+                g_host = np.asarray(g_dev)          # [B, k+1]
+                props_host = np.asarray(props_dev)  # [B, k] — one sync
+                self._span(rec, "serve.spec_verify", t_dec,
+                           occupancy=int(live.sum()), k=fw.spec_k)
+                _tr("spec round materialized")
+                K = fw.spec_k
+                for s in np.flatnonzero(live):
+                    s = int(s)
+                    if remaining[s] == 0:
+                        continue  # retired at its first token (EOS)
+                    meta, emit = slots[s]
+                    acc = 0
+                    while acc < K and \
+                            props_host[s, acc] == g_host[s, acc]:
+                        acc += 1
+                    metrics.count("llm.serve.spec_accepted", acc)
+                    metrics.count("llm.serve.spec_rejected", K - acc)
+                    emitted = []
+                    finished = False
+                    for j in range(acc + 1):
+                        tokid = int(g_host[s, j])
+                        last = remaining[s] == 1 or tokid == eos
+                        # accepted draft tokens vs the target-sampled
+                        # bonus/fallback token: the accept/reject path's
+                        # pipeline-native surface (tensor_if
+                        # compared_value=META_VALUE, tensor_demux
+                        # by-meta= — docs/SERVING.md §4c)
+                        self._emit_token(
+                            emit, meta, tokid, int(sidx[s]), bool(last),
+                            extra={"spec_draft": 1 if j < acc else 0})
+                        emitted.append(tokid)
+                        sidx[s] += 1
+                        remaining[s] -= 1
+                        if last:
+                            retire(s)
+                            finished = True
+                            break
+                    if not finished:
+                        pos[s] += len(emitted)
+                        seq = [int(tok_h[s])] + emitted
+                        tok_h[s] = seq[-1]
+                        tok_prev_h[s] = seq[-2]
+                # commit the new token state by VALUE: the device
+                # vectors rebuild from the host mirrors (newly admitted
+                # rows were synced in step 4), replicated onto the mesh
+                # under TP — a transfer, never a compile
+                tok = jnp.asarray(tok_h)
+                tok_prev = jnp.asarray(tok_prev_h)
+                if fw.mesh is not None:
+                    tok = _rep(fw.mesh, tok)
+                    tok_prev = _rep(fw.mesh, tok_prev)
 
             if not progressed:
                 with self._idle_lock:

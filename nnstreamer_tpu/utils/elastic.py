@@ -177,11 +177,19 @@ SERVE_KNOB_SIGNATURE: Dict[str, bool] = {
     "top_k": True,
     "top_p": True,
     "dtype": True,
+    # speculative decoding: the draft model's geometry and the verify
+    # step's k+1 width are compiled program structure
+    "draft": True,
+    "spec_k": True,
+    "draft_seed": True,
     "max_new": False,
     "prefill_budget": False,
     "admit_timeout": False,
     "stream_idle_timeout": False,
     "seed": False,
+    # prefix sharing is host-only state (refcounts + the hash index):
+    # flipping it changes admission behavior, never a compiled signature
+    "prefix_cache": False,
 }
 
 
@@ -196,6 +204,7 @@ SERVE_KNOB_DEFAULTS: Dict[str, object] = {
     "stream_chunk": 8, "temperature": 0.0, "top_k": 0, "top_p": 1.0,
     "dtype": "bfloat16", "max_new": 32, "admit_timeout": 30.0,
     "stream_idle_timeout": 5.0, "seed": 0,
+    "draft": "", "spec_k": 4, "draft_seed": 0, "prefix_cache": 1,
 }
 
 

@@ -68,6 +68,19 @@ SPAN_KINDS: Dict[str, str] = {
                     "the live slots (args: occupancy, chunk; closes at "
                     "chunk materialization, so it covers the device "
                     "time)",
+    "serve.prefix_hit": "continuous LLM serving: an admitted prompt's "
+                        "leading blocks matched the prefix cache and "
+                        "mapped copy-on-write into its table (instant; "
+                        "args: slot, blocks = shared mappings, tokens = "
+                        "prefill skipped)",
+    "serve.cow_fork": "continuous LLM serving: a shared block a stream "
+                      "was about to write got a private copy first "
+                      "(args: src, dst pool block ids — an eager value "
+                      "move, no program touched)",
+    "serve.spec_verify": "continuous LLM serving: one speculative round "
+                         "(draft propose + k+1-wide target verify; "
+                         "args: occupancy, k; closes at round "
+                         "materialization like serve.decode)",
     "admit.shed": "query-server admission shed a request under backlog "
                   "(instant; args: tenant, msg, backlog — the victim's "
                   "trace id is the span tid, minted at shed when the "

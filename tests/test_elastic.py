@@ -167,6 +167,63 @@ class TestDrainAdopt:
         fw_a.close()
         fw_other.close()
 
+    def test_drain_under_sharing_materializes_private_blocks(self):
+        """Drain a stream whose prefix blocks are SHARED (refcount>1)
+        with a still-live peer: the v2 snapshot must carry private
+        copies (never alias pool blocks), the surviving stream must
+        finish bit-identically, and the adopted continuation must be
+        bit-identical to an undrained run."""
+        custom = ("max_new:24,serve:continuous,slots:2,stream_chunk:2,"
+                  "temperature:0.0,dtype:float32,block_size:8,"
+                  "prefill_chunk:8")
+        rng = np.random.default_rng(42)
+        pre = rng.integers(1, 500, (32,), np.int32)
+        pa = np.concatenate([pre, rng.integers(1, 500, (3,), np.int32)])
+        pb = np.concatenate([pre, rng.integers(1, 500, (5,), np.int32)])
+        refs = []
+        for p in (pa, pb):
+            c = Collector()
+            fw = make_fw(custom)
+            fw.submit([p], {}, c)
+            assert c.done.wait(120)
+            refs.append(c.ids)
+            fw.close()
+
+        fw_a, fw_b = make_fw(custom), make_fw(custom)
+        got_a, got_b = Collector(), Collector()
+        seen_b = threading.Event()
+
+        def emit_b(tensors, meta):
+            got_b(tensors, meta)
+            if len(got_b.toks) >= 3:
+                seen_b.set()
+
+        fw_a.submit([pa], {}, got_a)
+        while not got_a.toks:
+            time.sleep(0.005)
+        fw_b_sid_holder = fw_a.submit([pb], {}, emit_b)
+        del fw_b_sid_holder
+        assert seen_b.wait(120)
+        # B's prefix blocks are shared with the still-live A
+        snap = fw_a.drain_stream(got_b.sid, timeout=30)
+        assert snap["version"] == 2 and snap["kind"] == "live"
+        assert snap["shared_blocks"] >= 4, snap["shared_blocks"]
+        # the snapshot's cache rows are host copies, not pool views
+        assert isinstance(snap["blocks_k"], np.ndarray)
+        # survivor decodes to completion bit-identically — the drain
+        # did not perturb (or free) the blocks it still references
+        assert got_a.done.wait(120)
+        assert got_a.ids == refs[0]
+        cont = Collector()
+        fw_b.adopt_stream(snap, cont)
+        assert cont.done.wait(120)
+        assert got_b.ids[:snap["sidx"]] + cont.ids == refs[1]
+        # both pools whole again after everything retires
+        for fw in (fw_a, fw_b):
+            stats = fw._serve.pool_stats()
+            assert stats["blocks_free"] == stats["blocks_total"]
+            fw.close()
+
     def test_snapshot_file_version_gate(self, tmp_path):
         path = str(tmp_path / "snap.pkl")
         ckpt.save_stream_snapshot(path, {"kind": "queued", "version": 1})

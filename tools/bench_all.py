@@ -131,6 +131,31 @@ ROWS = [
     ("llm7b_int4_continuous_x32", ["--config", "llm7b", "--llm-quant",
                                    "int4", "--llm-serve", "continuous",
                                    "--llm-streams", "32"]),
+    # prefix-sharing row (ISSUE 15, docs/SERVING.md §4b): 32 streams all
+    # carrying the same 256-token system preamble — streams past the
+    # first hit the prefix cache, so their admission reservation and
+    # first-token prefill collapse to ~the 32-token suffix.  Compare
+    # late_join_first_token_ms + prefix_hit_blocks/cow_forks against
+    # the llm7b_int8_continuous_x32 row (no sharing) — the ≥5x
+    # admission-to-first-token target; the CPU-proxy A/B shape is
+    # bench.py --config prefix_spec (BENCH_SPEC_r01)
+    ("llm7b_int8_prefix_x32", ["--config", "llm7b", "--llm-quant",
+                               "int8", "--llm-serve", "continuous",
+                               "--llm-streams", "32",
+                               "--llm-prefix", "256"]),
+    # speculative decoding row (ISSUE 15, §4c): llama_tiny draft
+    # (vocab/max_seq overridden to the target's) proposes 4 tokens per
+    # round, the int8 7B target verifies them in ONE [slots,5]-wide
+    # paged step.  NOTE the random-weight caveat: zoo weights give a
+    # near-zero accept rate, so THIS row measures the structural floor
+    # (k tiny-draft steps + one wide verify per emitted token) — the
+    # trained-draft win is the roofline projection
+    # (accept*k+1)/(1+k*cost_ratio) carried by BENCH_SPEC_r01's row;
+    # the row's spec_accept_rate field makes the caveat self-evidencing
+    ("llm7b_spec_k4", ["--config", "llm7b", "--llm-quant", "int8",
+                       "--llm-serve", "continuous", "--llm-streams", "4",
+                       "--llm-draft", "llama_tiny",
+                       "--llm-spec-k", "4"]),
     # 2-D placement rows (ISSUE 9): tensor-parallel llama decode on the
     # pipeline's shared (data x model) mesh — per-chip weight + KV HBM
     # divide by M; the tp A/B pins greedy-id identity and records the

@@ -121,6 +121,19 @@ estimate, asserting the resource report prices the trainer's
 optimizer-state + gradient HBM (the "train state" line + the budget
 warning naming it), strict against tools/learn_deep_baseline.txt.
 
+AND it runs the spec gate (ISSUE 15, docs/SERVING.md §4b/§4c):
+tests/test_spec_decode.py in its own pytest process — ref-count/CoW
+allocator invariants (free only at refcount 0, fork-on-write isolation,
+recycled-slot identity under churn, the stale-table sentinel on
+multi-token writes), shared-prefix admission collapse, logical-block
+tenant quotas, greedy bit-identity of speculative vs plain decode at
+accept rates 0/partial/1, and the 5-program census pin — then ``lint
+--deep`` over examples/llm_prefix_serving.py with ``NNS_TPU_HBM_BUDGET``
+pinned below the estimate, asserting the resource report PRICES the
+draft model's params + block pool beside the ref-counted KV pool
+("draft params" / "draft pool" / "kv pool" lines + the budget warning),
+strict against tools/spec_deep_baseline.txt.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -149,6 +162,7 @@ FETCH_BASELINE = os.path.join(REPO, "tools", "fetch_deep_baseline.txt")
 ASR_BASELINE = os.path.join(REPO, "tools", "asr_deep_baseline.txt")
 XRAY_BASELINE = os.path.join(REPO, "tools", "xray_baseline.txt")
 LEARN_BASELINE = os.path.join(REPO, "tools", "learn_deep_baseline.txt")
+SPEC_BASELINE = os.path.join(REPO, "tools", "spec_deep_baseline.txt")
 
 #: HBM budget the MXU gate pins for the streaming-ASR example's deep
 #: lint: below the estimate, so the hbm-budget warning fires with the
@@ -463,6 +477,60 @@ def run_serving_gate(update: bool, timeout: int = 900) -> int:
            "OK" if ok else
            "POOL NOT PRICED" if not priced else "NEW DIAGNOSTICS")
     print(f"serving gate: {tag} ({passed} tests passed)")
+    if not ok and not update:
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_spec_gate(update: bool, timeout: int = 900) -> int:
+    """Prefix-sharing + speculative-decoding gate (ISSUE 15, docs/
+    SERVING.md §4b/§4c): tests/test_spec_decode.py as its own pytest
+    process (allocator refcount/CoW invariants, shared-prefix admission
+    collapse, logical-block quotas, spec-vs-plain greedy bit-identity at
+    every accept rate, the 5-program census pin), then ``lint --deep``
+    over the shared-prefix serving example with a sub-estimate HBM
+    budget pinned — the report must PRICE the draft's params and block
+    pool beside the ref-counted KV pool."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_spec_decode.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"spec gate: tests TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"spec gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    env["NNS_TPU_HBM_BUDGET"] = SERVING_GATE_BUDGET
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--deep", "-v", "--strict",
+           "--files", os.path.join("examples", "llm_prefix_serving.py"),
+           "--baseline", SPEC_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("spec gate: deep lint TIMED OUT after 300s", file=sys.stderr)
+        return 2
+    priced = all(k in lint.stdout
+                 for k in ("draft params", "draft pool", "kv pool"))
+    ok = lint.returncode == 0 and priced
+    tag = ("updated" if update else
+           "OK" if ok else
+           "DRAFT NOT PRICED" if not priced else "NEW DIAGNOSTICS")
+    print(f"spec gate: {tag} ({passed} tests passed)")
     if not ok and not update:
         for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
             print(f"  {line}", file=sys.stderr)
@@ -951,6 +1019,7 @@ def main() -> int:
     tracing_rc = run_tracing_gate()
     mxu_rc = run_mxu_gate(args.update)
     serving_rc = run_serving_gate(args.update)
+    spec_rc = run_spec_gate(args.update)
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
     elastic_rc = run_elastic_gate()
@@ -958,7 +1027,7 @@ def main() -> int:
     xray_rc = run_xray_gate(args.update)
     learn_rc = run_learn_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
-               or mxu_rc or serving_rc or fetch_rc or soak_rc
+               or mxu_rc or serving_rc or spec_rc or fetch_rc or soak_rc
                or elastic_rc or armor_rc or xray_rc or learn_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
