@@ -558,7 +558,8 @@ def bench_detection(batch: int, batches: int, size: int, warmup: int,
 def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
                           streams: int, model: str, quant: str,
                           shared_prefix: int = 0, draft: str = "",
-                          spec_k: int = 4) -> dict:
+                          spec_k: int = 4,
+                          temperature: float = 0.0) -> dict:
     """Continuous batching: stagger ``streams`` prompts into the RUNNING
     decode loop; report aggregate tokens/sec plus the late joiner's
     first-token latency (the metric continuous batching exists for —
@@ -647,7 +648,8 @@ def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
         "metric": (f"{model}_{quant or 'bf16'}_continuous_tokens_per_sec"
                    f"_{streams}_streams"
                    + (f"_prefix{shared_prefix}" if shared_prefix else "")
-                   + (f"_spec_k{spec_k}" if draft else "")),
+                   + (f"_spec_k{spec_k}" if draft else "")
+                   + ("_sampled" if temperature > 0.0 else "")),
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tps / 20.0, 3),
@@ -659,6 +661,8 @@ def _bench_llm_continuous(p, rng, max_new: int, prompt_len: int,
         "full_occupancy_tokens_per_sec": round(occ_tps, 1),
         "wall_s": round(wall, 3),
     }
+    if temperature > 0.0:
+        row["temperature"] = temperature
     snap1 = _metrics.snapshot()
 
     def delta(name):
@@ -824,7 +828,7 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
               quant: str = "", streams: int = 1,
               serve: str = "", text: bool = False,
               shared_prefix: int = 0, draft: str = "",
-              spec_k: int = 4) -> dict:
+              spec_k: int = 4, temperature: float = 0.0) -> dict:
     """Config #5: tokens/sec through the llm filter (jitted prefill +
     lax.scan decode).  vs_baseline compares against the reference's
     llama.cpp CPU path order of magnitude (~20 tok/s).
@@ -839,11 +843,12 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
     import nnstreamer_tpu as nt
 
     rng = np.random.default_rng(0)
-    if (shared_prefix or draft) and serve != "continuous":
-        # both rows only exist on the serve loop; silently dropping the
+    if (shared_prefix or draft or temperature > 0.0) \
+            and serve != "continuous":
+        # these rows only exist on the serve loop; silently dropping the
         # flags would record a mislabeled plain-decode artifact
-        raise SystemExit("--llm-prefix/--llm-draft require "
-                         "--llm-serve continuous")
+        raise SystemExit("--llm-prefix/--llm-draft/--llm-temperature "
+                         "require --llm-serve continuous")
     if max_new is None:
         # continuous default decodes longer so the steady full-occupancy
         # phase dominates the stagger ramp in the headline window (the
@@ -897,9 +902,16 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
                    f",block_size:{block_size}"
                    f",kv_blocks:{n_streams * need}")
         if draft:
-            # speculative decoding (docs/SERVING.md §4c): greedy-only,
-            # preset draft priced beside the target
-            custom += f",draft:{draft},spec_k:{spec_k},temperature:0.0"
+            # speculative decoding (docs/SERVING.md §4c): preset draft
+            # priced beside the target.  temperature 0 = greedy accept
+            # (bit-identical stream); >0 = rejection sampling (§4d) —
+            # SAME fused verify program, accept math swaps in-body.
+            custom += f",draft:{draft},spec_k:{spec_k}," \
+                      f"temperature:{temperature}"
+        elif temperature > 0.0:
+            # sampled serve row (docs/SERVING.md §4d): per-slot seeded
+            # PRNG rides the standing loop — same program census
+            custom += f",temperature:{temperature}"
     # invoke-dynamic only for the continuous path: the committed static
     # rows were measured without it, and it must stay that way so this
     # commit reproduces the artifact's exact pipelines.  The '!' before
@@ -919,7 +931,8 @@ def bench_llm(batches: int, warmup: int, model: str = "llama_small",
         return _bench_llm_continuous(p, rng, max_new, prompt_len,
                                      n_streams, model, quant,
                                      shared_prefix=shared_prefix,
-                                     draft=draft, spec_k=spec_k)
+                                     draft=draft, spec_k=spec_k,
+                                     temperature=temperature)
     toks = 0
     with p:
         # streams>1: N concurrent prompts decode in ONE lax.scan loop.
@@ -1105,6 +1118,179 @@ def bench_prefix_spec(batches: int, warmup: int,
             "llama_tiny draft vs int8 7B) pays ~{:.2%} of the target's "
             "HBM bytes per draft step; projection = "
             "(accept*k+1)/(1+k*cost_ratio)".format(c)),
+    }
+
+
+def bench_gqa_sampling(batches: int, warmup: int,
+                       model: str = "llama_tiny",
+                       spec_k: int = 3) -> dict:
+    """ISSUE 16 A/B: the three decode hot-loop changes in one row —
+    grouped-GQA kernel traffic, the fused speculative verify's host
+    transfer budget, and the sampled serve loop's overhead.
+
+    Arm 1 (kernel): flash attention on the SAME [B,S,Hkv,D] K/V fed
+    grouped vs pre-repeated to [B,S,H,D].  On the CPU proxy the Pallas
+    kernel runs interpreted and per-call trace overhead dominates the
+    wall (measured ratio ~1x despite the repeated layout running
+    H/Hkv x the grid) — the A/B here only pins that grouped is never
+    SLOWER; the silicon claim rides the projection below, which is pure
+    ``serving_plan`` arithmetic (decode K/V bytes scale with n_kv_heads,
+    tests/test_kernels_gqa.py pins the kernel's DMA structure).
+
+    Arm 2 (sampling): continuous-serve tokens/sec at temperature 0.9 vs
+    greedy on identical prompts — the per-slot seeded sampler
+    (docs/SERVING.md §4d) compiles into the standing decode program, so
+    its cost is a few fused element-wise ops per step, not a program
+    swap.  The tiny CPU preset EXAGGERATES the sampler's share (its
+    model step is microseconds; sort/cumsum over the vocab is
+    comparable) — the silicon delta is llm7b_sampled_x32 vs
+    llm7b_int8_continuous_x32, where the 7B step dwarfs it.
+
+    Arm 3 (fused verify): sampled speculative serve (rejection
+    sampling through the SAME fused [slots, k+1] verify program), plus
+    the per-round host-transfer ledger the fusion buys: the loop now
+    downloads exactly the emitted rows + accept counts, where the
+    unfused round also shipped the proposals down and the tok/tok_prev
+    state back up (tests/test_sampling.py pins proposals-never-leave).
+
+    Silicon projection: llama2_7b at n_kv_heads 8 (the production 70B
+    GQA geometry on the 7B shape) vs its stock 32 at int8 weights,
+    32 streams x 1024 live context tokens — decode is HBM-roofline
+    bound (PROFILE_LLM_r5 precedent), so projected tok/s scales with
+    step bytes: (params + kv_mha) / (params + kv_gqa)."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import nnstreamer_tpu as nt
+    from nnstreamer_tpu.core.log import metrics as _metrics
+    from nnstreamer_tpu.filters.llm import serving_plan
+    from nnstreamer_tpu.models import llama as _llama
+    from nnstreamer_tpu.ops import attention as _att
+
+    rng = np.random.default_rng(0)
+    on_cpu = jax.default_backend() == "cpu"
+
+    # -- arm 1: grouped vs repeated kernel ---------------------------------
+    b, s, h, hkv, d = 1, 256, 8, 2, 32
+    kk = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kk[0], (b, s, h, d), jnp.float32)
+    kt = jax.random.normal(kk[1], (b, s, hkv, d), jnp.float32)
+    vt = jax.random.normal(kk[2], (b, s, hkv, d), jnp.float32)
+    krep = jnp.repeat(kt, h // hkv, axis=2)
+    vrep = jnp.repeat(vt, h // hkv, axis=2)
+
+    def kernel_ms(kx, vx) -> float:
+        def once():
+            jax.block_until_ready(_att.flash_attention(
+                q, kx, vx, causal=True, block_q=64, block_k=64,
+                interpret=on_cpu or None))
+        once()  # trace/compile warm-up
+        reps = max(2, min(batches, 4 if on_cpu else 32))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            once()
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    grouped_ms = kernel_ms(kt, vt)
+    repeated_ms = kernel_ms(krep, vrep)
+
+    # -- arms 2+3: serve-loop tok/s (greedy / sampled / sampled spec) ------
+    max_new, streams, plen = 32, 2, 12
+
+    def serve_tps(temp: float, spec: bool) -> tuple:
+        extra = f",draft:{model},spec_k:{spec_k}" if spec else ""
+        desc = ("appsrc name=src ! "
+                f"tensor_filter framework=llm model={model} "
+                f"custom=max_new:{max_new},serve:continuous,slots:"
+                f"{streams},stream_chunk:4,temperature:{temp},seed:3,"
+                f"block_size:16,kv_blocks:0,prefix_cache:0{extra} "
+                "invoke-dynamic=true ! tensor_sink name=out")
+        a0 = _metrics.snapshot().get("llm.serve.spec_accepted", 0.0)
+        r0 = _metrics.snapshot().get("llm.serve.spec_rejected", 0.0)
+        with nt.Pipeline(desc) as p:
+            p.push("src", rng.integers(1, 400, (plen,), np.int32))
+            first = p.pull("out", timeout=2100)  # compile + stream 0 live
+            for _ in range(streams - 1):
+                p.push("src", rng.integers(1, 400, (plen,), np.int32))
+            bufs = [p.pull("out", timeout=900)
+                    for _ in range(streams * max_new - 1)]
+            p.eos()
+            p.wait(timeout=60)
+        emits = sorted(bf.meta["emit_t"] for bf in bufs)
+        wall = emits[-1] - first.meta["emit_t"]
+        snap = _metrics.snapshot()
+        acc = snap.get("llm.serve.spec_accepted", 0.0) - a0
+        rej = snap.get("llm.serve.spec_rejected", 0.0) - r0
+        rate = acc / (acc + rej) if acc + rej else 0.0
+        return len(emits) / wall, rate
+
+    greedy_tps, _ = serve_tps(0.0, False)
+    sampled_tps, _ = serve_tps(0.9, False)
+    spec_tps, accept_rate = serve_tps(0.9, True)
+
+    # fused-verify host ledger, per round at [slots, k+1] (int32):
+    # fused = emitted rows + accept counts; the unfused structure also
+    # downloaded the k proposals and re-uploaded tok/tok_prev/positions
+    fused_bytes = streams * (spec_k + 1) * 4 + streams * 4
+    unfused_bytes = (fused_bytes + streams * spec_k * 4
+                     + 3 * streams * 4)
+
+    # -- silicon projection: 7B int8 decode step bytes, MHA vs GQA-8 -------
+    big = _llama.PRESETS["llama2_7b"]
+    gqa = dataclasses.replace(big, n_kv_heads=8)
+    p_mha = serving_plan(big, slots=32, dtype="bfloat16")
+    p_gqa = serving_plan(gqa, slots=32, dtype="bfloat16")
+    param = _llama.param_bytes_estimate(big, quant="int8",
+                                        param_dtype="bfloat16")
+    live_ctx = 32 * 1024  # 32 streams x 1024 live context tokens
+    step_mha = param + live_ctx * p_mha["decode_bytes_per_ctx_token"]
+    step_gqa = param + live_ctx * p_gqa["decode_bytes_per_ctx_token"]
+    proj = step_mha / step_gqa
+
+    return {
+        "metric": "gqa_grouped_decode_projected_speedup_7b",
+        "value": round(proj, 2),
+        "unit": "x",
+        "vs_baseline": round(proj / 1.3, 3),  # the >=1.3x tentpole bar
+        "kv_groups_7b_gqa8": p_gqa["kv_groups"],
+        "decode_bytes_per_ctx_token_mha": p_mha[
+            "decode_bytes_per_ctx_token"],
+        "decode_bytes_per_ctx_token_gqa8": p_gqa[
+            "decode_bytes_per_ctx_token"],
+        "param_bytes_7b_int8": int(param),
+        "projection_live_ctx_tokens": live_ctx,
+        "flash_grouped_ms": round(grouped_ms, 1),
+        "flash_repeated_ms": round(repeated_ms, 1),
+        "kernel_ab_ratio": round(repeated_ms / grouped_ms, 2)
+        if grouped_ms else 0.0,
+        "kernel_proxy_caveat": (
+            "interpreted Pallas on the CPU proxy: per-call trace "
+            "overhead dominates the wall, so the A/B only pins that the "
+            "grouped layout is never slower — on silicon the win is the "
+            "K/V DMA traffic ratio (kv_groups), priced by serving_plan "
+            "and pinned by tests/test_kernels_gqa.py"),
+        "greedy_tokens_per_sec": round(greedy_tps, 1),
+        "sampled_tokens_per_sec": round(sampled_tps, 1),
+        "sampler_overhead_pct": round(
+            (greedy_tps / sampled_tps - 1) * 100, 1)
+        if sampled_tps else 0.0,
+        "sampler_proxy_caveat": (
+            "tiny-preset CPU proxy: the model step is microseconds, so "
+            "the compiled-in sampler's vocab-length sort/cumsum reads "
+            "as tens of percent — at 7B the same ops are noise against "
+            "the HBM-bound step (llm7b_sampled_x32 vs "
+            "llm7b_int8_continuous_x32 measures it)"),
+        "spec_sampled_tokens_per_sec": round(spec_tps, 1),
+        "spec_k": spec_k,
+        "spec_accept_rate": round(accept_rate, 3),
+        "fused_verify_host_bytes_per_round": fused_bytes,
+        "unfused_verify_host_bytes_per_round": unfused_bytes,
+        "verify_host_transfer_reduction": round(
+            unfused_bytes / fused_bytes, 2),
     }
 
 
@@ -1959,7 +2145,7 @@ def main() -> int:
                              "llm", "llm7b", "link", "batching", "adaptive",
                              "asr_stream", "train_stream", "sharded",
                              "tp", "tp_grid", "fetch", "prefix_spec",
-                             "all"])
+                             "gqa_sampling", "all"])
     # classification defaults to 256: the r3 on-chip session measured 2x
     # the fps AND 2x the MFU of batch 64 (30,137 fps / 0.175 MFU vs
     # 15,116 / 0.088) at a still-interactive 5.4 ms p50 — deeper batches
@@ -1989,6 +2175,10 @@ def main() -> int:
     ap.add_argument("--llm-spec-k", type=int, default=4,
                     help="proposals per speculative round (with "
                          "--llm-draft)")
+    ap.add_argument("--llm-temperature", type=float, default=0.0,
+                    help="llm/llm7b continuous: sampled serving "
+                         "(per-slot seeded temperature/top-k/top-p, "
+                         "docs/SERVING.md §4d); 0 = greedy")
     ap.add_argument("--llm-serve", default="", choices=["", "continuous"],
                     help="continuous: staggered prompts join a RUNNING "
                          "decode loop (reports late-join latency too)")
@@ -2064,6 +2254,8 @@ def main() -> int:
             "fetch": ("async_fetch_speedup_depth2_donate_vs_serial", "x"),
             "prefix_spec": ("llama_small_prefix_hit_admission_speedup",
                             "x"),
+            "gqa_sampling": ("gqa_grouped_decode_projected_speedup_7b",
+                             "x"),
         }
         todo = (["classification", "detection", "pose", "segmentation",
                  "audio", "llm"]
@@ -2116,7 +2308,8 @@ def main() -> int:
                                  text=args.llm_text,
                                  shared_prefix=args.llm_prefix,
                                  draft=args.llm_draft,
-                                 spec_k=args.llm_spec_k),
+                                 spec_k=args.llm_spec_k,
+                                 temperature=args.llm_temperature),
         "llm7b": lambda: bench_llm(2, 1, model="llama2_7b",
                                    quant=args.llm_quant,
                                    streams=args.llm_streams,
@@ -2124,7 +2317,8 @@ def main() -> int:
                                    text=args.llm_text,
                                    shared_prefix=args.llm_prefix,
                                    draft=args.llm_draft,
-                                   spec_k=args.llm_spec_k),
+                                   spec_k=args.llm_spec_k,
+                                   temperature=args.llm_temperature),
         "link": bench_link,
         "batching": lambda: bench_batching(args.batches, args.warmup),
         "adaptive": lambda: bench_adaptive(args.batches, args.warmup),
@@ -2139,6 +2333,8 @@ def main() -> int:
         "prefix_spec": lambda: bench_prefix_spec(
             max(4, args.batches // 16), args.warmup,
             model=args.llm_model, spec_k=args.llm_spec_k),
+        "gqa_sampling": lambda: bench_gqa_sampling(
+            max(2, args.batches // 32), args.warmup),
     }
     todo = list(runners) if args.config == "all" else [args.config]
     if args.config == "all":

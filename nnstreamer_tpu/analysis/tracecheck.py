@@ -97,14 +97,28 @@ class StageResource:
     #: excluded from hbm_bytes/by_category to avoid double counting.
     draft_param_bytes: int = 0
     draft_pool_bytes: int = 0
+    #: sampler per-slot PRNG key state (continuous LLM serving with
+    #: ``temperature > 0`` — serving_plan's ``prng_state_bytes``);
+    #: tiny but ledger-reconciled like every resident category
+    prng_bytes: int = 0
+    #: per-step decode HBM TRAFFIC model (continuous LLM serving): K+V
+    #: bytes the grouped-GQA kernel streams per live context token per
+    #: decode step — priced at ``n_kv_heads`` (serving_plan's
+    #: ``decode_bytes_per_ctx_token``); pricing at ``n_heads`` is the
+    #: stale over-prediction the reconciliation regression pins.
+    #: Traffic, not residency: excluded from ``hbm_bytes``.
+    decode_bytes_per_ctx_token: int = 0
+    #: query heads sharing one KV head's streamed blocks (H / Hkv)
+    kv_groups: int = 1
 
     @property
     def hbm_bytes(self) -> int:
         """Per-device HBM this stage plans for: resident params + KV pool
-        + aggregator ring + training state + in-flight activations
-        (dispatch window already multiplied into rows)."""
+        + aggregator ring + training state + sampler PRNG state +
+        in-flight activations (dispatch window already multiplied into
+        rows)."""
         return (self.param_bytes + self.pool_bytes + self.ring_bytes
-                + self.train_bytes
+                + self.train_bytes + self.prng_bytes
                 + self.act_row_bytes * self.rows_per_device)
 
 
@@ -155,6 +169,7 @@ class ResourceReport:
             "activations": sum(s.act_row_bytes * s.rows_per_device
                                for s in self.stages),
             "train_state": sum(s.train_bytes for s in self.stages),
+            "prng_state": sum(s.prng_bytes for s in self.stages),
         }
 
     def summary(self) -> str:
@@ -194,6 +209,12 @@ class ResourceReport:
                 + (f"agg ring {_mib(s.ring_bytes)}, " if s.ring_bytes
                    else "")
                 + (f"train state {_mib(s.train_bytes)}, " if s.train_bytes
+                   else "")
+                + (f"prng state {s.prng_bytes} B, " if s.prng_bytes
+                   else "")
+                + (f"decode traffic {s.decode_bytes_per_ctx_token} "
+                   f"B/ctx-token (x{s.kv_groups} KV sharing), "
+                   if s.decode_bytes_per_ctx_token and s.kv_groups > 1
                    else "")
                 + f"act/row {_mib(s.act_row_bytes)}, "
                 f"rows/dev {s.rows_per_device}, "
@@ -499,6 +520,7 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
         )
         int(opts.get("stream_chunk", 8))  # the decode chunk length
         spec_k = max(1, int(opts.get("spec_k", 4)))
+        temperature = float(opts.get("temperature", 0.0))
     except (TypeError, ValueError):
         diags.append(Diagnostic(
             "recompile-unbounded", WARNING,
@@ -530,7 +552,8 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
 
     dtype = str(opts.get("dtype", "bfloat16"))
     plan = serving_plan(cfg, dtype=dtype, draft_cfg=draft_cfg,
-                        spec_k=spec_k, **plan_kw)
+                        spec_k=spec_k, temperature=temperature,
+                        **plan_kw)
     quant = str(opts.get("quant", "")).lower()
     param_dtype = str(opts.get("param_dtype", "float32"))
     # Tensor parallelism: the pipeline's resolved model axis, with the
@@ -592,7 +615,10 @@ def _llm_serving_stage(node, diags, model_par: int = 1):
         rows_per_device=slots, variants=plan["programs"],
         batchable=False, shard_eligible=False, sharded=ways > 1,
         pos=node.pos, pool_bytes=pool + draft_pool,
-        draft_param_bytes=draft_params, draft_pool_bytes=draft_pool)
+        draft_param_bytes=draft_params, draft_pool_bytes=draft_pool,
+        prng_bytes=plan["prng_state_bytes"],
+        decode_bytes_per_ctx_token=plan["decode_bytes_per_ctx_token"],
+        kv_groups=plan["kv_groups"])
 
 
 def _trainer_stage(node, diags, model_par: int = 1):

@@ -77,10 +77,83 @@ def _next_bucket(t: int) -> int:
     return b
 
 
+#: Per-slot PRNG draw tags (docs/SERVING.md §4d).  Every device-side
+#: draw folds (absolute token position, tag) into the slot's own key;
+#: the tag separates the four draw kinds one position can host — the
+#: non-spec sample, the draft proposal, the k accept uniforms, and the
+#: residual/bonus resample.
+TAG_SAMPLE, TAG_DRAFT, TAG_ACCEPT, TAG_FINAL = 100, 101, 102, 103
+
+
+def _fold_slot_keys(keys, p, tag):
+    """Per-draw derived keys ([B, 2] uint32 slot keys + [B] absolute
+    positions -> [B, 2]): fold the position, then the draw tag."""
+    import jax
+
+    kk = jax.vmap(jax.random.fold_in)(keys, p)
+    return jax.vmap(lambda kd: jax.random.fold_in(kd, tag))(kk)
+
+
+def spec_rejection_commit(pt, dprobs, props, keys, pos, live):
+    """Standard speculative rejection sampling, vectorized per slot.
+
+    ``pt`` [B, k+1, V]: the TARGET's filtered sampling distributions
+    over (last committed token + k proposals); ``dprobs`` [B, k, V]:
+    the DRAFT distributions each proposal was drawn from; ``props``
+    [B, k]: the proposals; ``keys`` [B, 2]: slot base keys; ``pos``
+    [B]: absolute positions (the fold anchor); ``live`` [B] bool:
+    parked-row mask (parked rows commit nothing).
+
+    Accepts proposal i iff ``u_i * q(x_i) < p(x_i)`` (u ~ U[0,1) from
+    the slot key folded at (pos, TAG_ACCEPT)), keeps the longest
+    accepted prefix, and resamples the first rejection from the
+    normalized residual ``max(p - q, 0)`` — or the bonus distribution
+    ``pt[k]`` when all k accept (padding q with a zero row makes that
+    fall out of the same gather).  Emitted tokens are distributed
+    EXACTLY as sampling the target one token at a time, which is the
+    marginal tests/test_sampling.py chi-squares this helper against.
+
+    Returns ``(em, acc)``: ``em`` [B, k+1] the emitted-token rows
+    (accepted proposals, then the residual/bonus token at column
+    ``acc``); ``acc`` [B] the accept counts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k_spec = props.shape[1]
+    q_x = jnp.take_along_axis(dprobs, props[:, :, None], axis=2)[:, :, 0]
+    pt_x = jnp.take_along_axis(
+        pt[:, :k_spec], props[:, :, None], axis=2)[:, :, 0]
+    ka = _fold_slot_keys(keys, pos, TAG_ACCEPT)
+    u = jax.vmap(lambda kd: jax.random.uniform(kd, (k_spec,)))(ka)
+    ok = (u * q_x < pt_x).astype(jnp.int32)
+    acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+    acc = jnp.where(live, acc, 0)
+    # residual at the first rejected position; padding q with a zero
+    # row makes acc == k fall through to the bonus distribution pt[k]
+    # automatically.  A residual with (numerically) zero mass can only
+    # mean p == q at that position — fall back to pt itself.
+    qpad = jnp.concatenate([dprobs, jnp.zeros_like(dprobs[:, :1])], axis=1)
+    resid = jnp.maximum(pt - qpad, 0.0)
+    r_at = jnp.take_along_axis(resid, acc[:, None, None], axis=1)[:, 0]
+    pt_at = jnp.take_along_axis(pt, acc[:, None, None], axis=1)[:, 0]
+    rsum = jnp.sum(r_at, axis=-1, keepdims=True)
+    r = jnp.where(rsum > 1e-20, r_at, pt_at)
+    kf = _fold_slot_keys(keys, pos, TAG_FINAL)
+    final = jax.vmap(jax.random.categorical)(
+        kf, jnp.log(jnp.maximum(r, 1e-38))).astype(jnp.int32)
+    # emitted rows: accepted proposals then the final (residual/bonus)
+    # token at column ``acc``
+    em = jnp.concatenate([props, jnp.zeros_like(props[:, :1])], axis=1)
+    col = jnp.arange(k_spec + 1)[None, :]
+    em = jnp.where(col == acc[:, None], final[:, None], em)
+    return em, acc
+
+
 def serving_plan(cfg, *, slots: int, block_size: int = 16,
                  kv_blocks: int = 0, prefill_chunk: int = 32,
                  dtype: str = "bfloat16", draft_cfg=None,
-                 spec_k: int = 4) -> Dict[str, int]:
+                 spec_k: int = 4, temperature: float = 0.0) -> Dict[str, int]:
     """Static sizing of the paged-KV serving state, WITHOUT building
     anything — one home for the arithmetic :class:`_ContinuousLoop` and
     the deep lint's resource report (analysis/tracecheck.py) must agree
@@ -106,6 +179,23 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
       draft shares the allocator, block tables, and ``n_blocks`` with
       the target, so its pool is the same geometry at the draft's
       (L, H_kv, hd) — 0 without a draft.
+    * ``decode_bytes_per_ctx_token`` — per-decode-step HBM traffic the
+      paged attention kernel reads PER LIVE CONTEXT TOKEN: K + V rows
+      across every layer at the model's ``n_kv_heads`` — NOT
+      ``n_heads``.  The kernel DMAs each K/V block once per query-head
+      GROUP (ops/attention.py), so a GQA config's decode traffic is
+      ``n_kv_heads/n_heads`` of the repeated-layout figure; predicted
+      step bytes = (sum of live context lengths, block-rounded) x this
+      coefficient.  nns-xray's roofline attribution and the deep lint
+      consume it — pricing with ``n_heads`` here is exactly the stale
+      over-prediction the reconciliation regression pins.
+    * ``kv_groups`` — ``n_heads // n_kv_heads``, the per-block DMA
+      sharing factor of the grouped kernel (1 = plain MHA, no win).
+    * ``prng_state_bytes`` — the sampler's per-slot PRNG key state
+      (one uint32[2] counter key per slot) carried device-resident when
+      ``temperature > 0``; 0 for greedy loops.  Tiny, but the xray HBM
+      ledger reconciles measured-vs-predicted by category, so an
+      unpriced resident buffer is a drift seed.
     * ``programs`` — compiled XLA signatures the standing loop ever
       uses.  Without speculation: the ``[slots]``-row paged decode
       chunk, the ``[1, prefill_chunk]`` prefill step, and the slot-token
@@ -113,11 +203,14 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
       the propose/verify pair and the draft gets its own prefill step:
       target prefill, draft prefill, draft propose (k draft steps + the
       refresh step as ONE scan), target verify (a ``[slots, k+1]``-wide
-      paged step), and the slot-token setter (5).  Every shape is
-      static in admission state — stream join/leave/complete AND
-      accept/reject ratios change VALUES only — which is why this
-      census is CLOSED (the compile-counter pins in
-      tests/test_llm_continuous.py and tests/test_spec_decode.py).
+      paged step that commits tokens/positions in-program), and the
+      slot-token setter (5).  Every shape is static in admission state —
+      stream join/leave/complete AND accept/reject ratios change VALUES
+      only — which is why this census is CLOSED (the compile-counter
+      pins in tests/test_llm_continuous.py and tests/test_spec_decode
+      .py).  Sampling (``temperature > 0``) swaps program BODIES (the
+      sampler is compiled in, per-slot keys ride as values), never the
+      count.
     """
     import math
 
@@ -125,6 +218,8 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
 
     bs = max(1, int(block_size))
     C = max(1, int(prefill_chunk))
+    itemsize = 2 if str(dtype) in ("bfloat16", "float16") else 4
+    hd = cfg.dim // cfg.n_heads
     pad_max = math.ceil((cfg.max_seq - 1) / C) * C
     # Speculation: the final rounds dispatch the fixed [slots, k+1]-wide
     # verify (and the k-step propose scan) even when fewer tokens remain,
@@ -147,6 +242,14 @@ def serving_plan(cfg, *, slots: int, block_size: int = 16,
         "draft_pool_bytes": (
             _llama.paged_cache_bytes(draft_cfg, n_blocks, bs, dtype=dtype)
             if draft_cfg is not None else 0),
+        # K + V, every layer, at the KV-head count — the grouped kernel's
+        # per-context-token decode read (ops/attention.py shares each
+        # block DMA across the whole query-head group)
+        "decode_bytes_per_ctx_token": (
+            2 * cfg.n_layers * cfg.n_kv_heads * hd * itemsize),
+        "kv_groups": cfg.n_heads // cfg.n_kv_heads,
+        "prng_state_bytes": (int(slots) * 2 * 4
+                             if float(temperature) > 0.0 else 0),
         "programs": 5 if draft_cfg is not None else 3,
     }
 
@@ -268,9 +371,14 @@ class LLMFramework(Framework):
         # Speculative decoding (docs/SERVING.md §4c): ``draft:<preset>``
         # builds a small draft model that proposes ``spec_k`` tokens per
         # round; the target verifies them in ONE fixed-shape
-        # [slots, k+1]-wide paged step.  Greedy-only: acceptance is
-        # exact prefix match against the target's own argmax, so the
-        # emitted stream is bit-identical to plain decode.
+        # [slots, k+1]-wide paged step.  Greedy (temperature:0):
+        # acceptance is exact prefix match against the target's own
+        # argmax, so the emitted stream is bit-identical to plain
+        # decode.  Sampled (temperature>0): standard speculative
+        # rejection sampling — each proposal is accepted with
+        # min(1, p_target/p_draft) and rejections resample from the
+        # normalized residual, so every emitted token is distributed
+        # EXACTLY as non-speculative sampling (docs/SERVING.md §4d).
         self.draft_name = str(opts.pop("draft", "") or "")
         self.spec_k = max(1, int(opts.pop("spec_k", 4)))
         draft_seed = int(opts.pop("draft_seed", 0))
@@ -314,12 +422,10 @@ class LLMFramework(Framework):
                     "draft: (speculative decoding) requires "
                     "serve:continuous — the per-request stream path has "
                     "no standing verify loop")
-            if self.temperature > 0.0:
-                raise FrameworkError(
-                    "draft: (speculative decoding) is greedy-only: "
-                    "acceptance is exact prefix match against the "
-                    "target's argmax, which sampling breaks — set "
-                    "temperature:0 or drop the draft")
+            # temperature > 0 composes with the draft: verify switches
+            # from exact-prefix-match to speculative rejection sampling
+            # (distribution-equivalent to the non-spec sampler, see
+            # docs/SERVING.md §4d) — no guard needed here.
             if self.draft_name not in llama.PRESETS:
                 raise FrameworkError(
                     f"draft model {self.draft_name!r} must be a preset "
@@ -562,8 +668,12 @@ class LLMFramework(Framework):
         host-value snapshot (trainer/checkpoint.py's serialization
         substrate), and its slot + blocks return to the free list.
         Greedy continuation after :meth:`adopt_stream` is bit-identical
-        to an undrained run; sampled (temperature > 0) streams continue
-        from a fresh RNG key (the snapshot records ``greedy``)."""
+        to an undrained run; sampled (temperature > 0) streams carry
+        their per-slot PRNG key in the snapshot (``prng_key``), so a
+        same-seed continuation is ALSO bit-identical — the key is a
+        pure function of (framework seed, admission number) and every
+        draw folds in the absolute token position, never the slot or
+        wall-clock step (docs/SERVING.md §4d)."""
         if self._serve is None:
             raise FrameworkError("no continuous serve loop is running")
         return self._serve.drain_stream(int(stream_id), timeout)
@@ -823,12 +933,14 @@ class _ContinuousLoop:
     model proposes ``spec_k`` tokens per round (one scan; its paged
     pool shares this allocator's tables block-for-block) and the
     target verifies them in ONE fixed-shape ``[slots, spec_k+1]``-wide
-    paged step — a k-wide prefill chunk.  The host accepts the longest
-    proposal prefix matching the target's own argmax plus the target's
-    bonus token: 1..k+1 tokens per TARGET dispatch, bit-identical to
-    plain greedy decode at every accept rate.  Accept/reject moves
-    positions by VALUES; the census grows to exactly 5 programs
-    (serving_plan).
+    paged step — a k-wide prefill chunk that ALSO accepts and commits
+    in-program: greedy loops take the longest proposal prefix matching
+    the target's own argmax plus the target's bonus token (bit-
+    identical to plain greedy decode at every accept rate); sampled
+    loops run speculative rejection sampling (distribution-equivalent
+    to the non-spec sampler).  1..k+1 tokens per TARGET dispatch; the
+    host reads back only the accept count + emitted rows and the
+    census grows to exactly 5 programs (serving_plan).
 
     **Fixed decode signature.**  Every program — the per-chunk paged
     decode ``[slots]``-row scan (or the propose/verify pair), the
@@ -862,7 +974,8 @@ class _ContinuousLoop:
         plan = serving_plan(cfg, slots=fw.slots, block_size=bs,
                             kv_blocks=fw.kv_blocks,
                             prefill_chunk=fw.prefill_chunk, dtype=fw.dtype,
-                            draft_cfg=fw.draft_cfg, spec_k=fw.spec_k)
+                            draft_cfg=fw.draft_cfg, spec_k=fw.spec_k,
+                            temperature=temperature)
         self.max_blocks = plan["max_blocks"]
         self.n_blocks = plan["n_blocks"]
         self.sentinel = self.n_blocks  # unallocated table entry
@@ -911,24 +1024,37 @@ class _ContinuousLoop:
         #: once per executed hot-swap, published as llm.serve.param_version
         self.param_version = 0
 
-        def decode_chunk(params, tok, pool, tables, pos, key, length):
+        # -- per-slot PRNG (docs/SERVING.md §4d) ------------------------
+        # Slot keys ride slot state the way tok_prev does: every draw
+        # folds (absolute token position, draw tag) into the slot's own
+        # key, so a stream's sampled tokens are a pure function of
+        # (framework seed, admission number, position) — independent of
+        # batch composition, accept history, and wall-clock step.  Churn
+        # changes key VALUES only; the compiled programs never see a new
+        # signature, and drain/adopt carries the key in the snapshot.
+        self._sampled = temperature > 0.0
+        slot_keys = _fold_slot_keys  # module level so tests drive it raw
+
+        def decode_chunk(params, tok, pool, tables, pos, keys, length):
             """``length`` paged decode steps as ONE program (lax.scan):
             every slot advances at its own depth through its own blocks.
             ``pos`` arrives fresh from host bookkeeping each call, so a
-            parked row can never creep toward int32 wraparound."""
+            parked row can never creep toward int32 wraparound.  ONE
+            signature for greedy and sampled loops: at temperature 0
+            the per-slot key folds are dead code XLA drops."""
             def step(carry, _):
-                tok, pool, key, p = carry
-                key, sub = jax.random.split(key)
+                tok, pool, p = carry
                 logits, pool = llama.forward_paged(
                     params, tok[:, None], pool, tables, p, cfg,
                     compute_dtype=fw.dtype)
-                nxt = llama.sample_token(logits[:, -1], sub, temperature,
-                                         fw.top_k, fw.top_p)
-                return (nxt, pool, key, p + 1), nxt
+                kstep = slot_keys(keys, p + 1, TAG_SAMPLE)
+                nxt = llama.sample_token_per_slot(
+                    logits[:, -1], kstep, temperature, fw.top_k, fw.top_p)
+                return (nxt, pool, p + 1), nxt
 
-            (tok, pool, key, _), toks = lax.scan(
-                step, (tok, pool, key, pos), None, length=length)
-            return jnp.moveaxis(toks, 0, 1), tok, pool, key
+            (tok, pool, _), toks = lax.scan(
+                step, (tok, pool, pos), None, length=length)
+            return jnp.moveaxis(toks, 0, 1), tok, pool
 
         self._decode = jax.jit(
             decode_chunk, static_argnames=("length",), donate_argnums=(2,))
@@ -974,17 +1100,21 @@ class _ContinuousLoop:
             self._draft_prefill = jax.jit(draft_prefill_step,
                                           donate_argnums=(2,))
 
-            def propose(dparams, tok_prev, tok, dpool, tables, pos):
+            def propose(dparams, tok_prev, tok, dpool, tables, pos, keys):
                 """One speculative round's draft side: re-feed the
                 PREVIOUS token at ``pos - 1`` (the refresh step — after
                 a fully-accepted round the draft pool has a hole at the
                 last committed position; recomputing it from identical
                 context is bit-exact and keeps the pool hole-free), then
-                ``k`` greedy draft steps from ``tok``.  Returns
-                proposals [B, k] + the updated draft pool.  Parked rows
-                stay parked: the refresh position is clamped to the
-                park value so their table lookups still resolve to the
-                sentinel and the paged kernel issues zero DMAs."""
+                ``k`` draft steps from ``tok``.  Greedy loops take the
+                draft's argmax; sampled loops draw each proposal from
+                the FILTERED draft distribution with the slot key folded
+                at the proposal's absolute position, and return those
+                distributions [B, k, vocab] so verify can run rejection
+                sampling.  Parked rows stay parked: the refresh position
+                is clamped to the park value so their table lookups
+                still resolve to the sentinel and the paged kernel
+                issues zero DMAs."""
                 rpos = jnp.where(pos >= park_bound, pos, pos - 1)
                 _, dpool = llama.forward_paged(
                     dparams, tok_prev[:, None], dpool, tables, rpos,
@@ -995,32 +1125,75 @@ class _ContinuousLoop:
                     logits, dpool = llama.forward_paged(
                         dparams, t[:, None], dpool, tables, p, dcfg,
                         compute_dtype=fw.dtype)
-                    nxt = jnp.argmax(logits[:, -1],
-                                     axis=-1).astype(jnp.int32)
-                    return (nxt, dpool, p + 1), nxt
+                    if temperature > 0.0:
+                        filt = llama.filter_logits(
+                            logits[:, -1], temperature, fw.top_k, fw.top_p)
+                        probs = jax.nn.softmax(filt, axis=-1)
+                        kstep = slot_keys(keys, p + 1, TAG_DRAFT)
+                        nxt = jax.vmap(jax.random.categorical)(
+                            kstep, filt).astype(jnp.int32)
+                    else:
+                        probs = jnp.zeros(
+                            (logits.shape[0], 1), jnp.float32)  # unused
+                        nxt = jnp.argmax(logits[:, -1],
+                                         axis=-1).astype(jnp.int32)
+                    return (nxt, dpool, p + 1), (nxt, probs)
 
-                (_, dpool, _), props = lax.scan(
+                (_, dpool, _), (props, dprobs) = lax.scan(
                     step, (tok, dpool, pos), None, length=k_spec)
-                return jnp.moveaxis(props, 0, 1), dpool
+                return (jnp.moveaxis(props, 0, 1),
+                        jnp.moveaxis(dprobs, 0, 1), dpool)
 
             self._propose = jax.jit(propose, donate_argnums=(3,))
 
-            def verify(params, tok, props, pool, tables, pos):
-                """One speculative round's target side: ONE fixed-shape
-                ``[B, k+1]``-wide paged step over (last committed token
-                + the k proposals) — a k-wide prefill chunk in the
-                chunked-prefill sense.  Returns the target's greedy
-                argmax at every position [B, k+1]; the host computes
-                the accepted prefix by comparing against the proposals
-                (values, not shapes)."""
+            def verify(params, tok, tok_prev, props, dprobs, pool,
+                       tables, pos, keys):
+                """One speculative round's target side, FUSED: ONE
+                fixed-shape ``[B, k+1]``-wide paged step over (last
+                committed token + the k proposals), then accept/commit
+                IN-PROGRAM — greedy loops take the longest proposal
+                prefix matching the target's own argmax; sampled loops
+                run standard speculative rejection sampling (accept
+                x_i with min(1, p/q); resample rejections from the
+                normalized residual max(p-q, 0)), which emits tokens
+                distributed EXACTLY as the non-spec sampler.  The new
+                tok/tok_prev/positions are computed here as device
+                values, so the host reads back only the per-slot accept
+                count + the emitted-token rows — no per-round
+                accept-mask round-trip, no tok re-upload.  Parked rows
+                pass through untouched."""
                 toks = jnp.concatenate([tok[:, None], props], axis=1)
                 logits, pool = llama.forward_paged(
                     params, toks, pool, tables, pos, cfg,
                     compute_dtype=fw.dtype)
-                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return g, pool
+                live = pos < park_bound
+                if temperature > 0.0:
+                    filt = llama.filter_logits(
+                        logits, temperature, fw.top_k, fw.top_p)
+                    pt = jax.nn.softmax(filt, axis=-1)  # [B, k+1, V]
+                    em, acc = spec_rejection_commit(
+                        pt, dprobs, props, keys, pos, live)
+                else:
+                    g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    ok = (props == g[:, :k_spec]).astype(jnp.int32)
+                    acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+                    acc = jnp.where(live, acc, 0)
+                    # g[j] == props[j] for j < acc, and g[acc] is the
+                    # bonus/correction token: g IS the emitted row
+                    em = g
+                # the last emitted token: em[acc] (the final/residual
+                # draw in sampled loops, the target argmax in greedy)
+                new_tok = jnp.take_along_axis(
+                    em, acc[:, None], axis=1)[:, 0]
+                prev_cand = jnp.take_along_axis(
+                    em, jnp.maximum(acc - 1, 0)[:, None], axis=1)[:, 0]
+                new_prev = jnp.where(acc > 0, prev_cand, tok)
+                tok2 = jnp.where(live, new_tok, tok)
+                prev2 = jnp.where(live, new_prev, tok_prev)
+                pos2 = jnp.where(live, pos + acc + 1, pos)
+                return em, acc, tok2, prev2, pos2, pool
 
-            self._verify = jax.jit(verify, donate_argnums=(3,))
+            self._verify = jax.jit(verify, donate_argnums=(5,))
         xr = getattr(fw, "_xray", None)
         if xr is not None:
             # nns-xray: the standing loop's predicted census IS
@@ -1353,8 +1526,9 @@ class _ContinuousLoop:
 
         self._pool_nbytes = _tree_bytes(pool) + (
             _tree_bytes(draft_pool) if draft_pool is not None else 0)
-        # Device carries tok/pool/key between chunks (r4: materializing
-        # them per chunk cost tunnel roundtrips).  EVERYTHING ELSE is
+        # Device carries tok/pool (+ per-slot PRNG keys, and positions
+        # under speculation) between chunks (r4: materializing them per
+        # chunk cost tunnel roundtrips).  EVERYTHING ELSE is
         # host bookkeeping: positions advance deterministically (+length
         # per chunk for live rows, parked otherwise) and block tables
         # change only at admit/retire, so both live as numpy and ride to
@@ -1362,6 +1536,29 @@ class _ContinuousLoop:
         tok = jnp.zeros((B,), jnp.int32)
         tok_prev = jnp.zeros((B,), jnp.int32) if self._spec else None
         key = jax.random.PRNGKey(fw.seed)
+        # Per-slot PRNG state (docs/SERVING.md §4d): each slot's base
+        # key is fold_in(PRNGKey(seed), admission number) — a pure
+        # function of (seed, admission order), NOT of stream ids (those
+        # are process-global and would differ between two same-seed
+        # runs in one process, breaking bit-reproducibility).  The
+        # device twin rebuilds by VALUE at admission/adopt events (a
+        # transfer, never a compile); every per-token draw then folds
+        # (absolute position, tag) inside the compiled programs.
+        base_key = np.asarray(jax.random.PRNGKey(fw.seed), np.uint32)
+        adm_no = 0
+        keys_h = np.zeros((B, 2), np.uint32)
+        keys_dev = jnp.asarray(keys_h)
+        # the measured PRNG slot-state footprint the xray HBM ledger
+        # reconciles against serving_plan's prng_state_bytes
+        self._prng_nbytes = int(keys_h.nbytes) if self._sampled else 0
+        # Speculative loops also carry positions as a device twin: the
+        # fused verify commits pos += accepted+1 in-program, so the
+        # host never re-uploads positions per round.  The host numpy
+        # `pos` below stays authoritative for admission/drain
+        # bookkeeping; park/admission/adopt events push its per-slot
+        # values into pos_dev through the existing _set_tok signature.
+        pos_dev = jnp.full((B,), self.park, jnp.int32) \
+            if self._spec else None
         _rep = None
         if fw.mesh is not None:
             # Commit the carried device state to the mesh UP FRONT: the
@@ -1373,8 +1570,29 @@ class _ContinuousLoop:
 
             tok = _rep(fw.mesh, tok)
             key = _rep(fw.mesh, key)
+            keys_dev = _rep(fw.mesh, keys_dev)
             if tok_prev is not None:
                 tok_prev = _rep(fw.mesh, tok_prev)
+            if pos_dev is not None:
+                pos_dev = _rep(fw.mesh, pos_dev)
+
+        def push_keys() -> None:
+            """Rebuild the device key vector from the host mirror — an
+            admission/adopt-event VALUE move (replicated under TP), so
+            steady-state rounds never touch it."""
+            nonlocal keys_dev
+            keys_dev = jnp.asarray(keys_h)
+            if fw.mesh is not None:
+                keys_dev = _rep(fw.mesh, keys_dev)
+
+        def fresh_slot_key() -> np.ndarray:
+            nonlocal adm_no
+            k = np.asarray(
+                jax.random.fold_in(jnp.asarray(base_key), adm_no),
+                np.uint32)
+            adm_no += 1
+            return k
+
         pos = np.full((B,), self.park, np.int32)  # parked = idle
         tables = np.full((B, self.max_blocks), self.sentinel, np.int32)
         free = list(range(self.n_blocks))  # host free list (block ids)
@@ -1544,10 +1762,19 @@ class _ContinuousLoop:
         chain_cache: Dict[int, list] = {}
 
         def retire(s: int) -> None:
+            nonlocal pos_dev
             release(slot_blocks[s])
             slot_blocks[s] = []
             tables[s, :] = self.sentinel
             pos[s] = self.park
+            if pos_dev is not None:
+                # re-park the device twin too: the fused verify carries
+                # positions on device, and a retired row must stop
+                # advancing (same int32[B] _set_tok signature — no new
+                # program)
+                pos_dev = self._set_tok(
+                    pos_dev, np.int32(s),
+                    jnp.asarray(np.int32(self.park)))
             slots[s] = None
             remaining[s] = 0
             sidx[s] = 0
@@ -1608,18 +1835,23 @@ class _ContinuousLoop:
         if self._spec:
             # every slot is parked: the propose/verify warm-ups compile
             # their (only) signatures, write nothing (sentinel tables),
-            # and DMA nothing
+            # and DMA nothing.  pos_dev rides through verify and comes
+            # back all-parked (the in-program live mask passes parked
+            # rows through untouched).
             draft_pool = self._draft_prefill(
                 d_params, jnp.zeros((1, C), jnp.int32), draft_pool,
                 tables[:1], pos[:1] * 0)
-            props_w, draft_pool = self._propose(
-                d_params, tok_prev, tok, draft_pool, tables, pos)
-            g_w, pool = self._verify(params, tok, props_w, pool, tables,
-                                     pos)
-            np.asarray(g_w)
+            props_w, dprobs_w, draft_pool = self._propose(
+                d_params, tok_prev, tok, draft_pool, tables, pos_dev,
+                keys_dev)
+            em_w, acc_w, tok, tok_prev, pos_dev, pool = self._verify(
+                params, tok, tok_prev, props_w, dprobs_w, pool, tables,
+                pos_dev, keys_dev)
+            np.asarray(em_w)
         else:
-            toks_w, tok, pool, key = self._decode(
-                params, tok, pool, tables, pos, key, length=fw.chunk)
+            toks_w, tok, pool = self._decode(
+                params, tok, pool, tables, pos, keys_dev,
+                length=fw.chunk)
             np.asarray(toks_w)
         release(warm_blocks)
         tables[0, :] = self.sentinel
@@ -1686,6 +1918,12 @@ class _ContinuousLoop:
                             "tok_prev": int(tok_prev_h[s]),
                             "shared_blocks": n_shared,
                             "greedy": fw.temperature == 0.0,
+                            # per-slot PRNG key (docs/SERVING.md §4d):
+                            # same-seed sampled continuation after
+                            # adopt_stream is bit-identical because
+                            # draws fold the absolute position, not the
+                            # slot or step
+                            "prng_key": [int(v) for v in keys_h[s]],
                             "meta": {k: v for k, v in meta.items()
                                      if k not in _SNAPSHOT_META_DROP},
                             "prompt": np.asarray(self._slot_prompt[s]),
@@ -1814,6 +2052,17 @@ class _ContinuousLoop:
                                 tok_prev, np.int32(s),
                                 jnp.asarray(np.int32(
                                     snap.get("tok_prev", 0))))
+                            pos_dev = self._set_tok(
+                                pos_dev, np.int32(s),
+                                jnp.asarray(np.int32(p_next)))
+                        # sampled streams continue their own PRNG
+                        # stream: the snapshot key (if present) slots
+                        # in; pre-sampling snapshots get a fresh one
+                        pk = snap.get("prng_key")
+                        keys_h[s] = (np.asarray(pk, np.uint32)
+                                     if pk is not None
+                                     else fresh_slot_key())
+                        push_keys()
                         pos[s] = p_next
                         remaining[s] = rem
                         sidx[s] = int(snap["sidx"])
@@ -2139,10 +2388,19 @@ class _ContinuousLoop:
                             break
                         # first-token sample stays EAGER (outside jit):
                         # logits are already device-resident and the
-                        # dispatch overlaps the decode chunk below
-                        key, sub = jax.random.split(key)
+                        # dispatch overlaps the decode chunk below.
+                        # The admitted stream gets its slot PRNG key
+                        # here; the first token sits at position T, so
+                        # its draw folds (T, sample tag) — the same
+                        # convention the decode scan uses, making the
+                        # whole stream a pure function of (seed,
+                        # admission number, positions).
+                        keys_h[s] = fresh_slot_key()
+                        push_keys()
+                        kft = jax.random.fold_in(jax.random.fold_in(
+                            jnp.asarray(keys_h[s]), st["T"]), 100)
                         st["first"] = llama.sample_token(
-                            logits, sub, fw.temperature, fw.top_k,
+                            logits, kft, fw.temperature, fw.top_k,
                             fw.top_p)[0]
                         tok = self._set_tok(tok, np.int32(s), st["first"])
                         tok_prev_h[s] = st["last_tok"]
@@ -2150,10 +2408,14 @@ class _ContinuousLoop:
                             # the round's refresh step re-feeds the
                             # LAST PROMPT token at T-1 (bit-exact
                             # rewrite); must be device-resident before
-                            # this iteration's propose dispatch
+                            # this iteration's propose dispatch — and
+                            # the device position twin goes live at T
                             tok_prev = self._set_tok(
                                 tok_prev, np.int32(s),
                                 jnp.asarray(np.int32(st["last_tok"])))
+                            pos_dev = self._set_tok(
+                                pos_dev, np.int32(s),
+                                jnp.asarray(np.int32(st["T"])))
                         # register the prompt's full blocks in the
                         # prefix index (content is in-flight on device;
                         # pool donation chains order any reader after
@@ -2190,24 +2452,30 @@ class _ContinuousLoop:
             # reserved blocks or drop; outputs are never emitted).
             live = remaining > 0
             toks_dev = None
-            g_dev = props_dev = None
+            em_dev = acc_dev = None
             if live.any():
                 t_dec = time.monotonic_ns()
                 if self._spec:
                     # one speculative round: draft proposes k tokens,
-                    # the target verifies them in ONE [slots, k+1]-wide
-                    # paged step.  Both dispatches are async; positions
-                    # advance per-row by the ACCEPTED count in step 5
-                    # (a host value — no shape ever changes).
-                    props_dev, draft_pool = self._propose(
-                        d_params, tok_prev, tok, draft_pool, tables, pos)
-                    g_dev, pool = self._verify(
-                        params, tok, props_dev, pool, tables, pos)
+                    # the target verifies AND COMMITS them in ONE
+                    # [slots, k+1]-wide paged step — tok/tok_prev/
+                    # positions come back as device values (async
+                    # futures; rebinding them here is free), so the
+                    # host never re-uploads token state per round.
+                    # Step 4's retires re-park pos_dev AFTER this
+                    # rebind, so a first-token EOS still wins.
+                    props_dev, dprobs_dev, draft_pool = self._propose(
+                        d_params, tok_prev, tok, draft_pool, tables,
+                        pos_dev, keys_dev)
+                    (em_dev, acc_dev, tok, tok_prev, pos_dev,
+                     pool) = self._verify(
+                        params, tok, tok_prev, props_dev, dprobs_dev,
+                        pool, tables, pos_dev, keys_dev)
                     metrics.count("llm.serve.spec_rounds")
                     _tr("spec round dispatched")
                 else:
-                    toks_dev, tok, pool, key = self._decode(
-                        params, tok, pool, tables, pos, key,
+                    toks_dev, tok, pool = self._decode(
+                        params, tok, pool, tables, pos, keys_dev,
                         length=fw.chunk)
                     pos[live] += fw.chunk  # parked rows stay parked
                     _tr("chunk dispatched")
@@ -2262,17 +2530,20 @@ class _ContinuousLoop:
                         if last:
                             retire(int(s))
 
-            # 5b. speculative accept/commit: compare the draft's
-            # proposals against the target's own greedy argmax at every
-            # verified position — the accepted prefix plus the target's
-            # bonus token emit; everything after the first divergence is
-            # discarded (its K/V rows get overwritten before they can
-            # ever be attended, the same overwrite-before-attend
-            # discipline chunked prefill relies on).  All host VALUES:
-            # positions/tokens update per row, nothing recompiles.
-            if g_dev is not None:
-                g_host = np.asarray(g_dev)          # [B, k+1]
-                props_host = np.asarray(props_dev)  # [B, k] — one sync
+            # 5b. speculative emit: the fused verify already accepted
+            # and COMMITTED on device (tok/tok_prev/pos_dev rebound at
+            # dispatch); the host materializes only the per-slot accept
+            # count + the emitted-token rows — one [B] + one [B, k+1]
+            # D2H per round, no accept-mask round-trip, no proposal
+            # fetch, no token re-upload.  Everything after the first
+            # rejection is discarded (its K/V rows get overwritten
+            # before they can ever be attended, the same overwrite-
+            # before-attend discipline chunked prefill relies on).
+            # Host mirrors (tok_h/tok_prev_h/pos) update from the same
+            # values, so drain snapshots stay exact.
+            if em_dev is not None:
+                em_host = np.asarray(em_dev)    # [B, k+1]
+                acc_host = np.asarray(acc_dev)  # [B] — one sync
                 self._span(rec, "serve.spec_verify", t_dec,
                            occupancy=int(live.sum()), k=fw.spec_k)
                 _tr("spec round materialized")
@@ -2282,16 +2553,13 @@ class _ContinuousLoop:
                     if remaining[s] == 0:
                         continue  # retired at its first token (EOS)
                     meta, emit = slots[s]
-                    acc = 0
-                    while acc < K and \
-                            props_host[s, acc] == g_host[s, acc]:
-                        acc += 1
+                    acc = int(acc_host[s])
                     metrics.count("llm.serve.spec_accepted", acc)
                     metrics.count("llm.serve.spec_rejected", K - acc)
                     emitted = []
                     finished = False
                     for j in range(acc + 1):
-                        tokid = int(g_host[s, j])
+                        tokid = int(em_host[s, j])
                         last = remaining[s] == 1 or tokid == eos
                         # accepted draft tokens vs the target-sampled
                         # bonus/fallback token: the accept/reject path's
@@ -2305,6 +2573,10 @@ class _ContinuousLoop:
                         sidx[s] += 1
                         remaining[s] -= 1
                         if last:
+                            # retire() re-parks pos_dev, overriding the
+                            # in-program advance for this row — device
+                            # tok/tok_prev keep stale values there,
+                            # which parked rows never read
                             retire(s)
                             finished = True
                             break
@@ -2313,15 +2585,6 @@ class _ContinuousLoop:
                         seq = [int(tok_h[s])] + emitted
                         tok_h[s] = seq[-1]
                         tok_prev_h[s] = seq[-2]
-                # commit the new token state by VALUE: the device
-                # vectors rebuild from the host mirrors (newly admitted
-                # rows were synced in step 4), replicated onto the mesh
-                # under TP — a transfer, never a compile
-                tok = jnp.asarray(tok_h)
-                tok_prev = jnp.asarray(tok_prev_h)
-                if fw.mesh is not None:
-                    tok = _rep(fw.mesh, tok)
-                    tok_prev = _rep(fw.mesh, tok_prev)
 
             if not progressed:
                 with self._idle_lock:

@@ -912,12 +912,12 @@ def _block(cfg: LlamaConfig, lp, x, positions, kv=None, pos_offset=None,
         attn = attn_fn(q, _repeat_kv(k_all, H // Hkv), _repeat_kv(v_all, H // Hkv))
     elif kv is None or prefill:
         # Blockwise flash kernel (Pallas; falls back to plain XLA attention
-        # internally when T doesn't tile into its blocks).
+        # internally when T doesn't tile into its blocks).  K/V go in
+        # UNREPEATED — the kernel shares each streamed block across the
+        # query-head group, and the XLA fallback repeats internally.
         from ..ops.attention import flash_attention
 
-        kr = _repeat_kv(k, H // Hkv)
-        vr = _repeat_kv(v, H // Hkv)
-        attn = flash_attention(q, kr, vr, causal=True)
+        attn = flash_attention(q, k, v, causal=True)
     else:
         kr = _repeat_kv(k_all, H // Hkv)
         vr = _repeat_kv(v_all, H // Hkv)
@@ -1239,23 +1239,24 @@ def forward_seq_parallel(mesh, params, tokens, cfg: LlamaConfig,
     return jax.jit(fn)(params, tokens)
 
 
-def sample_token(logits, key, temperature: float, top_k: int = 0,
-                 top_p: float = 1.0):
-    """logits [B, vocab] -> token ids [B].
+def filter_logits(logits, temperature: float, top_k: int = 0,
+                  top_p: float = 1.0):
+    """Apply the sampler chain's logit filters: [.., vocab] -> [.., vocab].
 
-    ``top_k`` (0 = off) keeps the k highest logits; ``top_p`` (1.0 = off)
-    keeps the smallest set whose probability mass reaches p (nucleus).
-    Both are STATIC (Python) values baked into the compiled program —
-    masking is where/inf over the fixed vocab axis, so the MXU shape
-    never changes and no host roundtrip happens mid-decode.  Reference
-    analog: llama.cpp's sampler chain (tensor_filter_llamacpp.cc,
-    SURVEY §2.4 [UNVERIFIED]).
+    ``temperature`` scales, ``top_k`` (0 = off) keeps the k highest
+    logits, ``top_p`` (1.0 = off) keeps the smallest set whose
+    probability mass reaches p (nucleus); masked positions go to -inf.
+    All knobs are STATIC (Python) values baked into the compiled
+    program — masking is where/inf over the fixed vocab axis, so the
+    MXU shape never changes and no host roundtrip happens mid-decode.
+    ``softmax(filter_logits(...))`` is the exact sampling distribution,
+    which is what speculative rejection sampling needs on both the
+    draft and target sides (filters/llm.py verify).  Caller must have
+    temperature > 0.
     """
     import jax
     import jax.numpy as jnp
 
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     neg = jnp.asarray(-jnp.inf, logits.dtype)
     if top_k and 0 < top_k < logits.shape[-1]:
@@ -1275,7 +1276,45 @@ def sample_token(logits, key, temperature: float, top_k: int = 0,
         kept = jnp.where(cut, jnp.asarray(jnp.inf, logits.dtype), sort)
         thresh = jnp.min(kept, axis=-1, keepdims=True)
         logits = jnp.where(logits < thresh, neg, logits)
+    return logits
+
+
+def sample_token(logits, key, temperature: float, top_k: int = 0,
+                 top_p: float = 1.0):
+    """logits [B, vocab] -> token ids [B], one shared PRNG key.
+
+    Reference analog: llama.cpp's sampler chain
+    (tensor_filter_llamacpp.cc, SURVEY §2.4 [UNVERIFIED]).  Filter
+    semantics live in :func:`filter_logits`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_token_per_slot(logits, keys, temperature: float, top_k: int = 0,
+                          top_p: float = 1.0):
+    """logits [B, vocab] + per-slot keys [B, 2] uint32 -> token ids [B].
+
+    The continuous-serving sampler: each slot draws from its OWN PRNG
+    stream, so a slot's emitted tokens are a pure function of its slot
+    key and token positions — independent of which other slots share
+    the batch.  Join/leave churn changes the VALUES in ``keys``, never
+    a shape, so the compiled decode program is reused as-is
+    (filters/llm.py census pins).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = filter_logits(logits, temperature, top_k, top_p)
+    draw = jax.vmap(lambda kd, lg: jax.random.categorical(kd, lg, axis=-1))
+    return draw(keys, logits).astype(jnp.int32)
 
 
 def generate_scan(params, prompt, cfg: LlamaConfig, max_new: int,

@@ -15,11 +15,16 @@ Kernel structure (VMEM-bounded for any sequence length):
 * causal q-blocks stop their kv stream at the diagonal — skipped blocks are
   never even fetched from HBM.
 
-Layouts: q/k/v are [B, S, H, D] (heads after seq, matching models/llama.py).
-GQA is handled by the caller (repeat kv heads first).  On non-TPU backends
-the public entry falls back to :func:`attention_reference` (compiled XLA)
-unless ``interpret=True`` is passed explicitly (tests do, for bit-faithful
-kernel coverage on CPU).
+Layouts: q is [B, S, H, D] (heads after seq, matching models/llama.py);
+k/v are [B, S, Hkv, D] with ``H % Hkv == 0`` — GQA/MQA K/V arrive
+UNREPEATED.  The kernel grid runs one cell per (batch, kv-head) and keeps
+the whole query-head group resident against each streamed K/V block, so a
+block is DMA'd into VMEM once per group instead of once per query head:
+grouped decode/prefill HBM traffic is ``Hkv/H`` of the repeated layout's.
+On non-TPU backends the public entry falls back to
+:func:`attention_reference` (compiled XLA, which performs the repeat
+internally so it stays a bit-faithful twin) unless ``interpret=True`` is
+passed explicitly (tests do, for bit-faithful kernel coverage on CPU).
 """
 
 from __future__ import annotations
@@ -69,9 +74,30 @@ def paged_kernel_enabled() -> bool:
     return _disable_count == 0
 
 
+def _repeat_kv_heads(x, n_rep: int):
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D]; query head i reads kv head
+    i // n_rep (the models/llama.py ``_repeat_kv`` layout)."""
+    if n_rep == 1:
+        return x
+    b, s, hkv, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, hkv, n_rep, d)).reshape(
+            b, s, hkv * n_rep, d)
+
+
 def attention_reference(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
-    """Plain-XLA attention (the flash kernel's semantics, materialized)."""
+    """Plain-XLA attention (the flash kernel's semantics, materialized).
+
+    Accepts grouped K/V (``k.shape[2]`` dividing ``q.shape[2]``) and
+    repeats internally — XLA fuses the broadcast into the einsum, so the
+    repeated tree is never a real HBM allocation here.  This keeps the
+    reference the bit-faithful twin of the grouped kernel.
+    """
     d = q.shape[-1]
+    h, hkv = q.shape[2], k.shape[2]
+    if h != hkv:
+        k = _repeat_kv_heads(k, h // hkv)
+        v = _repeat_kv_heads(v, h // hkv)
     scale = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
@@ -87,20 +113,29 @@ def attention_reference(q, k, v, *, causal: bool = False, scale: Optional[float]
 
 def _flash_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_k: int, causal: bool,
                   scale: float, q_offset: int):
-    """One (batch*head, q-block) grid cell.
+    """One (batch*kv-head, q-block) grid cell.
 
-    q_ref/o_ref: VMEM [block_q, d] tiles; k_hbm/v_hbm: the full [BH, Skv, d]
-    arrays left in HBM — kv blocks are DMA'd through a 2-slot VMEM scratch.
+    q_ref/o_ref: VMEM [block_q, G, d] tiles holding the WHOLE query-head
+    group for this kv head (G = H // Hkv; G == 1 is plain MHA); k_hbm/v_hbm:
+    the full [B*Hkv, Skv, d] arrays left in HBM — kv blocks are DMA'd
+    through a 2-slot VMEM scratch ONCE per group, and all G query heads
+    score against the resident block.  That single sharing is the whole
+    GQA win: grouped HBM traffic is Hkv/H of the repeated layout's.
     """
-    block_q, d = q_ref.shape
+    block_q, grp, d = q_ref.shape
+    rows = block_q * grp
     skv = k_hbm.shape[1]
     nk = skv // block_k
     i = pl.program_id(0)
     j = pl.program_id(1)
 
-    q = q_ref[:].astype(jnp.float32) * scale
-    qpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    # flatten the group into the row dim: row r = q_row * G + g, so the
+    # MXU sees one [block_q*G, d] x [d, block_k] contraction per block
+    q = q_ref[:].astype(jnp.float32).reshape(rows, d) * scale
+    qpos = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, grp, block_k), 0).reshape(rows, block_k)
+    kpos = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, grp, block_k), 2).reshape(rows, block_k)
 
     if causal:
         # The last row of this q block attends up to j*block_q + block_q - 1
@@ -156,11 +191,12 @@ def _flash_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_k: int, causal: bool,
                 preferred_element_type=jnp.float32)
             return m_new, l_new, acc_new
 
-        m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((block_q, 1), jnp.float32)
-        acc0 = jnp.zeros((block_q, d), jnp.float32)
+        m0 = jnp.full((rows, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((rows, 1), jnp.float32)
+        acc0 = jnp.zeros((rows, d), jnp.float32)
         m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-        o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        o_ref[:] = (acc / jnp.maximum(l, 1e-30)).reshape(
+            block_q, grp, d).astype(o_ref.dtype)
 
     pl.run_scoped(
         scoped,
@@ -182,7 +218,12 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ):
-    """Blockwise attention for [B, S, H, D] tensors.
+    """Blockwise attention for [B, S, H, D] q and [B, S, Hkv, D] k/v.
+
+    ``Hkv`` may divide ``H`` (GQA/MQA) — pass K/V UNREPEATED; the kernel
+    shares each streamed K/V block across the whole query-head group, and
+    the XLA fallback repeats internally, so both paths emit identical
+    values from the grouped layout.
 
     Uses the Pallas kernel on TPU backends (or anywhere when
     ``interpret=True`` is forced); otherwise — including non-tiling shapes —
@@ -195,6 +236,7 @@ def flash_attention(
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
+    hkv = k.shape[2]
     scale_v = (d ** -0.5) if scale is None else scale
     if interpret is None:
         interpret = False
@@ -207,17 +249,22 @@ def flash_attention(
         or sq % block_q
         or skv % block_k
         or k.shape != v.shape
-        or k.shape[2] != h
+        or h % hkv
         # Mosaic DMA slices must align the minor dim to the 128-lane tiling;
         # interpreter mode has no such constraint.
         or (not interpret and d % 128)
     ):
         return attention_reference(q, k, v, causal=causal, scale=scale_v)
 
-    # [B, S, H, D] -> [B*H, S, D]
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+    grp = h // hkv
+    # q: [B, S, H, D] -> [B*Hkv, S, G, D] (query head h = kv_head*G + g,
+    # the models/llama.py _repeat_kv layout); k/v: [B, S, Hkv, D] ->
+    # [B*Hkv, S, D] — one grid row per (batch, kv-head) so a K/V block is
+    # fetched once for all G query heads of its group.
+    qf = q.reshape(b, sq, hkv, grp, d).transpose(0, 2, 1, 3, 4).reshape(
+        b * hkv, sq, grp, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, skv, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, skv, d)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -228,17 +275,19 @@ def flash_attention(
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b * hkv, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, grp, d), lambda i, j: (i, j, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # kv stay in HBM
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=pl.BlockSpec(
+            (None, block_q, grp, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, sq, grp, d), q.dtype),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, hkv, sq, grp, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, sq, h, d)
 
 
 # ---------------------------------------------------------------------------

@@ -68,7 +68,8 @@ log = logger(__name__)
 #: streaming sample window, reconciled against
 #: ``trainer/subplugin.train_plan``'s eval_shape-abstracted estimate.
 HBM_CATEGORIES: Tuple[str, ...] = ("params", "kv_pool", "agg_rings",
-                                   "activations", "train_state")
+                                   "activations", "train_state",
+                                   "prng_state")
 
 #: ledger categories below this are never drift-warned: transient
 #: windows (activations) legitimately read 0 between dispatches, and
@@ -590,6 +591,10 @@ def measure_hbm(pipeline) -> Dict[str, int]:
         loop = getattr(fw, "_serve", None) if fw is not None else None
         if loop is not None:
             out["kv_pool"] += int(getattr(loop, "_pool_nbytes", 0) or 0)
+            # sampler per-slot PRNG key state (temperature > 0 loops;
+            # 0 for greedy — serving_plan's prng_state_bytes twin)
+            out["prng_state"] += int(
+                getattr(loop, "_prng_nbytes", 0) or 0)
         ring = getattr(el, "_ring", None)
         if ring is not None and hasattr(ring, "nbytes"):
             out["agg_rings"] += int(ring.nbytes)

@@ -1,0 +1,186 @@
+"""GQA/MQA-grouped attention kernels: value + traffic contracts.
+
+The grouped kernels (ops/attention.py) take K/V UNREPEATED at
+``[*, Hkv, *]`` and share each streamed block across the whole
+query-head group.  Three things must hold, and each gets pinned here:
+
+1. **Values**: the grouped layout is bit-identical to feeding the SAME
+   kernel a pre-repeated ``Hkv == H`` layout (the pre-refactor data
+   path) at every ratio, including MQA — the refactor moved bytes, not
+   math.  (Vs the materialized XLA reference it is allclose, not
+   bitwise: blockwise online softmax re-associates the reduction.)
+2. **Stream count**: the flash grid is ``(B * Hkv, Sq / block_q)`` —
+   one K/V stream per (batch, KV head), NOT per query head — and the
+   paged grid is ``(B,)``; K/V operands ride ANY memory space (the
+   kernel's own DMAs stream them), so HBM reads scale with ``Hkv``.
+3. **DMA structure**: each grid cell issues exactly one double-buffered
+   K stream and one V stream (6 ``make_async_copy`` call sites: 2 warm
+   starts + 2 prefetches + 2 waits), with NO per-query-head DMA loop —
+   the count is invariant in H/Hkv.  Interpret mode traces the cell
+   body once, so call-site counting is exact.
+
+Plus the prediction side: ``serving_plan``'s
+``decode_bytes_per_ctx_token`` must price the pool at ``n_kv_heads``
+(the grouped kernel's actual traffic), not ``n_heads`` — the stale
+over-prediction nns-xray's reconciliation flagged.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import llama
+from nnstreamer_tpu.ops import attention as A
+from nnstreamer_tpu.filters.llm import serving_plan
+
+jax.config.update("jax_platform_name", "cpu")
+
+RATIOS = [1, 2, 4, 8]  # H / Hkv group sizes; 8 with H=8 is MQA (Hkv=1)
+H = 8
+
+
+def _repeat(x, rep):
+    """models/llama.py's GQA layout: query head h = kv_head * rep + g."""
+    b, s, hkv, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, hkv, rep, d)).reshape(b, s, hkv * rep, d)
+
+
+def _flash_inputs(hkv, *, b=2, s=256, d=32, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(kq, (b, s, H, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+    return q, k, v
+
+
+class _PallasCapture:
+    """Wrap ``pl.pallas_call`` (and ``pltpu.make_async_copy``) through the
+    module under test, recording the grid actually launched and the
+    number of DMA call sites traced."""
+
+    def __init__(self):
+        self.grids = []
+        self.dma_calls = 0
+
+    def install(self, monkeypatch):
+        real_call = A.pl.pallas_call
+        real_dma = A.pltpu.make_async_copy
+
+        def spy_call(*args, **kw):
+            if "grid" in kw:
+                self.grids.append(tuple(kw["grid"]))
+            elif "grid_spec" in kw:
+                self.grids.append(tuple(kw["grid_spec"].grid))
+            return real_call(*args, **kw)
+
+        def spy_dma(*args, **kw):
+            self.dma_calls += 1
+            return real_dma(*args, **kw)
+
+        monkeypatch.setattr(A.pl, "pallas_call", spy_call)
+        monkeypatch.setattr(A.pltpu, "make_async_copy", spy_dma)
+        return self
+
+
+class TestFlashGrouped:
+    @pytest.mark.parametrize("rep", RATIOS)
+    def test_bit_identical_to_repeated_layout(self, rep):
+        hkv = H // rep
+        q, k, v = _flash_inputs(hkv)
+        grouped = A.flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
+        repeated = A.flash_attention(
+            q, _repeat(k, rep), _repeat(v, rep), causal=True,
+            block_q=64, block_k=64, interpret=True)
+        assert np.array_equal(np.asarray(grouped), np.asarray(repeated))
+        ref = A.attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(grouped), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("rep", RATIOS)
+    def test_kv_streams_scale_with_hkv_not_h(self, rep, monkeypatch):
+        hkv = H // rep
+        b, s, bq = 2, 256, 64
+        cap = _PallasCapture().install(monkeypatch)
+        q, k, v = _flash_inputs(hkv, b=b, s=s)
+        A.flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=64, interpret=True)
+        # one grid row per (batch, KV head): stream count is b * hkv —
+        # constant H, shrinking hkv => fewer K/V streams, same output
+        assert cap.grids == [(b * hkv, s // bq)]
+        # exactly one double-buffered K + one V stream per cell (2 warm
+        # starts + 2 prefetches + 2 waits), no per-query-head DMA loop
+        assert cap.dma_calls == 6
+
+
+class TestPagedGrouped:
+    def _pool_case(self, hkv, *, b=3, d=32, bs=16, n_blocks=24, seed=1):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(kq, (b, 1, H, d), jnp.float32)
+        k_pool = jax.random.normal(kk, (n_blocks, bs, hkv, d), jnp.float32)
+        v_pool = jax.random.normal(kv, (n_blocks, bs, hkv, d), jnp.float32)
+        tbl = jnp.arange(b * 8, dtype=jnp.int32).reshape(b, 8) % n_blocks
+        lens = jnp.asarray([5, bs * 3, bs * 8], jnp.int32)[:b]
+        return q, k_pool, v_pool, tbl, lens
+
+    def _repeat_pool(self, pool, rep):
+        n, bs, hkv, d = pool.shape
+        return jnp.broadcast_to(
+            pool[:, :, :, None, :], (n, bs, hkv, rep, d)).reshape(
+                n, bs, hkv * rep, d)
+
+    @pytest.mark.parametrize("rep", RATIOS)
+    def test_bit_identical_to_repeated_pool(self, rep):
+        hkv = H // rep
+        q, kp, vp, tbl, lens = self._pool_case(hkv)
+        grouped = A.paged_attention(q, kp, vp, tbl, lens, interpret=True)
+        repeated = A.paged_attention(
+            q, self._repeat_pool(kp, rep), self._repeat_pool(vp, rep),
+            tbl, lens, interpret=True)
+        assert np.array_equal(np.asarray(grouped), np.asarray(repeated))
+        ref = A.paged_attention_reference(q, kp, vp, tbl, lens)
+        np.testing.assert_allclose(
+            np.asarray(grouped), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("rep", RATIOS)
+    def test_one_stream_per_row(self, rep, monkeypatch):
+        hkv = H // rep
+        cap = _PallasCapture().install(monkeypatch)
+        q, kp, vp, tbl, lens = self._pool_case(hkv)
+        A.paged_attention(q, kp, vp, tbl, lens, interpret=True)
+        # one grid cell per batch row regardless of head layout; the
+        # row streams ceil(len/bs) blocks of its OWN Hkv-sized pool
+        assert cap.grids == [(q.shape[0],)]
+        assert cap.dma_calls == 6
+
+
+class TestServingPlanTraffic:
+    """decode_bytes_per_ctx_token must track n_kv_heads — pricing GQA
+    traffic at n_heads is the stale prediction the xray reconciliation
+    regression exists to catch."""
+
+    def test_gqa_prices_kv_heads_not_q_heads(self):
+        dense = llama.PRESETS["llama2_7b"]  # n_kv_heads == n_heads == 32
+        gqa = dataclasses.replace(dense, n_kv_heads=8)
+        p_dense = serving_plan(dense, slots=4, dtype="bfloat16")
+        p_gqa = serving_plan(gqa, slots=4, dtype="bfloat16")
+        assert p_dense["kv_groups"] == 1
+        assert p_gqa["kv_groups"] == 4
+        # traffic coefficient shrinks by exactly the group factor
+        assert (p_dense["decode_bytes_per_ctx_token"]
+                == 4 * p_gqa["decode_bytes_per_ctx_token"])
+        # and matches the closed form: K+V rows over all layers at Hkv
+        assert p_gqa["decode_bytes_per_ctx_token"] == (
+            2 * gqa.n_layers * gqa.n_kv_heads * gqa.head_dim * 2)
+
+    def test_prng_state_priced_only_when_sampled(self):
+        cfg = llama.PRESETS["llama_tiny"]
+        greedy = serving_plan(cfg, slots=6, dtype="float32")
+        sampled = serving_plan(cfg, slots=6, dtype="float32",
+                               temperature=0.8)
+        assert greedy["prng_state_bytes"] == 0
+        assert sampled["prng_state_bytes"] == 6 * 2 * 4  # uint32[2]/slot

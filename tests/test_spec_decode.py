@@ -471,11 +471,12 @@ class TestSpeculativeDecoding:
             serve_loop = fw._serve
             real = serve_loop._propose
 
-            def all_rejected(dp, tp, tk, pool, tables, pos):
-                props, pool = real(dp, tp, tk, pool, tables, pos)
+            def all_rejected(dp, tp, tk, pool, tables, pos, keys):
+                props, dprobs, pool = real(dp, tp, tk, pool, tables,
+                                           pos, keys)
                 import jax.numpy as jnp
 
-                return jnp.full_like(props, dead), pool
+                return jnp.full_like(props, dead), dprobs, pool
 
             serve_loop._propose = all_rejected
             fw.submit([prompt], {}, emit)
@@ -528,15 +529,20 @@ class TestSpeculativeDecoding:
         finally:
             fw.close()
 
-    def test_greedy_only_and_preset_only_are_rejected(self):
+    def test_preset_only_and_continuous_only_are_rejected(self):
+        """draft: still demands a preset zoo name + the continuous
+        loop; temperature > 0 is NO LONGER rejected (speculative
+        rejection sampling, docs/SERVING.md §4d) — pinned by the
+        sampled-spec tests in tests/test_sampling.py."""
         from nnstreamer_tpu.filters.base import FrameworkError
 
-        with pytest.raises(FrameworkError, match="greedy-only"):
-            _fw("serve:continuous,temperature:0.8,draft:llama_tiny")
         with pytest.raises(FrameworkError, match="preset"):
             _fw("serve:continuous,temperature:0.0,draft:/tmp/x.gguf")
         with pytest.raises(FrameworkError, match="serve:continuous"):
             _fw("temperature:0.0,draft:llama_tiny")
+        # sampled + draft constructs (the old greedy-only guard is gone)
+        fw = _fw("serve:continuous,temperature:0.8,draft:llama_tiny")
+        fw.close()
 
 
 # ---------------------------------------------------------------------------

@@ -156,6 +156,30 @@ ROWS = [
                        "--llm-serve", "continuous", "--llm-streams", "4",
                        "--llm-draft", "llama_tiny",
                        "--llm-spec-k", "4"]),
+    # ISSUE 16 rows.  gqa_kernel_ab: grouped-vs-repeated flash kernel
+    # A/B + the 7B GQA-8 roofline projection (the >=1.3x decode bar);
+    # CPU sentinel because the arithmetic projection and the serve-loop
+    # arms are proxy-meaningful while a silicon sweep re-runs it without
+    # the sentinel to time the REAL kernel DMAs (BENCH_KERNELS_r01).
+    ("gqa_kernel_ab", ["CPU", "--config", "gqa_sampling"]),
+    # sampled serving at depth: 32 streams with the per-slot seeded
+    # sampler compiled into the standing decode program — compare
+    # against llm7b_int8_continuous_x32 (greedy, same geometry); the
+    # delta IS the sampler's cost (docs/SERVING.md §4d says ~free)
+    ("llm7b_sampled_x32", ["--config", "llm7b", "--llm-quant", "int8",
+                           "--llm-serve", "continuous",
+                           "--llm-streams", "32",
+                           "--llm-temperature", "0.9"]),
+    # sampled speculation: rejection sampling through the SAME fused
+    # [slots,5] verify program the greedy row uses — accept rate rides
+    # the row (random-weight caveat of llm7b_spec_k4 applies; emitted
+    # tokens stay EXACTLY target-sampler distributed either way)
+    ("llm7b_spec_sampled_k4", ["--config", "llm7b", "--llm-quant",
+                               "int8", "--llm-serve", "continuous",
+                               "--llm-streams", "4",
+                               "--llm-draft", "llama_tiny",
+                               "--llm-spec-k", "4",
+                               "--llm-temperature", "0.9"]),
     # 2-D placement rows (ISSUE 9): tensor-parallel llama decode on the
     # pipeline's shared (data x model) mesh — per-chip weight + KV HBM
     # divide by M; the tp A/B pins greedy-id identity and records the

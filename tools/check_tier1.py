@@ -134,6 +134,19 @@ draft model's params + block pool beside the ref-counted KV pool
 ("draft params" / "draft pool" / "kv pool" lines + the budget warning),
 strict against tools/spec_deep_baseline.txt.
 
+AND it runs the kernel gate (ISSUE 16, docs/ARCHITECTURE.md "Kernels
+and lane discipline" + docs/SERVING.md §4d): tests/test_kernels_gqa.py
++ tests/test_sampling.py as their own pytest process — grouped-GQA
+flash/paged kernel bit-identity vs the repeated layout at every H/Hkv
+ratio incl. MQA, the grid/DMA stream-count scaling pins (K/V streams
+x Hkv, not H), the serving_plan decode-traffic coefficient regression,
+chi-squared rejection-sampling distribution equivalence, fixed-seed
+bitwise reproducibility + batch-composition independence, sampled
+drain/adopt PRNG carry, the 3/5-program census pins with the sampler
+compiled in, and the fused-verify transfer-budget trap — then
+``python -m nnstreamer_tpu.tools.doctor --gate`` re-asserting census
+drift 0 with the sampled/spec programs in the build.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -432,6 +445,48 @@ def run_mxu_gate(update: bool, timeout: int = 900) -> int:
         for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
             print(f"  {line}", file=sys.stderr)
         return 1
+    return 0
+
+
+def run_kernel_gate(timeout: int = 900) -> int:
+    """Grouped-GQA kernel + production-sampling gate (ISSUE 16, see
+    module docstring): the two test files as their own pytest process,
+    then ``doctor --gate`` — its rc is the census-drift verdict; the
+    xray gate owns the verdict-line baseline, this run only re-asserts
+    drift 0 with the sampler/spec programs compiled in."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_kernels_gqa.py", "tests/test_sampling.py", "-q",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"kernel gate: tests TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    passed = count_dots(proc.stdout)
+    if proc.returncode != 0:
+        print(f"kernel gate: tests FAILED ({passed} passed)")
+        for line in proc.stdout.strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.doctor", "--gate"]
+    try:
+        doc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                             text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"kernel gate: doctor TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    if doc.returncode != 0:
+        print(f"kernel gate: DOCTOR DRIFT ({passed} tests passed)")
+        for line in (doc.stdout + doc.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return doc.returncode
+    print(f"kernel gate: OK ({passed} tests passed, doctor census "
+          "drift 0)")
     return 0
 
 
@@ -1020,6 +1075,7 @@ def main() -> int:
     mxu_rc = run_mxu_gate(args.update)
     serving_rc = run_serving_gate(args.update)
     spec_rc = run_spec_gate(args.update)
+    kernel_rc = run_kernel_gate()
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
     elastic_rc = run_elastic_gate()
@@ -1027,8 +1083,8 @@ def main() -> int:
     xray_rc = run_xray_gate(args.update)
     learn_rc = run_learn_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
-               or mxu_rc or serving_rc or spec_rc or fetch_rc or soak_rc
-               or elastic_rc or armor_rc or xray_rc or learn_rc)
+               or mxu_rc or serving_rc or spec_rc or kernel_rc or fetch_rc
+               or soak_rc or elastic_rc or armor_rc or xray_rc or learn_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
