@@ -89,8 +89,21 @@ class Metrics:
     """Process-wide counters + gauges + latency reservoirs/histograms,
     thread-safe (see module docstring for the lock discipline)."""
 
+    #: nns-tsan lock discipline (lint --threads verifies statically,
+    #: NNS_TPU_TSAN=1 verifies live — docs/ANALYSIS.md "Threads pass")
+    _GUARDED_BY = {
+        "_counters": "_lock", "_gauges": "_lock", "_lat": "_lock",
+        "_hist": "_lock", "_vhist": "_lock", "_lcounters": "_lock",
+        "_lgauges": "_lock", "_llat": "_lock", "_lhist": "_lock",
+    }
+
     def __init__(self):
-        self._lock = threading.Lock()
+        # function-level import: utils.locks is stdlib-only, but core.log
+        # is imported package-wide at init and the lazy import keeps the
+        # core -> utils edge out of module load order
+        from ..utils.locks import make_lock
+
+        self._lock = make_lock("Metrics._lock")
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._gauges: Dict[str, float] = {}
         self._lat: Dict[str, List[float]] = collections.defaultdict(list)

@@ -99,6 +99,9 @@ class _ServerCore:
       low lane is also full, it sheds as above.
     """
 
+    _GUARDED_BY = {"_conns": "_lock", "_conn_locks": "_lock",
+                   "_conn_tenants": "_lock", "_next_conn": "_lock"}
+
     def __init__(self, host: str, port: int, topic: str = "",
                  max_backlog: int = 256, admission: str = "block",
                  on_admit_event=None, send_buf: int = 0, journal=None):

@@ -29,7 +29,7 @@ from ..core.buffer import Buffer
 from ..core.log import STALL_FLOOR_S as _STALL_FLOOR_S
 from ..core.log import logger, metrics
 from ..core.registry import register_element
-from ..utils import tracing
+from ..utils import locks, tracing
 from ..utils.tracing import META_TENANT, META_TRACE_ID
 from .base import SinkElement
 
@@ -60,6 +60,11 @@ class TensorSink(SinkElement):
     #: app whatever tensors arrive — reduced geometry included
     admits_reduced_payload = True
 
+    #: nns-tsan lock discipline (lint --threads verifies statically,
+    #: NNS_TPU_TSAN=1 verifies live — docs/ANALYSIS.md "Threads pass")
+    _GUARDED_BY = {"_pool": "_win_lock", "_pool_stopped": "_win_lock",
+                   "_outstanding": "_win_lock", "_win_peak": "_win_lock"}
+
     def __init__(self, props=None, name=None):
         super().__init__(props, name)
         cap = int(self.props.get("max_buffers", 1024))
@@ -77,7 +82,10 @@ class TensorSink(SinkElement):
         self._pool = None  # lazy fetch_depth-wide resolver pool
         self._pool_stopped = False  # stop() ran: never mint a new pool
         self._outstanding = 0  # submitted-but-unmaterialized window
-        self._win_lock = _threading.Lock()  # counter shared with pool threads
+        # counter shared with pool threads (nns-tsan tracked: the
+        # fetch-window gauge race IS the escaped bug that motivated
+        # the threads pass — docs/ANALYSIS.md)
+        self._win_lock = locks.make_lock("TensorSink._win_lock")
         self._win_peak = 0  # high-water window depth this run
         self._parked = None  # not-yet-done Future seen by try_pop
 
@@ -185,6 +193,7 @@ class TensorSink(SinkElement):
 
     def _fetch_done(self, fut) -> None:
         with self._win_lock:  # runs on pool threads, racing _submit_fetch
+            locks.assert_guarded(self, "_outstanding")
             self._outstanding -= 1
             # gauge write INSIDE the lock: writes are then ordered by
             # acquisition, so the live series stays truthful as the

@@ -132,6 +132,9 @@ class AdaptiveLadder:
     ladder tuple is swapped atomically under a small lock.
     """
 
+    _GUARDED_BY = {"_minted": "_lock", "_sizes": "_lock",
+                   "_align": "_lock"}
+
     def __init__(self, base: Optional[Sequence[int]] = None, *,
                  budget: int = 0, align: int = 1,
                  warm: Optional[Sequence[int]] = None,
@@ -164,8 +167,11 @@ class AdaptiveLadder:
         dp=4 deployment re-rounds to 8 here (deduping against the base),
         instead of sitting in the ladder as a never-dispatchable entry
         that burns a census budget slot."""
-        self._align = max(1, int(value))
+        # nns-tsan unguarded-write: the re-round below READS _align, so
+        # the swap must be atomic with it — a racing setter otherwise
+        # re-rounds _minted against the other thread's width
         with self._lock:
+            self._align = max(1, int(value))
             self._minted = {self._aligned(s) for s in self._minted}
             self._minted.difference_update(self.base)
             self._sizes = tuple(sorted(set(self.base) | self._minted))

@@ -33,7 +33,7 @@ from ..core.config import get_config
 from ..core.log import Timer, logger, metrics
 from ..core.registry import KIND_ELEMENT, get as registry_get
 from ..elements.base import Element, SinkElement, SourceElement, SRC
-from ..utils import tracing
+from ..utils import locks, tracing
 from ..utils.armor import META_POISON as _META_POISON
 from .graph import PipelineGraph
 from .parser import parse as parse_launch
@@ -70,12 +70,18 @@ class _StageQueue:
     waiter that can make progress; ``notify_all`` survives only in
     :meth:`close`, where waking everyone is the point."""
 
+    #: nns-tsan lock discipline (lint --threads verifies statically,
+    #: NNS_TPU_TSAN=1 verifies live — docs/ANALYSIS.md "Threads pass")
+    _GUARDED_BY = {"_dq": "_lock", "_closed": "_lock"}
+
     def __init__(self, capacity: int):
         self._dq: Deque = collections.deque()
         self._cap = max(1, capacity)
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._not_full = threading.Condition(self._lock)
+        self._lock = locks.make_lock("StageQueue._lock")
+        self._not_empty = locks.make_condition(self._lock,
+                                               name="StageQueue._not_empty")
+        self._not_full = locks.make_condition(self._lock,
+                                              name="StageQueue._not_full")
         self._closed = False
 
     def put(self, item) -> bool:

@@ -255,6 +255,51 @@ def dogfood(*, strict: bool, baseline: Optional[set] = None,
     return 1 if fails else 0
 
 
+def lint_threads(*, strict: bool, verbose: bool,
+                 baseline: Optional[set] = None,
+                 collected: Optional[List[str]] = None,
+                 files: Optional[List[str]] = None) -> int:
+    """nns-tsan static side: run the concurrency passes (guarded-by,
+    lock-order graph, thread lifecycle, bare condition waits) over the
+    whole package (or ``files``) — docs/ANALYSIS.md "Threads pass"."""
+    from ..analysis import concurrency
+
+    if files:
+        reports, stats = concurrency.lint_paths(files)
+    else:
+        reports, stats = concurrency.lint_package()
+    rc = 0
+    accepted = n_err = n_warn = n_new = 0
+    for rep in reports:
+        keys = [concurrency.baseline_key(d) for d in rep]
+        if collected is not None:
+            collected.extend(keys)
+        fails = []
+        for d, k in zip(rep.diagnostics, keys):
+            n_err += 1 if d.severity == "error" else 0
+            n_warn += 1 if d.severity == "warning" else 0
+            if baseline is not None and k in baseline:
+                accepted += 1
+                continue
+            if d.severity == "error" or strict:
+                fails.append(d)
+        if fails:
+            rc = 1
+            n_new += len(fails)
+            sub = type(rep)(rep.source)
+            sub.extend(fails)
+            print(sub.render())
+        elif verbose and rep.diagnostics:
+            print(rep.render())
+    print(f"threads: {stats['files']} file(s), {stats['threaded']} "
+          f"threaded module(s), {stats['guarded_classes']} guarded "
+          f"class(es), {stats['locks']} lock(s), {stats['edges']} "
+          f"order edge(s); {n_err} error(s), {n_warn} warning(s), "
+          f"{n_new} new"
+          + (f", {accepted} baseline-accepted" if accepted else ""))
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu.tools.lint",
@@ -271,6 +316,12 @@ def main(argv=None) -> int:
                     help="lint examples/ and tests/test_pipeline_e2e.py")
     ap.add_argument("--dogfood", action="store_true",
                     help="lint nnstreamer_tpu's own device_fns")
+    ap.add_argument("--threads", action="store_true",
+                    help="nns-tsan static side: lock discipline "
+                         "(_GUARDED_BY), lock-order graph, thread "
+                         "lifecycle, bare condition waits over the "
+                         "package (docs/ANALYSIS.md 'Threads pass'); "
+                         "with --files, over those files instead")
     ap.add_argument("--deep", action="store_true",
                     help="also abstractly execute every device stage "
                          "(jax.eval_shape: shape/dtype contract checks + "
@@ -294,7 +345,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if not args.pipeline and not args.files and not args.examples \
-            and not args.dogfood:
+            and not args.dogfood and not args.threads:
         ap.print_usage(sys.stderr)
         return 2
 
@@ -342,11 +393,18 @@ def main(argv=None) -> int:
         e2e = os.path.join(repo, "tests", "test_pipeline_e2e.py")
         if os.path.exists(e2e):
             files.append(e2e)
-    if files:
+    if files and not args.threads:
         rc = max(rc, lint_files(files, strict=args.strict,
                                 verbose=args.verbose, baseline=baseline,
                                 collected=collected, deep=args.deep,
                                 reconfig=reconfig))
+
+    if args.threads:
+        rc = max(rc, lint_threads(strict=args.strict,
+                                  verbose=args.verbose,
+                                  baseline=baseline,
+                                  collected=collected,
+                                  files=files or None))
 
     if args.dogfood:
         rc = max(rc, dogfood(strict=args.strict, baseline=baseline,

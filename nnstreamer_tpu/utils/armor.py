@@ -111,6 +111,8 @@ def load_dlq_entry(path: str):
 class DeadLetterQueue:
     """Bounded directory of quarantined requests."""
 
+    _GUARDED_BY = {"_n": "_lock"}
+
     def __init__(self, path: str, max_entries: int = 256,
                  max_bytes: int = 64 << 20):
         self.path = path
@@ -190,6 +192,8 @@ class CircuitBreaker:
     ``apply_fn(tenant, engage)`` (the pipeline wires this to every
     query-server core's ``tenant_admission`` map — PR 11's autoscaler
     lever, reused).  The trip latches until :meth:`reset`."""
+
+    _GUARDED_BY = {"_hits": "_lock", "tripped": "_lock"}
 
     def __init__(self, threshold: int, window_s: float,
                  apply_fn: Callable[[str, bool], None],

@@ -368,6 +368,8 @@ class Autoscaler:
     >>> scaler.start()   # 0.5 s daemon, like the SLO engine
     """
 
+    _GUARDED_BY = {"_state": "_lock", "actions": "_lock"}
+
     def __init__(self, pipeline, policy, *, spill_to=None,
                  metrics=None, recorder: Optional[tracing.FlightRecorder]
                  = None):

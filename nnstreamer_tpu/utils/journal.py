@@ -54,6 +54,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..core.log import logger, metrics
+from . import locks
 
 log = logger(__name__)
 
@@ -121,6 +122,15 @@ class Journal:
     files directly — the yank_process soak inspects a killed server's
     journal this way)."""
 
+    #: nns-tsan lock discipline (lint --threads verifies statically,
+    #: NNS_TPU_TSAN=1 verifies live — docs/ANALYSIS.md "Threads pass")
+    _GUARDED_BY = {
+        "_file": "_lock", "_file_bytes": "_lock", "_seg_index": "_lock",
+        "_unsynced": "_lock", "_live_unacked": "_lock",
+        "_seg_seqnos": "_lock", "_cur_seqnos": "_lock",
+        "_next_seq": "_lock",
+    }
+
     def __init__(self, path: str, *, fsync: str = "batch",
                  segment_bytes: int = 8 << 20, batch_every: int = 256,
                  batch_interval_s: float = 0.05):
@@ -134,7 +144,7 @@ class Journal:
         self.batch_every = max(1, int(batch_every))
         self.batch_interval_s = max(0.001, float(batch_interval_s))
         os.makedirs(path, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Journal._lock")
         self._stop_flush = threading.Event()
         self._kick = threading.Event()  # batch_every backstop wakeup
         self._flusher: Optional[threading.Thread] = None

@@ -227,6 +227,8 @@ class SLOEngine:
     #: minimum seconds a throughput window must span
     MIN_RATE_WINDOW_S = 2.0
 
+    _GUARDED_BY = {"_history": "_eval_lock"}
+
     def __init__(self, policy: SLOPolicy, sinks: Sequence[str] = (),
                  metrics: Optional[Metrics] = None,
                  recorder: Optional[tracing.FlightRecorder] = None):

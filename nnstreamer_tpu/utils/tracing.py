@@ -139,6 +139,12 @@ SPAN_KINDS: Dict[str, str] = {
                   "the deep lint's predicted census (instant; args: "
                   "program, reason; the flight-recorder window is "
                   "dumped to the log alongside)",
+    "tsan.inversion": "nns-tsan: a live lock-order inversion or "
+                      "guarded-field violation observed by the tracked "
+                      "locks (NNS_TPU_TSAN=1; instant; args: reason = "
+                      "both acquisition paths; the flight-recorder "
+                      "window is dumped to the log alongside — "
+                      "docs/ANALYSIS.md 'Threads pass')",
 }
 
 #: buffer-meta keys the tracer owns (stamped only when tracing is active)

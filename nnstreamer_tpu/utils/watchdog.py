@@ -28,6 +28,8 @@ class Watchdog:
     ...         wd.feed()         # still alive
     """
 
+    _GUARDED_BY = {"_timer": "_lock", "_fired": "_lock", "_gen": "_lock"}
+
     def __init__(self, timeout: float, on_timeout: Callable[[], None]):
         self.timeout = float(timeout)
         self.on_timeout = on_timeout

@@ -59,6 +59,7 @@ import time
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
 from ..core.log import logger, metrics
+from . import locks
 
 log = logger(__name__)
 
@@ -314,8 +315,14 @@ class ProgramRegistry:
     fn; ``register()`` records one compile and fires ``census-drift``
     when the live set escapes the prediction."""
 
+    #: nns-tsan lock discipline (lint --threads verifies statically,
+    #: NNS_TPU_TSAN=1 verifies live — docs/ANALYSIS.md "Threads pass")
+    _GUARDED_BY = {"_expected": "_lock", "_live": "_lock",
+                   "_trackers": "_lock", "_drifts": "_lock",
+                   "_drift_dumped": "_lock"}
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ProgramRegistry._lock")
         #: (stage, kind) -> (budget, allow-set or None, note)
         self._expected: Dict[Tuple[str, str],
                              Tuple[int, Optional[FrozenSet[int]], str]] = {}

@@ -539,7 +539,17 @@ class TestJournalPipeline:
                     sock, wire.encode_buffer(_req(1.0, 0, "ghost")))
             finally:
                 sock.close()  # gone before the answer
+            from nnstreamer_tpu.utils.journal import scan
             deadline = time.monotonic() + 15.0
+            # The reader journals the request asynchronously — an empty
+            # WAL also has no unanswered entries, so polling for absence
+            # alone can win the race against the append and exit before
+            # the server ever saw the request (teardown then strands the
+            # entry).  Establish presence first, then poll for the ack.
+            while time.monotonic() < deadline \
+                    and not scan(jdir).requests:
+                time.sleep(0.05)
+            assert scan(jdir).requests, "request never journaled"
             while time.monotonic() < deadline \
                     and replay_unanswered(jdir):
                 time.sleep(0.05)

@@ -1,0 +1,446 @@
+"""nns-tsan tests: golden bad fixtures for the static concurrency lint
+(exact diagnostic code + caret position), a clean dogfood pass over the
+shipped package, and live TrackedLock/TrackedCondition semantics —
+inversion raise, self-deadlock-before-block, guarded-field assertion,
+and the structurally-zero-overhead off path (docs/ANALYSIS.md "Threads
+pass")."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from nnstreamer_tpu.analysis import concurrency
+from nnstreamer_tpu.analysis.diagnostics import ERROR, WARNING
+from nnstreamer_tpu.utils import locks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_fixture(tmp_path, source, name="fix.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    reports, stats = concurrency.lint_paths([str(p)], root=str(tmp_path))
+    diags = [d for rep in reports for d in rep.diagnostics]
+    return reports, diags, source
+
+
+def _caret_line(report):
+    """The rendered caret block for the report's first diagnostic."""
+    return report.render(carets=True)
+
+
+# ---------------------------------------------------------------------------
+# golden bad fixtures: one per diagnostic class, exact code + position
+# ---------------------------------------------------------------------------
+
+UNGUARDED = '''\
+import threading
+
+
+class Counter:
+    _GUARDED_BY = {"_n": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        self._n += 1
+'''
+
+
+def test_unguarded_write_detected(tmp_path):
+    reports, diags, src = _lint_fixture(tmp_path, UNGUARDED)
+    assert [d.code for d in diags] == ["unguarded-write"]
+    d = diags[0]
+    assert d.severity == ERROR
+    assert d.path.endswith("Counter.bump._n")
+    # caret lands exactly on the write statement
+    assert d.pos == src.index("self._n += 1")
+    rendered = reports[0].render()
+    assert "self._n += 1" in rendered and "^" in rendered
+
+
+def test_guarded_write_clean(tmp_path):
+    ok = UNGUARDED.replace(
+        "    def bump(self):\n        self._n += 1\n",
+        "    def bump(self):\n        with self._lock:\n"
+        "            self._n += 1\n")
+    _, diags, _ = _lint_fixture(tmp_path, ok)
+    assert diags == []
+
+
+def test_mutator_call_flagged(tmp_path):
+    """unguarded-write: container mutators count as writes."""
+    src = UNGUARDED.replace("self._n = 0", "self._n = []").replace(
+        "self._n += 1", "self._n.append(1)")
+    _, diags, s = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == ["unguarded-write"]
+    assert diags[0].pos == s.index("self._n.append(1)")
+
+
+def test_locked_helper_chain_proven(tmp_path):
+    """Regression for the fixpoint call-site rule (unguarded-write):
+    a ``_locked`` helper chain of depth 2 whose only entry holds the
+    lock must NOT flag — Journal's append → _write_locked →
+    _rotate_locked shape."""
+    src = '''\
+import threading
+
+
+class J:
+    _GUARDED_BY = {"_n": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def append(self):
+        with self._lock:
+            self._write_locked()
+
+    def _write_locked(self):
+        self._rotate_locked()
+
+    def _rotate_locked(self):
+        self._n += 1
+'''
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert diags == []
+
+
+def test_unlocked_caller_breaks_the_proof(tmp_path):
+    """unguarded-write names the call site that fails the proof."""
+    src = '''\
+import threading
+
+
+class J:
+    _GUARDED_BY = {"_n": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def good(self):
+        with self._lock:
+            self._bump()
+
+    def bad(self):
+        self._bump()
+
+    def _bump(self):
+        self._n += 1
+'''
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == ["unguarded-write"]
+    assert "J.bad()" in diags[0].message
+
+
+INVERSION = '''\
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+
+def forward():
+    with A:
+        with B:
+            pass
+
+
+def backward():
+    with B:
+        with A:
+            pass
+'''
+
+
+def test_lock_order_inversion_detected(tmp_path):
+    _, diags, _ = _lint_fixture(tmp_path, INVERSION)
+    inv = [d for d in diags if d.code == "lock-order-inversion"]
+    assert len(inv) == 1
+    d = inv[0]
+    assert d.severity == ERROR
+    # both acquisition paths are named in the message
+    assert ":A -> " in d.message and ":B -> " in d.message
+    assert d.path.startswith("order:")
+
+
+def test_consistent_order_clean(tmp_path):
+    src = INVERSION.replace("    with B:\n        with A:",
+                            "    with A:\n        with B:")
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d for d in diags if d.code == "lock-order-inversion"] == []
+
+
+UNJOINED = '''\
+import threading
+
+
+class Owner:
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+'''
+
+
+def test_unjoined_thread_detected(tmp_path):
+    _, diags, src = _lint_fixture(tmp_path, UNJOINED)
+    assert [d.code for d in diags] == ["unjoined-thread"]
+    d = diags[0]
+    assert d.severity == ERROR
+    assert d.pos == src.index("threading.Thread(")
+
+
+def test_joined_thread_clean(tmp_path):
+    src = UNJOINED + '''
+    def stop(self):
+        self._thread.join()
+'''
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert diags == []
+
+
+def test_join_via_tuple_swap_dataflow(tmp_path):
+    """The ``t, self._thread = self._thread, None`` idiom still counts
+    as joining the owned thread (unjoined-thread dataflow)."""
+    src = UNJOINED + '''
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+'''
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert diags == []
+
+
+def test_daemon_thread_warned(tmp_path):
+    src = UNJOINED.replace("target=self._run)",
+                           "target=self._run, daemon=True)")
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == ["daemon-thread"]
+    assert diags[0].severity == WARNING
+
+
+COND_WAIT = '''\
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items = []
+
+    def get(self):
+        with self._cond:
+            if not self._items:
+                self._cond.wait()
+            return self._items.pop()
+'''
+
+
+def test_cond_wait_without_predicate_loop(tmp_path):
+    _, diags, src = _lint_fixture(tmp_path, COND_WAIT)
+    assert [d.code for d in diags] == ["cond-wait-no-predicate"]
+    d = diags[0]
+    assert d.severity == WARNING
+    assert d.pos == src.index("self._cond.wait()")  # the bare wait
+
+
+def test_cond_wait_in_while_clean(tmp_path):
+    src = COND_WAIT.replace("            if not self._items:",
+                            "            while not self._items:")
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# dogfood: the shipped package passes vs the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_package_dogfood_clean_vs_baseline():
+    reports, stats = concurrency.lint_package()
+    baseline = set()
+    with open(os.path.join(REPO, "tools", "tsan_baseline.txt")) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln and not ln.startswith("#"):
+                baseline.add(ln)
+    new = [d for rep in reports for d in rep.diagnostics
+           if concurrency.baseline_key(d) not in baseline]
+    assert new == [], "\n".join(str(d) for d in new)
+    # errors are NEVER baselined — the file may only carry warnings
+    errs = [d for rep in reports for d in rep.diagnostics
+            if d.severity == ERROR]
+    assert errs == [], "\n".join(str(d) for d in errs)
+    assert stats["guarded_classes"] >= 12
+    assert stats["threaded"] >= 20
+
+
+def test_baseline_keys_carry_no_line_numbers():
+    reports, _ = concurrency.lint_package()
+    for rep in reports:
+        for d in rep.diagnostics:
+            key = concurrency.baseline_key(d)
+            assert key.startswith("threads:")
+            assert ":char" not in key and " " not in key
+
+
+# ---------------------------------------------------------------------------
+# dynamic side: tracked primitives
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tsan(monkeypatch):
+    monkeypatch.setenv(locks.ENV_FLAG, "1")
+    monkeypatch.setenv(locks.ENV_RAISE, "1")
+    locks.reset()
+    yield
+    locks.reset()
+
+
+def test_live_inversion_raises_with_both_paths(tsan):
+    a = locks.make_lock("T.A")
+    b = locks.make_lock("T.B")
+    assert isinstance(a, locks.TrackedLock)
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=forward, name="fwd")
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(locks.LockOrderError) as ei:
+            with a:
+                pass
+    msg = str(ei.value)
+    assert "T.B -> T.A" in msg and "T.A -> T.B" in msg
+    rep = locks.report()
+    assert rep["enabled"] and len(rep["inversions"]) == 1
+    # the liveness counter the check_tier1 tsan gate pins on: edges can
+    # be 0 in a clean run, acquisitions cannot
+    assert rep["acquisitions"] >= 3 and rep["edges"] >= 2
+
+
+def test_inversion_recorded_without_raise(tsan, monkeypatch):
+    monkeypatch.delenv(locks.ENV_RAISE, raising=False)
+    a = locks.make_lock("R.A")
+    b = locks.make_lock("R.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # records, does not raise (the soak posture)
+            pass
+    assert len(locks.report()["inversions"]) == 1
+
+
+def test_self_deadlock_caught_before_blocking(tsan):
+    lk = locks.make_lock("T.self")
+    with lk:
+        t0 = time.monotonic()
+        with pytest.raises(locks.LockOrderError, match="self-deadlock"):
+            lk.acquire()
+        assert time.monotonic() - t0 < 1.0  # raised, never blocked
+    assert not lk.locked()
+
+
+def test_rlock_reentry_legal(tsan):
+    rl = locks.make_rlock("T.re")
+    with rl:
+        with rl:
+            assert rl.held_by_me()
+    assert not rl.locked()
+    assert locks.report()["inversions"] == []
+
+
+def test_condition_over_shared_tracked_lock(tsan):
+    lk = locks.make_lock("T.q")
+    not_empty = locks.make_condition(lk, name="T.q.not_empty")
+    items = []
+
+    def producer():
+        time.sleep(0.05)
+        with not_empty:
+            items.append(1)
+            not_empty.notify()
+
+    t = threading.Thread(target=producer, name="prod")
+    t.start()
+    with not_empty:
+        while not items:
+            assert not_empty.wait(timeout=5.0)
+    t.join()
+    assert items == [1]
+    assert locks.report()["inversions"] == []
+
+
+def test_assert_guarded_live(tsan):
+    class Owner:
+        _GUARDED_BY = {"_n": "_lock"}
+
+        def __init__(self):
+            self._lock = locks.make_lock("Owner._lock")
+            self._n = 0
+
+    o = Owner()
+    with o._lock:
+        locks.assert_guarded(o, "_n")  # held: fine
+    with pytest.raises(locks.GuardViolation, match="Owner._n"):
+        locks.assert_guarded(o, "_n")  # not held: flagged
+    assert len(locks.report()["guard_violations"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# off path: structurally zero overhead when the env is unset
+# ---------------------------------------------------------------------------
+
+def test_off_mode_vends_plain_primitives(monkeypatch):
+    monkeypatch.delenv(locks.ENV_FLAG, raising=False)
+    assert type(locks.make_lock("x")) is type(threading.Lock())
+    assert isinstance(locks.make_rlock("x"),
+                      type(threading.RLock()))
+    assert isinstance(locks.make_condition(name="x"),
+                      threading.Condition)
+
+
+def test_off_mode_never_touches_the_graph(monkeypatch):
+    """The CI structural pin: with the env unset, NO graph hook may
+    run — the off path is the untracked path, not 'tracking that
+    discards' (the tracing-off posture, tools/tracing_gate.py)."""
+    monkeypatch.delenv(locks.ENV_FLAG, raising=False)
+
+    def boom(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError("LockOrderGraph hook ran in off mode")
+
+    monkeypatch.setattr(locks.LockOrderGraph, "acquired", boom)
+    monkeypatch.setattr(locks.LockOrderGraph, "released", boom)
+    monkeypatch.setattr(locks.LockOrderGraph, "before_acquire", boom)
+    lk = locks.make_lock("off")
+    with lk:
+        pass
+    cond = locks.make_condition(name="off.cond")
+    with cond:
+        cond.notify_all()
+    # a fully-plain-locked owner still runs assert_guarded for free
+    monkeypatch.setattr(locks, "_active", False)
+
+    class Owner:
+        _GUARDED_BY = {"_n": "_lock"}
+
+        def __init__(self):
+            self._lock = locks.make_lock("Owner._lock")
+            self._n = 0
+
+    locks.assert_guarded(Owner(), "_n")  # no lock held: still silent

@@ -291,12 +291,19 @@ class TestServerSurvivesGarbage:
             # 6 salvageable + 6 noise frames rejected, per tenant.
             # Poll: the last NOISE frame is never answered, so its
             # reject may still be mid-count when the 12th answer lands
-            # client-side.
+            # client-side.  Same for `out`: the sink counts AFTER
+            # core.send() completes the socket write, so the client can
+            # read answer 12 before the stage thread reaches the
+            # counter — wait for both, don't assert a happens-before
+            # the server never promised.
             import time as _t
 
             deadline = _t.monotonic() + 5.0
-            while _t.monotonic() < deadline and metrics.snapshot().get(
-                    "query_server.wire_rejects", 0.0) < 12.0:
+            while _t.monotonic() < deadline and (
+                    metrics.snapshot().get(
+                        "query_server.wire_rejects", 0.0) < 12.0
+                    or metrics.snapshot().get(
+                        "query_server.out", 0.0) < 6.0):
                 _t.sleep(0.02)
             snap = metrics.snapshot()
             lab = metrics.labeled_counters()

@@ -194,6 +194,14 @@ ROWS = [
     ("llama_decode_tp2", ["CPU", "--config", "tp", "--tp-ways", "2"]),
     ("llama_decode_tp4", ["CPU", "--config", "tp", "--tp-ways", "4"]),
     ("sharded_grid_dp2xtp2", ["CPU", "--config", "tp_grid"]),
+    # nns-tsan off-mode sentinel (ISSUE 17, docs/ANALYSIS.md "Threads
+    # pass"): with NNS_TPU_TSAN unset the lock factories hand back PLAIN
+    # threading primitives, so the only residual cost is the guarded-
+    # field early-out check; this row pins that cost ≤2% of per-buffer
+    # service time the same deterministic way tracing_gate.py pins the
+    # trace-off guard (wall-clock A/B noise on this host exceeds the
+    # bound being checked)
+    ("tsan_overhead", ["TSAN"]),
 ]
 
 
@@ -218,6 +226,14 @@ def run_row(label: str, argv, timeout: int) -> dict:
     elif argv and argv[0] == "DOCTOR":
         cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.doctor"] \
             + argv[1:]
+    # TSAN sentinel: tools/tsan_overhead.py (same stdout contract) —
+    # MUST run with NNS_TPU_TSAN unset so it measures the off path
+    elif argv and argv[0] == "TSAN":
+        cmd = [sys.executable,
+               os.path.join(REPO, "tools", "tsan_overhead.py")] + argv[1:]
+        env = dict(env if env is not None else os.environ)
+        env.pop("NNS_TPU_TSAN", None)
+        env.pop("NNS_TPU_TSAN_RAISE", None)
     else:
         cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)

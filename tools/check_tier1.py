@@ -147,6 +147,17 @@ compiled in, and the fused-verify transfer-budget trap — then
 ``python -m nnstreamer_tpu.tools.doctor --gate`` re-asserting census
 drift 0 with the sampled/spec programs in the build.
 
+AND it runs the tsan gate (ISSUE 17, docs/ANALYSIS.md "Threads pass"):
+``lint --threads --strict`` over the whole package — the ``_GUARDED_BY``
+write discipline, the nested-``with`` lock-order graph (cycle = a
+``lock-order-inversion`` naming both acquisition paths), thread
+join-lifecycle + bare-condition-wait audits — strict against
+tools/tsan_baseline.txt (reviewed daemon-thread suppressions only;
+errors are never baselined), with the pass asserted jax-free; then the
+chaos smoke re-run with ``NNS_TPU_TSAN=1`` so every hot lock owner vends
+tracked primitives — the rows must report zero LIVE inversions and zero
+guarded-field violations with a non-empty order graph.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -176,6 +187,7 @@ ASR_BASELINE = os.path.join(REPO, "tools", "asr_deep_baseline.txt")
 XRAY_BASELINE = os.path.join(REPO, "tools", "xray_baseline.txt")
 LEARN_BASELINE = os.path.join(REPO, "tools", "learn_deep_baseline.txt")
 SPEC_BASELINE = os.path.join(REPO, "tools", "spec_deep_baseline.txt")
+TSAN_BASELINE = os.path.join(REPO, "tools", "tsan_baseline.txt")
 
 #: HBM budget the MXU gate pins for the streaming-ASR example's deep
 #: lint: below the estimate, so the hbm-budget warning fires with the
@@ -1057,6 +1069,108 @@ def run_xray_gate(update: bool, timeout: int = 900) -> int:
     return 0
 
 
+def run_tsan_gate(update: bool, timeout: int = 600) -> int:
+    """nns-tsan gate (ISSUE 17, docs/ANALYSIS.md "Threads pass"): the
+    static concurrency lint (``lint --threads --strict``) over the whole
+    package in its own process — guarded-by discipline, the nested-with
+    lock-order graph, thread lifecycles — strict against
+    tools/tsan_baseline.txt (daemon-thread suppressions only: errors
+    are never baselined), with the pass asserted jax-free; then the
+    chaos smoke re-run with ``NNS_TPU_TSAN=1`` so every tracked lock
+    records into the live order graph — the rows must report ZERO
+    observed inversions and zero guarded-field violations."""
+    import json
+    import tempfile
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    probe = (
+        "import sys\n"
+        "from nnstreamer_tpu.analysis import concurrency\n"
+        "concurrency.lint_package()\n"
+        "assert 'jax' not in sys.modules, "
+        "'lint --threads must stay jax-free'\n")
+    cmd = [sys.executable, "-c", probe]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        print("tsan gate: jax-free probe TIMED OUT", file=sys.stderr)
+        return 2
+    if proc.returncode != 0:
+        print("tsan gate: STATIC PASS IMPORTS JAX (or crashed)")
+        for line in (proc.stdout + proc.stderr).strip().splitlines()[-10:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--threads", "--strict", "--baseline", TSAN_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("tsan gate: lint --threads TIMED OUT after 300s",
+              file=sys.stderr)
+        return 2
+    if lint.returncode != 0 and not update:
+        print("tsan gate: NEW DIAGNOSTICS")
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return lint.returncode
+    summary = next((ln for ln in lint.stdout.splitlines()
+                    if ln.startswith("threads:")), "")
+
+    out = os.path.join(tempfile.gettempdir(), "nns_tsan_gate.json")
+    env["NNS_TPU_TSAN"] = "1"
+    cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+           "--chaos-smoke", "--out", out]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"tsan gate: chaos smoke TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    problems = []
+    if proc.returncode != 0:
+        problems.append(f"soak.py --chaos-smoke rc={proc.returncode}")
+    rows = {}
+    try:
+        with open(out) as f:
+            rows = {r["profile"]: r for r in json.load(f)["rows"]}
+    except (OSError, ValueError, KeyError) as e:
+        problems.append(f"unreadable tsan chaos artifact: {e}")
+    for profile, r in rows.items():
+        tsan = r.get("tsan") or {}
+        if not tsan.get("enabled"):
+            problems.append(f"{profile}: tracked locks not engaged "
+                            f"(tsan={tsan})")
+            continue
+        if tsan.get("inversions"):
+            problems.append(
+                f"{profile}: LIVE lock-order inversion(s): "
+                f"{tsan['inversions']}")
+        if tsan.get("guard_violations"):
+            problems.append(
+                f"{profile}: guarded-field violation(s): "
+                f"{tsan['guard_violations']}")
+        # edges need two DISTINCT tracked locks nested, which a clean
+        # chaos run may legitimately never do — liveness is pinned on
+        # the acquisition counter instead
+        if tsan.get("acquisitions", 0) < 1:
+            problems.append(f"{profile}: zero tracked-lock acquisitions "
+                            "— the sanitizer never engaged")
+    if not rows:
+        problems.append("no chaos rows produced")
+    tag = ("updated" if update and not problems else
+           "OK" if not problems else "FAILED")
+    print(f"tsan gate: {tag} ({summary or 'no lint summary'})")
+    for p in problems:
+        print(f"  tsan gate: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -1082,9 +1196,11 @@ def main() -> int:
     armor_rc = run_armor_gate()
     xray_rc = run_xray_gate(args.update)
     learn_rc = run_learn_gate(args.update)
+    tsan_rc = run_tsan_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
                or mxu_rc or serving_rc or spec_rc or kernel_rc or fetch_rc
-               or soak_rc or elastic_rc or armor_rc or xray_rc or learn_rc)
+               or soak_rc or elastic_rc or armor_rc or xray_rc or learn_rc
+               or tsan_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:

@@ -805,6 +805,11 @@ def run_chaos_profile(chaos: str, *, tenants: int = 3,
         row["reclaimed_ok"] = bool(
             row["pool"]
             and row["pool"]["blocks_free"] == row["pool"]["blocks_total"])
+        # nns-tsan posture (docs/ANALYSIS.md "Threads pass"): with
+        # NNS_TPU_TSAN=1 the tracked locks record-only here; the tsan
+        # gate asserts zero live inversions over the whole chaos run
+        from nnstreamer_tpu.utils import locks
+        row["tsan"] = locks.report()
         if wd_fired.is_set() or not row["surviving_p99_green"]:
             row["ring_dump"] = tracing.format_recent(5.0)[-120:]
         else:
