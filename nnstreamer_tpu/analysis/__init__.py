@@ -45,6 +45,7 @@ def analyze(
     caps: bool = True,
     topology: bool = True,
     purity: bool = True,
+    proto: bool = False,
     queue_capacity: Optional[int] = None,
     deep: bool = False,
     batch_max: Optional[int] = None,
@@ -109,6 +110,18 @@ def analyze(
         from .purity import lint_graph
 
         run("purity", lambda: lint_graph(graph))
+    if proto:
+        # nns-proto (docs/ANALYSIS.md "Protocol pass"): a package-level
+        # property, not a per-pipeline one — the serving protocol
+        # alphabet, handler totality, unanswered-path proof, and the
+        # model-vs-code drift gate over the protocol modules.
+        from . import protocol as _protocol
+
+        def _run_proto():
+            reports, _stats = _protocol.lint_package()
+            return [d for rep in reports for d in rep]
+
+        run("protocol", _run_proto)
     if deep:
         from .tracecheck import deep_check
 

@@ -25,6 +25,7 @@ from ..core.buffer import Buffer
 from ..core.caps import Caps, MediaType
 from ..core.config import get_config
 from ..core.log import Timer, logger, metrics
+from ..core.meta_keys import META_STREAM_INDEX, META_STREAM_LAST
 from ..core.registry import KIND_FILTER, get as registry_get, lookup, names, register_element
 from ..core.types import TensorFormat, TensorsSpec
 from ..filters.base import Framework, FrameworkError, parse_accelerator
@@ -299,10 +300,10 @@ class TensorFilter(Element):
                         yield (SRC, prev)
                     final = self._compose_outputs(buf.tensors, list(outs))
                     out_buf = buf.with_tensors(final, spec=None)
-                    out_buf.meta["stream_index"] = i
+                    out_buf.meta[META_STREAM_INDEX] = i
                     prev = out_buf
                 if prev is not None:
-                    prev.meta["stream_last"] = True
+                    prev.meta[META_STREAM_LAST] = True
                     yield (SRC, prev)
                 dt = time.perf_counter() - t0
                 self._n_invoked += 1

@@ -38,6 +38,7 @@ from typing import Deque, Dict, Iterator, List, Optional, Tuple, Union
 from ..core.buffer import Buffer, Event
 from ..core.caps import Caps
 from ..core.log import logger, metrics
+from ..core import meta_keys
 from ..core.registry import register_element
 from ..utils import elastic, wire
 from ..utils.armor import META_POISON
@@ -46,23 +47,35 @@ from .base import Element, ElementError, SourceElement, SinkElement, SRC
 
 log = logger(__name__)
 
-_META_MSG = "_query_msg"
-_META_CONN = "_query_conn"
+# Protocol meta keys are declared once in core/meta_keys.py (the nns-proto
+# lint's alphabet source of truth); the short module aliases below keep
+# call sites readable.
+_META_MSG = meta_keys.META_QUERY_MSG
+_META_CONN = meta_keys.META_QUERY_CONN
 #: journal seqno of an accepted request (docs/ROBUSTNESS.md): stamped by
 #: the serversrc reader when a request journal is configured, consumed
 #: (ack + strip) by the serversink when the answer leaves
-_META_JSEQ = "_journal_seq"
+_META_JSEQ = meta_keys.META_JOURNAL_SEQ
 #: marks a buffer re-admitted by journal replay (its original
 #: connection died with the previous process; the serversink acks it
 #: as answered instead of warning about the missing conn)
-_META_REPLAY = "_journal_replay"
-#: tenant identity riding the wire meta (utils/tracing.META_TENANT):
+_META_REPLAY = meta_keys.META_JOURNAL_REPLAY
+#: tenant identity riding the wire meta (core/meta_keys.META_TENANT):
 #: stamped by the client (``tenant=`` prop / appsrc / hello fallback),
 #: read by the server for per-tenant accounting + admission decisions
-_META_TENANT = "_tenant"
+_META_TENANT = meta_keys.META_TENANT
 #: serversrc batching: list of per-request meta dicts riding one stacked
 #: buffer; serversink splits output rows back to each client.
-_META_BATCH = "_query_batch"
+_META_BATCH = meta_keys.META_QUERY_BATCH
+# server verdict / streaming response flags (same registry)
+_META_SHED = meta_keys.META_SHED
+_META_WIRE_REJECT = meta_keys.META_WIRE_REJECT
+_META_ERROR = meta_keys.META_ERROR
+_META_ABORT = meta_keys.META_ABORT_REASON
+_META_SIDX = meta_keys.META_STREAM_INDEX
+_META_SLAST = meta_keys.META_STREAM_LAST
+_META_SABORT = meta_keys.META_STREAM_ABORTED
+_META_TQ = meta_keys.META_ENQUEUE_NS
 
 #: Placeholder in ``_done`` for a fully-streamed request: advances the
 #: in-order cursor without emitting (its buffers already went downstream).
@@ -255,8 +268,9 @@ class _ServerCore:
         if mid is None:
             return  # nothing to route the reject to
         notice = Buffer([], meta={
-            _META_MSG: mid, "wire_reject": True,
-            "abort_reason": "wire", "error": str(err)[:200]})
+            _META_MSG: mid, _META_WIRE_REJECT: True,
+            _META_ABORT: meta_keys.ABORT_REASON_WIRE,
+            _META_ERROR: str(err)[:200]})
         if tenant is not None:
             notice.meta[_META_TENANT] = tenant
         self.send(int(cid), wire.encode_buffer(notice))
@@ -329,7 +343,7 @@ class _ServerCore:
         mid = buf.meta.get(_META_MSG)
         if cid is None or mid is None:
             return  # nothing to answer (not a query-framed request)
-        notice = Buffer([], meta={_META_MSG: mid, "shed": True})
+        notice = Buffer([], meta={_META_MSG: mid, _META_SHED: True})
         if tenant is not None:
             notice.meta[_META_TENANT] = tenant
         self.send(int(cid), wire.encode_buffer(notice))
@@ -707,7 +721,7 @@ class TensorQueryServerSink(SinkElement):
     def _send_failed(self, meta: Dict) -> None:
         metrics.count(f"{self.name}.dropped")
         stream_id = meta.get(elastic.META_STREAM_ID)
-        if "stream_index" not in meta or stream_id is None \
+        if _META_SIDX not in meta or stream_id is None \
                 or stream_id in self._cancelled_sids:
             return
         if elastic.cancel_stream(stream_id, "dead-connection"):
@@ -733,9 +747,9 @@ class TensorQueryServerSink(SinkElement):
             seq = meta.get(_META_JSEQ)
         if seq is None or core.journal is None:
             return False
-        if not undeliverable and "stream_index" in meta \
-                and not (meta.get("stream_last")
-                         or meta.get("stream_aborted")):
+        if not undeliverable and _META_SIDX in meta \
+                and not (meta.get(_META_SLAST)
+                         or meta.get(_META_SABORT)):
             return False
         return core.journal.ack(int(seq))
 
@@ -743,6 +757,19 @@ class TensorQueryServerSink(SinkElement):
         core = _get_server(self.sid)
         if core is None:
             raise ElementError(f"no query server with id={self.sid}")
+        try:
+            return self._process_routed(core, buf)
+        except BaseException as e:
+            # nns-proto unanswered-path: never let an exception strand a
+            # routed client into a timeout — answer with a typed
+            # ``abort_reason="internal"`` terminator first (double-answer
+            # is safe: the client dedupes by msg id and journal acks are
+            # idempotent, both model-checked by analysis/statemachine.py
+            # exactly-once), then surface the error to the pipeline.
+            self._abort_unanswered(core, buf.meta, e)
+            raise
+
+    def _process_routed(self, core, buf: Buffer):
         if _META_BATCH in buf.meta:
             return self._send_batched(core, buf)
         cid = buf.meta.get(_META_CONN)
@@ -767,7 +794,7 @@ class TensorQueryServerSink(SinkElement):
         # Do not leak server-side routing or tracer-internal meta back to
         # the client (the queue-stamp map is this pipeline's plumbing).
         out.meta.pop(_META_CONN, None)
-        out.meta.pop("_tq", None)
+        out.meta.pop(_META_TQ, None)
         out.meta.pop(_META_REPLAY, None)
         out.meta.pop(META_POISON, None)  # the typed abort_reason stays
         jseq = out.meta.pop(_META_JSEQ, None)
@@ -793,13 +820,20 @@ class TensorQueryServerSink(SinkElement):
         tensors = [np.asarray(t) for t in host.tensors]
         for t in tensors:
             if t.ndim == 0 or t.shape[0] < len(metas):
-                raise ElementError(
+                err = ElementError(
                     f"{self.name}: batched output leading dim "
                     f"{t.shape[:1] or None} < {len(metas)} batched requests "
                     "— the served model must be batch-leading for "
                     "serversrc max-batch")
+                # nns-proto unanswered-path: a bare raise here would
+                # strand len(metas) clients into timeouts.  Answer each
+                # batched request with a typed internal abort, THEN
+                # surface the config error.
+                for m in metas:
+                    self._abort_unanswered(core, m, err)
+                raise err
         resp_meta = {k: v for k, v in host.meta.items()
-                     if k not in (_META_BATCH, _META_CONN, "_tq",
+                     if k not in (_META_BATCH, _META_CONN, _META_TQ,
                                   _META_JSEQ, _META_REPLAY,
                                   META_POISON)}
         for i, m in enumerate(metas):
@@ -827,6 +861,41 @@ class TensorQueryServerSink(SinkElement):
                                   undeliverable=True)
                 self._send_failed(out.meta)
         return []
+
+    def _abort_unanswered(self, core, meta: dict,
+                          err: BaseException) -> None:
+        """Answer one routed request (or every row of a batch) with a
+        typed ``stream_aborted`` / ``abort_reason="internal"`` terminator
+        instead of leaving the client to wait out its timeout.  Best
+        effort — the client may already be gone — and idempotent: a
+        duplicate answer is deduped by msg id client-side and the
+        journal ack is a no-op the second time."""
+        if _META_BATCH in meta:
+            for m in meta[_META_BATCH]:
+                self._abort_unanswered(core, m, err)
+            return
+        cid = meta.get(_META_CONN)
+        jseq = meta.get(_META_JSEQ)
+        if cid is None or meta.get(_META_MSG) is None:
+            # nothing to route an answer to; still release the WAL entry
+            self._ack_journal(core, meta, jseq, undeliverable=True)
+            return
+        term = Buffer([], meta={
+            k: v for k, v in meta.items()
+            if k not in (_META_CONN, _META_JSEQ, _META_REPLAY,
+                         _META_BATCH, _META_TQ, META_POISON)})
+        term.meta[_META_SABORT] = True
+        term.meta[_META_ABORT] = meta_keys.ABORT_REASON_INTERNAL
+        term.meta[_META_ERROR] = str(err)[:200]
+        if _META_SIDX in term.meta:
+            term.meta[_META_SLAST] = True
+        try:
+            core.send(int(cid), wire.encode_buffer(term))
+        except Exception:
+            pass  # answering is best-effort; the error still propagates
+        self._ack_journal(core, term.meta, jseq, undeliverable=True)
+        metrics.count("query_server.aborted_internal",
+                      tenant=term.meta.get(_META_TENANT))
 
 
 @register_element("tensor_query_client")
@@ -1129,8 +1198,8 @@ class TensorQueryClient(Element):
                     self._pending.pop(mid)
                     self._streaming.discard(mid)
                     term = orig.with_tensors([])
-                    term.meta.update(stream_last=True,
-                                     stream_aborted=True)
+                    term.meta.update({_META_SLAST: True,
+                                      _META_SABORT: True})
                     self._done[mid] = term
                 else:
                     self._pending[mid] = (orig, time.monotonic())
@@ -1165,14 +1234,14 @@ class TensorQueryClient(Element):
         #5: "tensor_filter + tensor_query" token streaming).
         """
         mid = int(buf.meta.pop(_META_MSG, -1))
-        streamed = "stream_index" in buf.meta
+        streamed = _META_SIDX in buf.meta
         emit_now: Optional[Buffer] = None
         with self._cv:
             entry = self._pending.get(mid)
             if entry is None:
                 if mid in self._aborted:
                     # late tokens of a timed-out (dropped) stream
-                    if buf.meta.get("stream_last"):
+                    if buf.meta.get(_META_SLAST):
                         self._aborted.discard(mid)
                     metrics.count(f"{self.name}.late_dropped")
                 else:
@@ -1187,7 +1256,7 @@ class TensorQueryClient(Element):
                 # keep-alive: each token resets the request's timeout
                 self._pending[mid] = (orig, time.monotonic())
                 self._streaming.add(mid)
-                if buf.meta.get("stream_last"):
+                if buf.meta.get(_META_SLAST):
                     self._pending.pop(mid)
                     self._streaming.discard(mid)
                     self._done[mid] = _STREAM_DONE
@@ -1195,19 +1264,28 @@ class TensorQueryClient(Element):
             else:
                 self._pending.pop(mid)
                 self._done[mid] = buf
-            if buf.meta.get("shed"):
+            if buf.meta.get(_META_SHED):
                 # the server's admission control dropped this request and
                 # answered immediately (docs/SERVING.md "Front door")
                 metrics.count(f"{self.name}.sheds")
-            if buf.meta.get("abort_reason") == "poison":
+            abort_reason = buf.meta.get(_META_ABORT)
+            if abort_reason == meta_keys.ABORT_REASON_POISON:
                 # typed poison terminator (docs/ROBUSTNESS.md): the
                 # request crashed a server stage and was quarantined
                 metrics.count(f"{self.name}.poisoned")
-            elif buf.meta.get("wire_reject"):
+            elif buf.meta.get(_META_WIRE_REJECT):
                 # the server rejected this request's wire frame (typed
                 # WireError) — delivered like any response so the app
                 # sees abort_reason="wire" instead of a timeout
                 metrics.count(f"{self.name}.wire_rejected")
+            elif abort_reason is not None:
+                # any other typed abort (e.g. "internal"): the server
+                # chose answering over silence; its error detail rides
+                # the response meta
+                log.warning("%s: msg=%d aborted by server (%s): %s",
+                            self.name, mid, abort_reason,
+                            buf.meta.get(_META_ERROR, ""))
+                metrics.count(f"{self.name}.aborted")
             metrics.count(f"{self.name}.responses")
             self._cv.notify_all()
         if emit_now is not None:
@@ -1275,8 +1353,8 @@ class TensorQueryClient(Element):
                             self._streaming.discard(mid)
                             self._aborted.add(mid)
                             term = entry[0].with_tensors([])
-                            term.meta.update(stream_last=True,
-                                             stream_aborted=True)
+                            term.meta.update({_META_SLAST: True,
+                                              _META_SABORT: True})
                             self._done[mid] = term
                         else:
                             self._emit_next += 1

@@ -29,8 +29,8 @@ from ..core.buffer import Buffer
 from ..core.log import STALL_FLOOR_S as _STALL_FLOOR_S
 from ..core.log import logger, metrics
 from ..core.registry import register_element
+from ..core.meta_keys import META_TENANT, META_TRACE_ID
 from ..utils import locks, tracing
-from ..utils.tracing import META_TENANT, META_TRACE_ID
 from .base import SinkElement
 
 log = logger(__name__)

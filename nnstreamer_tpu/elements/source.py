@@ -22,6 +22,7 @@ import numpy as np
 
 from ..core.buffer import Buffer, Event
 from ..core.caps import Caps, MediaType, parse_caps_string, video_bpp
+from ..core.meta_keys import META_TENANT
 from ..core.log import STALL_FLOOR_S
 from ..core.log import metrics as _metrics
 from ..core.registry import register_element
@@ -113,8 +114,8 @@ class AppSrc(SourceElement):
             buf = Buffer([np.frombuffer(bytes(data), np.uint8)], pts=pts)
         else:
             buf = Buffer([np.asarray(data)], pts=pts)
-        if self.tenant is not None and "_tenant" not in buf.meta:
-            buf.meta["_tenant"] = self.tenant
+        if self.tenant is not None and META_TENANT not in buf.meta:
+            buf.meta[META_TENANT] = self.tenant
         if self._inflight_sem is not None:
             stop = getattr(self, "_stop_event", None)
             t0 = _time.perf_counter()

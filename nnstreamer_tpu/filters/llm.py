@@ -52,7 +52,11 @@ from ..core.types import TensorFormat, TensorsSpec
 from ..models import llama
 from ..models.zoo import build as build_model
 from ..utils import elastic
-from ..utils.tracing import META_TENANT as _META_TENANT
+from ..core.meta_keys import (META_ABORT_REASON, META_QUERY_CONN,
+                              META_ENQUEUE_NS, META_STREAM_ABORTED,
+                              META_STREAM_ID, META_STREAM_INDEX,
+                              META_STREAM_LAST)
+from ..core.meta_keys import META_TENANT as _META_TENANT
 from .base import (Framework, FrameworkError, parse_custom_options,
                    place_swapped_params)
 
@@ -63,7 +67,7 @@ from .base import (Framework, FrameworkError, parse_custom_options,
 #: whatever client holds that id there (the adopting deployment's front
 #: door re-associates delivery; callers may re-stamp snapshot["meta"]
 #: before adopt_stream).
-_SNAPSHOT_META_DROP = ("_tq", "_query_conn")
+_SNAPSHOT_META_DROP = (META_ENQUEUE_NS, META_QUERY_CONN)
 
 log = logger(__name__)
 
@@ -1413,14 +1417,14 @@ class _ContinuousLoop:
         out_meta = dict(meta)
         if extra:
             out_meta.update(extra)
-        out_meta["stream_index"] = index
+        out_meta[META_STREAM_INDEX] = index
         # Serving telemetry: when THIS token left the decode loop
         # (monotonic seconds).  Lets consumers measure generation-window
         # throughput precisely instead of inferring it from pull times,
         # which lag emission by queue dwell.
         out_meta["emit_t"] = time.monotonic()
         if last:
-            out_meta["stream_last"] = True
+            out_meta[META_STREAM_LAST] = True
         piece = self.fw.tokenizer.decode_piece(token_id)
         emit([np.asarray([token_id], np.int32),
               np.frombuffer(piece, np.uint8).copy()], out_meta)
@@ -1435,7 +1439,7 @@ class _ContinuousLoop:
             def abort(meta, emit, idx=0):
                 try:
                     self._emit_token(
-                        emit, {**meta, "stream_aborted": True}, 0, idx,
+                        emit, {**meta, META_STREAM_ABORTED: True}, 0, idx,
                         True)
                 except Exception:  # noqa: BLE001
                     pass
@@ -1802,8 +1806,8 @@ class _ContinuousLoop:
             cleanup — the elastic twin of the crash terminator."""
             try:
                 self._emit_token(
-                    emit, {**meta, "stream_aborted": True,
-                           "abort_reason": reason}, 0, idx, True)
+                    emit, {**meta, META_STREAM_ABORTED: True,
+                           META_ABORT_REASON: reason}, 0, idx, True)
             except Exception:  # noqa: BLE001 - downstream may be gone too
                 pass
             sid = meta.get(elastic.META_STREAM_ID)
@@ -1909,7 +1913,7 @@ class _ContinuousLoop:
                             # snapshot never aliases pool blocks
                             # another live stream still holds)
                             "version": 2, "kind": "live",
-                            "stream_id": sid,
+                            META_STREAM_ID: sid,
                             "cfg": _dc.asdict(cfg), "dtype": fw.dtype,
                             "block_size": bs, "pos": int(pos[s]),
                             "remaining": int(remaining[s]),
@@ -1947,7 +1951,7 @@ class _ContinuousLoop:
                         ent = self._waiting.pop(wi)
                         cmd["result"] = {
                             "version": 2, "kind": "queued",
-                            "stream_id": sid,
+                            META_STREAM_ID: sid,
                             "cfg": _dc.asdict(cfg), "dtype": fw.dtype,
                             "block_size": bs,
                             "greedy": fw.temperature == 0.0,
@@ -1975,7 +1979,7 @@ class _ContinuousLoop:
                 elif cmd["kind"] == "adopt":
                     snap = cmd["snapshot"]
                     t0 = time.monotonic_ns()
-                    sid = int(snap.get("stream_id", 0))
+                    sid = int(snap.get(META_STREAM_ID, 0))
                     if sid <= 0 or sid in elastic.live_stream_ids():
                         # cross-process snapshots may collide with a
                         # live local id — remint, the snapshot id is

@@ -300,6 +300,51 @@ def lint_threads(*, strict: bool, verbose: bool,
     return rc
 
 
+def lint_proto(*, strict: bool, verbose: bool,
+               baseline: Optional[set] = None,
+               collected: Optional[List[str]] = None,
+               files: Optional[List[str]] = None) -> int:
+    """nns-proto: message-alphabet + handler-totality lint and the
+    model-vs-code drift gate over the distributed serving protocol
+    modules (docs/ANALYSIS.md "Protocol pass").  With ``files``, lints
+    those files (no drift gate — the gate is a whole-surface claim)."""
+    from ..analysis import protocol
+
+    if files:
+        reports, stats = protocol.lint_paths(files)
+    else:
+        reports, stats = protocol.lint_package()
+    rc = 0
+    accepted = n_err = n_warn = n_new = 0
+    for rep in reports:
+        keys = [protocol.baseline_key(d) for d in rep]
+        if collected is not None:
+            collected.extend(keys)
+        fails = []
+        for d, k in zip(rep.diagnostics, keys):
+            n_err += 1 if d.severity == "error" else 0
+            n_warn += 1 if d.severity == "warning" else 0
+            if baseline is not None and k in baseline:
+                accepted += 1
+                continue
+            if d.severity == "error" or strict:
+                fails.append(d)
+        if fails:
+            rc = 1
+            n_new += len(fails)
+            sub = type(rep)(rep.source)
+            sub.extend(fails)
+            print(sub.render())
+        elif verbose and rep.diagnostics:
+            print(rep.render())
+    print(f"proto: {stats['files']} file(s), {stats['keys']} meta key(s), "
+          f"{stats['kinds']} control kind(s), {stats['handlers']} "
+          f"handler(s) ({stats['proven']} proven), {stats['models']} "
+          f"model(s); {n_err} error(s), {n_warn} warning(s), {n_new} new"
+          + (f", {accepted} baseline-accepted" if accepted else ""))
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu.tools.lint",
@@ -322,6 +367,12 @@ def main(argv=None) -> int:
                          "lifecycle, bare condition waits over the "
                          "package (docs/ANALYSIS.md 'Threads pass'); "
                          "with --files, over those files instead")
+    ap.add_argument("--proto", action="store_true",
+                    help="nns-proto: message-alphabet + handler-totality "
+                         "lint, unanswered-path proof, and model-vs-code "
+                         "drift gate over the serving protocol modules "
+                         "(docs/ANALYSIS.md 'Protocol pass'); with "
+                         "--files, over those files instead")
     ap.add_argument("--deep", action="store_true",
                     help="also abstractly execute every device stage "
                          "(jax.eval_shape: shape/dtype contract checks + "
@@ -345,7 +396,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if not args.pipeline and not args.files and not args.examples \
-            and not args.dogfood and not args.threads:
+            and not args.dogfood and not args.threads and not args.proto:
         ap.print_usage(sys.stderr)
         return 2
 
@@ -393,7 +444,7 @@ def main(argv=None) -> int:
         e2e = os.path.join(repo, "tests", "test_pipeline_e2e.py")
         if os.path.exists(e2e):
             files.append(e2e)
-    if files and not args.threads:
+    if files and not args.threads and not args.proto:
         rc = max(rc, lint_files(files, strict=args.strict,
                                 verbose=args.verbose, baseline=baseline,
                                 collected=collected, deep=args.deep,
@@ -405,6 +456,13 @@ def main(argv=None) -> int:
                                   baseline=baseline,
                                   collected=collected,
                                   files=files or None))
+
+    if args.proto:
+        rc = max(rc, lint_proto(strict=args.strict,
+                                verbose=args.verbose,
+                                baseline=baseline,
+                                collected=collected,
+                                files=files or None))
 
     if args.dogfood:
         rc = max(rc, dogfood(strict=args.strict, baseline=baseline,

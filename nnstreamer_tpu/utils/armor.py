@@ -38,17 +38,18 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.log import logger, metrics
+#: META_POISON marks a poison terminator: runners forward such buffers
+#: WITHOUT invoking the stage (they are answers, not work), sinks
+#: deliver them like any response.  META_DLQ carries the DLQ record
+#: context on a quarantined entry.  Both are declared in the shared
+#: protocol registry (core/meta_keys.py) and re-exported here.
+from ..core.meta_keys import (  # noqa: F401  (re-export)
+    ABORT_REASON_POISON, META_ABORT_REASON, META_DLQ, META_POISON,
+    META_STREAM_ABORTED, META_STREAM_INDEX, META_STREAM_LAST,
+)
 from . import tracing, wire
 
 log = logger(__name__)
-
-#: meta key marking a poison terminator: runners forward such buffers
-#: WITHOUT invoking the stage (they are answers, not work), sinks
-#: deliver them like any response
-META_POISON = "_poison"
-
-#: meta key carrying the DLQ record context on a quarantined entry
-META_DLQ = "_dlq"
 
 _DLQ_PREFIX = "poison-"
 _DLQ_SUFFIX = ".nns"
@@ -353,9 +354,9 @@ def poison_terminator(buf, error: BaseException):
     term = buf.with_tensors([])
     term.meta.pop("_host_post", None)
     term.meta[META_POISON] = True
-    term.meta["abort_reason"] = "poison"
+    term.meta[META_ABORT_REASON] = ABORT_REASON_POISON
     term.meta["error"] = f"{type(error).__name__}: {str(error)[:200]}"
-    if "stream_index" in term.meta:
-        term.meta["stream_last"] = True
-        term.meta["stream_aborted"] = True
+    if META_STREAM_INDEX in term.meta:
+        term.meta[META_STREAM_LAST] = True
+        term.meta[META_STREAM_ABORTED] = True
     return term

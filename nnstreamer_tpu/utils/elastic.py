@@ -53,14 +53,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.log import logger
 from ..core.log import metrics as _global_metrics
-from . import tracing
-
-log = logger(__name__)
-
 #: buffer-meta key carrying the continuous-serving stream id.  App data
 #: (JSON-safe int), stamped at submit regardless of trace mode: the
 #: dead-connection backchannel must work in untraced deployments too.
-META_STREAM_ID = "stream_id"
+#: Declared in the shared protocol registry (core/meta_keys.py).
+from ..core.meta_keys import META_STREAM_ID  # noqa: F401  (re-export)
+from . import tracing
+
+log = logger(__name__)
 
 
 # ---------------------------------------------------------------------------

@@ -140,6 +140,11 @@ def client_handshake(conn: socket.socket, hello_type: str, **fields) -> dict:
     wire.write_frame(conn, json.dumps(
         {"type": hello_type, "proto": PROTOCOL_VERSION, **fields}).encode("utf-8"))
     ack = parse_control(wire.read_frame(conn))
+    if ack and ack.get("type") == "nack":
+        # the server's typed refusal carries the reason (version/topic
+        # mismatch) — surface it instead of the raw frame
+        raise ConnectionError(
+            f"server rejected handshake: {ack.get('reason', 'unspecified')}")
     if not ack or ack.get("type") != "ack":
         raise ConnectionError(f"server rejected connection: {ack}")
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

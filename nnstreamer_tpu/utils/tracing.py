@@ -38,6 +38,10 @@ import json
 import threading
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
+from ..core.meta_keys import (  # noqa: F401  (canonical registry; re-exported)
+    META_ENQUEUE_NS, META_INGRESS_NS, META_TENANT, META_TRACE_ID,
+)
+
 #: span taxonomy (docs/OBSERVABILITY.md) — kind -> meaning
 SPAN_KINDS: Dict[str, str] = {
     "ingress": "trace id born at a source (instant; args carry pts)",
@@ -147,16 +151,13 @@ SPAN_KINDS: Dict[str, str] = {
                       "docs/ANALYSIS.md 'Threads pass')",
 }
 
-#: buffer-meta keys the tracer owns (stamped only when tracing is active)
-META_TRACE_ID = "_tid"
-META_INGRESS_NS = "_ts0"
-META_ENQUEUE_NS = "_tq"
-#: tenant identity (docs/SERVING.md "Front door").  NOT tracer-owned in
-#: the off-path sense: an app/element that sets it explicitly (appsrc
-#: ``tenant=``, query client ``tenant=``, the wire meta) owns the key;
-#: the RUNTIME only stamps a pipeline-default tenant at ingress when
-#: tracing is active, so the trace_mode=off hot path stays stamp-free.
-META_TENANT = "_tenant"
+# Buffer-meta keys the tracer owns (META_TRACE_ID / META_INGRESS_NS /
+# META_ENQUEUE_NS, stamped only when tracing is active) and META_TENANT
+# (docs/SERVING.md "Front door"; NOT tracer-owned in the off-path sense:
+# an app/element that sets it explicitly owns the key, the RUNTIME only
+# stamps a pipeline-default tenant at ingress when tracing is active)
+# are declared in core/meta_keys.py — the shared protocol registry —
+# and re-exported above for the existing importers.
 
 DEFAULT_RING_CAPACITY = 65536
 

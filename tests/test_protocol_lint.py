@@ -1,0 +1,407 @@
+"""nns-proto tests: golden bad fixtures for the protocol lint (exact
+diagnostic code + caret position), the unanswered-path fixpoint proof,
+the bounded model checker (clean shipped models, mutated models with
+counterexample traces), the model-vs-code alphabet drift gate, a clean
+dogfood pass over the shipped protocol modules, the fixed true
+positives in elements/query.py, and the jax-free import pin
+(docs/ANALYSIS.md "Protocol pass")."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.analysis import protocol, statemachine
+from nnstreamer_tpu.analysis.diagnostics import ERROR, WARNING
+from nnstreamer_tpu.core import meta_keys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_fixture(tmp_path, source, name="fix.py", registry=None,
+                  drift=False):
+    p = tmp_path / name
+    p.write_text(source)
+    reports, stats = protocol.lint_paths(
+        [str(p)], root=str(tmp_path), registry=registry, drift_gate=drift)
+    diags = [d for rep in reports for d in rep.diagnostics]
+    return reports, diags, stats
+
+
+# ---------------------------------------------------------------------------
+# meta-key-drift: unregistered literal in a meta context, caret on the key
+# ---------------------------------------------------------------------------
+
+DRIFT = '''\
+def stamp(buf):
+    buf.meta["_totally_new_key"] = 1
+'''
+
+
+def test_meta_key_drift_detected(tmp_path):
+    reports, diags, _ = _lint_fixture(tmp_path, DRIFT)
+    assert [d.code for d in diags] == ["meta-key-drift"]
+    d = diags[0]
+    assert d.severity == ERROR
+    assert "_totally_new_key" in d.message
+    # caret lands exactly on the key literal
+    assert DRIFT[d.pos:d.pos + len('"_totally_new_key"')] \
+        == '"_totally_new_key"'
+
+
+def test_registered_key_is_clean(tmp_path):
+    src = ('from nnstreamer_tpu.core.meta_keys import META_SHED\n'
+           'def stamp(buf):\n'
+           '    buf.meta[META_SHED] = True\n'
+           'def read(buf):\n'
+           '    return buf.meta.get(META_SHED)\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == []
+
+
+def test_control_kind_drift(tmp_path):
+    src = 'def hello():\n    return {"type": "teleport", "proto": 2}\n'
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == ["meta-key-drift"]
+    assert "control kind 'teleport'" in diags[0].message
+
+
+def test_abort_reason_drift(tmp_path):
+    src = ('def abort(buf):\n'
+           '    buf.meta["abort_reason"] = "cosmic_ray"\n'
+           '    buf.meta["stream_aborted"] = True\n'
+           '    buf.meta.get("abort_reason")\n'
+           '    buf.meta.get("stream_aborted")\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == ["meta-key-drift"]
+    assert "abort reason 'cosmic_ray'" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# handler totality: sent-but-unhandled / handled-but-unsent
+# ---------------------------------------------------------------------------
+
+def test_unhandled_message(tmp_path):
+    src = ('def stamp(buf):\n'
+           '    buf.meta["shed"] = True\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    codes = {d.code for d in diags}
+    assert codes == {"unhandled-message"}
+    d = [d for d in diags if d.code == "unhandled-message"][0]
+    assert d.severity == ERROR and "'shed'" in d.message
+
+
+def test_dead_handler(tmp_path):
+    src = ('def read(buf):\n'
+           '    return buf.meta.get("wire_reject")\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert [d.code for d in diags] == ["dead-handler"]
+    assert diags[0].severity == WARNING
+
+
+def test_external_keys_exempt_from_totality(tmp_path):
+    # _tq is stamped by the runtime outside the protocol modules: a
+    # lone read must not be a dead-handler
+    src = ('def read(buf):\n'
+           '    return buf.meta.pop("_tq", None)\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# unanswered-path: the fixpoint call-proof
+# ---------------------------------------------------------------------------
+
+UNANSWERED = '''\
+def handle_request(core, metrics, buf):
+    mid = buf.meta.get("_query_msg")
+    if mid is None:
+        metrics.count("server.dropped")
+        return
+    if not core.ready:
+        return            # strands the client: armed, no answer
+    core.send(mid, b"ok")
+'''
+
+
+def _paths(diags):
+    return [d for d in diags if d.code == "unanswered-path"]
+
+
+def test_unanswered_path_detected(tmp_path):
+    reports, diags, stats = _lint_fixture(tmp_path, UNANSWERED)
+    diags = _paths(diags)
+    assert [d.code for d in diags] == ["unanswered-path"]
+    d = diags[0]
+    assert d.severity == ERROR and "handle_request" in d.path
+    # caret on the bad return (line 7), not the accounted drop above it
+    line = UNANSWERED[:d.pos].count("\n") + 1
+    assert line == 7
+    assert stats["handlers"] == 1 and stats["proven"] == 0
+
+
+def test_pre_arming_exit_is_exempt(tmp_path):
+    src = ('def handle_request(core, buf):\n'
+           '    if core is None:\n'
+           '        raise RuntimeError("no core")\n'
+           '    mid = buf.meta.get("_query_msg")\n'
+           '    core.send(mid, b"ok")\n')
+    _, diags, stats = _lint_fixture(tmp_path, src)
+    assert _paths(diags) == [] and stats["proven"] == 1
+
+
+def test_accounted_drop_answers(tmp_path):
+    src = ('def handle_request(metrics, buf):\n'
+           '    mid = buf.meta.get("_query_msg")\n'
+           '    if mid is None:\n'
+           '        metrics.count("server.dropped")\n'
+           '        return\n'
+           '    buf.reply(mid)\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert _paths(diags) == []
+
+
+def test_fixpoint_proves_local_helper(tmp_path):
+    # handle_* answers only through a local helper, which itself
+    # answers on every path — the fixpoint must prove the chain
+    src = ('def _finish(core, mid):\n'
+           '    if core.up:\n'
+           '        core.send(mid, b"ok")\n'
+           '    else:\n'
+           '        core.send(mid, b"down")\n'
+           '\n'
+           'def handle_request(core, buf):\n'
+           '    mid = buf.meta.get("_query_msg")\n'
+           '    return _finish(core, mid)\n')
+    _, diags, stats = _lint_fixture(tmp_path, src)
+    assert _paths(diags) == [] and stats["proven"] == 1
+
+
+def test_loop_body_answering_covers_batch(tmp_path):
+    src = ('def handle_batch(core, buf):\n'
+           '    rows = buf.meta["_query_batch"]\n'
+           '    for m in rows:\n'
+           '        core.send(m, b"ok")\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert _paths(diags) == []
+
+
+def test_raise_after_arming_detected(tmp_path):
+    src = ('def handle_request(core, buf):\n'
+           '    mid = buf.meta.get("_query_msg")\n'
+           '    raise RuntimeError("boom")\n')
+    diags = _paths(_lint_fixture(tmp_path, src)[1])
+    assert [d.code for d in diags] == ["unanswered-path"]
+    assert "raise" in diags[0].message
+
+
+def test_broad_except_guard_absorbs_raise(tmp_path):
+    src = ('def handle_request(core, buf):\n'
+           '    mid = buf.meta.get("_query_msg")\n'
+           '    try:\n'
+           '        if core.bad:\n'
+           '            raise RuntimeError("boom")\n'
+           '        core.send(mid, b"ok")\n'
+           '    except Exception as e:\n'
+           '        core.abort_request(mid, e)\n'
+           '        raise\n')
+    _, diags, _ = _lint_fixture(tmp_path, src)
+    assert _paths(diags) == []
+
+
+# ---------------------------------------------------------------------------
+# the shipped models verify; known-bad mutations produce counterexamples
+# ---------------------------------------------------------------------------
+
+def test_shipped_models_verify_under_faults():
+    for name, factory in statemachine.SHIPPED_MODELS.items():
+        res = statemachine.check(factory())
+        assert res.ok, f"{name}: {res.violation.render()}"
+        assert res.states > 10, name
+
+
+@pytest.mark.parametrize("factory,prop", [
+    (lambda: statemachine.exactly_once_model(client_dedupe=False),
+     "answered-at-most-once"),
+    (lambda: statemachine.exactly_once_model(resend=False),
+     "deadlock"),
+    (lambda: statemachine.handover_model(adopt_guard=False),
+     "no-duplicate-stream"),
+    # never releasing source HBM blocks wedges the handover: the
+    # all-done accepting state becomes unreachable (liveness, not a
+    # safety invariant — the blocks are leaked, not double-used)
+    (lambda: statemachine.handover_model(release_on_drain=False),
+     "deadlock"),
+    (lambda: statemachine.quarantine_model(dlq_guard=False),
+     "quarantined-never-relive"),
+    (lambda: statemachine.hysteresis_model(honor_cooldown=False),
+     "no-flip-inside-cooldown"),
+])
+def test_mutated_model_yields_counterexample(factory, prop):
+    res = statemachine.check(factory())
+    assert not res.ok
+    assert prop in res.violation.prop or prop == res.violation.kind
+    # the trace is a real executable path: non-empty, rendered with
+    # rule names and the violating state
+    assert res.violation.trace
+    rendered = res.violation.render()
+    assert "trace" in rendered.lower() or "->" in rendered
+
+
+# ---------------------------------------------------------------------------
+# model-vs-code alphabet drift gate
+# ---------------------------------------------------------------------------
+
+FIXTURE_REGISTRY = '''\
+META_KV_XFER = "_kv_xfer"
+PROTOCOL_META_KEYS = frozenset({META_KV_XFER})
+CONTROL_TYPES = frozenset({"hello", "ack", "nack"})
+ABORT_REASONS = frozenset({"wire"})
+EXTERNAL_META_KEYS = frozenset(set())
+'''
+
+
+def test_alphabet_drift_gate_fails_on_unmodelled_kind(tmp_path):
+    # a new registered message kind used by code but absent from every
+    # shipped model's declared alphabet must fail the gate
+    (tmp_path / "core").mkdir()
+    (tmp_path / "core" / "meta_keys.py").write_text(FIXTURE_REGISTRY)
+    src = ('def move(buf):\n'
+           '    buf.meta["_kv_xfer"] = 1\n'
+           'def recv(buf):\n'
+           '    return buf.meta.get("_kv_xfer")\n')
+    reg = protocol.load_registry(str(tmp_path))
+    assert reg.meta_keys == {"_kv_xfer"}
+    _, diags, _ = _lint_fixture(tmp_path, src, registry=reg, drift=True)
+    drift = [d for d in diags if d.code == "model-alphabet-drift"]
+    assert len(drift) == 1 and drift[0].severity == ERROR
+    assert "_kv_xfer" in drift[0].message
+
+
+def test_shipped_alphabet_matches_code_exactly():
+    # the dogfood drift gate: zero drift, zero surplus
+    reports, stats = protocol.lint_package()
+    diags = [d for rep in reports for d in rep.diagnostics]
+    assert [d for d in diags if "alphabet" in d.code] == []
+    assert stats["models"] == len(statemachine.SHIPPED_MODELS) == 4
+
+
+# ---------------------------------------------------------------------------
+# dogfood: the shipped protocol modules are clean (nothing baselined)
+# ---------------------------------------------------------------------------
+
+def test_dogfood_clean():
+    reports, stats = protocol.lint_package()
+    errors = [d for rep in reports for d in rep.diagnostics
+              if d.severity == ERROR]
+    assert errors == []
+    # the one dogfood handler (TensorQueryServerSink.process) is PROVEN
+    # all-paths-answering, not merely unflagged
+    assert stats["handlers"] == 1 and stats["proven"] == 1
+
+
+def test_cli_proto_gate():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu.tools.lint", "--proto",
+         "--strict", "--baseline",
+         os.path.join(REPO, "tools", "proto_baseline.txt")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "proto:" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# the fixed true positives in elements/query.py stay fixed
+# ---------------------------------------------------------------------------
+
+def _make_sink():
+    from nnstreamer_tpu.elements import query
+
+    sink = object.__new__(query.TensorQueryServerSink)
+    sink.name = "qsink"
+    return sink, query
+
+
+class _FakeCore:
+    def __init__(self, fail_sends=0):
+        self.sent = []
+        self.journal = None
+        self._fail = fail_sends
+
+    def send(self, cid, payload):
+        if self._fail > 0:
+            self._fail -= 1
+            raise RuntimeError("socket torn")
+        self.sent.append((cid, payload))
+        return True
+
+
+def test_batch_leading_violation_answers_before_raising():
+    # regression for the unanswered-path true positive: a non-batch-
+    # leading model output must answer every batched client with a
+    # typed internal abort, not strand them into timeouts
+    from nnstreamer_tpu.core.buffer import Buffer
+    from nnstreamer_tpu.utils import wire
+
+    sink, query = _make_sink()
+    core = _FakeCore()
+    buf = Buffer([np.zeros((1, 4), dtype=np.float32)], meta={
+        query._META_BATCH: [
+            {query._META_CONN: 1, query._META_MSG: 10},
+            {query._META_CONN: 2, query._META_MSG: 11},
+        ]})
+    with pytest.raises(Exception, match="batch-leading"):
+        sink._send_batched(core, buf)
+    assert len(core.sent) == 2
+    for (cid, payload), mid in zip(core.sent, (10, 11)):
+        term, _flags = wire.decode_buffer(payload)
+        assert term.meta[meta_keys.META_QUERY_MSG] == mid
+        assert term.meta[meta_keys.META_STREAM_ABORTED] is True
+        assert term.meta[meta_keys.META_ABORT_REASON] \
+            == meta_keys.ABORT_REASON_INTERNAL
+        assert "batch-leading" in term.meta[meta_keys.META_ERROR]
+
+
+def test_process_guard_aborts_on_unexpected_exception(monkeypatch):
+    # the broad guard in process: an exception mid-processing answers
+    # the routed client with abort_reason="internal" then re-raises
+    from nnstreamer_tpu.core.buffer import Buffer
+    from nnstreamer_tpu.utils import wire
+
+    sink, query = _make_sink()
+    core = _FakeCore(fail_sends=1)  # the real send blows up...
+    monkeypatch.setattr(query, "_get_server", lambda sid: core)
+    sink.sid = 0
+    buf = Buffer([np.zeros((4,), dtype=np.float32)],
+                 meta={query._META_CONN: 3, query._META_MSG: 42})
+    with pytest.raises(RuntimeError, match="socket torn"):
+        sink.process(None, buf)
+    # ...and the guard's typed abort is the second send
+    assert len(core.sent) == 1
+    term, _flags = wire.decode_buffer(core.sent[0][1])
+    assert term.meta[meta_keys.META_QUERY_MSG] == 42
+    assert term.meta[meta_keys.META_ABORT_REASON] \
+        == meta_keys.ABORT_REASON_INTERNAL
+
+
+# ---------------------------------------------------------------------------
+# jax-free pin: the analysis side must import (and run) without jax
+# ---------------------------------------------------------------------------
+
+def test_protocol_pass_is_jax_free():
+    code = (
+        "import sys\n"
+        "from nnstreamer_tpu.analysis import protocol, statemachine\n"
+        "reports, stats = protocol.lint_package()\n"
+        "res = statemachine.check(statemachine.quarantine_model())\n"
+        "assert res.ok\n"
+        "assert 'jax' not in sys.modules, 'protocol pass imported jax'\n"
+        "print('jaxfree-ok', stats['files'], res.states)\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "jaxfree-ok" in r.stdout

@@ -202,7 +202,41 @@ ROWS = [
     # trace-off guard (wall-clock A/B noise on this host exceeds the
     # bound being checked)
     ("tsan_overhead", ["TSAN"]),
+    # nns-proto sentinel (ISSUE 19, docs/ANALYSIS.md "Protocol pass"):
+    # the whole protocol verification surface as one row — the
+    # alphabet/totality/unanswered-path lint over the serving modules
+    # plus all four shipped models explored to exhaustion under
+    # drop/dup/reorder/crash faults; value = total states explored,
+    # with per-model state counts and the lint error count attached so
+    # a sweep archive records how big the verified space was
+    ("proto_check", ["PROTO"]),
 ]
+
+#: the PROTO row's payload: jax-free, so it runs anywhere the repo does
+PROTO_SNIPPET = r"""
+import json, time
+from nnstreamer_tpu.analysis import protocol, statemachine
+t0 = time.perf_counter()
+reports, stats = protocol.lint_package()
+errors = sum(1 for rep in reports for d in rep.diagnostics
+             if d.severity == "error")
+per_model = {}
+states = 0
+for name, factory in statemachine.SHIPPED_MODELS.items():
+    res = statemachine.check(factory())
+    per_model[name] = {"states": res.states, "ok": res.ok,
+                       "transitions": res.transitions}
+    states += res.states
+elapsed = time.perf_counter() - t0
+print(json.dumps({
+    "metric": "proto_check", "value": states, "unit": "states",
+    "elapsed_s": round(elapsed, 3), "lint_errors": errors,
+    "lint_files": stats["files"], "handlers_proven": stats["proven"],
+    "models": per_model,
+    "all_verified": errors == 0 and all(m["ok"]
+                                        for m in per_model.values()),
+}))
+"""
 
 
 def run_row(label: str, argv, timeout: int) -> dict:
@@ -234,6 +268,10 @@ def run_row(label: str, argv, timeout: int) -> dict:
         env = dict(env if env is not None else os.environ)
         env.pop("NNS_TPU_TSAN", None)
         env.pop("NNS_TPU_TSAN_RAISE", None)
+    # PROTO sentinel: the protocol lint + all four model checks inline
+    # (jax-free; same one-line metric contract)
+    elif argv and argv[0] == "PROTO":
+        cmd = [sys.executable, "-c", PROTO_SNIPPET] + argv[1:]
     else:
         cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)

@@ -158,6 +158,18 @@ chaos smoke re-run with ``NNS_TPU_TSAN=1`` so every hot lock owner vends
 tracked primitives — the rows must report zero LIVE inversions and zero
 guarded-field violations with a non-empty order graph.
 
+AND it runs the proto gate (ISSUE 19, docs/ANALYSIS.md "Protocol
+pass"): a jax-free probe (``lint --proto`` and the bounded model
+checker must import and run without jax in sys.modules), then ``lint
+--proto --strict`` in its own process — message-alphabet + handler-
+totality lint, the unanswered-path call-proof over the serving
+handlers, and the model-vs-code alphabet drift gate (a new message
+kind without a model update fails CI) — strict against
+tools/proto_baseline.txt (empty: protocol errors are fixed in-code,
+never baselined); then a mutated-model smoke: a deliberately broken
+exactly-once model (client dedupe off) must yield a counterexample
+trace, proving the checker can actually falsify, not just verify.
+
 AND it runs the serving gate (docs/SERVING.md §4):
 tests/test_llm_continuous.py in its own pytest process — paged-vs-dense
 bit-identity, block allocator churn, and the compile-counter pin that
@@ -188,6 +200,7 @@ XRAY_BASELINE = os.path.join(REPO, "tools", "xray_baseline.txt")
 LEARN_BASELINE = os.path.join(REPO, "tools", "learn_deep_baseline.txt")
 SPEC_BASELINE = os.path.join(REPO, "tools", "spec_deep_baseline.txt")
 TSAN_BASELINE = os.path.join(REPO, "tools", "tsan_baseline.txt")
+PROTO_BASELINE = os.path.join(REPO, "tools", "proto_baseline.txt")
 
 #: HBM budget the MXU gate pins for the streaming-ASR example's deep
 #: lint: below the estimate, so the hbm-budget warning fires with the
@@ -1171,6 +1184,71 @@ def run_tsan_gate(update: bool, timeout: int = 600) -> int:
     return 1 if problems else 0
 
 
+def run_proto_gate(update: bool, timeout: int = 600) -> int:
+    """nns-proto gate (ISSUE 19, docs/ANALYSIS.md "Protocol pass"):
+    jax-free probe (the lint AND the bounded model checker must run
+    with jax never imported), then ``lint --proto --strict`` against
+    tools/proto_baseline.txt — alphabet/totality lint, unanswered-path
+    proof, the four shipped protocol models verified under
+    drop/dup/reorder/crash faults, and the model-vs-code alphabet
+    drift gate — then a mutated-model smoke proving the checker can
+    FALSIFY (a dedupe-less exactly-once model must produce a
+    counterexample trace)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    probe = (
+        "import sys\n"
+        "from nnstreamer_tpu.analysis import protocol, statemachine\n"
+        "protocol.lint_package()\n"
+        "res = statemachine.check(statemachine.exactly_once_model())\n"
+        "assert res.ok, res.violation.render()\n"
+        "bad = statemachine.check(\n"
+        "    statemachine.exactly_once_model(client_dedupe=False))\n"
+        "assert not bad.ok and bad.violation.trace, "
+        "'mutated model was not falsified'\n"
+        "assert 'jax' not in sys.modules, "
+        "'lint --proto must stay jax-free'\n"
+        "print(f'proto probe: {res.states} states ok, mutated model "
+        "falsified in {bad.states} states')\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", probe], cwd=REPO,
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+    except subprocess.TimeoutExpired:
+        print("proto gate: jax-free probe TIMED OUT", file=sys.stderr)
+        return 2
+    if proc.returncode != 0:
+        print("proto gate: PROBE FAILED (imports jax, model broken, or "
+              "checker cannot falsify)")
+        for line in (proc.stdout + proc.stderr).strip().splitlines()[-10:]:
+            print(f"  {line}", file=sys.stderr)
+        return proc.returncode
+    probe_line = next((ln for ln in proc.stdout.splitlines()
+                       if ln.startswith("proto probe:")), "")
+
+    cmd = [sys.executable, "-m", "nnstreamer_tpu.tools.lint",
+           "--proto", "--strict", "--baseline", PROTO_BASELINE]
+    if update:
+        cmd.append("--update-baseline")
+    try:
+        lint = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"proto gate: lint --proto TIMED OUT after {timeout}s",
+              file=sys.stderr)
+        return 2
+    summary = next((ln for ln in lint.stdout.splitlines()
+                    if ln.startswith("proto:")), "")
+    if lint.returncode != 0 and not update:
+        print("proto gate: NEW DIAGNOSTICS")
+        for line in (lint.stdout + lint.stderr).strip().splitlines()[-15:]:
+            print(f"  {line}", file=sys.stderr)
+        return lint.returncode
+    tag = "updated" if update else "OK"
+    print(f"proto gate: {tag} ({summary or 'no lint summary'}; "
+          f"{probe_line or 'no probe line'})")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -1197,10 +1275,11 @@ def main() -> int:
     xray_rc = run_xray_gate(args.update)
     learn_rc = run_learn_gate(args.update)
     tsan_rc = run_tsan_gate(args.update)
+    proto_rc = run_proto_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
                or mxu_rc or serving_rc or spec_rc or kernel_rc or fetch_rc
                or soak_rc or elastic_rc or armor_rc or xray_rc or learn_rc
-               or tsan_rc)
+               or tsan_rc or proto_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
