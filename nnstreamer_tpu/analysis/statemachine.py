@@ -47,7 +47,8 @@ from ..core import meta_keys
 __all__ = [
     "Rule", "Model", "Violation", "CheckResult", "check",
     "exactly_once_model", "handover_model", "quarantine_model",
-    "hysteresis_model", "SHIPPED_MODELS", "shipped_alphabet",
+    "hysteresis_model", "weave_clock_model", "SHIPPED_MODELS",
+    "shipped_alphabet",
 ]
 
 
@@ -673,12 +674,90 @@ def hysteresis_model(cooldown: int = 2, *, honor_cooldown: bool = True,
     )
 
 
+def weave_clock_model(retries: int = 2, *, dedup_guard: bool = True
+                      ) -> Model:
+    """nns-weave clock probe/ack exchange (elements/query.py client rx
+    loop + _ServerCore._reader; docs/OBSERVABILITY.md "Distributed
+    tracing"): the client sends ``clock`` probes stamped t0, the server
+    answers each with a ``clock_ack`` echoing t0, and the client applies
+    ONE offset sample per outstanding probe — a duplicated or replayed
+    ack (the channels are lossy AND duplicating) must never double-apply,
+    or the refresh-timestamp bookkeeping would claim more samples than
+    probes were ever sent.  The distributed parent context
+    (``_tparent``) rides the same connection's data frames; its
+    scrub-then-adopt step has no protocol state beyond what
+    exactly-once already covers, so this model carries it in the
+    alphabet only.
+
+    ``dedup_guard=False`` removes the outstanding-probe check: a
+    duplicated ack double-applies (safety counterexample).
+    """
+    init = {
+        "retries": retries,      # probes the client may still send
+        "probes": 0,             # probes sent so far (t0 = probe index)
+        "outstanding": frozenset(),  # t0s sent but not yet applied
+        "synced": 0,             # distinct probes that produced a sample
+        "applied": 0,            # offset applications (must <= probes)
+        "c2s": (),               # clock probes in flight
+        "s2c": (),               # clock_acks in flight
+        "_drop": 1, "_dup": 1, "_reorder": 1,
+    }
+
+    def send_probe(s):
+        t = dict(s)
+        t0 = t["probes"]
+        t["retries"] -= 1
+        t["probes"] += 1
+        t["outstanding"] = t["outstanding"] | {t0}
+        t["c2s"] = t["c2s"] + (t0,)
+        return t
+
+    def server_echo(s):
+        t = dict(s)
+        t0, t["c2s"] = t["c2s"][0], t["c2s"][1:]
+        t["s2c"] = t["s2c"] + (("ack", t0),)
+        return t
+
+    def client_apply(s):
+        t = dict(s)
+        (_, t0), t["s2c"] = t["s2c"][0], t["s2c"][1:]
+        if t0 in t["outstanding"] or not dedup_guard:
+            if t0 in t["outstanding"]:
+                t["outstanding"] = t["outstanding"] - {t0}
+                t["synced"] += 1
+            t["applied"] += 1
+        return t
+
+    return Model(
+        name="weave-clock",
+        init=init,
+        rules=[
+            Rule("clock.send-probe", lambda s: s["retries"] > 0,
+                 send_probe),
+            Rule("server.echo", lambda s: bool(s["c2s"]), server_echo),
+            Rule("client.apply", lambda s: bool(s["s2c"]), client_apply),
+        ],
+        invariants={
+            "applies-bounded-by-probes":
+                lambda s: s["applied"] <= s["probes"],
+        },
+        accepting=lambda s: s["synced"] >= 1 or (
+            s["retries"] == 0 and not s["c2s"] and not s["s2c"]),
+        alphabet=frozenset({
+            meta_keys.CTRL_CLOCK, meta_keys.CTRL_CLOCK_ACK,
+            meta_keys.META_TRACE_PARENT,
+        }),
+        channels=("c2s", "s2c"),
+    )
+
+
 #: name -> zero-arg factory for every model shipped (and CI-checked)
 SHIPPED_MODELS: Dict[str, Callable[[], Model]] = {
     "exactly-once": exactly_once_model,
     "drain-adopt": handover_model,
     "dlq-quarantine": quarantine_model,
     "spill-hysteresis": hysteresis_model,
+    "weave-clock": weave_clock_model,
 }
 
 

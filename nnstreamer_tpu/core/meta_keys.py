@@ -34,6 +34,13 @@ META_QUERY_BATCH = "_query_batch"
 META_TENANT = "_tenant"
 #: per-buffer trace id (stamped at source ingress when tracing is active)
 META_TRACE_ID = "_tid"
+#: distributed parent trace context (docs/OBSERVABILITY.md "Distributed
+#: tracing"): the CLIENT's epoch-prefixed trace id riding the query wire
+#: both directions — the serversrc adopts it as the server-side trace id
+#: (after scrubbing any client-supplied ``_tid``), and the serversink
+#: echoes it on every response/token so the client can link ``recv``
+#: spans back to the originating request
+META_TRACE_PARENT = "_tparent"
 #: ingress timestamp (ns) for end-to-end latency spans
 META_INGRESS_NS = "_ts0"
 #: enqueue timestamp (ns) for queue-wait spans
@@ -84,16 +91,24 @@ ABORT_REASONS = frozenset({
     ABORT_REASON_WIRE, ABORT_REASON_POISON, ABORT_REASON_INTERNAL,
 })
 
-#: JSON control-channel message types (utils/net.py handshake)
+#: JSON control-channel message types (utils/net.py handshake; the
+#: clock pair is the nns-weave NTP-style echo — docs/OBSERVABILITY.md
+#: "Distributed tracing": a client-initiated probe carrying t0, answered
+#: with (t0, t1, t2) + the server's trace epoch)
 CTRL_HELLO = "hello"
 CTRL_ACK = "ack"
 CTRL_NACK = "nack"
-CONTROL_TYPES = frozenset({CTRL_HELLO, CTRL_ACK, CTRL_NACK})
+CTRL_CLOCK = "clock"
+CTRL_CLOCK_ACK = "clock_ack"
+CONTROL_TYPES = frozenset({
+    CTRL_HELLO, CTRL_ACK, CTRL_NACK, CTRL_CLOCK, CTRL_CLOCK_ACK,
+})
 
 #: the full meta-key alphabet — the lint's ground truth
 PROTOCOL_META_KEYS = frozenset({
     META_QUERY_MSG, META_QUERY_CONN, META_JOURNAL_SEQ, META_JOURNAL_REPLAY,
-    META_QUERY_BATCH, META_TENANT, META_TRACE_ID, META_INGRESS_NS,
+    META_QUERY_BATCH, META_TENANT, META_TRACE_ID, META_TRACE_PARENT,
+    META_INGRESS_NS,
     META_ENQUEUE_NS, META_POISON, META_DLQ, META_STREAM_ID,
     META_STREAM_INDEX, META_STREAM_LAST, META_STREAM_ABORTED,
     META_ABORT_REASON, META_SHED, META_WIRE_REJECT, META_ERROR,

@@ -26,6 +26,7 @@ Protocol (all frames length-prefixed, utils/wire.read_frame/write_frame):
 from __future__ import annotations
 
 import collections
+import json
 import queue as _queue
 import random
 import socket
@@ -40,9 +41,10 @@ from ..core.caps import Caps
 from ..core.log import logger, metrics
 from ..core import meta_keys
 from ..core.registry import register_element
-from ..utils import elastic, wire
+from ..utils import elastic, tracing as _tracing, wire
 from ..utils.armor import META_POISON
-from ..utils.net import TcpListener, client_handshake, server_handshake
+from ..utils.net import (TcpListener, client_handshake, parse_control,
+                         server_handshake)
 from .base import Element, ElementError, SourceElement, SinkElement, SRC
 
 log = logger(__name__)
@@ -76,6 +78,12 @@ _META_SIDX = meta_keys.META_STREAM_INDEX
 _META_SLAST = meta_keys.META_STREAM_LAST
 _META_SABORT = meta_keys.META_STREAM_ABORTED
 _META_TQ = meta_keys.META_ENQUEUE_NS
+#: distributed trace context (nns-weave, docs/OBSERVABILITY.md): the
+#: client's epoch-prefixed trace id rides requests as _tparent, is
+#: adopted server-side as the trace id (after the _tid scrub below) and
+#: echoed on every response/token so both rings share one id
+_META_TID = meta_keys.META_TRACE_ID
+_META_TPARENT = meta_keys.META_TRACE_PARENT
 
 #: Placeholder in ``_done`` for a fully-streamed request: advances the
 #: in-order cursor without emitting (its buffers already went downstream).
@@ -192,6 +200,20 @@ class _ServerCore:
                     return
                 if raw is None:
                     return
+                ctrl = parse_control(raw)
+                if ctrl is not None:
+                    # post-handshake JSON control frame.  Today's only
+                    # kind: the nns-weave clock echo (a traced client
+                    # refreshes its offset estimate mid-connection);
+                    # unknown kinds are ignored for forward compat.
+                    if ctrl.get("type") == "clock" \
+                            and isinstance(ctrl.get("t0"), int):
+                        self.send(cid, json.dumps(
+                            {"type": "clock_ack", "t0": ctrl["t0"],
+                             "t1": time.monotonic_ns(),
+                             "epoch": _tracing.trace_epoch(),
+                             "t2": time.monotonic_ns()}).encode("utf-8"))
+                    continue
                 try:
                     buf, _flags = wire.decode_buffer(raw)
                 except wire.WireError as e:
@@ -213,6 +235,19 @@ class _ServerCore:
                 buf.meta.pop(_META_JSEQ, None)
                 buf.meta.pop(_META_REPLAY, None)
                 buf.meta.pop(META_POISON, None)
+                # distributed trace context: a client-stamped _tid is
+                # NEVER trusted (it would alias this server's own ids);
+                # the _tparent context is adopted as the server-side
+                # trace id only while tracing is active, and restored so
+                # it rides every response back.  Off mode: scrub only,
+                # zero stamps.
+                buf.meta.pop(_META_TID, None)
+                tparent = buf.meta.pop(_META_TPARENT, None)
+                if _tracing.recorder.active \
+                        and isinstance(tparent, int) \
+                        and 0 < tparent < (1 << 63):
+                    buf.meta[_META_TID] = tparent
+                    buf.meta[_META_TPARENT] = tparent
                 frame_had_tenant = _META_TENANT in buf.meta
                 if conn_tenant is not None:
                     # per-frame meta wins; the hello tenant is the
@@ -801,6 +836,7 @@ class TensorQueryServerSink(SinkElement):
         if core.send(int(cid), wire.encode_buffer(out)):
             metrics.count("query_server.out",
                           tenant=out.meta.get(_META_TENANT))
+            self._reply_span(out.meta)
             self._ack_journal(core, out.meta, jseq)
         else:
             # undeliverable (client gone): ack anyway — the answer was
@@ -855,12 +891,28 @@ class TensorQueryServerSink(SinkElement):
             if core.send(int(cid), wire.encode_buffer(out)):
                 metrics.count("query_server.out",
                               tenant=out.meta.get(_META_TENANT))
+                self._reply_span(out.meta)
                 self._ack_journal(core, out.meta, jseq)
             else:
                 self._ack_journal(core, out.meta, jseq,
                                   undeliverable=True)
                 self._send_failed(out.meta)
         return []
+
+    def _reply_span(self, out_meta: dict) -> None:
+        """``query.reply`` instant for one response/token frame that hit
+        the wire — the server end of the nns-weave reply→recv flow
+        arrow.  Off mode: the element-pinned recorder is None and this
+        is one pointer check."""
+        rec = getattr(self, "_trace_rec", None)
+        if rec is None:
+            return
+        args = {"msg": out_meta.get(_META_MSG)}
+        ten = out_meta.get(_META_TENANT)
+        if ten is not None:
+            args["tenant"] = ten
+        rec.record("query.reply", self.name, out_meta.get(_META_TID),
+                   time.monotonic_ns(), 0, **args)
 
     def _abort_unanswered(self, core, meta: dict,
                           err: BaseException) -> None:
@@ -983,6 +1035,63 @@ class TensorQueryClient(Element):
         self._socks: List[socket.socket] = []
         self._readers: List[threading.Thread] = []
         self._async_emit = None  # injected by the runtime (wants_async_emit)
+        # nns-weave clock refresh watermark (monotonic seconds of the last
+        # accepted handshake echo / probe ack on ANY connection)
+        self._clock_last = 0.0
+
+    #: seconds between NTP-style clock probes on an idle connection
+    CLOCK_REFRESH_S = 5.0
+
+    def _note_clock(self, clk) -> None:
+        """Feed one clock sample (handshake echo or probe ack, shape
+        ``{"epoch", "offset_ns", "uncertainty_ns"}``) into the
+        element-pinned recorder and re-arm the refresh timer; records a
+        ``clock.sync`` instant so the residual skew is visible in the
+        trace, never hidden.  Off mode: the recorder is None and the
+        sample is dropped (no state, no spans)."""
+        if not isinstance(clk, dict):
+            return
+        self._clock_last = time.monotonic()
+        rec = getattr(self, "_trace_rec", None)
+        if rec is None:
+            return
+        rec.note_clock(clk["epoch"], clk["offset_ns"],
+                       clk["uncertainty_ns"])
+        rec.record("clock.sync", self.name, None, time.monotonic_ns(), 0,
+                   peer_epoch=clk["epoch"], offset_ns=clk["offset_ns"],
+                   uncertainty_ns=clk["uncertainty_ns"])
+
+    def _maybe_clock_probe(self, sock) -> None:
+        """Periodic clock refresh: on an idle rx tick, send a ``clock``
+        control probe so long-lived connections track drift between the
+        peer monotonic bases (the handshake echo only samples once).
+        Off mode: one pointer check."""
+        if getattr(self, "_trace_rec", None) is None:
+            return
+        if time.monotonic() - self._clock_last < self.CLOCK_REFRESH_S:
+            return
+        self._clock_last = time.monotonic()  # re-arm even if the send fails
+        probe = json.dumps({"type": "clock", "t0": time.monotonic_ns(),
+                            "epoch": _tracing.trace_epoch()}).encode("utf-8")
+        try:
+            with self._send_lock:
+                if self._socks:
+                    wire.write_frame(sock, probe)
+        except OSError:
+            pass  # a dead socket is the reconnect machinery's problem
+
+    def _handle_clock_ack(self, ctrl: dict) -> None:
+        """Consume a ``clock_ack`` control frame (t0 echo + server
+        receive/send stamps + server trace epoch) into a clock sample."""
+        if ctrl.get("type") != "clock_ack":
+            return
+        t0, t1 = ctrl.get("t0"), ctrl.get("t1")
+        t2, epoch = ctrl.get("t2"), ctrl.get("epoch")
+        if not all(isinstance(v, int) for v in (t0, t1, t2, epoch)):
+            return
+        off, unc = _tracing.clock_offset(t0, t1, t2, time.monotonic_ns())
+        self._note_clock({"epoch": epoch, "offset_ns": off,
+                         "uncertainty_ns": unc})
 
     def _destinations(self) -> List[Tuple[str, int]]:
         """``hosts="h1:p1,h2:p2"`` (round-robin fan-out, the reference's
@@ -1038,7 +1147,7 @@ class TensorQueryClient(Element):
                 hello_fields = dict(caps="other/tensors", topic=self.topic)
                 if self.tenant is not None:
                     hello_fields["tenant"] = self.tenant
-                client_handshake(sock, "hello", **hello_fields)
+                ack = client_handshake(sock, "hello", **hello_fields)
             except (ConnectionError, OSError) as e:
                 # OSError covers a handshake-phase socket.timeout; close
                 # the half-open socket before retrying.
@@ -1049,6 +1158,9 @@ class TensorQueryClient(Element):
                 last = e
                 continue
             sock.settimeout(0.2)
+            # handshake-piggybacked clock echo (client_handshake
+            # synthesizes ack["clock"] from a weave-aware server's stamps)
+            self._note_clock(ack.get("clock"))
             return sock
         raise last if last is not None else ElementError(
             f"{self.name}: cannot connect {host}:{port}")
@@ -1096,6 +1208,7 @@ class TensorQueryClient(Element):
             try:
                 raw = wire.read_frame(sock)
             except socket.timeout:
+                self._maybe_clock_probe(sock)
                 continue
             except OSError:
                 raw = None
@@ -1127,6 +1240,10 @@ class TensorQueryClient(Element):
                         self._rx_error = ConnectionError("query server closed connection")
                     self._cv.notify_all()
                 return
+            ctrl = parse_control(raw)
+            if ctrl is not None:  # clock_ack etc.; never a tensor frame
+                self._handle_clock_ack(ctrl)
+                continue
             try:
                 buf, _flags = wire.decode_buffer(raw)
             except ValueError as e:
@@ -1234,6 +1351,13 @@ class TensorQueryClient(Element):
         #5: "tensor_filter + tensor_query" token streaming).
         """
         mid = int(buf.meta.pop(_META_MSG, -1))
+        rec = getattr(self, "_trace_rec", None)
+        if rec is not None:
+            # ``query.recv`` instant, tid = the echoed parent context so
+            # the merge links it to this request's client/server spans
+            rec.record("query.recv", self.name,
+                       buf.meta.get(_META_TPARENT), time.monotonic_ns(),
+                       0, msg=mid)
         streamed = _META_SIDX in buf.meta
         emit_now: Optional[Buffer] = None
         with self._cv:
@@ -1373,6 +1497,13 @@ class TensorQueryClient(Element):
         host_buf = buf.to_host()
         if self.tenant is not None and _META_TENANT not in host_buf.meta:
             host_buf.meta[_META_TENANT] = self.tenant
+        rec = getattr(self, "_trace_rec", None)
+        tid = host_buf.meta.get(_META_TID) if rec is not None else None
+        if isinstance(tid, int):
+            # distributed parent context: the epoch-prefixed local trace
+            # id rides the wire both directions (the server adopts it,
+            # every response/token echoes it back)
+            host_buf.meta[_META_TPARENT] = tid
         with self._cv:
             mid = self._next_msg
             self._next_msg += 1
@@ -1400,6 +1531,9 @@ class TensorQueryClient(Element):
                 metrics.count(f"{self.name}.send_failures")
             else:
                 raise ElementError(f"{self.name}: send failed: {e}") from e
+        if rec is not None:
+            rec.record("query.send", self.name, tid, time.monotonic_ns(),
+                       0, msg=mid)
         metrics.count(f"{self.name}.requests")
         return []
 

@@ -1636,6 +1636,14 @@ class _ContinuousLoop:
         self._slot_sid: list = [None] * B
         self._slot_tenant: list = [None] * B
         self._slot_prompt: list = [None] * B
+        #: per-slot serving timeline (docs/OBSERVABILITY.md "Distributed
+        #: tracing"): enqueue/admit/first-token/last-emit stamps
+        #: (monotonic seconds) feeding the TTFT / ITL / phase-split
+        #: histograms.  Values are MILLISECONDS (the ``_ms`` series are
+        #: reservoir-quantile sources; the seconds-scaled fixed bucket
+        #: ladder saturates for them).  None for adopted streams — their
+        #: enqueue happened in another process, so TTFT is unknowable.
+        self._slot_time: list = [None] * B
         eos = getattr(fw.tokenizer, "eos", -1) if fw.stop_eos else -1
 
         import os as _os
@@ -1787,10 +1795,48 @@ class _ContinuousLoop:
                 elastic.unregister_stream(sid)
                 self._owned_sids.discard(sid)
                 self._cancelled.pop(sid, None)
+            tt = self._slot_time[s]
+            if tt is not None and tt["first"] is not None:
+                # per-stream phase splits at retirement: time queued,
+                # time from admission to first token (prefill + first
+                # dispatch), time spent decoding
+                ten = self._slot_tenant[s]
+                metrics.observe_latency(
+                    "llm.serve.queue_ms",
+                    (tt["admit"] - tt["enq"]) * 1e3, tenant=ten)
+                metrics.observe_latency(
+                    "llm.serve.prefill_ms",
+                    (tt["first"] - tt["admit"]) * 1e3, tenant=ten)
+                metrics.observe_latency(
+                    "llm.serve.decode_ms",
+                    (tt["last"] - tt["first"]) * 1e3, tenant=ten)
+            self._slot_time[s] = None
             self._slot_sid[s] = None
             self._slot_tenant[s] = None
             self._slot_prompt[s] = None
             metrics.gauge(f"llm.serve.slot{s}.occupied", 0.0)
+
+        def mark_emit(s: int) -> None:
+            """One emitted token's wall stamp: first emission observes
+            TTFT (enqueue → first token, the client-visible number),
+            later ones observe the inter-token gap.  Chunked decode
+            materializes a whole chunk at once, so intra-chunk ITL
+            samples are ~0 and the chunk boundary carries the gap —
+            that IS the emission timeline a streaming client sees."""
+            tt = self._slot_time[s]
+            if tt is None:
+                return  # adopted stream (or warmup): no local enqueue
+            now = time.monotonic()
+            if tt["first"] is None:
+                tt["first"] = tt["last"] = now
+                metrics.observe_latency(
+                    "llm.serve.ttft_ms", (now - tt["enq"]) * 1e3,
+                    tenant=self._slot_tenant[s])
+            else:
+                metrics.observe_latency(
+                    "llm.serve.itl_ms", (now - tt["last"]) * 1e3,
+                    tenant=self._slot_tenant[s])
+                tt["last"] = now
 
         def slot_of(sid) -> Optional[int]:
             if sid is None:
@@ -2303,6 +2349,8 @@ class _ContinuousLoop:
                 self._slot_sid[s] = sid
                 self._slot_tenant[s] = tenant
                 self._slot_prompt[s] = prompt[:, :T].copy()
+                self._slot_time[s] = {"enq": t_enq, "admit": t_admit / 1e9,
+                                      "first": None, "last": None}
                 if shared:
                     metrics.count("llm.serve.prefix_hits")
                     metrics.count("llm.serve.prefix_hit_blocks", shared)
@@ -2502,6 +2550,7 @@ class _ContinuousLoop:
                 first_last = st["n"] == 1 or first == eos
                 self._emit_token(st["emit"], st["meta"], first, 0,
                                  first_last)
+                mark_emit(s)
                 if first_last:
                     # n==1 or EOS on token 0: the in-flight chunk's row
                     # decodes garbage that step 5 skips via remaining==0
@@ -2527,6 +2576,7 @@ class _ContinuousLoop:
                         last = remaining[s] == 1 or tokid == eos
                         self._emit_token(emit, meta, tokid,
                                          int(sidx[s]), bool(last))
+                        mark_emit(int(s))
                         tok_prev_h[s] = tok_h[s]
                         tok_h[s] = tokid
                         sidx[s] += 1
@@ -2560,6 +2610,15 @@ class _ContinuousLoop:
                     acc = int(acc_host[s])
                     metrics.count("llm.serve.spec_accepted", acc)
                     metrics.count("llm.serve.spec_rejected", K - acc)
+                    if K:
+                        # accept rate = accepted drafts / proposed (the
+                        # +1 bonus/fallback token is not a draft)
+                        metrics.gauge("llm.serve.spec_accept_rate",
+                                      acc / K)
+                        ten = self._slot_tenant[s]
+                        if ten is not None:
+                            metrics.gauge("llm.serve.spec_accept_rate",
+                                          acc / K, tenant=ten)
                     emitted = []
                     finished = False
                     for j in range(acc + 1):
@@ -2573,6 +2632,7 @@ class _ContinuousLoop:
                         self._emit_token(
                             emit, meta, tokid, int(sidx[s]), bool(last),
                             extra={"spec_draft": 1 if j < acc else 0})
+                        mark_emit(s)
                         emitted.append(tokid)
                         sidx[s] += 1
                         remaining[s] -= 1

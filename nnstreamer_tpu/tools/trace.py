@@ -12,6 +12,12 @@
         "videotestsrc num-buffers=64 ! tensor_converter ! tensor_sink" \\
         --out trace.json
 
+    # join N per-process ring dumps (tracing.dump_ring) into ONE
+    # offset-corrected Chrome trace with cross-wire flow arrows
+    # (docs/OBSERVABILITY.md "Distributed tracing")
+    python -m nnstreamer_tpu.tools.trace merge server.ring client.ring \\
+        --out merged.json
+
 See docs/OBSERVABILITY.md for the span taxonomy and how the per-buffer
 trace ids link batched dispatches back to individual rows.
 """
@@ -97,6 +103,30 @@ def _cmd_run(args) -> int:
     return 0
 
 
+def _cmd_merge(args) -> int:
+    from ..utils.tracing import merge_ring_files, validate_chrome
+
+    try:
+        obj, stats = merge_ring_files(args.files)
+    except (OSError, ValueError) as e:
+        print(f"merge: {e}", file=sys.stderr)
+        return 1
+    problems = validate_chrome(obj)
+    with open(args.out, "w") as f:
+        json.dump(obj, f)
+    align = obj.get("otherData", {}).get("weave", [])
+    unaligned = [a["proc"] for a in align if not a.get("aligned", True)]
+    print(f"{args.out}: {stats['rings']} rings, {stats['spans']} spans, "
+          f"{stats['arrows']} cross-wire arrows"
+          + (f"; UNALIGNED (no clock path): {', '.join(unaligned)}"
+             if unaligned else ""))
+    if problems:
+        for p in problems[:20]:
+            print(f"{args.out}: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m nnstreamer_tpu.tools.trace",
@@ -112,9 +142,14 @@ def main(argv=None) -> int:
     r.add_argument("--out", default="trace.json")
     r.add_argument("--mode", default="ring", choices=["ring", "full"])
     r.add_argument("--timeout", type=float, default=120.0)
+    m = sub.add_parser(
+        "merge", help="join N per-process ring dumps into one Chrome "
+        "trace (offset-corrected, cross-wire flow arrows)")
+    m.add_argument("files", nargs="+")
+    m.add_argument("--out", default="merged.json")
     args = ap.parse_args(argv)
     return {"validate": _cmd_validate, "summary": _cmd_summary,
-            "run": _cmd_run}[args.cmd](args)
+            "run": _cmd_run, "merge": _cmd_merge}[args.cmd](args)
 
 
 if __name__ == "__main__":

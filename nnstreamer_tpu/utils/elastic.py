@@ -73,8 +73,12 @@ _streams_lock = threading.Lock()
 
 
 def next_stream_id() -> int:
-    """Process-unique continuous-serving stream id (minted at submit)."""
-    return next(_stream_ids)
+    """GLOBALLY-unique continuous-serving stream id (minted at submit):
+    epoch-prefixed like trace ids (docs/OBSERVABILITY.md "Distributed
+    tracing"), so a drained stream adopted by another process never
+    collides with the adopter's own ids.  Sampler keys are a function of
+    the admission number, not this id, so determinism is unaffected."""
+    return (tracing.trace_epoch() << 32) | (next(_stream_ids) & 0xFFFFFFFF)
 
 
 def register_stream(stream_id: int,

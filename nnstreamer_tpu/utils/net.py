@@ -11,10 +11,11 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from typing import Callable, Optional
 
 from ..core.log import logger
-from . import wire
+from . import tracing, wire
 
 log = logger(__name__)
 
@@ -119,8 +120,17 @@ def finish_server_handshake(conn: socket.socket, hello: Optional[dict],
         wire.write_frame(conn, json.dumps(
             {"type": "nack", "reason": "topic mismatch"}).encode())
         return None
-    wire.write_frame(conn, json.dumps(
-        {"type": "ack", "topic": topic, "proto": PROTOCOL_VERSION}).encode())
+    ack = {"type": "ack", "topic": topic, "proto": PROTOCOL_VERSION}
+    if isinstance(hello.get("t0"), int):
+        # nns-weave clock echo piggybacked on the handshake
+        # (docs/OBSERVABILITY.md "Distributed tracing"): echo the
+        # client's send stamp with our receive/send stamps + trace epoch
+        # so the client can derive offset ± uncertainty between the two
+        # monotonic bases.  t1 ideally marks hello arrival; stamping it
+        # here (validation later than read) only widens the bound.
+        ack.update(t0=hello["t0"], t1=time.monotonic_ns(),
+                   epoch=tracing.trace_epoch(), t2=time.monotonic_ns())
+    wire.write_frame(conn, json.dumps(ack).encode())
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return hello
 
@@ -136,10 +146,20 @@ def server_handshake(conn: socket.socket, expect_type: str,
 
 
 def client_handshake(conn: socket.socket, hello_type: str, **fields) -> dict:
-    """Send hello, await ack; raises ConnectionError on rejection."""
+    """Send hello, await ack; raises ConnectionError on rejection.
+
+    The hello carries a clock-echo stamp (``t0`` + this process's trace
+    epoch); a weave-aware server echoes ``t0/t1/t2`` + its epoch in the
+    ack, and the returned dict then gains a synthesized ``clock`` entry
+    ``{"epoch", "offset_ns", "uncertainty_ns"}`` (offset = peer − local
+    monotonic base) for the caller to feed into
+    ``tracing.recorder.note_clock``.  Older servers ignore the stamp."""
+    t0 = time.monotonic_ns()
     wire.write_frame(conn, json.dumps(
-        {"type": hello_type, "proto": PROTOCOL_VERSION, **fields}).encode("utf-8"))
+        {"type": hello_type, "proto": PROTOCOL_VERSION, "t0": t0,
+         "epoch": tracing.trace_epoch(), **fields}).encode("utf-8"))
     ack = parse_control(wire.read_frame(conn))
+    t3 = time.monotonic_ns()
     if ack and ack.get("type") == "nack":
         # the server's typed refusal carries the reason (version/topic
         # mismatch) — surface it instead of the raw frame
@@ -148,4 +168,10 @@ def client_handshake(conn: socket.socket, hello_type: str, **fields) -> dict:
     if not ack or ack.get("type") != "ack":
         raise ConnectionError(f"server rejected connection: {ack}")
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if ack.get("t0") == t0 and isinstance(ack.get("t1"), int) \
+            and isinstance(ack.get("t2"), int) \
+            and isinstance(ack.get("epoch"), int):
+        off, unc = tracing.clock_offset(t0, ack["t1"], ack["t2"], t3)
+        ack["clock"] = {"epoch": ack["epoch"], "offset_ns": off,
+                        "uncertainty_ns": unc}
     return ack

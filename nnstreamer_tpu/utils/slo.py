@@ -63,6 +63,10 @@ class TenantSLO:
     p50_ms: float = 0.0
     p99_ms: float = 0.0
     min_fps: float = 0.0
+    #: serving-timeline objective (docs/OBSERVABILITY.md "Distributed
+    #: tracing"): p99 time-to-first-token, evaluated off the tenant's
+    #: ``llm.serve.ttft_ms`` reservoir (millisecond-valued)
+    ttft_p99_ms: float = 0.0
     #: fraction of requests allowed to violate p99 latency or be shed
     #: before burn_rate reads 1.0
     error_budget: float = 0.01
@@ -73,6 +77,7 @@ class TenantSLO:
                    p50_ms=float(d.get("p50_ms", 0.0)),
                    p99_ms=float(d.get("p99_ms", 0.0)),
                    min_fps=float(d.get("min_fps", 0.0)),
+                   ttft_p99_ms=float(d.get("ttft_p99_ms", 0.0)),
                    error_budget=float(d.get("error_budget", 0.01)))
 
     def to_dict(self) -> dict:
@@ -126,7 +131,8 @@ def validate_policy(d: dict) -> List[str]:
             problems.append(f"tenants[{i}]: duplicate tenant {name!r}")
         else:
             seen.add(name)
-        for key in ("p50_ms", "p99_ms", "min_fps", "error_budget"):
+        for key in ("p50_ms", "p99_ms", "min_fps", "ttft_p99_ms",
+                    "error_budget"):
             v = t.get(key, 0)
             if not isinstance(v, (int, float)) or v < 0:
                 problems.append(
@@ -137,7 +143,7 @@ def validate_policy(d: dict) -> List[str]:
                 f"tenants[{i}].error_budget: a fraction in [0, 1], "
                 f"got {eb}")
         unknown = set(t) - {"tenant", "p50_ms", "p99_ms", "min_fps",
-                            "error_budget"}
+                            "ttft_p99_ms", "error_budget"}
         if unknown:
             problems.append(
                 f"tenants[{i}]: unknown keys {sorted(unknown)}")
@@ -268,6 +274,19 @@ class SLOEngine:
                   max(0, math.ceil(q / 100.0 * len(samples)) - 1))
         return samples[idx] * 1e3
 
+    def _tenant_ttft(self, tenant: str, q: float) -> Optional[float]:
+        """q-th percentile (ms) of the tenant's time-to-first-token off
+        the ``llm.serve.ttft_ms`` labeled reservoir (already
+        millisecond-valued — no unit conversion)."""
+        samples = list(self.metrics.reservoir("llm.serve.ttft_ms",
+                                              tenant=tenant))
+        if not samples:
+            return None
+        samples.sort()
+        idx = min(len(samples) - 1,
+                  max(0, math.ceil(q / 100.0 * len(samples)) - 1))
+        return samples[idx]
+
     def _tenant_counts(self, tenant: str, threshold_ms: float
                        ) -> Tuple[int, int]:
         """(requests, requests over threshold) summed across sinks from
@@ -315,6 +334,9 @@ class SLOEngine:
             fps = (requests - base_n.get(tenant, 0)) / window
             p50 = self._tenant_latency(tenant, 50.0)
             p99 = self._tenant_latency(tenant, 99.0)
+            ttft_p99 = (self._tenant_ttft(tenant, 99.0)
+                        if slo is not None and slo.ttft_p99_ms > 0
+                        else None)
             budget = slo.error_budget if slo else 0.01
             attempts = requests + shed_n
             bad = lat_bad + shed_n
@@ -331,6 +353,11 @@ class SLOEngine:
                 if slo.min_fps > 0 and fps < slo.min_fps:
                     violations.append(
                         f"throughput {fps:.1f}fps < {slo.min_fps:g}fps")
+                if slo.ttft_p99_ms > 0 and ttft_p99 is not None \
+                        and ttft_p99 > slo.ttft_p99_ms:
+                    violations.append(
+                        f"ttft p99 {ttft_p99:.1f}ms > "
+                        f"{slo.ttft_p99_ms:g}ms")
                 if burn > 1.0:
                     violations.append(
                         f"error budget burning at {burn:.2f}x "
@@ -345,6 +372,7 @@ class SLOEngine:
                 "violations": violations,
                 "p50_ms": p50,
                 "p99_ms": p99,
+                "ttft_p99_ms": ttft_p99,
                 "fps": fps,
                 "requests": requests,
                 "sheds": shed_n,

@@ -28,6 +28,17 @@ trace id) loadable in Perfetto / ``chrome://tracing`` alongside the
 ``utils.profiler.trace`` xplane; :func:`dump_recent_to_log` formats the
 last K seconds for crash reports; ``python -m nnstreamer_tpu.tools.trace``
 validates/summarizes dumps.  See docs/OBSERVABILITY.md.
+
+nns-weave (docs/OBSERVABILITY.md "Distributed tracing") extends the
+recorder across processes: trace ids carry a random per-process **epoch**
+in their high bits (:func:`trace_epoch`, so ids minted by different
+processes never collide), NTP-style echoes on the query handshake feed
+per-peer clock offsets into :meth:`FlightRecorder.note_clock`,
+:func:`dump_ring`/:func:`load_ring` serialize a ring to a wire-codec
+framed file, and :func:`merge_rings` joins N dumps into ONE Chrome trace
+— one pid per process, offset-corrected timestamps, cross-wire flow
+arrows (client ``query.send`` → server ``ingress``, server
+``query.reply`` → client ``query.recv``).
 """
 
 from __future__ import annotations
@@ -35,7 +46,9 @@ from __future__ import annotations
 import collections
 import itertools
 import json
+import os
 import threading
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
 from ..core.meta_keys import (  # noqa: F401  (canonical registry; re-exported)
@@ -149,6 +162,22 @@ SPAN_KINDS: Dict[str, str] = {
                       "both acquisition paths; the flight-recorder "
                       "window is dumped to the log alongside — "
                       "docs/ANALYSIS.md 'Threads pass')",
+    "query.send": "nns-weave: one request frame written to the query "
+                  "wire by the client (args: msg = wire message id; tid "
+                  "= the epoch-prefixed trace id stamped as _tparent — "
+                  "the merge pairs it with the server's ingress span)",
+    "query.recv": "nns-weave: one response/token frame consumed by the "
+                  "query client (instant; args: msg; tid = the echoed "
+                  "_tparent context — pairs with the server's "
+                  "query.reply span in a merged trace)",
+    "query.reply": "nns-weave: one response/token frame written to a "
+                   "connection by the serversink (instant; args: msg; "
+                   "tid = the adopted distributed trace id)",
+    "clock.sync": "nns-weave: one NTP-style clock sample against a peer "
+                  "(instant; args: epoch = peer trace epoch, offset_ns "
+                  "= peer minus local monotonic base, uncertainty_ns = "
+                  "half the echo round trip — the residual skew a "
+                  "merged timeline carries, never hides)",
 }
 
 # Buffer-meta keys the tracer owns (META_TRACE_ID / META_INGRESS_NS /
@@ -161,12 +190,42 @@ SPAN_KINDS: Dict[str, str] = {
 
 DEFAULT_RING_CAPACITY = 65536
 
+#: random 31-bit process epoch: the high half of every trace id minted by
+#: this process, so ids from different processes (a query client and its
+#: server, N soak workers) never alias in a merged view.  31 bits keeps
+#: ``(epoch << 32) | counter`` inside a signed int64 for the wire codec
+#: and Perfetto; zero is reserved (no epoch / pre-weave dumps).
+_PROCESS_EPOCH = (int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF) or 1
+
 _trace_ids = itertools.count(1)
 
 
+def trace_epoch() -> int:
+    """This process's random 31-bit trace epoch (the id high bits; also
+    exchanged on the query handshake so clock offsets are keyed by it)."""
+    return _PROCESS_EPOCH
+
+
 def next_trace_id() -> int:
-    """Process-unique per-buffer trace id (assigned at source ingress)."""
-    return next(_trace_ids)
+    """Globally-unique per-buffer trace id (assigned at source ingress):
+    ``epoch << 32 | local counter``.  The 32-bit counter wraps after 4 G
+    ids — far beyond any ring's lifetime — and the random epoch high bits
+    keep two processes' ids disjoint without coordination."""
+    return (_PROCESS_EPOCH << 32) | (next(_trace_ids) & 0xFFFFFFFF)
+
+
+def clock_offset(t0: int, t1: int, t2: int, t3: int) -> "tuple[int, int]":
+    """NTP-style offset estimate from one echo: the caller stamped ``t0``
+    (send) and ``t3`` (receive) on ITS monotonic clock, the peer stamped
+    ``t1`` (receive) and ``t2`` (send) on ITS OWN.  Returns
+    ``(offset_ns, uncertainty_ns)`` where ``offset = peer - local`` and
+    the true offset lies within ``offset ± uncertainty`` (half the
+    round-trip minus the peer's hold time) — asymmetric path delay can
+    consume the whole bound, which is why merged traces carry it as a
+    span arg instead of pretending the correction is exact."""
+    offset = ((t1 - t0) + (t2 - t3)) // 2
+    delay = (t3 - t0) - (t2 - t1)
+    return int(offset), max(0, int(delay // 2))
 
 
 class Span(NamedTuple):
@@ -200,6 +259,9 @@ class FlightRecorder:
                  capacity: int = DEFAULT_RING_CAPACITY):
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
+        #: peer trace epoch -> (offset_ns, uncertainty_ns, sampled_at_ns)
+        #: fed by the query handshake / periodic clock echoes (cold path)
+        self._clock: Dict[int, "tuple[int, int, int]"] = {}
         self.mode = "off"
         self.capacity = capacity
         self.active = False
@@ -248,6 +310,28 @@ class FlightRecorder:
 
     def clear(self) -> None:
         self._ring.clear()
+        with self._lock:
+            self._clock.clear()
+
+    def note_clock(self, peer_epoch: int, offset_ns: int,
+                   uncertainty_ns: int) -> None:
+        """Record one clock sample against a peer process (cold path,
+        called from the handshake / periodic echo).  A tighter sample
+        replaces a looser one; a looser sample only replaces an entry
+        older than ~60 s (drift makes stale precision worthless)."""
+        with self._lock:
+            now = time.monotonic_ns()
+            prev = self._clock.get(int(peer_epoch))
+            if prev is not None and uncertainty_ns > prev[1] \
+                    and now - prev[2] < 60_000_000_000:
+                return
+            self._clock[int(peer_epoch)] = (
+                int(offset_ns), int(uncertainty_ns), now)
+
+    def clock(self) -> Dict[int, "tuple[int, int, int]"]:
+        """Snapshot of the per-peer clock table (offset = peer − local)."""
+        with self._lock:
+            return dict(self._clock)
 
     def __len__(self) -> int:
         return len(self._ring)
@@ -395,6 +479,233 @@ def validate_chrome(obj: Any) -> List[str]:
                 f"event {i}: ts {ts} < previous {last_ts} (not monotonic)")
         last_ts = ts
     return problems
+
+
+# -- distributed ring export + merge (nns-weave) -----------------------------
+
+def _json_safe(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def dump_ring(path: str, rec: Optional[FlightRecorder] = None,
+              proc: Optional[str] = None) -> int:
+    """Serialize the recorder's ring (plus its per-peer clock table and
+    this process's trace epoch) to ``path`` as ONE wire-codec frame:
+    the span columns ride as int64 tensors, everything else as wire
+    meta.  Works in any mode (a breach post-mortem may dump a recorder
+    that was just switched off).  Returns the span count."""
+    import numpy as np
+
+    from . import wire
+    rec = rec or recorder
+    evs = rec.events()
+    cols = [
+        np.asarray([e.ts for e in evs], np.int64),
+        np.asarray([e.dur for e in evs], np.int64),
+        np.asarray([-1 if e.tid is None else e.tid for e in evs],
+                   np.int64),
+    ]
+    from ..core.buffer import Buffer
+    meta = {
+        "weave_ring": 1,
+        "epoch": trace_epoch(),
+        "proc": proc or f"pid{os.getpid()}",
+        "clock": [[pe, off, unc]
+                  for pe, (off, unc, _t) in sorted(rec.clock().items())],
+        "kind": [e.kind for e in evs],
+        "stage": [e.stage for e in evs],
+        "args": [({k: _json_safe(v) for k, v in e.args.items()}
+                  if e.args else None) for e in evs],
+    }
+    payload = wire.encode_buffer(Buffer(cols, meta=meta))
+    with open(path, "wb") as f:
+        f.write(wire.frame_bytes(payload))
+    return len(evs)
+
+
+#: ring dumps are trusted local artifacts, not front-door input — the
+#: limits only need to admit a full 64 Ki-span ring with fat args
+_RING_LIMITS = None
+
+
+def _ring_limits():
+    global _RING_LIMITS
+    if _RING_LIMITS is None:
+        from . import wire
+        _RING_LIMITS = wire.WireLimits(max_meta_bytes=256 << 20,
+                                       max_frame_bytes=1 << 30)
+    return _RING_LIMITS
+
+
+def load_ring(path: str) -> Dict[str, Any]:
+    """Read one :func:`dump_ring` file back.  Returns ``{"epoch", "proc",
+    "clock": {peer_epoch: (offset_ns, uncertainty_ns)}, "spans"}``.
+    Raises :class:`ValueError` (wire rejects are a subclass) on anything
+    that is not a framed weave ring dump."""
+    from . import wire
+    with open(path, "rb") as f:
+        raw = f.read()
+    payload = wire.unframe_bytes(raw, _ring_limits())
+    buf, _flags = wire.decode_buffer(payload, _ring_limits())
+    meta = buf.meta
+    if meta.get("weave_ring") != 1 or len(buf.tensors) != 3:
+        raise ValueError(f"{path}: not a weave ring dump")
+    ts, dur, tid = buf.tensors
+    kinds, stages, argses = meta["kind"], meta["stage"], meta["args"]
+    if not (len(ts) == len(kinds) == len(stages) == len(argses)):
+        raise ValueError(f"{path}: ring dump columns disagree on length")
+    spans = [
+        Span(int(ts[i]), int(dur[i]), kinds[i], stages[i],
+             None if int(tid[i]) < 0 else int(tid[i]), argses[i])
+        for i in range(len(kinds))
+    ]
+    return {
+        "epoch": int(meta.get("epoch", 0)),
+        "proc": str(meta.get("proc", "?")),
+        "clock": {int(pe): (int(off), int(unc))
+                  for pe, off, unc in meta.get("clock", [])},
+        "spans": spans,
+    }
+
+
+def _solve_offsets(rings: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-ring timebase correction: walk the clock-sample graph (each
+    ring's samples are edges epoch → peer with offset = peer − local)
+    from ring 0's epoch, accumulating uncertainty.  ``ts_reference =
+    ts_local + delta``.  Rings with no path to the reference keep delta 0
+    and are flagged unaligned (their skew is unknown, not hidden)."""
+    delta: Dict[int, "tuple[int, int]"] = {rings[0]["epoch"]: (0, 0)}
+    # adjacency over epochs, both directions of every sample
+    edges: Dict[int, List["tuple[int, int, int]"]] = {}
+    for r in rings:
+        for peer, (off, unc) in r["clock"].items():
+            # local -> peer: t_peer = t_local + off
+            edges.setdefault(r["epoch"], []).append((peer, off, unc))
+            edges.setdefault(peer, []).append((r["epoch"], -off, unc))
+    frontier = [rings[0]["epoch"]]
+    while frontier:
+        ep = frontier.pop()
+        d, u = delta[ep]
+        for peer, off, unc in edges.get(ep, ()):
+            if peer in delta:
+                continue
+            # ts_ref = t_peer + delta_peer and t_peer = t_local + off
+            # with ts_ref = t_local + d  =>  delta_peer = d - off
+            delta[peer] = (d - off, u + unc)
+            frontier.append(peer)
+    out = []
+    for r in rings:
+        d, u = delta.get(r["epoch"], (0, 0))
+        out.append({"proc": r["proc"], "epoch": r["epoch"],
+                    "offset_ns": d, "uncertainty_ns": u,
+                    "aligned": r["epoch"] in delta})
+    return out
+
+
+def merge_rings(rings: Sequence[Dict[str, Any]]
+                ) -> "tuple[Dict[str, Any], Dict[str, Any]]":
+    """Join N loaded ring dumps (:func:`load_ring`) into one Chrome trace
+    object: one pid per process, per-stage tracks, timestamps corrected
+    onto ring 0's timebase via the clock-sample graph, and cross-wire
+    flow arrows pairing client ``query.send`` → server ``ingress`` and
+    server ``query.reply`` → client ``query.recv`` spans that share a
+    (globally-unique) trace id across different processes.  Returns
+    ``(chrome_obj, stats)``; the object passes :func:`validate_chrome`."""
+    if not rings:
+        return to_chrome([]), {"rings": 0, "spans": 0, "arrows": 0}
+    align = _solve_offsets(rings)
+    meta_evs: List[Dict[str, Any]] = []
+    out: List[Dict[str, Any]] = []
+    track: Dict[Any, int] = {}
+    # tid -> [(ring_idx, rec_dict)] per linkable kind
+    ends: Dict[str, Dict[int, List["tuple[int, Dict[str, Any]]"]]] = {
+        "query.send": {}, "ingress": {}, "query.reply": {},
+        "query.recv": {},
+    }
+    total = 0
+    for i, (r, al) in enumerate(zip(rings, align)):
+        pid = i + 1
+        meta_evs.append({
+            "ph": "M", "pid": pid, "tid": 0, "ts": 0,
+            "name": "process_name",
+            "args": {"name": f"{r['proc']} epoch={r['epoch']}"},
+        })
+        d = al["offset_ns"]
+        for e in r["spans"]:
+            total += 1
+            t = track.get((pid, e.stage))
+            if t is None:
+                t = track[(pid, e.stage)] = len(track) + 1
+                meta_evs.append({
+                    "ph": "M", "pid": pid, "tid": t, "ts": 0,
+                    "name": "thread_name", "args": {"name": e.stage}})
+            args: Dict[str, Any] = {}
+            if e.tid is not None:
+                args["trace_id"] = e.tid
+            if e.args:
+                args.update(e.args)
+            rec = {"name": e.kind, "cat": e.kind,
+                   "ph": "X" if e.dur > 0 else "i",
+                   "ts": (e.ts + d) / 1e3, "pid": pid, "tid": t,
+                   "args": args}
+            if e.dur > 0:
+                rec["dur"] = e.dur / 1e3
+            else:
+                rec["s"] = "t"
+            if e.tid is not None and e.kind in ends:
+                ends[e.kind].setdefault(e.tid, []).append((i, rec))
+            out.append(rec)
+    # cross-wire flow arrows: same trace id, different process, ordered
+    # pairing (one send per request; replies/recvs pair per token)
+    flows: List[Dict[str, Any]] = []
+    flow_ids = itertools.count(1)
+    for src_kind, dst_kind in (("query.send", "ingress"),
+                               ("query.reply", "query.recv")):
+        for tid, srcs in ends[src_kind].items():
+            dsts = [p for p in ends[dst_kind].get(tid, ())]
+            if dst_kind == "ingress":
+                # the id's epoch prefix names the MINTING ring: its own
+                # source-ingress span (same tid, earlier ts) is not a
+                # wire adoption and must not eat the ordered pairing
+                # slot of the server's adopted-ingress span
+                dsts = [p for p in dsts
+                        if rings[p[0]]["epoch"] != (tid >> 32)]
+            for (si, srec), (di, drec) in zip(sorted(srcs, key=lambda p: p[1]["ts"]),
+                                              sorted(dsts, key=lambda p: p[1]["ts"])):
+                if si == di:
+                    continue  # same process: not a wire crossing
+                fid = next(flow_ids)
+                unc = (align[si]["uncertainty_ns"]
+                       + align[di]["uncertainty_ns"])
+                flows.append({
+                    "ph": "s", "id": fid, "pid": srec["pid"],
+                    "tid": srec["tid"],
+                    "ts": srec["ts"] + srec.get("dur", 0.0),
+                    "name": "xwire", "cat": "xwire",
+                    "args": {"trace_id": tid, "uncertainty_ns": unc}})
+                flows.append({
+                    "ph": "f", "bp": "e", "id": fid, "pid": drec["pid"],
+                    "tid": drec["tid"], "ts": drec["ts"],
+                    "name": "xwire", "cat": "xwire",
+                    "args": {"trace_id": tid}})
+    all_events = meta_evs + out + flows
+    all_events.sort(key=lambda r: (r["ts"], 0 if r["ph"] == "M" else 1))
+    obj = {"traceEvents": all_events, "displayTimeUnit": "ms",
+           "otherData": {"spanKinds": dict(SPAN_KINDS), "weave": align}}
+    stats = {"rings": len(rings), "spans": total,
+             "arrows": len(flows) // 2,
+             "unaligned": [a["proc"] for a in align if not a["aligned"]]}
+    return obj, stats
+
+
+def merge_ring_files(paths: Sequence[str]
+                     ) -> "tuple[Dict[str, Any], Dict[str, Any]]":
+    """:func:`load_ring` each path, :func:`merge_rings` the lot."""
+    return merge_rings([load_ring(p) for p in paths])
 
 
 # -- post-mortem log dump ----------------------------------------------------

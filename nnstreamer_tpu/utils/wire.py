@@ -377,6 +377,40 @@ def write_frame(sock, payload: bytes) -> None:
     sock.sendall(wire_gather([payload]))
 
 
+def frame_bytes(payload: bytes) -> bytes:
+    """The exact bytes :func:`write_frame` would put on a socket
+    (``u64 len | payload | u32 crc32``) — the file framing flight-recorder
+    ring dumps use (utils/tracing.dump_ring)."""
+    from ..native import wire_gather
+
+    return wire_gather([payload])
+
+
+def unframe_bytes(raw: bytes, limits: WireLimits = None) -> bytes:
+    """Validate and strip the :func:`frame_bytes` framing from an
+    in-memory frame (a ring-dump file read whole).  Exactly one frame
+    must span the input; length and crc violations raise
+    :exc:`WireError` like the socket reader's."""
+    from ..native import wire_check
+
+    lim = limits or DEFAULT_LIMITS
+    if len(raw) < 12:
+        raise WireError(f"framed blob too short ({len(raw)} bytes)")
+    (length,) = struct.unpack_from("<Q", raw, 0)
+    if length > lim.max_frame_bytes:
+        raise WireError(
+            f"frame declares {length} bytes, limit {lim.max_frame_bytes}")
+    if len(raw) != 8 + length + 4:
+        raise WireError(
+            f"framed blob is {len(raw)} bytes, expected "
+            f"{8 + length + 4} for the declared payload")
+    payload = raw[8:8 + length]
+    (crc,) = struct.unpack_from("<I", raw, 8 + length)
+    if not wire_check(payload, crc):
+        raise WireError("wire frame crc mismatch (corrupt dump)")
+    return payload
+
+
 def _read_exact(sock, n: int, idle_timeout: bool = False) -> Optional[bytes]:
     import socket as _socket
 

@@ -238,6 +238,10 @@ def test_shipped_models_verify_under_faults():
      "quarantined-never-relive"),
     (lambda: statemachine.hysteresis_model(honor_cooldown=False),
      "no-flip-inside-cooldown"),
+    # without the outstanding-probe dedup a duplicated clock_ack
+    # double-applies one probe's offset sample
+    (lambda: statemachine.weave_clock_model(dedup_guard=False),
+     "applies-bounded-by-probes"),
 ])
 def test_mutated_model_yields_counterexample(factory, prop):
     res = statemachine.check(factory())
@@ -285,7 +289,7 @@ def test_shipped_alphabet_matches_code_exactly():
     reports, stats = protocol.lint_package()
     diags = [d for rep in reports for d in rep.diagnostics]
     assert [d for d in diags if "alphabet" in d.code] == []
-    assert stats["models"] == len(statemachine.SHIPPED_MODELS) == 4
+    assert stats["models"] == len(statemachine.SHIPPED_MODELS) == 5
 
 
 # ---------------------------------------------------------------------------

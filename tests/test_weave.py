@@ -1,0 +1,427 @@
+"""nns-weave (ISSUE 20): cross-process distributed tracing — wire-
+propagated trace context, NTP-style clock alignment, ring-dump merge
+with cross-wire flow arrows, and the per-stream serving timeline
+(docs/OBSERVABILITY.md "Distributed tracing").
+
+The contract under test: trace ids are epoch-prefixed so two processes
+can never mint the same id; the parent context (``_tparent``) rides the
+query wire both directions and the server adopts it at ingress; clock
+offsets estimated from handshake echoes bound their own error; ``merge``
+joins N per-process ring dumps into ONE schema-clean, ts-monotonic
+Chrome trace with client→server→client flow arrows; and NONE of it
+touches the trace_mode=off hot path (``record`` never runs, no stamps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.core.log import Metrics, metrics
+from nnstreamer_tpu.core.types import TensorsSpec
+from nnstreamer_tpu.filters.custom_easy import register_custom_easy
+from nnstreamer_tpu.utils import tracing
+from nnstreamer_tpu.utils.slo import SLOEngine, SLOPolicy, TenantSLO
+from nnstreamer_tpu.utils.tracing import (FlightRecorder, Span,
+                                          clock_offset, dump_ring,
+                                          load_ring, merge_ring_files,
+                                          merge_rings, next_trace_id,
+                                          recorder, trace_epoch,
+                                          validate_chrome)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    metrics.reset()
+    recorder.configure("off")
+    recorder.clear()
+    yield
+    recorder.configure("off")
+    recorder.clear()
+    metrics.reset()
+
+
+@pytest.fixture()
+def _models():
+    spec = TensorsSpec.from_string("4", "float32")
+    register_custom_easy(
+        "w-double", lambda ins: [ins[0] * 2], in_spec=spec, out_spec=spec,
+    )
+    yield
+
+
+# -- epoch-prefixed trace ids ----------------------------------------------
+
+def test_trace_ids_epoch_prefixed_and_int64_safe():
+    ep = trace_epoch()
+    assert 1 <= ep <= 0x7FFFFFFF
+    a, b = next_trace_id(), next_trace_id()
+    assert a != b and a >> 32 == ep and b >> 32 == ep
+    assert a < 2 ** 63  # survives the wire codec's int64 tensors
+
+
+def test_two_processes_mint_disjoint_ids():
+    """Satellite 1: the epoch high bits keep two real processes' id
+    spaces disjoint without coordination."""
+    prog = ("from nnstreamer_tpu.utils import tracing\n"
+            "import json\n"
+            "print(json.dumps({'epoch': tracing.trace_epoch(),"
+            " 'ids': [tracing.next_trace_id() for _ in range(64)]}))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c", prog], cwd=REPO,
+                              env=env, capture_output=True, text=True,
+                              timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        outs.append(json.loads(proc.stdout))
+    a, b = outs
+    # 31-bit random epochs: a collision here is a 1-in-2^31 fluke, and
+    # it would be exactly the aliasing the epoch prefix exists to stop
+    assert a["epoch"] != b["epoch"]
+    assert not set(a["ids"]) & set(b["ids"])
+    # and both are disjoint from THIS process's ids
+    mine = {next_trace_id() for _ in range(64)}
+    assert not (set(a["ids"]) | set(b["ids"])) & mine
+
+
+# -- clock offset estimator ------------------------------------------------
+
+def test_clock_offset_symmetric_delay_exact():
+    """With symmetric path delay the estimate recovers the true offset
+    EXACTLY and the uncertainty equals the one-way delay."""
+    true_off, d, hold = 5_000_000, 40_000, 7_000
+    t0 = 1_000_000
+    t1 = t0 + d + true_off       # peer clock = local + true_off
+    t2 = t1 + hold
+    t3 = t2 - true_off + d
+    off, unc = clock_offset(t0, t1, t2, t3)
+    assert off == true_off
+    assert unc == d
+
+
+@pytest.mark.parametrize("fwd,back", [(10_000, 90_000), (90_000, 10_000),
+                                      (1, 200_000)])
+def test_clock_offset_asymmetric_error_within_uncertainty(fwd, back):
+    """Asymmetric delay biases the estimate by (fwd-back)/2 — always
+    within the reported uncertainty bound (half the round trip)."""
+    true_off, hold = -3_000_000, 11_000
+    t0 = 2_000_000
+    t1 = t0 + fwd + true_off
+    t2 = t1 + hold
+    t3 = t2 - true_off + back
+    off, unc = clock_offset(t0, t1, t2, t3)
+    assert abs(off - true_off) <= unc
+    assert unc == (fwd + back) // 2
+
+
+def test_note_clock_keeps_tightest_sample():
+    rec = FlightRecorder("ring")
+    rec.note_clock(42, 1_000, 50_000)
+    rec.note_clock(42, 1_100, 5_000)    # tighter: replaces
+    assert rec.clock()[42][:2] == (1_100, 5_000)
+    rec.note_clock(42, 9_999, 40_000)   # looser + fresh entry: ignored
+    assert rec.clock()[42][:2] == (1_100, 5_000)
+    rec.clear()
+    assert rec.clock() == {}
+
+
+# -- ring dump round trip --------------------------------------------------
+
+def test_dump_load_ring_round_trip(tmp_path):
+    rec = FlightRecorder("ring")
+    rec.note_clock(77, -123_456, 9_000)
+    spans = [
+        Span(1_000, 500, "ingress", "src", next_trace_id(), None),
+        Span(2_000, 0, "query.send", "qc", next_trace_id(),
+             {"msg": 3, "note": "x"}),
+        Span(3_000, 0, "clock.sync", "qc", None,
+             {"peer_epoch": 77, "offset_ns": -123_456}),
+    ]
+    for s in spans:
+        rec.record(s.kind, s.stage, s.tid, s.ts, s.dur, **(s.args or {}))
+    p = str(tmp_path / "a.ring")
+    assert dump_ring(p, rec=rec, proc="me") == 3
+    ring = load_ring(p)
+    assert ring["epoch"] == trace_epoch()
+    assert ring["proc"] == "me"
+    assert ring["clock"] == {77: (-123_456, 9_000)}
+    assert ring["spans"] == spans
+
+
+def test_load_ring_rejects_non_ring_files(tmp_path):
+    p = str(tmp_path / "junk.ring")
+    with open(p, "wb") as f:
+        f.write(b"not a wire frame at all")
+    with pytest.raises(ValueError):
+        load_ring(p)
+    empty = str(tmp_path / "empty.ring")  # a SIGKILLed worker's mkstemp
+    open(empty, "wb").close()
+    with pytest.raises(ValueError):
+        load_ring(empty)
+
+
+# -- merge: alignment, arrows, monotonicity --------------------------------
+
+def _wire_rings(n_req=4, offset=500_000):
+    """One synthetic client/server ring pair: the client clock runs
+    ``offset`` ns behind the server and carries one clock sample.  The
+    client ring includes its own MINTING ingress span per id — the
+    real shape; pairing must skip it in favor of the server's
+    adopted-ingress span (regression: it used to eat the zip slot)."""
+    cli_ep, srv_ep = 111, 222
+    cli, srv = [], []
+    for k in range(n_req):
+        tid = (cli_ep << 32) | (k + 1)
+        s = 1_000_000 + k * 100_000  # server-frame send instant
+        cli.append(Span(s - offset - 5_000, 0, "ingress", "src", tid,
+                        None))
+        cli.append(Span(s - offset, 0, "query.send", "qc", tid, None))
+        srv.append(Span(s + 20_000, 10_000, "ingress", "ssrc", tid, None))
+        srv.append(Span(s + 40_000, 0, "query.reply", "ssink", tid, None))
+        cli.append(Span(s + 60_000 - offset, 0, "query.recv", "qc", tid,
+                        None))
+    return (
+        {"epoch": srv_ep, "proc": "server", "clock": {}, "spans": srv},
+        {"epoch": cli_ep, "proc": "client",
+         "clock": {srv_ep: (offset, 2_000)}, "spans": cli},
+    )
+
+
+def test_merge_flow_arrows_link_both_directions():
+    srv_ring, cli_ring = _wire_rings(n_req=3)
+    obj, stats = merge_rings([srv_ring, cli_ring])
+    assert stats == {"rings": 2, "spans": 15, "arrows": 6,
+                     "unaligned": []}
+    assert validate_chrome(obj) == []
+    evs = obj["traceEvents"]
+    pids = {e["args"]["name"].split(" epoch=")[0]: e["pid"] for e in evs
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    starts = {e["id"]: e for e in evs if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in evs if e.get("ph") == "f"}
+    assert set(starts) == set(finishes) and len(starts) == 6
+    crossings = {(starts[i]["pid"], finishes[i]["pid"]) for i in starts}
+    # both wire directions, never a same-process arrow
+    assert crossings == {(pids["client"], pids["server"]),
+                         (pids["server"], pids["client"])}
+    for i in starts:
+        assert starts[i]["args"]["trace_id"] == \
+            finishes[i]["args"]["trace_id"]
+        assert starts[i]["args"]["uncertainty_ns"] >= 2_000
+        # the arrow lands where it starts or later (offset-corrected)
+        assert finishes[i]["ts"] >= starts[i]["ts"]
+
+
+def test_merge_offset_correction_aligns_timebases():
+    """The client ring's spans land on the server timebase: its
+    query.send precedes the server ingress AFTER correction even though
+    the raw client clock ran 0.5 ms behind."""
+    srv_ring, cli_ring = _wire_rings(n_req=1)
+    raw_send = cli_ring["spans"][0].ts
+    raw_ingress = srv_ring["spans"][0].ts
+    assert raw_send < raw_ingress  # true even uncorrected here
+    obj, _ = merge_rings([srv_ring, cli_ring])
+    xs = {}
+    for e in obj["traceEvents"]:
+        if e.get("ph") in ("X", "i") and e.get("args", {}).get("trace_id"):
+            if e["name"] != "ingress" or e.get("dur"):  # server's ingress
+                xs[e["name"]] = e
+    # corrected: send sits 20 us before the ADOPTED ingress, not 520 us
+    gap_us = xs["ingress"]["ts"] - xs["query.send"]["ts"]
+    assert 15 <= gap_us <= 25
+    align = {a["proc"]: a for a in obj["otherData"]["weave"]}
+    assert align["client"]["aligned"] and align["client"]["offset_ns"] == \
+        500_000
+    assert align["server"]["offset_ns"] == 0
+
+
+def test_merge_monotonic_over_shuffled_rings(tmp_path):
+    """Satellite 4: ring order on the command line and span order inside
+    each ring must not matter — the merged trace is globally ts-sorted
+    and schema-clean either way."""
+    import random
+
+    rng = random.Random(7)
+    srv_ring, cli_ring = _wire_rings(n_req=8)
+    third = {"epoch": 333, "proc": "client2",
+             "clock": {222: (-250_000, 1_500)},
+             "spans": [Span(5_000_000 + k * 9_000, 0, "query.send", "qc",
+                            (333 << 32) | k, None) for k in range(16)]}
+    for ring in (srv_ring, cli_ring, third):
+        rng.shuffle(ring["spans"])
+    for order in ([srv_ring, cli_ring, third],
+                  [third, cli_ring, srv_ring]):
+        obj, stats = merge_rings(order)
+        assert validate_chrome(obj) == []
+        assert stats["unaligned"] == []
+        ts = [e["ts"] for e in obj["traceEvents"]]
+        assert ts == sorted(ts)
+
+
+def test_merge_unaligned_ring_is_flagged_not_hidden():
+    srv_ring, cli_ring = _wire_rings(n_req=1)
+    stray = {"epoch": 999, "proc": "stray", "clock": {},
+             "spans": [Span(10, 0, "ingress", "s", None, None)]}
+    obj, stats = merge_rings([srv_ring, cli_ring, stray])
+    assert stats["unaligned"] == ["stray"]
+    align = {a["proc"]: a for a in obj["otherData"]["weave"]}
+    assert align["stray"]["aligned"] is False
+    assert validate_chrome(obj) == []
+
+
+def test_merge_cli_end_to_end(tmp_path, monkeypatch):
+    """python -m nnstreamer_tpu.tools.trace merge over real dump_ring
+    files from two (simulated) processes → one validating trace."""
+    paths = []
+    for ring in _wire_rings(n_req=2):
+        rec = FlightRecorder("ring")
+        for pe, (off, unc) in ring["clock"].items():
+            rec.note_clock(pe, off, unc)
+        for s in ring["spans"]:
+            rec.record(s.kind, s.stage, s.tid, s.ts, s.dur)
+        monkeypatch.setattr(tracing, "_PROCESS_EPOCH", ring["epoch"])
+        p = str(tmp_path / f"{ring['proc']}.ring")
+        dump_ring(p, rec=rec, proc=ring["proc"])
+        paths.append(p)
+    obj, stats = merge_ring_files(paths)
+    assert stats["rings"] == 2 and stats["arrows"] == 4
+    assert validate_chrome(obj) == []
+    out = str(tmp_path / "merged.json")
+    from nnstreamer_tpu.tools import trace as trace_cli
+    assert trace_cli.main(["merge", *paths, "--out", out]) == 0
+    with open(out) as f:
+        assert validate_chrome(json.load(f)) == []
+    assert trace_cli.main(["validate", out]) == 0
+
+
+# -- wire propagation through real query pipelines -------------------------
+
+def _query_roundtrip(trace_mode, n=4, sid=41):
+    srv = nt.Pipeline(
+        f"tensor_query_serversrc name=ssrc port=0 id={sid} ! "
+        "tensor_filter framework=custom-easy model=w-double ! "
+        f"tensor_query_serversink id={sid}", trace_mode=trace_mode)
+    with srv:
+        port = srv.element("ssrc").bound_port
+        cli = nt.Pipeline(
+            f"appsrc name=src ! tensor_query_client port={port} "
+            "timeout=20 ! tensor_sink name=out", trace_mode=trace_mode)
+        with cli:
+            for i in range(n):
+                cli.push("src", np.full((4,), float(i), np.float32))
+            for i in range(n):
+                out = cli.pull("out", timeout=20)
+                np.testing.assert_allclose(out.tensors[0],
+                                           np.full((4,), 2.0 * i))
+            cli.eos("src")
+            cli.wait(timeout=20)
+
+
+def test_wire_context_propagates_in_ring_mode(_models):
+    """The ingress-minted trace id crosses the wire (``_tparent``) and
+    comes back: client send/recv and server ingress/reply spans agree on
+    the id set, and the handshake echo seeded the clock table."""
+    _query_roundtrip("ring")
+    by_kind = {}
+    for e in recorder.events():
+        if e.tid is not None:
+            by_kind.setdefault(e.kind, set()).add(e.tid)
+    sent = by_kind.get("query.send", set())
+    assert len(sent) == 4
+    assert all(t >> 32 == trace_epoch() for t in sent)
+    assert sent == by_kind.get("ingress", set()) \
+        == by_kind.get("query.reply", set()) \
+        == by_kind.get("query.recv", set())
+    clk = recorder.clock()
+    assert trace_epoch() in clk  # in-process server: peer epoch == ours
+    off, unc, _t = clk[trace_epoch()]
+    assert unc >= 0 and abs(off) <= unc + 50_000_000
+
+
+def test_off_mode_record_raises_pin(_models, monkeypatch):
+    """Satellite 4: every new weave hook site is a pointer check, not
+    "tracing that discards" — with trace_mode=off a raising ``record``
+    proves no site runs, and nothing was stamped or noted."""
+
+    def boom(*a, **k):
+        raise AssertionError("FlightRecorder.record ran with "
+                             "trace_mode=off")
+
+    monkeypatch.setattr(FlightRecorder, "record", boom)
+    _query_roundtrip("off", sid=42)
+    assert recorder.events() == []
+    assert recorder.clock() == {}
+
+
+# -- per-stream serving timeline -------------------------------------------
+
+def test_serve_timeline_ttft_itl_and_splits():
+    from nnstreamer_tpu.filters.llm import LLMFramework
+
+    metrics.reset()
+    fw = LLMFramework()
+    fw.open({"model": "llama_tiny",
+             "custom": "max_new:8,serve:continuous,slots:2,"
+                       "stream_chunk:2,temperature:0.0,dtype:float32"})
+    try:
+        done = threading.Event()
+        toks = []
+
+        def emit(tensors, meta):
+            toks.append(int(tensors[0][0]) if len(tensors[0]) else -1)
+            if meta.get("stream_last"):
+                done.set()
+
+        fw.submit([np.asarray([3, 5, 7, 9], np.int32)],
+                  {"_tenant": "acme"}, emit)
+        assert done.wait(60)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not \
+                metrics.reservoir("llm.serve.decode_ms", tenant="acme"):
+            time.sleep(0.05)  # splits land at retire, just after last tok
+    finally:
+        fw.close()
+    ttft = metrics.reservoir("llm.serve.ttft_ms", tenant="acme")
+    itl = metrics.reservoir("llm.serve.itl_ms", tenant="acme")
+    assert len(ttft) == 1 and ttft[0] > 0
+    # 8 new tokens = 1 first + 7 inter-token gaps
+    assert len(itl) == 7 and all(v >= 0 for v in itl)
+    for series in ("llm.serve.queue_ms", "llm.serve.prefill_ms",
+                   "llm.serve.decode_ms"):
+        vals = metrics.reservoir(series, tenant="acme")
+        assert len(vals) == 1 and vals[0] >= 0, series
+        assert metrics.reservoir(series), series  # base twin too
+
+
+def test_slo_ttft_objective():
+    """Satellite: ``ttft_p99_ms`` evaluates off the millisecond-valued
+    reservoir — violation when the tail blows the objective, green when
+    under, absent when unconfigured."""
+    m = Metrics()
+    for v in [10.0] * 98 + [400.0, 500.0]:
+        m.observe_latency("llm.serve.ttft_ms", v, tenant="a")
+    for _ in range(100):
+        m.observe_latency("llm.serve.ttft_ms", 5.0, tenant="b")
+    pol = SLOPolicy(tenants=[TenantSLO("a", ttft_p99_ms=50.0),
+                             TenantSLO("b", ttft_p99_ms=50.0)])
+    eng = SLOEngine(pol, sinks=["out"], metrics=m)
+    rep = eng.evaluate()
+    va, vb = rep["tenants"]["a"], rep["tenants"]["b"]
+    assert va["ttft_p99_ms"] is not None and va["ttft_p99_ms"] > 50.0
+    assert any("ttft p99" in v for v in va["violations"])
+    assert vb["ttft_p99_ms"] is not None and vb["ttft_p99_ms"] <= 50.0
+    assert not any("ttft" in v for v in vb["violations"])
+    # unconfigured tenants don't grow a surprise objective
+    pol2 = SLOPolicy(tenants=[TenantSLO("a")])
+    rep2 = SLOEngine(pol2, sinks=["out"], metrics=m).evaluate()
+    assert rep2["tenants"]["a"]["ttft_p99_ms"] is None

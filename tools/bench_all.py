@@ -205,11 +205,19 @@ ROWS = [
     # nns-proto sentinel (ISSUE 19, docs/ANALYSIS.md "Protocol pass"):
     # the whole protocol verification surface as one row — the
     # alphabet/totality/unanswered-path lint over the serving modules
-    # plus all four shipped models explored to exhaustion under
+    # plus all shipped models explored to exhaustion under
     # drop/dup/reorder/crash faults; value = total states explored,
     # with per-model state counts and the lint error count attached so
     # a sweep archive records how big the verified space was
     ("proto_check", ["PROTO"]),
+    # nns-weave sentinel (ISSUE 20, docs/OBSERVABILITY.md "Distributed
+    # tracing"): synthesizes N per-process ring dumps (distinct trace
+    # epochs, clock samples back to the reference ring) through the real
+    # dump_ring wire framing, then times merge_ring_files; value = merge
+    # wall ms, with span/arrow counts, the schema verdict, and the
+    # alignment verdict attached so a sweep archive records the
+    # distributed-trace path stayed healthy; jax-free like the PROTO row
+    ("trace_merge", ["WEAVE"]),
 ]
 
 #: the PROTO row's payload: jax-free, so it runs anywhere the repo does
@@ -235,6 +243,58 @@ print(json.dumps({
     "models": per_model,
     "all_verified": errors == 0 and all(m["ok"]
                                         for m in per_model.values()),
+}))
+"""
+
+#: the WEAVE row's payload: the cross-process ring-merge path end to end
+#: (dump_ring wire framing -> load_ring -> clock-graph solve -> arrow
+#: pairing -> schema validate) over synthetic rings; jax-free
+WEAVE_SNIPPET = r"""
+import json, os, tempfile, time
+from nnstreamer_tpu.utils import tracing
+
+RINGS, REQS = 4, 512  # 1 server ring + 3 client rings, REQS round trips
+base = tracing.trace_epoch()
+epochs = [((base + i) % 0x7FFFFFFE) + 1 for i in range(RINGS)]
+offsets = [0] + [i * 500_000 for i in range(1, RINGS)]  # server - client
+paths, recs = [], []
+server = tracing.FlightRecorder("ring")
+for i in range(1, RINGS):
+    rec = tracing.FlightRecorder("ring")
+    rec.note_clock(epochs[0], offsets[i], 2_000)
+    for k in range(REQS):
+        tid = (epochs[i] << 32) | (k + 1)
+        s = (k * 100_000) + 1_000_000_000  # reference-frame send time
+        rec.record("ingress", "src", tid, s - offsets[i] - 5_000, 0)
+        rec.record("query.send", "qc", tid, s - offsets[i], 0, msg=k)
+        server.record("ingress", "ssrc", tid, s + 20_000, 10_000)
+        server.record("query.reply", "ssink", tid, s + 40_000, 0)
+        rec.record("query.recv", "qc", tid, s + 60_000 - offsets[i], 0)
+    recs.append((i, rec))
+for i, rec in recs:
+    fd, p = tempfile.mkstemp(suffix=".ring")
+    os.close(fd)
+    paths.append(p)
+    tracing._PROCESS_EPOCH = epochs[i]  # synthetic per-"process" epoch
+    tracing.dump_ring(p, rec=rec, proc=f"client-{i}")
+fd, p = tempfile.mkstemp(suffix=".ring")
+os.close(fd)
+tracing._PROCESS_EPOCH = epochs[0]
+tracing.dump_ring(p, rec=server, proc="server")
+paths.insert(0, p)
+t0 = time.perf_counter()
+obj, stats = tracing.merge_ring_files(paths)
+elapsed = (time.perf_counter() - t0) * 1e3
+problems = tracing.validate_chrome(obj)
+for p in paths:
+    os.unlink(p)
+print(json.dumps({
+    "metric": "trace_merge", "value": round(elapsed, 3), "unit": "ms",
+    "rings": stats["rings"], "spans": stats["spans"],
+    "arrows": stats["arrows"], "schema_ok": not problems,
+    "aligned": not stats["unaligned"],
+    "ok": (not problems and not stats["unaligned"]
+           and stats["arrows"] == 2 * (RINGS - 1) * REQS),
 }))
 """
 
@@ -268,10 +328,14 @@ def run_row(label: str, argv, timeout: int) -> dict:
         env = dict(env if env is not None else os.environ)
         env.pop("NNS_TPU_TSAN", None)
         env.pop("NNS_TPU_TSAN_RAISE", None)
-    # PROTO sentinel: the protocol lint + all four model checks inline
-    # (jax-free; same one-line metric contract)
+    # PROTO sentinel: the protocol lint + all shipped model checks
+    # inline (jax-free; same one-line metric contract)
     elif argv and argv[0] == "PROTO":
         cmd = [sys.executable, "-c", PROTO_SNIPPET] + argv[1:]
+    # WEAVE sentinel: the distributed ring-merge bench inline (jax-free;
+    # same one-line metric contract)
+    elif argv and argv[0] == "WEAVE":
+        cmd = [sys.executable, "-c", WEAVE_SNIPPET] + argv[1:]
     else:
         cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
     print(f"== {label}: {' '.join(argv)}", flush=True)

@@ -857,6 +857,88 @@ def run_elastic_gate(timeout: int = 900) -> int:
     return 1 if problems else 0
 
 
+def run_weave_gate() -> int:
+    """nns-weave gate (ISSUE 20, docs/OBSERVABILITY.md "Distributed
+    tracing"): reads the chaos artifact run_elastic_gate just produced
+    and asserts each chaos profile emitted ONE merged distributed trace
+    — schema-clean at merge time, readable on disk, ts-monotonic per
+    process, server pid present, at least one cross-wire s/f flow-arrow
+    pair, and (for drop_conn, where no worker is killed) spanning the
+    server plus >=2 tenant worker subprocesses.  The off-mode overhead
+    bound over the weave wire hook sites (query send/recv/reply, clock
+    probe) is re-asserted by run_tracing_gate via HOOKS_PER_BUFFER."""
+    import json
+    import tempfile
+
+    out = os.path.join(tempfile.gettempdir(), "nns_chaos_gate.json")
+    problems = []
+    rows = {}
+    try:
+        with open(out) as f:
+            rows = {r["profile"]: r for r in json.load(f)["rows"]}
+    except (OSError, ValueError, KeyError) as e:
+        problems.append(f"unreadable chaos artifact: {e}")
+    for profile in ("chaos_kill_worker", "chaos_drop_conn"):
+        r = rows.get(profile)
+        if r is None:
+            problems.append(f"missing {profile} row")
+            continue
+        merged = r.get("merged") or {}
+        if merged.get("error"):
+            problems.append(f"{profile}: ring merge failed: "
+                            f"{merged['error']}")
+            continue
+        if merged.get("problems"):
+            problems.append(f"{profile}: merged trace schema problems: "
+                            f"{merged['problems'][:3]}")
+        if merged.get("arrows", 0) < 1:
+            problems.append(f"{profile}: no cross-wire flow arrow "
+                            "survived the merge")
+        if merged.get("unaligned"):
+            problems.append(f"{profile}: rings with no clock path to the "
+                            f"reference: {merged['unaligned']}")
+        try:
+            with open(r.get("merged_trace") or "") as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{profile}: merged trace unreadable: {e}")
+            continue
+        evs = [e for e in obj.get("traceEvents", []) if isinstance(e, dict)]
+        procs = {e["args"]["name"].split(" epoch=")[0] for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        if "server" not in procs:
+            problems.append(f"{profile}: merged trace has no server "
+                            f"process (procs={sorted(procs)})")
+        workers = {p for p in procs if p.startswith("worker-")}
+        if profile == "chaos_drop_conn" and len(workers) < 2:
+            problems.append(
+                f"{profile}: merged trace spans only {len(workers)} "
+                f"worker subprocesses (<2): {sorted(procs)}")
+        starts = sum(1 for e in evs if e.get("ph") == "s")
+        finishes = sum(1 for e in evs if e.get("ph") == "f")
+        if starts < 1 or starts != finishes:
+            problems.append(f"{profile}: flow arrows unpaired "
+                            f"({starts} s vs {finishes} f)")
+        last: dict = {}
+        for e in evs:
+            if e.get("ph") != "X":
+                continue
+            pid = e.get("pid")
+            if e["ts"] < last.get(pid, float("-inf")):
+                problems.append(
+                    f"{profile}: ts not monotonic within pid {pid}")
+                break
+            last[pid] = e["ts"]
+    tag = "OK" if not problems else "FAILED"
+    detail = ", ".join(
+        f"{p.split('chaos_')[-1]}={rows.get(p, {}).get('merged', {}).get('arrows', '?')} arrows"
+        for p in ("chaos_kill_worker", "chaos_drop_conn"))
+    print(f"weave gate: {tag} ({detail})")
+    for p in problems:
+        print(f"  weave gate: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
 def run_armor_gate(timeout: int = 900) -> int:
     """nns-armor gate (ISSUE 12, see module docstring): the armor test
     files as their own pytest process, the seeded fuzz smoke over the
@@ -1189,7 +1271,7 @@ def run_proto_gate(update: bool, timeout: int = 600) -> int:
     jax-free probe (the lint AND the bounded model checker must run
     with jax never imported), then ``lint --proto --strict`` against
     tools/proto_baseline.txt — alphabet/totality lint, unanswered-path
-    proof, the four shipped protocol models verified under
+    proof, the shipped protocol models verified under
     drop/dup/reorder/crash faults, and the model-vs-code alphabet
     drift gate — then a mutated-model smoke proving the checker can
     FALSIFY (a dedupe-less exactly-once model must produce a
@@ -1271,6 +1353,7 @@ def main() -> int:
     fetch_rc = run_fetch_gate(args.update)
     soak_rc = run_soak_gate()
     elastic_rc = run_elastic_gate()
+    weave_rc = run_weave_gate()
     armor_rc = run_armor_gate()
     xray_rc = run_xray_gate(args.update)
     learn_rc = run_learn_gate(args.update)
@@ -1278,8 +1361,8 @@ def main() -> int:
     proto_rc = run_proto_gate(args.update)
     lint_rc = (lint_rc or deep_rc or sharded_rc or mesh_rc or tracing_rc
                or mxu_rc or serving_rc or spec_rc or kernel_rc or fetch_rc
-               or soak_rc or elastic_rc or armor_rc or xray_rc or learn_rc
-               or tsan_rc or proto_rc)
+               or soak_rc or elastic_rc or weave_rc or armor_rc or xray_rc
+               or learn_rc or tsan_rc or proto_rc)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:

@@ -223,7 +223,8 @@ def _stream_worker(args) -> int:
     cli = nt.Pipeline(
         f"appsrc name=src ! tensor_query_client name=qc port={args.port} "
         f"tenant={args.tenant} timeout={args.timeout} on-timeout=drop "
-        f"reconnect=6 ! tensor_sink name=out")
+        f"reconnect=6 ! tensor_sink name=out",
+        trace_mode="ring" if getattr(args, "ring_out", "") else "off")
     first_seen: set = set()  # stream_ids whose first token arrived
     t0 = time.monotonic()
     dead = False
@@ -348,6 +349,22 @@ def _write_worker_row(args, stats: dict) -> None:
 
 
 def run_worker(args) -> int:
+    try:
+        return _run_worker(args)
+    finally:
+        # nns-weave: dump this worker's flight-recorder ring at normal
+        # exit (the harness merges it with the server's; a SIGKILLed
+        # worker never gets here — that is the server-only fallback)
+        if getattr(args, "ring_out", ""):
+            try:
+                from nnstreamer_tpu.utils import tracing
+                tracing.dump_ring(args.ring_out,
+                                  proc=f"worker-{args.tenant}")
+            except Exception:  # noqa: BLE001 - artifact is best-effort
+                pass
+
+
+def _run_worker(args) -> int:
     if args.mode == "stream":
         return _stream_worker(args)
     if args.mode == "wedge":
@@ -630,18 +647,30 @@ class ChaosController(threading.Thread):
 
 def _spawn_worker(profile: str, port: int, tenant: str, duration: float,
                   rate: float, timeout: float, mode: str = "plain",
-                  inflight: int = 8):
+                  inflight: int = 8, ring: bool = False):
+    """Returns (proc, row_path, ring_path).  ``ring=True`` hands the
+    worker a ``--ring-out`` path: it runs its client pipeline with the
+    flight recorder on and dumps its ring there at normal exit — a
+    SIGKILLed worker leaves the file empty, which the harness-side merge
+    reports as a missing ring (docs/OBSERVABILITY.md "Distributed
+    tracing")."""
     fd, path = tempfile.mkstemp(prefix=f"soak-{tenant}-", suffix=".json")
     os.close(fd)
+    ring_path = ""
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--worker", "--mode", mode, "--port", str(port),
+            "--tenant", tenant, "--profile", profile,
+            "--duration", str(duration), "--rate", str(rate),
+            "--timeout", str(timeout), "--inflight", str(inflight),
+            "--out", path]
+    if ring:
+        rfd, ring_path = tempfile.mkstemp(
+            prefix=f"soak-ring-{tenant}-", suffix=".ring")
+        os.close(rfd)
+        argv += ["--ring-out", ring_path]
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__),
-         "--worker", "--mode", mode, "--port", str(port),
-         "--tenant", tenant, "--profile", profile,
-         "--duration", str(duration), "--rate", str(rate),
-         "--timeout", str(timeout), "--inflight", str(inflight),
-         "--out", path],
-        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
-    return proc, path
+        argv, cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    return proc, path, ring_path
 
 
 def _collect_worker_rows(row: dict, outs: list) -> None:
@@ -658,6 +687,44 @@ def _collect_worker_rows(row: dict, outs: list) -> None:
             except OSError:
                 pass
         row["tenants"][w["tenant"]] = w
+
+
+def _merge_chaos_rings(row: dict, worker_rings: list, tracing) -> None:
+    """nns-weave distributed breach artifact: dump the server's ring,
+    join it with every live worker's ring dump into ONE offset-corrected
+    Chrome trace (``row["merged_trace"]``), and record which rings were
+    missing (a SIGKILLed worker leaves an empty file — the server-side
+    view is the documented fallback).  Merge stats + schema problems ride
+    ``row["merged"]`` so the CI weave gate can assert on them."""
+    fd, spath = tempfile.mkstemp(prefix="soak-ring-server-",
+                                 suffix=".ring")
+    os.close(fd)
+    paths = [spath] + [p for p in worker_rings if p]
+    try:
+        tracing.dump_ring(spath, proc="server")
+        rings, missing = [], []
+        for p in paths:
+            try:
+                rings.append(tracing.load_ring(p))
+            except (OSError, ValueError):
+                missing.append(os.path.basename(p))
+        obj, stats = tracing.merge_rings(rings)
+        mfd, mpath = tempfile.mkstemp(prefix="soak-weave-",
+                                      suffix=".trace.json")
+        with os.fdopen(mfd, "w") as f:
+            json.dump(obj, f)
+        row["merged_trace"] = mpath
+        row["merged"] = {**stats, "rings_missing": missing,
+                         "problems": tracing.validate_chrome(obj)[:10]}
+    except Exception as e:  # noqa: BLE001 - artifact is best-effort
+        row["merged_trace"] = None
+        row["merged"] = {"error": str(e)}
+    finally:
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def run_chaos_profile(chaos: str, *, tenants: int = 3,
@@ -735,13 +802,16 @@ def run_chaos_profile(chaos: str, *, tenants: int = 3,
         workers, outs = [], []
         with wd:
             mon.start()
+            worker_rings = []
             for i, t in enumerate(tenant_names):
                 mode = ("wedge" if chaos == "wedge_tenant" and i == 0
                         else "stream")
-                proc, path = _spawn_worker(
-                    "steady", port, t, duration, 20.0, 15.0, mode=mode)
+                proc, path, ring_path = _spawn_worker(
+                    "steady", port, t, duration, 20.0, 15.0, mode=mode,
+                    ring=True)
                 workers.append(proc)
                 outs.append(path)
+                worker_rings.append(ring_path)
             ctl = ChaosController(
                 chaos, duration, workers=workers,
                 core_getter=lambda: srv.element("ssrc")._core,
@@ -778,6 +848,7 @@ def run_chaos_profile(chaos: str, *, tenants: int = 3,
             stop_mon.set()
             mon.join(timeout=2.0)
         _collect_worker_rows(row, outs)
+        _merge_chaos_rings(row, worker_rings, tracing)
         snap = metrics.snapshot()
         row["serve"] = {
             "cancelled": snap.get("llm.serve.cancelled", 0.0),
@@ -897,7 +968,7 @@ def run_elastic_profile(*, tenants: int = 3, duration: float = 24.0,
         with wd:
             mon.start()
             for t in tenant_names:
-                proc, path = _spawn_worker(
+                proc, path, _ = _spawn_worker(
                     "elastic", port, t, duration, rate, 10.0,
                     inflight=inflight)
                 workers.append(proc)
@@ -1204,6 +1275,8 @@ def main() -> int:
                     help="override per-profile duration (s)")
     # worker mode (internal): one tenant's load generator
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--ring-out", dest="ring_out", default="",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--mode", default="plain",
                     choices=("plain", "stream", "wedge"),
                     help=argparse.SUPPRESS)

@@ -93,9 +93,10 @@ def run_phase(trace_mode: str, reps: int = 5, tenant=None) -> float:
 
 #: off-mode hook sites a buffer can cross per stage hop (feed stamp guard,
 #: loop-top recorder check, inflight-emit guard, sink materialize getattr,
-#: per-member batch guards) — deliberately over-counted; the real number
-#: is ~2-3 per hop
-HOOKS_PER_BUFFER = 16
+#: per-member batch guards, plus the nns-weave query send/recv/reply and
+#: slot-timeline guards a distributed buffer crosses) — deliberately
+#: over-counted; the real number is ~2-3 per hop
+HOOKS_PER_BUFFER = 20
 
 
 def measure_guard_ns(iters: int = 500_000) -> float:
